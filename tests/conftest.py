@@ -1,0 +1,20 @@
+"""Test bootstrap: force an 8-device CPU mesh before JAX backends initialise.
+
+Multi-node TPU semantics are simulated as multi-device single-process SPMD
+(SURVEY §4: the reference simulates multi-node as multi-process single-node via
+``elastic_launch``; the JAX equivalent is a forced-multi-device host platform —
+the same SPMD code path that runs on a real pod).
+
+Note: plain env vars (``JAX_PLATFORMS`` / ``XLA_FLAGS``) are not enough here —
+a site plugin may pin ``jax_platforms`` programmatically at interpreter start,
+so we override through ``jax.config`` after import, before first backend use.
+"""
+
+import os
+
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,148 @@
+"""Aggregation metrics: protocol tests + numpy oracles.
+
+Mirrors ``/root/reference/tests/metrics/aggregation/``.
+"""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import Cat, Max, Mean, Min, Sum, Throughput
+from torcheval_tpu.utils.test_utils import (
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+    assert_result_close,
+)
+
+
+class TestSum(MetricClassTester):
+    def test_sum_class(self):
+        x = np.random.default_rng(0).random((NUM_TOTAL_UPDATES, 16)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Sum(),
+            state_names={"weighted_sum"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.sum(),
+        )
+
+    def test_sum_weighted(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0, 2.0]), weight=2.0)
+        m.update(jnp.asarray([3.0]), weight=jnp.asarray([4.0]))
+        assert_result_close(m.compute(), 18.0)
+
+    def test_sum_weight_shape_mismatch(self):
+        with self.assertRaisesRegex(ValueError, "weight"):
+            Sum().update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([1.0, 2.0, 3.0]))
+
+
+class TestMean(MetricClassTester):
+    def test_mean_class(self):
+        x = np.random.default_rng(1).random((NUM_TOTAL_UPDATES, 16)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Mean(),
+            state_names={"weighted_sum", "weights"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.mean(),
+        )
+
+    def test_mean_weighted(self):
+        m = Mean()
+        m.update(jnp.asarray([1.0, 2.0]), weight=1.0)
+        m.update(jnp.asarray([6.0]), weight=2.0)
+        # (1 + 2 + 12) / (1 + 1 + 2)
+        assert_result_close(m.compute(), 15.0 / 4.0)
+
+    def test_mean_zero_mean_data_is_not_treated_as_empty(self):
+        # documented fix of the reference quirk (mean.py:92-94)
+        m = Mean()
+        m.update(jnp.asarray([-1.0, 1.0]))
+        assert_result_close(m.compute(), 0.0)
+
+    def test_mean_no_update_returns_zero(self):
+        assert_result_close(Mean().compute(), 0.0)
+
+
+class TestMaxMin(MetricClassTester):
+    def test_max_class(self):
+        x = np.random.default_rng(2).random((NUM_TOTAL_UPDATES, 16)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Max(),
+            state_names={"max"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.max(),
+        )
+
+    def test_min_class(self):
+        x = np.random.default_rng(3).random((NUM_TOTAL_UPDATES, 16)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Min(),
+            state_names={"min"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.min(),
+        )
+
+
+class TestCat(MetricClassTester):
+    def test_cat_class(self):
+        x = np.random.default_rng(4).random((NUM_TOTAL_UPDATES, 4, 3)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Cat(),
+            state_names={"inputs"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.reshape(-1, 3),
+            # merged order differs from stream order only in grouping; with
+            # contiguous per-rank slices the concat equals the stream result.
+        )
+
+    def test_cat_empty(self):
+        self.assertEqual(Cat().compute().shape, (0,))
+
+    def test_cat_dim(self):
+        m = Cat(dim=1)
+        m.update(jnp.ones((2, 1)))
+        m.update(jnp.zeros((2, 2)))
+        self.assertEqual(m.compute().shape, (2, 3))
+
+
+class TestThroughput(MetricClassTester):
+    def test_throughput_class(self):
+        self.run_class_implementation_tests(
+            metric=Throughput(),
+            state_names={"num_total", "elapsed_time_sec"},
+            update_kwargs={
+                "num_processed": [10] * NUM_TOTAL_UPDATES,
+                "elapsed_time_sec": [2.0] * NUM_TOTAL_UPDATES,
+            },
+            compute_result=80 / 16.0,
+            # per-rank: 2 updates -> 20 items / 4 s; merge: 80 items, max 4 s
+            merge_and_compute_result=80 / 4.0,
+        )
+
+    def test_throughput_validation(self):
+        with self.assertRaisesRegex(ValueError, "num_processed"):
+            Throughput().update(-1, 1.0)
+        with self.assertRaisesRegex(ValueError, "elapsed_time_sec"):
+            Throughput().update(1, 0.0)
+
+    def test_throughput_no_update(self):
+        assert_result_close(Throughput().compute(), 0.0)
+
+
+class TestFunctionalAggregation(unittest.TestCase):
+    def test_functional_sum_and_mean(self):
+        from torcheval_tpu.metrics import functional as F
+
+        x = np.random.default_rng(5).random(100).astype(np.float32)
+        w = np.random.default_rng(6).random(100).astype(np.float32)
+        assert_result_close(F.sum(jnp.asarray(x)), x.sum())
+        assert_result_close(F.sum(jnp.asarray(x), jnp.asarray(w)), (x * w).sum())
+        assert_result_close(F.mean(jnp.asarray(x)), x.mean())
+        assert_result_close(
+            F.mean(jnp.asarray(x), jnp.asarray(w)), (x * w).sum() / w.sum()
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

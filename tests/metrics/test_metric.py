@@ -1,0 +1,124 @@
+"""Base ``Metric`` state-machine tests, one block per state container type.
+
+Mirrors the coverage of ``/root/reference/tests/metrics/test_metric.py:22-473``:
+state registration, reset, state_dict round-trip and strictness, device move,
+merge semantics of the dummy fixtures.
+"""
+
+import copy
+import pickle
+import unittest
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.test_utils import (
+    DummySumDequeStateMetric,
+    DummySumDictStateMetric,
+    DummySumListStateMetric,
+    DummySumMetric,
+)
+
+
+class TestMetricBase(unittest.TestCase):
+    def test_add_state_and_defaults(self):
+        m = DummySumMetric()
+        self.assertEqual(m.state_names, ("sum",))
+        np.testing.assert_allclose(np.asarray(m.sum), 0.0)
+        self.assertEqual(m._state_name_to_reduction["sum"], Reduction.SUM)
+
+    def test_update_compute_reset_tensor_state(self):
+        m = DummySumMetric()
+        m.update(jnp.asarray([1.0, 2.0])).update(jnp.asarray(3.0))
+        np.testing.assert_allclose(np.asarray(m.compute()), 6.0)
+        m.reset()
+        np.testing.assert_allclose(np.asarray(m.compute()), 0.0)
+
+    def test_list_state(self):
+        m = DummySumListStateMetric()
+        m.update(jnp.asarray([1.0, 2.0]))
+        m.update(jnp.asarray([3.0, 4.0]))
+        self.assertEqual(len(m.x), 2)
+        np.testing.assert_allclose(np.asarray(m.compute()), 10.0)
+        m.reset()
+        self.assertEqual(m.x, [])
+
+    def test_dict_state(self):
+        m = DummySumDictStateMetric()
+        m.update("a", jnp.asarray(1.0))
+        m.update("b", jnp.asarray(2.0))
+        m.update("a", jnp.asarray(3.0))
+        np.testing.assert_allclose(np.asarray(m.x["a"]), 4.0)
+        np.testing.assert_allclose(np.asarray(m.compute()), 6.0)
+        m.reset()
+        self.assertEqual(dict(m.x), {})
+
+    def test_deque_state_maxlen(self):
+        m = DummySumDequeStateMetric(maxlen=2)
+        for v in [1.0, 2.0, 3.0]:
+            m.update(jnp.asarray(v))
+        self.assertEqual(len(m.x), 2)
+        np.testing.assert_allclose(np.asarray(m.compute()), 5.0)
+        m.reset()
+        self.assertEqual(len(m.x), 0)
+        self.assertEqual(m.x.maxlen, 2)
+
+    def test_state_dict_roundtrip(self):
+        m = DummySumMetric()
+        m.update(jnp.asarray(5.0))
+        sd = m.state_dict()
+        m2 = DummySumMetric()
+        m2.load_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(m2.compute()), 5.0)
+
+    def test_load_state_dict_strict(self):
+        m = DummySumMetric()
+        with self.assertRaisesRegex(RuntimeError, "missing keys"):
+            m.load_state_dict({}, strict=True)
+        with self.assertRaisesRegex(RuntimeError, "unexpected"):
+            m.load_state_dict({"sum": jnp.zeros(()), "bogus": jnp.zeros(())})
+        # non-strict ignores extras
+        m.load_state_dict({"sum": jnp.asarray(7.0), "bogus": jnp.zeros(())}, strict=False)
+        np.testing.assert_allclose(np.asarray(m.compute()), 7.0)
+
+    def test_merge_state(self):
+        a, b, c = DummySumMetric(), DummySumMetric(), DummySumMetric()
+        a.update(jnp.asarray(1.0))
+        b.update(jnp.asarray(2.0))
+        c.update(jnp.asarray(4.0))
+        a.merge_state([b, c])
+        np.testing.assert_allclose(np.asarray(a.compute()), 7.0)
+        # sources untouched
+        np.testing.assert_allclose(np.asarray(b.compute()), 2.0)
+
+    def test_to_device(self):
+        m = DummySumMetric()
+        m.update(jnp.asarray(3.0))
+        m.to("cpu")
+        self.assertEqual(m.device.platform, "cpu")
+        np.testing.assert_allclose(np.asarray(m.compute()), 3.0)
+        # deque maxlen preserved through to()
+        d = DummySumDequeStateMetric(maxlen=3)
+        d.update(jnp.asarray(1.0))
+        d.to("cpu")
+        self.assertEqual(d.x.maxlen, 3)
+
+    def test_pickle_and_deepcopy(self):
+        m = DummySumListStateMetric()
+        m.update(jnp.asarray([1.0, 2.0]))
+        for clone in (copy.deepcopy(m), pickle.loads(pickle.dumps(m))):
+            np.testing.assert_allclose(np.asarray(clone.compute()), 3.0)
+            # clone is independent
+            clone.update(jnp.asarray(10.0))
+            np.testing.assert_allclose(np.asarray(m.compute()), 3.0)
+
+    def test_multiple_devices_available(self):
+        # conftest forces 8 CPU devices; the sync layer depends on this
+        self.assertGreaterEqual(len(jax.devices()), 8)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,12 @@
+"""torcheval_tpu: a TPU-native (JAX/XLA) model-evaluation metrics framework.
+
+A ground-up re-design of the capabilities of TorchEval for TPUs: streaming
+metrics whose state is a pytree of ``jax.Array`` s in HBM, per-batch updates
+compiled to jitted XLA kernels, and distributed sync expressed as typed mesh
+collectives (``psum`` / ``pmax`` / ``all_gather``) over ICI/DCN instead of
+pickled object gathers. See SURVEY.md for the structural map of the reference.
+"""
+
+from torcheval_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,19 @@
+from torcheval_tpu.metrics import functional
+from torcheval_tpu.metrics.aggregation import Cat, Max, Mean, Min, Sum, Throughput
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+
+__all__ = [
+    # base interface
+    "Metric",
+    "Reduction",
+    # functional metrics
+    "functional",
+    # class metrics
+    "Cat",
+    "Max",
+    "Mean",
+    "Min",
+    "Sum",
+    "Throughput",
+]

@@ -1,0 +1,56 @@
+"""Cat metric: concatenate all seen inputs. Reference:
+``torcheval/metrics/aggregation/cat.py``."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class Cat(Metric[jax.Array]):
+    """Concatenate all input arrays along ``dim``.
+
+    Sample-cache metric: state is a Python list of device arrays (appends are
+    O(1) host ops; no device work until :meth:`compute`).
+    Reference parity: ``aggregation/cat.py:24-96``, including the quirk that
+    merging concatenates each source metric's cache along *that metric's*
+    ``dim`` before appending.
+    """
+
+    def __init__(self, *, dim: int = 0, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self.dim = dim
+        # Reduction.CAT means axis-0 all_gather concat; for dim != 0 the sync
+        # layer must fall back to merge_state, so declare CUSTOM there.
+        self._add_state(
+            "inputs", [], reduction=Reduction.CAT if dim == 0 else Reduction.CUSTOM
+        )
+
+    def update(self, input: jax.Array) -> "Cat":
+        self.inputs.append(self._input(input))
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            return jnp.empty((0,))
+        return jnp.concatenate(self.inputs, axis=self.dim)
+
+    def merge_state(self, metrics: Iterable["Cat"]) -> "Cat":
+        for metric in metrics:
+            if metric.inputs:
+                self.inputs.append(
+                    jax.device_put(
+                        jnp.concatenate(metric.inputs, axis=metric.dim), self.device
+                    )
+                )
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.inputs:
+            self.inputs = [jnp.concatenate(self.inputs, axis=self.dim)]

@@ -1,0 +1,36 @@
+"""Max metric. Reference: ``torcheval/metrics/aggregation/max.py``."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class Max(Metric[jax.Array]):
+    """Streaming maximum over all seen elements.
+
+    Reference parity: ``aggregation/max.py:20-63``.
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("max", jnp.asarray(-jnp.inf), reduction=Reduction.MAX)
+
+    def update(self, input: jax.Array) -> "Max":
+        input = self._input(input)
+        self.max = jnp.maximum(self.max, jnp.max(input))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.max
+
+    def merge_state(self, metrics: Iterable["Max"]) -> "Max":
+        for metric in metrics:
+            self.max = jnp.maximum(self.max, jax.device_put(metric.max, self.device))
+        return self

@@ -1,0 +1,59 @@
+"""Mean metric. Reference: ``torcheval/metrics/aggregation/mean.py``."""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
+from torcheval_tpu.metrics.functional.aggregation.sum import _weight_check
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+_logger = logging.getLogger(__name__)
+
+
+class Mean(Metric[jax.Array]):
+    """Streaming weighted mean: ``sum(weight * input) / sum(weight)``.
+
+    Reference parity: ``aggregation/mean.py:20-102``, with one documented fix:
+    the reference treats an exactly-zero ``weighted_sum`` as "no updates yet"
+    (``mean.py:92-94``), returning 0.0 for legitimately zero-mean data. We test
+    ``weights == 0`` instead, which is the correct no-update signal.
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("weighted_sum", jnp.zeros(()), reduction=Reduction.SUM)
+        self._add_state("weights", jnp.zeros(()), reduction=Reduction.SUM)
+
+    def update(
+        self,
+        input: jax.Array,
+        *,
+        weight: Union[float, int, jax.Array] = 1.0,
+    ) -> "Mean":
+        input = self._input(input)
+        weight = _weight_check(input, weight)
+        weighted_sum, total_weight = _mean_update(input, weight)
+        self.weighted_sum = self.weighted_sum + weighted_sum
+        self.weights = self.weights + total_weight
+        return self
+
+    def compute(self) -> jax.Array:
+        if float(self.weights) == 0.0:
+            _logger.warning("No calls to update() have been made - returning 0.0")
+            return jnp.zeros(())
+        return self.weighted_sum / self.weights
+
+    def merge_state(self, metrics: Iterable["Mean"]) -> "Mean":
+        for metric in metrics:
+            self.weighted_sum = self.weighted_sum + jax.device_put(
+                metric.weighted_sum, self.device
+            )
+            self.weights = self.weights + jax.device_put(metric.weights, self.device)
+        return self

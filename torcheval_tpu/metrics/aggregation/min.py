@@ -1,0 +1,36 @@
+"""Min metric. Reference: ``torcheval/metrics/aggregation/min.py``."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class Min(Metric[jax.Array]):
+    """Streaming minimum over all seen elements.
+
+    Reference parity: ``aggregation/min.py:20-63``.
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("min", jnp.asarray(jnp.inf), reduction=Reduction.MIN)
+
+    def update(self, input: jax.Array) -> "Min":
+        input = self._input(input)
+        self.min = jnp.minimum(self.min, jnp.min(input))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.min
+
+    def merge_state(self, metrics: Iterable["Min"]) -> "Min":
+        for metric in metrics:
+            self.min = jnp.minimum(self.min, jax.device_put(metric.min, self.device))
+        return self

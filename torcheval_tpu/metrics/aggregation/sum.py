@@ -1,0 +1,45 @@
+"""Sum metric. Reference: ``torcheval/metrics/aggregation/sum.py``."""
+
+from __future__ import annotations
+
+from typing import Iterable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update, _weight_check
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class Sum(Metric[jax.Array]):
+    """Streaming (weighted) sum.
+
+    Reference parity: ``aggregation/sum.py:20-86``.
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("weighted_sum", jnp.zeros(()), reduction=Reduction.SUM)
+
+    def update(
+        self,
+        input: jax.Array,
+        *,
+        weight: Union[float, int, jax.Array] = 1.0,
+    ) -> "Sum":
+        input = self._input(input)
+        weight = _weight_check(input, weight)
+        self.weighted_sum = self.weighted_sum + _sum_update(input, weight)
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.weighted_sum
+
+    def merge_state(self, metrics: Iterable["Sum"]) -> "Sum":
+        for metric in metrics:
+            self.weighted_sum = self.weighted_sum + jax.device_put(
+                metric.weighted_sum, self.device
+            )
+        return self

@@ -1,0 +1,64 @@
+"""Throughput metric. Reference: ``torcheval/metrics/aggregation/throughput.py``.
+
+The only metric whose ``update`` takes host scalars, so it stays off the jit
+path entirely (SURVEY §7 "host-scalar metrics"): state is kept as jnp scalars
+for checkpoint/sync uniformity, but updates are trivial host-side adds.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+_logger = logging.getLogger(__name__)
+
+
+class Throughput(Metric[jax.Array]):
+    """Items processed per second.
+
+    Distributed merge sums counts but takes the **max** elapsed time across
+    replicas — in a synchronous program the slowest rank gates overall
+    throughput (reference: ``aggregation/throughput.py:97-108``).
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("num_total", jnp.zeros(()), reduction=Reduction.SUM)
+        self._add_state("elapsed_time_sec", jnp.zeros(()), reduction=Reduction.MAX)
+
+    def update(self, num_processed: int, elapsed_time_sec: float) -> "Throughput":
+        if num_processed < 0:
+            raise ValueError(
+                f"Expected num_processed to be a non-negative number, but received {num_processed}."
+            )
+        if elapsed_time_sec <= 0:
+            raise ValueError(
+                f"Expected elapsed_time_sec to be a positive number, but received {elapsed_time_sec}."
+            )
+        self.num_total = self.num_total + num_processed
+        self.elapsed_time_sec = self.elapsed_time_sec + elapsed_time_sec
+        return self
+
+    def compute(self) -> jax.Array:
+        if float(self.elapsed_time_sec) == 0.0:
+            _logger.warning("No calls to update() have been made - returning 0.0")
+            return jnp.zeros(())
+        return self.num_total / self.elapsed_time_sec
+
+    def merge_state(self, metrics: Iterable["Throughput"]) -> "Throughput":
+        for metric in metrics:
+            self.num_total = self.num_total + jax.device_put(
+                metric.num_total, self.device
+            )
+            self.elapsed_time_sec = jnp.maximum(
+                self.elapsed_time_sec,
+                jax.device_put(metric.elapsed_time_sec, self.device),
+            )
+        return self

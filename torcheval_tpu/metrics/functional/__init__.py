@@ -1,0 +1,6 @@
+from torcheval_tpu.metrics.functional.aggregation import mean, sum  # noqa: A004
+
+__all__ = [
+    "mean",
+    "sum",
+]

@@ -1,0 +1,4 @@
+from torcheval_tpu.metrics.functional.aggregation.mean import mean
+from torcheval_tpu.metrics.functional.aggregation.sum import sum  # noqa: A004
+
+__all__ = ["mean", "sum"]

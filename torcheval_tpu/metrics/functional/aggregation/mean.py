@@ -1,0 +1,40 @@
+"""Weighted mean. Reference: ``torcheval/metrics/functional/aggregation/mean.py``.
+
+Note: the reference exports ``mean`` in ``functional.__all__`` but forgets the
+import (``functional/__init__.py:7,45``) — a latent export bug we fix here
+(SURVEY §7 "parity with reference quirks").
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.sum import _weight_check
+from torcheval_tpu.utils.convert import as_jax
+
+
+@jax.jit
+def _mean_update(input: jax.Array, weight: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    weighted_sum = jnp.sum(input * weight)
+    if weight.ndim == 0:
+        total_weight = weight * input.size
+    else:
+        total_weight = jnp.sum(weight)
+    return weighted_sum, total_weight
+
+
+def mean(
+    input: jax.Array,
+    weight: Union[float, int, jax.Array] = 1.0,
+) -> jax.Array:
+    """Compute the weighted mean: ``sum(weight * input) / sum(weight)``.
+
+    Reference behavior: ``functional/aggregation/mean.py:13-58``.
+    """
+    input = as_jax(input)
+    weight = _weight_check(input, weight)
+    weighted_sum, total_weight = _mean_update(input, weight)
+    return weighted_sum / total_weight

@@ -1,0 +1,38 @@
+"""Weighted sum. Reference: ``torcheval/metrics/functional/aggregation/sum.py``."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+
+
+@jax.jit
+def _sum_update(input: jax.Array, weight: jax.Array) -> jax.Array:
+    return jnp.sum(input * weight)
+
+
+def _weight_check(input: jax.Array, weight) -> jax.Array:
+    weight = as_jax(weight, dtype=jnp.result_type(float))
+    if weight.ndim != 0 and weight.shape != input.shape:
+        raise ValueError(
+            "weight must be a scalar or an array whose shape matches input "
+            f"(input {input.shape}, weight {weight.shape})."
+        )
+    return weight
+
+
+def sum(  # noqa: A001 - parity with reference API name
+    input: jax.Array,
+    weight: Union[float, int, jax.Array] = 1.0,
+) -> jax.Array:
+    """Compute the weighted sum of ``input``.
+
+    Reference behavior: ``functional/aggregation/sum.py:13-56``.
+    """
+    input = as_jax(input)
+    weight = _weight_check(input, weight)
+    return _sum_update(input, weight)

@@ -1,0 +1,238 @@
+"""The ``Metric`` base class: a thin stateful shell over a pure functional core.
+
+Design (SURVEY.md §7). The reference's ``Metric`` is a mutating OO state
+machine whose math runs eagerly under ``@torch.inference_mode()``
+(``/root/reference/torcheval/metrics/metric.py:23-274``). The TPU-native
+re-design keeps the same *protocol* — ``update / compute / merge_state /
+reset / state_dict / load_state_dict / to`` — but:
+
+* **State is a pytree of ``jax.Array``s** registered via :meth:`_add_state`,
+  each with a declared :class:`~torcheval_tpu.metrics.state.Reduction` so the
+  distributed toolkit can sync it with a typed XLA collective instead of
+  pickling the object (reference: ``toolkit.py:235-257``).
+* **All math lives in pure jitted kernels** under
+  ``torcheval_tpu.metrics.functional``; class ``update`` methods only call a
+  kernel and rebind the returned arrays. Nothing here blocks on device→host
+  transfers, so back-to-back ``update()`` calls pipeline asynchronously on the
+  TPU (JAX dispatch is async; only ``compute()`` materialises values).
+* **No ``inference_mode`` analogue is needed** — JAX arrays are immutable and
+  jitted kernels are pure by construction.
+
+Class metrics exist for API parity with the reference; power users can drive
+the pure kernels directly (``torcheval_tpu.metrics.functional``) or go through
+the SPMD evaluator (``torcheval_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from abc import ABC, abstractmethod
+from collections import defaultdict, deque
+from typing import Any, Dict, Generic, Iterable, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.state import (
+    Reduction,
+    TState,
+    check_state_type,
+    copy_state,
+    put_state,
+)
+from torcheval_tpu.utils.devices import DeviceLike, canonical_device
+
+_logger: logging.Logger = logging.getLogger(__name__)
+
+
+def _zero_scalar() -> jax.Array:
+    """Module-level default factory so defaultdict state stays picklable."""
+    return jnp.zeros(())
+
+TComputeReturn = TypeVar("TComputeReturn")
+TSelf = TypeVar("TSelf", bound="Metric")
+
+
+class Metric(Generic[TComputeReturn], ABC):
+    """Abstract streaming metric.
+
+    Mirrors the reference protocol (``metric.py:23-274``): concrete metrics
+    register state with :meth:`_add_state` and implement ``update``,
+    ``compute`` and ``merge_state``. ``compute()`` must be idempotent and must
+    not mutate state.
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        self._device = canonical_device(device)
+        self._state_name_to_default: Dict[str, TState] = {}
+        self._state_name_to_reduction: Dict[str, Reduction] = {}
+
+    # ------------------------------------------------------------------ state
+    def _add_state(
+        self,
+        name: str,
+        default: TState,
+        *,
+        reduction: Optional[Reduction] = None,
+    ) -> None:
+        """Register a state variable and its cross-replica reduction.
+
+        ``default`` may be an array(-like), a list, a dict, or a deque of
+        arrays. If ``reduction`` is omitted it is inferred: lists/deques → CAT,
+        everything else → SUM (the dominant merge in the reference, §2.2).
+        """
+        if not isinstance(default, (list, dict, deque)):
+            default = jnp.asarray(default)
+        check_state_type(name, default)
+        if reduction is None:
+            reduction = Reduction.CAT if isinstance(default, (list, deque)) else Reduction.SUM
+        self._state_name_to_default[name] = copy_state(default)
+        self._state_name_to_reduction[name] = reduction
+        setattr(self, name, put_state(copy_state(default), self._device))
+
+    @property
+    def state_names(self) -> tuple:
+        return tuple(self._state_name_to_default)
+
+    def _states(self) -> Dict[str, TState]:
+        return {n: getattr(self, n) for n in self._state_name_to_default}
+
+    def _set_states(self, values: Dict[str, TState]) -> None:
+        for name, value in values.items():
+            setattr(self, name, value)
+
+    def _input(self, x) -> jax.Array:
+        """Convert an update argument (jax / numpy / torch-via-dlpack / python)
+        to a ``jax.Array`` on this metric's device. Torch tensors arrive as
+        committed host arrays, so the explicit placement is what makes mixing
+        them with HBM-resident state legal."""
+        from torcheval_tpu.utils.convert import as_jax
+
+        arr = as_jax(x)
+        if isinstance(arr, jax.Array) and arr.committed:
+            try:
+                if self._device in arr.devices():
+                    return arr
+            except Exception:
+                pass
+        return jax.device_put(arr, self._device)
+
+    # --------------------------------------------------------------- protocol
+    @abstractmethod
+    def update(self: TSelf, *args: Any, **kwargs: Any) -> TSelf:
+        """Fold a batch into the metric state. Must be cheap to call in a hot
+        loop: implementations dispatch one jitted kernel and return without
+        synchronising."""
+
+    @abstractmethod
+    def compute(self) -> TComputeReturn:
+        """Fold state into the final result. Idempotent; never mutates state."""
+
+    @abstractmethod
+    def merge_state(self: TSelf, metrics: Iterable[TSelf]) -> TSelf:
+        """Merge other replicas' state into self (other metrics unchanged)."""
+
+    def _prepare_for_merge_state(self) -> None:
+        """Pre-sync state compaction hook (e.g. concat a sample-cache list into
+        one array so the collective moves one buffer). Reference:
+        ``metric.py:112-121``."""
+
+    # ------------------------------------------------------------- life cycle
+    def reset(self: TSelf) -> TSelf:
+        """Reset all state variables to their registered defaults (placed on
+        the metric's current device)."""
+        for name, default in self._state_name_to_default.items():
+            value = put_state(copy_state(default), self._device)
+            if isinstance(default, dict):
+                d = defaultdict(_zero_scalar)
+                d.update(value)
+                value = d
+            setattr(self, name, value)
+        return self
+
+    def state_dict(self) -> Dict[str, TState]:
+        """Snapshot state as a plain dict (arrays are immutable — no clone
+        needed, unlike the reference's detach+clone dance)."""
+        out: Dict[str, TState] = {}
+        for name in self._state_name_to_default:
+            value = getattr(self, name)
+            check_state_type(name, value)
+            out[name] = copy_state(value)
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        state_dict = dict(state_dict)
+        names = set(self._state_name_to_default)
+        for name in names:
+            if name in state_dict:
+                value = state_dict[name]
+                check_state_type(name, value)
+                # place on this metric's device: loaded arrays may be committed
+                # elsewhere (e.g. a checkpoint taken on another host/device)
+                setattr(self, name, put_state(copy_state(value), self._device))
+        if strict:
+            unexpected = set(state_dict) - names
+            missing = names - set(state_dict)
+            if missing or unexpected:
+                raise RuntimeError(
+                    f"Error(s) in loading state_dict for {type(self).__name__}. "
+                    f"Encountered missing keys: {missing} and unexpected keys: "
+                    f"{unexpected}."
+                )
+
+    def to(self: TSelf, device: DeviceLike, *args: Any, **kwargs: Any) -> TSelf:
+        """Move all state to ``device`` (a jax.Device, platform string, or a
+        ``Sharding`` for mesh-distributed state)."""
+        self._device = canonical_device(device)
+        for name in self._state_name_to_default:
+            setattr(self, name, put_state(getattr(self, name), self._device))
+        return self
+
+    @property
+    def device(self):
+        return self._device
+
+    # ------------------------------------------------------------------ misc
+    def __deepcopy__(self: TSelf, memo: Dict[int, Any]) -> TSelf:
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if isinstance(v, jax.Array) or k == "_device":
+                # arrays are immutable and devices are process singletons:
+                # share, don't copy.
+                new.__dict__[k] = v
+            else:
+                new.__dict__[k] = copy.deepcopy(v, memo)
+        return new
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # jax.Device handles are process-local and unpicklable; serialise a
+        # (platform, id) spec instead. Shardings degrade to the default device
+        # on restore (cross-process restore cannot assume the same mesh).
+        state = dict(self.__dict__)
+        dev = state.pop("_device", None)
+        if isinstance(dev, jax.Device):
+            state["_device_spec"] = (dev.platform, dev.id)
+        else:
+            state["_device_spec"] = None
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        spec = state.pop("_device_spec", None)
+        self.__dict__.update(state)
+        device = None
+        if spec is not None:
+            platform, dev_id = spec
+            try:
+                devs = jax.devices(platform)
+                # match by device id, not list position: local ids need not be
+                # 0..n-1 in multi-process jobs
+                device = next((d for d in devs if d.id == dev_id), devs[0])
+            except RuntimeError:
+                device = None
+        self._device = canonical_device(device)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(device={self._device})"

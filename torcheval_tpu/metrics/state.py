@@ -1,0 +1,101 @@
+"""Metric state containers and collective-reduction declarations.
+
+TPU-first design departure from the reference: in torcheval, merge semantics
+live only inside each metric's ``merge_state`` method, and distributed sync
+pickles whole ``Metric`` objects through ``dist.gather_object``
+(``/root/reference/torcheval/metrics/toolkit.py:235-257``). Here every state
+variable *declares* its reduction (:class:`Reduction`) at registration time, so
+the sync layer can compile the merge into typed XLA collectives —
+``lax.psum`` for SUM states, ``lax.pmax``/``lax.pmin`` for MAX/MIN,
+``all_gather`` + concat for CAT (sample-cache) states — instead of moving
+pickled Python objects over the wire.
+
+Supported state container types mirror the reference's ``TState`` union
+(``/root/reference/torcheval/metrics/metric.py:18-20``):
+
+* ``jax.Array`` — the fast path; lives in HBM, updated by jitted kernels.
+* ``list[jax.Array]`` — unbounded sample caches (AUROC/PRC/Cat). Appends are
+  O(1) host ops; compaction to a single array happens at compute / pre-merge.
+* ``dict[Any, jax.Array]`` — host-side keyed accumulators (test fixtures; no
+  shipped metric uses them, see SURVEY §7).
+* ``deque[jax.Array]`` — bounded window state (test fixtures).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict, deque
+from typing import Any, Deque, Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+
+TState = Union[jax.Array, List[jax.Array], Dict[Any, jax.Array], Deque[jax.Array]]
+
+
+class Reduction(enum.Enum):
+    """How a state variable combines across metric replicas / mesh ranks."""
+
+    SUM = "sum"  # elementwise add            -> lax.psum
+    MAX = "max"  # elementwise max            -> lax.pmax
+    MIN = "min"  # elementwise min            -> lax.pmin
+    CAT = "cat"  # concatenate along axis 0   -> all_gather(..., tiled=True)
+    NONE = "none"  # replicated / identical on all ranks (e.g. threshold grids)
+    CUSTOM = "custom"  # only mergeable via the metric's merge_state()
+
+
+def check_state_type(name: str, value: Any) -> None:
+    """Validate a state value against the TState union (recursively)."""
+    if isinstance(value, jax.Array) or hasattr(value, "shape") and hasattr(value, "dtype"):
+        return
+    if isinstance(value, list) or isinstance(value, deque):
+        for v in value:
+            if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+                raise TypeError(
+                    f"Element of state {name!r} must be an array, got {type(v)!r}."
+                )
+        return
+    if isinstance(value, dict):
+        for k, v in value.items():
+            if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+                raise TypeError(
+                    f"Value of state {name!r}[{k!r}] must be an array, got {type(v)!r}."
+                )
+        return
+    raise TypeError(
+        f"State {name!r} must be a jax.Array, list, dict or deque of jax.Array, "
+        f"got {type(value)!r}."
+    )
+
+
+def put_state(value: TState, device) -> TState:
+    """Place a state value (any container type) on ``device``."""
+    if isinstance(value, (list, deque)):
+        moved = [jax.device_put(v, device) for v in value]
+        if isinstance(value, deque):
+            return deque(moved, maxlen=value.maxlen)
+        return moved
+    if isinstance(value, dict):
+        out = {k: jax.device_put(v, device) for k, v in value.items()}
+        if isinstance(value, defaultdict) and value.default_factory is not None:
+            d = defaultdict(value.default_factory)
+            d.update(out)
+            return d
+        return out
+    return jax.device_put(jnp.asarray(value), device)
+
+
+def copy_state(value: TState) -> TState:
+    """Structural copy of a state value. jax.Arrays are immutable, so the
+    arrays themselves are shared; containers are shallow-copied."""
+    if isinstance(value, list):
+        return list(value)
+    if isinstance(value, deque):
+        return deque(value, maxlen=value.maxlen)
+    if isinstance(value, defaultdict):
+        d = defaultdict(value.default_factory)
+        d.update(value)
+        return d
+    if isinstance(value, dict):
+        return dict(value)
+    return value

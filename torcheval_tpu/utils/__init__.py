@@ -1,0 +1,4 @@
+from torcheval_tpu.utils.convert import as_jax, to_numpy
+from torcheval_tpu.utils.devices import canonical_device
+
+__all__ = ["as_jax", "to_numpy", "canonical_device"]

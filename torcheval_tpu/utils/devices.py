@@ -1,0 +1,47 @@
+"""Device / placement helpers.
+
+The reference framework threads a ``torch.device`` through every metric
+(``/root/reference/torcheval/metrics/metric.py:49-50``). The TPU-native
+equivalent is a ``jax.Device`` *or* a ``jax.sharding.Sharding``: metric state is
+a pytree of ``jax.Array`` s that can live on one chip or be laid out across a
+mesh. ``None`` means "JAX's default device" (the first TPU chip when present).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+DeviceLike = Union[str, jax.Device, jax.sharding.Sharding, None]
+
+
+def canonical_device(device: DeviceLike) -> Union[jax.Device, jax.sharding.Sharding]:
+    """Resolve a user-supplied device spec to a concrete placement.
+
+    Accepts a ``jax.Device``, a ``jax.sharding.Sharding``, a platform string
+    (``"cpu"``, ``"tpu"``), or ``None`` (default device).
+    """
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, jax.sharding.Sharding):
+        return device
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, str):
+        devs = jax.devices(device)
+        if not devs:
+            raise ValueError(f"No devices found for platform {device!r}.")
+        return devs[0]
+    raise TypeError(
+        f"device must be a jax.Device, jax.sharding.Sharding, str or None, "
+        f"got {type(device)!r}."
+    )
+
+
+def device_of(x: jax.Array) -> Optional[jax.Device]:
+    """Best-effort single device of an array (None for multi-device arrays)."""
+    try:
+        return list(x.devices())[0] if len(x.devices()) == 1 else None
+    except Exception:
+        return None

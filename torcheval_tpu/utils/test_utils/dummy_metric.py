@@ -1,0 +1,110 @@
+"""Dummy metrics exercising every state container type.
+
+Reference: ``torcheval/utils/test_utils/dummy_metric.py:19-141`` — one fixture
+per ``TState`` variant (array / list / dict / deque) powering the base-class
+and toolkit tests.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class DummySumMetric(Metric[jax.Array]):
+    """Scalar-array state: running sum."""
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("sum", jnp.zeros(()), reduction=Reduction.SUM)
+
+    def update(self, x) -> "DummySumMetric":
+        self.sum = self.sum + jnp.sum(as_jax(x))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.sum
+
+    def merge_state(self, metrics: Iterable["DummySumMetric"]) -> "DummySumMetric":
+        for metric in metrics:
+            self.sum = self.sum + jax.device_put(metric.sum, self.device)
+        return self
+
+
+class DummySumListStateMetric(Metric[jax.Array]):
+    """List-of-arrays state: caches every update."""
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", [], reduction=Reduction.CAT)
+
+    def update(self, x) -> "DummySumListStateMetric":
+        self.x.append(jax.device_put(as_jax(x), self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        return jnp.stack(self.x).sum() if self.x else jnp.zeros(())
+
+    def merge_state(
+        self, metrics: Iterable["DummySumListStateMetric"]
+    ) -> "DummySumListStateMetric":
+        for metric in metrics:
+            self.x.extend(jax.device_put(x, self.device) for x in metric.x)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.x:
+            self.x = [jnp.stack([jnp.asarray(v, dtype=jnp.float32) for v in self.x]).sum()]
+
+
+class DummySumDictStateMetric(Metric[jax.Array]):
+    """Dict-keyed state (host-side only; no shipped metric uses dicts)."""
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", {}, reduction=Reduction.CUSTOM)
+
+    def update(self, key: str, x) -> "DummySumDictStateMetric":
+        self.x[key] = self.x.get(key, jnp.zeros(())) + jnp.sum(as_jax(x))
+        return self
+
+    def compute(self) -> jax.Array:
+        return jnp.stack(list(self.x.values())).sum() if self.x else jnp.zeros(())
+
+    def merge_state(
+        self, metrics: Iterable["DummySumDictStateMetric"]
+    ) -> "DummySumDictStateMetric":
+        for metric in metrics:
+            for k, v in metric.x.items():
+                self.x[k] = self.x.get(k, jnp.zeros(())) + jax.device_put(v, self.device)
+        return self
+
+
+class DummySumDequeStateMetric(Metric[jax.Array]):
+    """Deque state with bounded window."""
+
+    def __init__(self, *, maxlen: int = 10, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", deque(maxlen=maxlen), reduction=Reduction.CAT)
+
+    def update(self, x) -> "DummySumDequeStateMetric":
+        self.x.append(jax.device_put(as_jax(x), self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        return jnp.stack(list(self.x)).sum() if self.x else jnp.zeros(())
+
+    def merge_state(
+        self, metrics: Iterable["DummySumDequeStateMetric"]
+    ) -> "DummySumDequeStateMetric":
+        for metric in metrics:
+            self.x.extend(jax.device_put(x, self.device) for x in metric.x)
+        return self
