@@ -1,0 +1,181 @@
+"""Tier-2 protocol tests for the counter-family class metrics.
+
+Mirrors ``/root/reference/tests/metrics/classification/test_accuracy.py`` etc.:
+one run_class_implementation_tests spec per class, expected values computed by
+sklearn / numpy on the concatenated stream.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from sklearn.metrics import (
+    accuracy_score,
+    confusion_matrix as sk_confusion_matrix,
+    f1_score as sk_f1,
+    precision_score as sk_precision,
+    recall_score as sk_recall,
+)
+
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.utils.test_utils import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(7)
+C = 5
+SCORES = RNG.normal(size=(NUM_TOTAL_UPDATES, BATCH_SIZE, C)).astype(np.float32)
+TARGET = RNG.integers(0, C, size=(NUM_TOTAL_UPDATES, BATCH_SIZE))
+FLAT_PRED = SCORES.reshape(-1, C).argmax(1)
+FLAT_TARGET = TARGET.reshape(-1)
+BIN_SCORES = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+BIN_TARGET = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE))
+FLAT_BIN_PRED = (BIN_SCORES.reshape(-1) >= 0.5).astype(np.int64)
+FLAT_BIN_TARGET = BIN_TARGET.reshape(-1)
+
+
+class TestAccuracyClasses(MetricClassTester):
+    def test_multiclass_accuracy_micro(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(TARGET)},
+            compute_result=accuracy_score(FLAT_TARGET, FLAT_PRED),
+        )
+
+    def test_multiclass_accuracy_macro(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(average="macro", num_classes=C),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(TARGET)},
+            compute_result=sk_recall(FLAT_TARGET, FLAT_PRED, average="macro"),
+        )
+
+    def test_binary_accuracy(self):
+        self.run_class_implementation_tests(
+            metric=BinaryAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={
+                "input": jnp.asarray(BIN_SCORES),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=accuracy_score(FLAT_BIN_TARGET, FLAT_BIN_PRED),
+        )
+
+    def test_multilabel_accuracy(self):
+        target = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
+        scores = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 4)).astype(np.float32)
+        pred = (scores.reshape(-1, 4) >= 0.5).astype(np.int64)
+        expected = (pred == target.reshape(-1, 4)).all(axis=1).mean()
+        self.run_class_implementation_tests(
+            metric=MultilabelAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": jnp.asarray(scores), "target": jnp.asarray(target)},
+            compute_result=expected,
+        )
+
+    def test_topk_multilabel_accuracy(self):
+        k = 3
+        target = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, C))
+        flat = SCORES.reshape(-1, C)
+        idx = np.argsort(-flat, axis=1, kind="stable")[:, :k]
+        pred = np.zeros_like(target.reshape(-1, C))
+        np.put_along_axis(pred, idx, 1, axis=1)
+        expected = (pred == target.reshape(-1, C)).all(axis=1).mean()
+        self.run_class_implementation_tests(
+            metric=TopKMultilabelAccuracy(k=k),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(target)},
+            compute_result=expected,
+        )
+
+
+class TestF1Classes(MetricClassTester):
+    def test_multiclass_f1_weighted(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassF1Score(num_classes=C, average="weighted"),
+            state_names={"num_tp", "num_label", "num_prediction"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(TARGET)},
+            compute_result=sk_f1(
+                FLAT_TARGET, FLAT_PRED, average="weighted", zero_division=0
+            ),
+        )
+
+    def test_binary_f1(self):
+        self.run_class_implementation_tests(
+            metric=BinaryF1Score(),
+            state_names={"num_tp", "num_label", "num_prediction"},
+            update_kwargs={
+                "input": jnp.asarray(BIN_SCORES),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=sk_f1(FLAT_BIN_TARGET, FLAT_BIN_PRED, zero_division=0),
+        )
+
+
+class TestPrecisionRecallClasses(MetricClassTester):
+    def test_multiclass_precision_macro(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecision(num_classes=C, average="macro"),
+            state_names={"num_tp", "num_fp", "num_label"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(TARGET)},
+            compute_result=sk_precision(
+                FLAT_TARGET, FLAT_PRED, average="macro", zero_division=0
+            ),
+        )
+
+    def test_binary_precision(self):
+        self.run_class_implementation_tests(
+            metric=BinaryPrecision(),
+            state_names={"num_tp", "num_fp", "num_label"},
+            update_kwargs={
+                "input": jnp.asarray(BIN_SCORES),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=sk_precision(FLAT_BIN_TARGET, FLAT_BIN_PRED, zero_division=0),
+        )
+
+    def test_multiclass_recall_none(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassRecall(num_classes=C, average=None),
+            state_names={"num_tp", "num_labels", "num_predictions"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(TARGET)},
+            compute_result=sk_recall(
+                FLAT_TARGET, FLAT_PRED, average=None, zero_division=0
+            ),
+        )
+
+    def test_binary_recall(self):
+        self.run_class_implementation_tests(
+            metric=BinaryRecall(),
+            state_names={"num_tp", "num_true_labels"},
+            update_kwargs={
+                "input": jnp.asarray(BIN_SCORES),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=sk_recall(FLAT_BIN_TARGET, FLAT_BIN_PRED, zero_division=0),
+        )
+
+
+class TestConfusionMatrixClass(MetricClassTester):
+    def test_multiclass_confusion_matrix(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassConfusionMatrix(C),
+            state_names={"confusion_matrix"},
+            update_kwargs={"input": jnp.asarray(SCORES), "target": jnp.asarray(TARGET)},
+            compute_result=sk_confusion_matrix(
+                FLAT_TARGET, FLAT_PRED, labels=np.arange(C)
+            ),
+        )

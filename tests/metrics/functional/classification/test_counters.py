@@ -1,0 +1,299 @@
+"""Tier-1 oracle tests: counter-family functionals vs scikit-learn.
+
+Mirrors the reference strategy (SURVEY §4: sklearn as independent oracle,
+e.g. ``tests/metrics/functional/classification/test_accuracy.py:12,28-30``)
+plus invalid-input assertRaises coverage.
+"""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import (
+    accuracy_score,
+    confusion_matrix as sk_confusion_matrix,
+    f1_score as sk_f1,
+    precision_score as sk_precision,
+    recall_score as sk_recall,
+)
+
+from torcheval_tpu.metrics import functional as F
+from torcheval_tpu.utils.test_utils import assert_result_close
+
+RNG = np.random.default_rng(42)
+C = 7
+N = 500
+TARGET = RNG.integers(0, C, size=N)
+PRED_LABELS = RNG.integers(0, C, size=N)
+PRED_SCORES = RNG.normal(size=(N, C)).astype(np.float32)
+BIN_TARGET = RNG.integers(0, 2, size=N)
+BIN_SCORES = RNG.random(N).astype(np.float32)
+BIN_PRED = (BIN_SCORES >= 0.5).astype(np.int64)
+
+
+class TestMulticlassAccuracy(unittest.TestCase):
+    def test_micro_labels(self):
+        assert_result_close(
+            F.multiclass_accuracy(jnp.asarray(PRED_LABELS), jnp.asarray(TARGET)),
+            accuracy_score(TARGET, PRED_LABELS),
+        )
+
+    def test_micro_scores(self):
+        pred = PRED_SCORES.argmax(1)
+        assert_result_close(
+            F.multiclass_accuracy(jnp.asarray(PRED_SCORES), jnp.asarray(TARGET)),
+            accuracy_score(TARGET, pred),
+        )
+
+    def test_macro_and_none(self):
+        pred = PRED_SCORES.argmax(1)
+        # sklearn macro recall == torcheval macro accuracy (per-class acc is recall)
+        expected = sk_recall(TARGET, pred, average="macro")
+        assert_result_close(
+            F.multiclass_accuracy(
+                jnp.asarray(PRED_SCORES), jnp.asarray(TARGET),
+                average="macro", num_classes=C,
+            ),
+            expected,
+        )
+        per_class = F.multiclass_accuracy(
+            jnp.asarray(PRED_SCORES), jnp.asarray(TARGET), average=None, num_classes=C
+        )
+        expected_pc = sk_recall(TARGET, pred, average=None)
+        assert_result_close(per_class, expected_pc)
+
+    def test_topk(self):
+        k = 3
+        topk_hits = np.array(
+            [
+                (PRED_SCORES[i] > PRED_SCORES[i, TARGET[i]]).sum() < k
+                for i in range(N)
+            ]
+        )
+        assert_result_close(
+            F.multiclass_accuracy(
+                jnp.asarray(PRED_SCORES), jnp.asarray(TARGET), k=k
+            ),
+            topk_hits.mean(),
+        )
+
+    def test_invalid_inputs(self):
+        with self.assertRaisesRegex(ValueError, "`average` was not"):
+            F.multiclass_accuracy(jnp.zeros(3), jnp.zeros(3), average="bogus")
+        with self.assertRaisesRegex(ValueError, "num_classes should be a positive"):
+            F.multiclass_accuracy(jnp.zeros(3), jnp.zeros(3), average="macro")
+        with self.assertRaisesRegex(ValueError, "same first dimension"):
+            F.multiclass_accuracy(jnp.zeros(3), jnp.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            F.multiclass_accuracy(jnp.zeros((3, 2)), jnp.zeros((3, 2)))
+        with self.assertRaisesRegex(ValueError, "for k > 1"):
+            F.multiclass_accuracy(jnp.zeros(3), jnp.zeros(3), k=2)
+        with self.assertRaisesRegex(TypeError, "`k` to be an integer"):
+            F.multiclass_accuracy(jnp.zeros(3), jnp.zeros(3), k=1.5)
+
+
+class TestBinaryAccuracy(unittest.TestCase):
+    def test_binary(self):
+        assert_result_close(
+            F.binary_accuracy(jnp.asarray(BIN_SCORES), jnp.asarray(BIN_TARGET)),
+            accuracy_score(BIN_TARGET, BIN_PRED),
+        )
+
+    def test_threshold(self):
+        pred = (BIN_SCORES >= 0.8).astype(np.int64)
+        assert_result_close(
+            F.binary_accuracy(
+                jnp.asarray(BIN_SCORES), jnp.asarray(BIN_TARGET), threshold=0.8
+            ),
+            accuracy_score(BIN_TARGET, pred),
+        )
+
+
+class TestMultilabelAccuracy(unittest.TestCase):
+    def setUp(self):
+        self.target = RNG.integers(0, 2, size=(64, 5))
+        self.scores = RNG.random((64, 5)).astype(np.float32)
+        self.pred = (self.scores >= 0.5).astype(np.int64)
+
+    def test_exact_match(self):
+        expected = (self.pred == self.target).all(axis=1).mean()
+        assert_result_close(
+            F.multilabel_accuracy(jnp.asarray(self.scores), jnp.asarray(self.target)),
+            expected,
+        )
+
+    def test_hamming(self):
+        expected = (self.pred == self.target).mean()
+        assert_result_close(
+            F.multilabel_accuracy(
+                jnp.asarray(self.scores), jnp.asarray(self.target), criteria="hamming"
+            ),
+            expected,
+        )
+
+    def test_overlap_contain_belong(self):
+        overlap = (
+            ((self.pred == self.target) & (self.pred == 1)).max(axis=1)
+            | ((self.pred == 0) & (self.target == 0)).all(axis=1)
+        ).mean()
+        contain = ((self.pred - self.target) >= 0).all(axis=1).mean()
+        belong = ((self.pred - self.target) <= 0).all(axis=1).mean()
+        for criteria, expected in [
+            ("overlap", overlap),
+            ("contain", contain),
+            ("belong", belong),
+        ]:
+            assert_result_close(
+                F.multilabel_accuracy(
+                    jnp.asarray(self.scores), jnp.asarray(self.target), criteria=criteria
+                ),
+                expected,
+            )
+
+    def test_topk_respects_k(self):
+        # fixed reference bug: topk(k) was hardcoded to 2 (accuracy.py:394)
+        k = 3
+        idx = np.argsort(-self.scores, axis=1, kind="stable")[:, :k]
+        pred = np.zeros_like(self.target)
+        np.put_along_axis(pred, idx, 1, axis=1)
+        expected = (pred == self.target).all(axis=1).mean()
+        assert_result_close(
+            F.topk_multilabel_accuracy(
+                jnp.asarray(self.scores), jnp.asarray(self.target), k=k
+            ),
+            expected,
+        )
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "`criteria` was not"):
+            F.multilabel_accuracy(jnp.zeros((2, 2)), jnp.zeros((2, 2)), criteria="x")
+        with self.assertRaisesRegex(ValueError, "greater than 1"):
+            F.topk_multilabel_accuracy(jnp.zeros((2, 2)), jnp.zeros((2, 2)), k=1)
+
+
+class TestF1(unittest.TestCase):
+    def test_micro_macro_weighted_none(self):
+        pred = PRED_SCORES.argmax(1)
+        for average in ["micro", "macro", "weighted", None]:
+            expected = sk_f1(TARGET, pred, average=average, zero_division=0)
+            assert_result_close(
+                F.multiclass_f1_score(
+                    jnp.asarray(PRED_SCORES),
+                    jnp.asarray(TARGET),
+                    num_classes=C,
+                    average=average,
+                ),
+                expected,
+                atol=1e-5,
+            )
+
+    def test_binary_f1(self):
+        expected = sk_f1(BIN_TARGET, BIN_PRED, zero_division=0)
+        assert_result_close(
+            F.binary_f1_score(jnp.asarray(BIN_SCORES), jnp.asarray(BIN_TARGET)),
+            expected,
+        )
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "num_classes should be"):
+            F.multiclass_f1_score(jnp.zeros(3), jnp.zeros(3), average="macro")
+
+
+class TestPrecisionRecall(unittest.TestCase):
+    def test_precision_all_averages(self):
+        pred = PRED_SCORES.argmax(1)
+        for average in ["micro", "macro", "weighted", None]:
+            expected = sk_precision(TARGET, pred, average=average, zero_division=0)
+            assert_result_close(
+                F.multiclass_precision(
+                    jnp.asarray(PRED_SCORES),
+                    jnp.asarray(TARGET),
+                    num_classes=C,
+                    average=average,
+                ),
+                expected,
+            )
+
+    def test_recall_all_averages(self):
+        pred = PRED_SCORES.argmax(1)
+        for average in ["micro", "macro", "weighted", None]:
+            expected = sk_recall(TARGET, pred, average=average, zero_division=0)
+            assert_result_close(
+                F.multiclass_recall(
+                    jnp.asarray(PRED_SCORES),
+                    jnp.asarray(TARGET),
+                    num_classes=C,
+                    average=average,
+                ),
+                expected,
+            )
+
+    def test_binary(self):
+        assert_result_close(
+            F.binary_precision(jnp.asarray(BIN_SCORES), jnp.asarray(BIN_TARGET)),
+            sk_precision(BIN_TARGET, BIN_PRED, zero_division=0),
+        )
+        assert_result_close(
+            F.binary_recall(jnp.asarray(BIN_SCORES), jnp.asarray(BIN_TARGET)),
+            sk_recall(BIN_TARGET, BIN_PRED, zero_division=0),
+        )
+
+
+class TestConfusionMatrix(unittest.TestCase):
+    def test_multiclass(self):
+        pred = PRED_SCORES.argmax(1)
+        expected = sk_confusion_matrix(TARGET, pred, labels=np.arange(C))
+        np.testing.assert_array_equal(
+            np.asarray(
+                F.multiclass_confusion_matrix(
+                    jnp.asarray(PRED_SCORES), jnp.asarray(TARGET), C
+                )
+            ),
+            expected,
+        )
+
+    def test_normalized(self):
+        expected = sk_confusion_matrix(
+            BIN_TARGET, BIN_PRED, labels=[0, 1], normalize="true"
+        )
+        assert_result_close(
+            F.binary_confusion_matrix(
+                jnp.asarray(BIN_SCORES), jnp.asarray(BIN_TARGET), normalize="true"
+            ),
+            expected,
+        )
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "num_classes must be"):
+            F.multiclass_confusion_matrix(jnp.zeros(3), jnp.zeros(3), 1)
+        with self.assertRaisesRegex(ValueError, "normalize"):
+            F.multiclass_confusion_matrix(jnp.zeros(3), jnp.zeros(3), 3, normalize="x")
+
+
+class TestClassCountsMethods(unittest.TestCase):
+    def test_matmul_vs_scatter_agree(self):
+        from torcheval_tpu.ops import class_counts
+
+        labels = jnp.asarray(RNG.integers(0, 100, size=10_000))
+        weights = jnp.asarray(RNG.random(10_000).astype(np.float32))
+        a = class_counts(labels, 100, method="matmul")
+        b = class_counts(labels, 100, method="scatter")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        wa = class_counts(labels, 100, weights, method="matmul")
+        wb = class_counts(labels, 100, weights, method="scatter")
+        np.testing.assert_allclose(np.asarray(wa), np.asarray(wb), rtol=1e-5)
+
+
+if __name__ == "__main__":
+    unittest.main()
+
+
+class TestConfusionOutOfRange(unittest.TestCase):
+    def test_partial_out_of_range_sample_is_dropped(self):
+        # a sample with one bad coordinate must not fold into a valid cell
+        mat = F.multiclass_confusion_matrix(
+            jnp.asarray([0, 5]), jnp.asarray([0, 1]), 3
+        )
+        expected = np.zeros((3, 3), dtype=np.int32)
+        expected[0, 0] = 1
+        np.testing.assert_array_equal(np.asarray(mat), expected)

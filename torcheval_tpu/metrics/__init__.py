@@ -1,5 +1,19 @@
 from torcheval_tpu.metrics import functional
 from torcheval_tpu.metrics.aggregation import Cat, Max, Mean, Min, Sum, Throughput
+from torcheval_tpu.metrics.classification import (
+    BinaryAccuracy,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 
@@ -10,10 +24,22 @@ __all__ = [
     # functional metrics
     "functional",
     # class metrics
+    "BinaryAccuracy",
+    "BinaryConfusionMatrix",
+    "BinaryF1Score",
+    "BinaryPrecision",
+    "BinaryRecall",
     "Cat",
     "Max",
     "Mean",
     "Min",
+    "MulticlassAccuracy",
+    "MulticlassConfusionMatrix",
+    "MulticlassF1Score",
+    "MulticlassPrecision",
+    "MulticlassRecall",
+    "MultilabelAccuracy",
     "Sum",
     "Throughput",
+    "TopKMultilabelAccuracy",
 ]

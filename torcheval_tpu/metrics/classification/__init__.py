@@ -1,0 +1,34 @@
+from torcheval_tpu.metrics.classification.accuracy import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.metrics.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+)
+from torcheval_tpu.metrics.classification.f1_score import (
+    BinaryF1Score,
+    MulticlassF1Score,
+)
+from torcheval_tpu.metrics.classification.precision import (
+    BinaryPrecision,
+    MulticlassPrecision,
+)
+from torcheval_tpu.metrics.classification.recall import BinaryRecall, MulticlassRecall
+
+__all__ = [
+    "BinaryAccuracy",
+    "BinaryConfusionMatrix",
+    "BinaryF1Score",
+    "BinaryPrecision",
+    "BinaryRecall",
+    "MulticlassAccuracy",
+    "MulticlassConfusionMatrix",
+    "MulticlassF1Score",
+    "MulticlassPrecision",
+    "MulticlassRecall",
+    "MultilabelAccuracy",
+    "TopKMultilabelAccuracy",
+]

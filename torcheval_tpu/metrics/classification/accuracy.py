@@ -1,0 +1,165 @@
+"""Accuracy class metrics.
+
+Reference: ``torcheval/metrics/classification/accuracy.py`` — thin streaming
+accumulators over the pure kernels in
+``torcheval_tpu.metrics.functional.classification.accuracy``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.accuracy import (
+    _accuracy_compute,
+    _accuracy_param_check,
+    _accuracy_update_input_check,
+    _binary_accuracy_update,
+    _multiclass_accuracy_update,
+    _multilabel_accuracy_param_check,
+    _multilabel_accuracy_update,
+    _multilabel_shape_check,
+    _topk_multilabel_accuracy_param_check,
+    _topk_multilabel_accuracy_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+TAccuracy = TypeVar("TAccuracy", bound="MulticlassAccuracy")
+
+
+class MulticlassAccuracy(Metric[jax.Array]):
+    """Streaming multiclass accuracy.
+
+    Reference parity: ``classification/accuracy.py:32-144``. State is a
+    scalar pair (micro) or per-class ``(num_classes,)`` int32 counters.
+    """
+
+    def __init__(
+        self,
+        *,
+        average: Optional[str] = "micro",
+        num_classes: Optional[int] = None,
+        k: int = 1,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _accuracy_param_check(average, num_classes, k)
+        self.average = average
+        self.num_classes = num_classes
+        self.k = k
+        shape = () if average == "micro" else (num_classes,)
+        self._add_state(
+            "num_correct", jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+        )
+        self._add_state(
+            "num_total", jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+        )
+
+    def update(self, input, target) -> "MulticlassAccuracy":
+        input, target = self._input(input), self._input(target)
+        _accuracy_update_input_check(input, target, self.num_classes, self.k)
+        num_correct, num_total = _multiclass_accuracy_update(
+            input, target, self.average, self.num_classes, self.k
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self
+
+    def compute(self) -> jax.Array:
+        return _accuracy_compute(self.num_correct, self.num_total, self.average)
+
+    def merge_state(self, metrics: Iterable["MulticlassAccuracy"]) -> "MulticlassAccuracy":
+        for metric in metrics:
+            self.num_correct = self.num_correct + jax.device_put(
+                metric.num_correct, self.device
+            )
+            self.num_total = self.num_total + jax.device_put(
+                metric.num_total, self.device
+            )
+        return self
+
+
+class BinaryAccuracy(MulticlassAccuracy):
+    """Streaming binary accuracy with thresholding.
+
+    Reference parity: ``classification/accuracy.py:147-204``.
+    """
+
+    def __init__(
+        self, *, threshold: float = 0.5, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryAccuracy":
+        input, target = self._input(input), self._input(target)
+        _multilabel_shape_check(input, target)
+        if target.ndim != 1:
+            raise ValueError(
+                f"target should be a one-dimensional tensor, got shape {target.shape}."
+            )
+        num_correct, num_total = _binary_accuracy_update(input, target, self.threshold)
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self
+
+
+class MultilabelAccuracy(MulticlassAccuracy):
+    """Streaming multilabel accuracy under a configurable criterion.
+
+    Reference parity: ``classification/accuracy.py:207-302``.
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold: float = 0.5,
+        criteria: str = "exact_match",
+        device: DeviceLike = None,
+    ) -> None:
+        _multilabel_accuracy_param_check(criteria)
+        super().__init__(device=device)
+        self.threshold = threshold
+        self.criteria = criteria
+
+    def update(self, input, target) -> "MultilabelAccuracy":
+        input, target = self._input(input), self._input(target)
+        num_correct, num_total = _multilabel_accuracy_update(
+            input, target, self.threshold, self.criteria
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self
+
+
+class TopKMultilabelAccuracy(MulticlassAccuracy):
+    """Streaming multilabel accuracy where predictions are the top-k scores.
+
+    Reference parity: ``classification/accuracy.py:305-394``, with the
+    hardcoded ``topk(k=2)`` bug (``functional/.../accuracy.py:394``) fixed.
+    """
+
+    def __init__(
+        self,
+        *,
+        criteria: str = "exact_match",
+        k: int = 2,
+        device: DeviceLike = None,
+    ) -> None:
+        _topk_multilabel_accuracy_param_check(criteria, k)
+        super().__init__(device=device)
+        self.criteria = criteria
+        self.k = k
+
+    def update(self, input, target) -> "TopKMultilabelAccuracy":
+        input, target = self._input(input), self._input(target)
+        num_correct, num_total = _topk_multilabel_accuracy_update(
+            input, target, self.criteria, self.k
+        )
+        self.num_correct = self.num_correct + num_correct
+        self.num_total = self.num_total + num_total
+        return self

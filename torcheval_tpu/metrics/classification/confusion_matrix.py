@@ -1,0 +1,84 @@
+"""Confusion-matrix class metrics (framework extension; see the functional
+module for provenance — required by BASELINE config 3)."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _confusion_matrix_input_check,
+    _confusion_matrix_param_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.ops.confusion import confusion_matrix_counts, normalize_confusion_matrix
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class MulticlassConfusionMatrix(Metric[jax.Array]):
+    """Streaming (num_classes, num_classes) confusion counts; rows = true."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        *,
+        normalize: Optional[str] = None,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _confusion_matrix_param_check(num_classes, normalize)
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self._add_state(
+            "confusion_matrix",
+            jnp.zeros((num_classes, num_classes), dtype=jnp.int32),
+            reduction=Reduction.SUM,
+        )
+
+    def update(self, input, target) -> "MulticlassConfusionMatrix":
+        input, target = self._input(input), self._input(target)
+        _confusion_matrix_input_check(input, target)
+        if input.ndim == 2:
+            input = jnp.argmax(input, axis=1)
+        self.confusion_matrix = self.confusion_matrix + confusion_matrix_counts(
+            input, target, self.num_classes
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        return normalize_confusion_matrix(self.confusion_matrix, self.normalize)
+
+    def merge_state(
+        self, metrics: Iterable["MulticlassConfusionMatrix"]
+    ) -> "MulticlassConfusionMatrix":
+        for metric in metrics:
+            self.confusion_matrix = self.confusion_matrix + jax.device_put(
+                metric.confusion_matrix, self.device
+            )
+        return self
+
+
+class BinaryConfusionMatrix(MulticlassConfusionMatrix):
+    """Streaming 2x2 confusion counts after thresholding scores."""
+
+    def __init__(
+        self,
+        *,
+        threshold: float = 0.5,
+        normalize: Optional[str] = None,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(2, normalize=normalize, device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryConfusionMatrix":
+        input, target = self._input(input), self._input(target)
+        _confusion_matrix_input_check(input, target)
+        pred = jnp.where(input < self.threshold, 0, 1)
+        self.confusion_matrix = self.confusion_matrix + confusion_matrix_counts(
+            pred, target, 2
+        )
+        return self

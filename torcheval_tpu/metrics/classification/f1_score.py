@@ -1,0 +1,105 @@
+"""F1 class metrics.
+
+Reference: ``torcheval/metrics/classification/f1_score.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.f1_score import (
+    _binary_f1_score_update,
+    _f1_input_check,
+    _f1_score_compute,
+    _f1_score_param_check,
+    _f1_score_update,
+    _warn_empty_classes,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class MulticlassF1Score(Metric[jax.Array]):
+    """Streaming multiclass F1.
+
+    Reference parity: ``classification/f1_score.py:26-155``. State triple
+    (num_tp, num_label, num_prediction), scalar (micro) or per-class.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _f1_score_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        shape = () if average == "micro" else (num_classes,)
+        for name in ("num_tp", "num_label", "num_prediction"):
+            self._add_state(
+                name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+            )
+
+    def update(self, input, target) -> "MulticlassF1Score":
+        input, target = self._input(input), self._input(target)
+        _f1_input_check(input, target, self.num_classes, "multiclass f1 score")
+        num_tp, num_label, num_prediction = _f1_score_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_label = self.num_label + num_label
+        self.num_prediction = self.num_prediction + num_prediction
+        return self
+
+    def compute(self) -> jax.Array:
+        if self.average != "micro":
+            _warn_empty_classes(self.num_label)
+        return _f1_score_compute(
+            self.num_tp, self.num_label, self.num_prediction, self.average
+        )
+
+    def merge_state(self, metrics: Iterable["MulticlassF1Score"]) -> "MulticlassF1Score":
+        for metric in metrics:
+            self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
+            self.num_label = self.num_label + jax.device_put(
+                metric.num_label, self.device
+            )
+            self.num_prediction = self.num_prediction + jax.device_put(
+                metric.num_prediction, self.device
+            )
+        return self
+
+
+class BinaryF1Score(MulticlassF1Score):
+    """Streaming binary F1 with thresholding.
+
+    Reference parity: ``classification/f1_score.py:158-218``.
+    """
+
+    def __init__(
+        self, *, threshold: float = 0.5, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryF1Score":
+        input, target = self._input(input), self._input(target)
+        if input.ndim != 1 or target.ndim != 1 or input.shape != target.shape:
+            raise ValueError(
+                "input and target should be one-dimensional tensors of the same "
+                f"shape, got {input.shape} and {target.shape}."
+            )
+        num_tp, num_label, num_prediction = _binary_f1_score_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_label = self.num_label + num_label
+        self.num_prediction = self.num_prediction + num_prediction
+        return self

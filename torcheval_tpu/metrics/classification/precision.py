@@ -1,0 +1,105 @@
+"""Precision class metrics.
+
+Reference: ``torcheval/metrics/classification/precision.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.precision import (
+    _binary_precision_update,
+    _precision_compute,
+    _precision_input_check,
+    _precision_param_check,
+    _precision_update,
+    _warn_nan_classes,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class MulticlassPrecision(Metric[jax.Array]):
+    """Streaming multiclass precision.
+
+    Reference parity: ``classification/precision.py:25-160``. State triple
+    (num_tp, num_fp, num_label).
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _precision_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        shape = () if average == "micro" else (num_classes,)
+        for name in ("num_tp", "num_fp", "num_label"):
+            self._add_state(
+                name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+            )
+
+    def update(self, input, target) -> "MulticlassPrecision":
+        input, target = self._input(input), self._input(target)
+        _precision_input_check(input, target, self.num_classes)
+        num_tp, num_fp, num_label = _precision_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_fp = self.num_fp + num_fp
+        self.num_label = self.num_label + num_label
+        return self
+
+    def compute(self) -> jax.Array:
+        if self.average in (None, "None"):
+            _warn_nan_classes(self.num_tp, self.num_fp, "Precision")
+        return _precision_compute(self.num_tp, self.num_fp, self.num_label, self.average)
+
+    def merge_state(self, metrics: Iterable["MulticlassPrecision"]) -> "MulticlassPrecision":
+        for metric in metrics:
+            self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
+            self.num_fp = self.num_fp + jax.device_put(metric.num_fp, self.device)
+            self.num_label = self.num_label + jax.device_put(
+                metric.num_label, self.device
+            )
+        return self
+
+
+class BinaryPrecision(MulticlassPrecision):
+    """Streaming binary precision with thresholding.
+
+    Reference parity: ``classification/precision.py:163-214``.
+    """
+
+    def __init__(
+        self, *, threshold: float = 0.5, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryPrecision":
+        input, target = self._input(input), self._input(target)
+        if input.shape != target.shape:
+            raise ValueError(
+                "The `input` and `target` should have the same dimensions, "
+                f"got shapes {input.shape} and {target.shape}."
+            )
+        if target.ndim != 1:
+            raise ValueError(
+                f"target should be a one-dimensional tensor, got shape {target.shape}."
+            )
+        num_tp, num_fp, num_label = _binary_precision_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_fp = self.num_fp + num_fp
+        self.num_label = self.num_label + num_label
+        return self

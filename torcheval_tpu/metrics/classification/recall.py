@@ -1,0 +1,123 @@
+"""Recall class metrics.
+
+Reference: ``torcheval/metrics/classification/recall.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.recall import (
+    _binary_recall_compute,
+    _binary_recall_update,
+    _recall_compute,
+    _recall_input_check,
+    _recall_param_check,
+    _recall_update,
+    _warn_nan_recall,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class MulticlassRecall(Metric[jax.Array]):
+    """Streaming multiclass recall.
+
+    Reference parity: ``classification/recall.py:103-245``. State triple
+    (num_tp, num_labels, num_predictions).
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _recall_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        shape = () if average == "micro" else (num_classes,)
+        for name in ("num_tp", "num_labels", "num_predictions"):
+            self._add_state(
+                name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+            )
+
+    def update(self, input, target) -> "MulticlassRecall":
+        input, target = self._input(input), self._input(target)
+        _recall_input_check(input, target, self.num_classes)
+        num_tp, num_labels, num_predictions = _recall_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_labels = self.num_labels + num_labels
+        self.num_predictions = self.num_predictions + num_predictions
+        return self
+
+    def compute(self) -> jax.Array:
+        if self.average != "micro":
+            _warn_nan_recall(self.num_labels)
+        return _recall_compute(
+            self.num_tp, self.num_labels, self.num_predictions, self.average
+        )
+
+    def merge_state(self, metrics: Iterable["MulticlassRecall"]) -> "MulticlassRecall":
+        for metric in metrics:
+            self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
+            self.num_labels = self.num_labels + jax.device_put(
+                metric.num_labels, self.device
+            )
+            self.num_predictions = self.num_predictions + jax.device_put(
+                metric.num_predictions, self.device
+            )
+        return self
+
+
+class BinaryRecall(Metric[jax.Array]):
+    """Streaming binary recall with thresholding.
+
+    Reference parity: ``classification/recall.py:26-100``. State pair
+    (num_tp, num_true_labels).
+    """
+
+    def __init__(
+        self, *, threshold: float = 0.5, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+        self._add_state("num_tp", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM)
+        self._add_state(
+            "num_true_labels", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM
+        )
+
+    def update(self, input, target) -> "BinaryRecall":
+        input, target = self._input(input), self._input(target)
+        if input.shape != target.shape:
+            raise ValueError(
+                "The `input` and `target` should have the same dimensions, "
+                f"got shapes {input.shape} and {target.shape}."
+            )
+        if target.ndim != 1:
+            raise ValueError(
+                f"target should be a one-dimensional tensor, got shape {target.shape}."
+            )
+        num_tp, num_true_labels = _binary_recall_update(input, target, self.threshold)
+        self.num_tp = self.num_tp + num_tp
+        self.num_true_labels = self.num_true_labels + num_true_labels
+        return self
+
+    def compute(self) -> jax.Array:
+        return _binary_recall_compute(self.num_tp, self.num_true_labels)
+
+    def merge_state(self, metrics: Iterable["BinaryRecall"]) -> "BinaryRecall":
+        for metric in metrics:
+            self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
+            self.num_true_labels = self.num_true_labels + jax.device_put(
+                metric.num_true_labels, self.device
+            )
+        return self

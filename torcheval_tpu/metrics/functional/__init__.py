@@ -1,6 +1,32 @@
 from torcheval_tpu.metrics.functional.aggregation import mean, sum  # noqa: A004
+from torcheval_tpu.metrics.functional.classification import (
+    binary_accuracy,
+    binary_confusion_matrix,
+    binary_f1_score,
+    binary_precision,
+    binary_recall,
+    multiclass_accuracy,
+    multiclass_confusion_matrix,
+    multiclass_f1_score,
+    multiclass_precision,
+    multiclass_recall,
+    multilabel_accuracy,
+    topk_multilabel_accuracy,
+)
 
 __all__ = [
+    "binary_accuracy",
+    "binary_confusion_matrix",
+    "binary_f1_score",
+    "binary_precision",
+    "binary_recall",
     "mean",
+    "multiclass_accuracy",
+    "multiclass_confusion_matrix",
+    "multiclass_f1_score",
+    "multiclass_precision",
+    "multiclass_recall",
+    "multilabel_accuracy",
     "sum",
+    "topk_multilabel_accuracy",
 ]

@@ -1,0 +1,37 @@
+from torcheval_tpu.metrics.functional.classification.accuracy import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+    topk_multilabel_accuracy,
+)
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+)
+from torcheval_tpu.metrics.functional.classification.f1_score import (
+    binary_f1_score,
+    multiclass_f1_score,
+)
+from torcheval_tpu.metrics.functional.classification.precision import (
+    binary_precision,
+    multiclass_precision,
+)
+from torcheval_tpu.metrics.functional.classification.recall import (
+    binary_recall,
+    multiclass_recall,
+)
+
+__all__ = [
+    "binary_accuracy",
+    "binary_confusion_matrix",
+    "binary_f1_score",
+    "binary_precision",
+    "binary_recall",
+    "multiclass_accuracy",
+    "multiclass_confusion_matrix",
+    "multiclass_f1_score",
+    "multiclass_precision",
+    "multiclass_recall",
+    "multilabel_accuracy",
+    "topk_multilabel_accuracy",
+]

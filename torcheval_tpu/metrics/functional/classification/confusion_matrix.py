@@ -1,0 +1,75 @@
+"""Confusion matrix (multiclass / binary).
+
+Not present in the reference snapshot (v0.0.3) but required by the benchmark
+target (BASELINE.md config 3: "MulticlassConfusionMatrix + F1, num_classes=
+1000, ImageNet eval"); API modelled on later torcheval / sklearn conventions.
+Rows are true classes, columns predicted classes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.ops.confusion import confusion_matrix_counts
+from torcheval_tpu.utils.convert import as_jax
+
+_NORMALIZE_OPTIONS = (None, "all", "pred", "true")
+
+
+def _confusion_matrix_param_check(num_classes, normalize) -> None:
+    if num_classes is None or num_classes < 2:
+        raise ValueError(f"num_classes must be at least 2, got {num_classes}.")
+    if normalize not in _NORMALIZE_OPTIONS:
+        raise ValueError(
+            f"normalize must be one of {_NORMALIZE_OPTIONS}, got {normalize}."
+        )
+
+
+def _confusion_matrix_input_check(input: jax.Array, target: jax.Array) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+
+
+def multiclass_confusion_matrix(
+    input,
+    target,
+    num_classes: int,
+    *,
+    normalize: Optional[str] = None,
+) -> jax.Array:
+    """(num_classes, num_classes) confusion counts; ``input`` may be labels
+    ``(n,)`` or scores ``(n, c)`` (argmax applied)."""
+    _confusion_matrix_param_check(num_classes, normalize)
+    input, target = as_jax(input), as_jax(target)
+    _confusion_matrix_input_check(input, target)
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    return confusion_matrix_counts(input, target, num_classes, normalize=normalize)
+
+
+def binary_confusion_matrix(
+    input,
+    target,
+    *,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+) -> jax.Array:
+    """2x2 confusion counts after thresholding scores."""
+    if normalize not in _NORMALIZE_OPTIONS:
+        raise ValueError(
+            f"normalize must be one of {_NORMALIZE_OPTIONS}, got {normalize}."
+        )
+    input, target = as_jax(input), as_jax(target)
+    _confusion_matrix_input_check(input, target)
+    pred = jnp.where(input < threshold, 0, 1)
+    return confusion_matrix_counts(pred, target, 2, normalize=normalize)

@@ -1,0 +1,161 @@
+"""Precision (binary / multiclass).
+
+Reference: ``torcheval/metrics/functional/classification/precision.py``
+(update ``:113-139``, compute ``:141-176``). Static-shape ``jnp.where``
+averaging; state triple is (num_tp, num_fp, num_label) like the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.ops.confusion import class_counts
+from torcheval_tpu.utils.convert import as_jax
+
+_logger = logging.getLogger(__name__)
+
+# the reference allows the string "None" here (precision.py:182)
+_AVERAGE_OPTIONS = ("micro", "macro", "weighted", "None", None)
+
+
+def _precision_param_check(num_classes: Optional[int], average: Optional[str]) -> None:
+    if average not in _AVERAGE_OPTIONS:
+        raise ValueError(
+            f"`average` was not in the allowed value of {_AVERAGE_OPTIONS}, got {average}."
+        )
+    if average != "micro" and (num_classes is None or num_classes <= 0):
+        raise ValueError(
+            f"num_classes should be a positive number when average={average}."
+            f" Got num_classes={num_classes}."
+        )
+
+
+def _precision_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not input.ndim == 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample,) or (num_sample, num_classes), "
+            f"got {input.shape}."
+        )
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _precision_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    input = input.astype(jnp.int32)
+    target = target.astype(jnp.int32)
+    if average == "micro":
+        num_tp = (input == target).sum(dtype=jnp.int32)
+        num_fp = (input != target).sum(dtype=jnp.int32)
+        return num_tp, num_fp, jnp.zeros((), dtype=jnp.int32)
+    correct = (input == target).astype(jnp.int32)
+    num_label = class_counts(target, num_classes)
+    num_tp = class_counts(target, num_classes, correct)
+    num_fp = class_counts(input, num_classes, 1 - correct)
+    return num_tp, num_fp, num_label
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _precision_compute(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_label: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    num_tp = num_tp.astype(jnp.float32)
+    num_fp = num_fp.astype(jnp.float32)
+    num_label = num_label.astype(jnp.float32)
+    denom = num_tp + num_fp
+    precision = jnp.where(denom > 0, num_tp / jnp.maximum(denom, 1.0), 0.0)
+    if average == "micro":
+        return precision
+    mask = (num_label != 0) | (denom != 0)
+    if average == "macro":
+        return jnp.where(mask, precision, 0.0).sum() / jnp.maximum(mask.sum(), 1)
+    if average == "weighted":
+        return (precision * (num_label / jnp.maximum(num_label.sum(), 1.0))).sum()
+    return precision  # average in (None, "None")
+
+
+@jax.jit
+def _binary_precision_update(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    pred = jnp.where(input < threshold, 0, 1)
+    tgt = target.astype(jnp.int32)
+    num_tp = (pred & tgt).sum(dtype=jnp.int32)
+    num_fp = (pred & (1 - tgt)).sum(dtype=jnp.int32)
+    return num_tp, num_fp, jnp.zeros((), dtype=jnp.int32)
+
+
+def _warn_nan_classes(num_tp, num_fp, what: str) -> None:
+    tp, fp = np.asarray(num_tp), np.asarray(num_fp)
+    if tp.ndim and ((tp + fp) == 0).any():
+        bad = np.nonzero((tp + fp) == 0)[0]
+        _logger.warning(
+            f"{bad.tolist()} classes have zero instances in both the predictions "
+            f"and the ground truth labels. {what} is still logged as zero."
+        )
+
+
+def multiclass_precision(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+) -> jax.Array:
+    """TP / (TP + FP), multiclass.
+
+    Reference: ``functional/classification/precision.py:55-110``.
+    """
+    _precision_param_check(num_classes, average)
+    input, target = as_jax(input), as_jax(target)
+    _precision_input_check(input, target, num_classes)
+    num_tp, num_fp, num_label = _precision_update(input, target, num_classes, average)
+    if average in (None, "None"):
+        _warn_nan_classes(num_tp, num_fp, "Precision")
+    return _precision_compute(num_tp, num_fp, num_label, average)
+
+
+def binary_precision(input, target, *, threshold: float = 0.5) -> jax.Array:
+    """Binary precision after thresholding.
+
+    Reference: ``functional/classification/precision.py:17-52``.
+    """
+    input, target = as_jax(input), as_jax(target)
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same dimensions, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    num_tp, num_fp, num_label = _binary_precision_update(input, target, threshold)
+    return _precision_compute(num_tp, num_fp, num_label, "micro")

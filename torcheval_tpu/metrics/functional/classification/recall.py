@@ -1,0 +1,178 @@
+"""Recall (binary / multiclass).
+
+Reference: ``torcheval/metrics/functional/classification/recall.py``
+(update ``:153-179``, compute ``:182-212``). Static-shape ``jnp.where``
+averaging; NaN classes (no ground-truth instances) become zero with a warning,
+as in the reference (``recall.py:195-202``).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.ops.confusion import class_counts
+from torcheval_tpu.utils.convert import as_jax
+
+_logger = logging.getLogger(__name__)
+
+_AVERAGE_OPTIONS = ("micro", "macro", "weighted", None)
+
+
+def _recall_param_check(num_classes: Optional[int], average: Optional[str]) -> None:
+    if average not in _AVERAGE_OPTIONS:
+        raise ValueError(
+            f"`average` was not in the allowed values of {_AVERAGE_OPTIONS}, "
+            f"got {average}."
+        )
+    if average != "micro" and (num_classes is None or num_classes <= 0):
+        raise ValueError(
+            f"`num_classes` should be a positive number when average={average}, "
+            f"got num_classes={num_classes}."
+        )
+
+
+def _recall_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not input.ndim == 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample,) or (num_sample, num_classes), "
+            f"got {input.shape}."
+        )
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _recall_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    input = input.astype(jnp.int32)
+    target = target.astype(jnp.int32)
+    if average == "micro":
+        num_tp = (input == target).sum(dtype=jnp.int32)
+        n = jnp.asarray(target.size, dtype=jnp.int32)
+        return num_tp, n, n
+    correct = (input == target).astype(jnp.int32)
+    num_labels = class_counts(target, num_classes)
+    num_predictions = class_counts(input, num_classes)
+    num_tp = class_counts(target, num_classes, correct)
+    return num_tp, num_labels, num_predictions
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _recall_compute(
+    num_tp: jax.Array,
+    num_labels: jax.Array,
+    num_predictions: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    num_tp = num_tp.astype(jnp.float32)
+    num_labels_f = num_labels.astype(jnp.float32)
+    num_predictions_f = num_predictions.astype(jnp.float32)
+    recall = jnp.where(
+        num_labels_f > 0, num_tp / jnp.maximum(num_labels_f, 1.0), 0.0
+    )
+    if average == "micro":
+        return recall
+    if average == "macro":
+        mask = (num_labels_f != 0) | (num_predictions_f != 0)
+        return jnp.where(mask, recall, 0.0).sum() / jnp.maximum(mask.sum(), 1)
+    if average == "weighted":
+        weights = num_labels_f / jnp.maximum(num_labels_f.sum(), 1.0)
+        return (recall * weights).sum()
+    return recall  # average is None
+
+
+@jax.jit
+def _binary_recall_update(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array]:
+    pred = jnp.where(input < threshold, 0, 1)
+    tgt = target.astype(jnp.int32)
+    num_tp = (pred & tgt).sum(dtype=jnp.int32)
+    num_true_labels = tgt.sum(dtype=jnp.int32)
+    return num_tp, num_true_labels
+
+
+def _warn_nan_recall(num_labels) -> None:
+    labels = np.asarray(num_labels)
+    if labels.ndim and (labels == 0).any():
+        nan_classes = np.nonzero(labels == 0)[0]
+        _logger.warning(
+            f"One or more NaNs identified, as no ground-truth instances of "
+            f"{nan_classes.tolist()} have been seen. These have been converted to zero."
+        )
+
+
+def multiclass_recall(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+) -> jax.Array:
+    """TP / (TP + FN), multiclass.
+
+    Reference: ``functional/classification/recall.py:96-151``.
+    """
+    _recall_param_check(num_classes, average)
+    input, target = as_jax(input), as_jax(target)
+    _recall_input_check(input, target, num_classes)
+    num_tp, num_labels, num_predictions = _recall_update(
+        input, target, num_classes, average
+    )
+    if average != "micro":
+        _warn_nan_recall(num_labels)
+    return _recall_compute(num_tp, num_labels, num_predictions, average)
+
+
+def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
+    """Binary recall after thresholding.
+
+    Reference: ``functional/classification/recall.py:14-46``.
+    """
+    input, target = as_jax(input), as_jax(target)
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same dimensions, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    num_tp, num_true_labels = _binary_recall_update(input, target, threshold)
+    return _binary_recall_compute(num_tp, num_true_labels)
+
+
+def _binary_recall_compute(num_tp, num_true_labels) -> jax.Array:
+    if int(num_true_labels) == 0:
+        _logger.warning(
+            "One or more NaNs identified, as no ground-truth instances have "
+            "been seen. These have been converted to zero."
+        )
+    recall = num_tp.astype(jnp.float32) / jnp.maximum(
+        num_true_labels.astype(jnp.float32), 1.0
+    )
+    return jnp.where(num_true_labels > 0, recall, 0.0)

@@ -144,7 +144,10 @@ class Metric(Generic[TComputeReturn], ABC):
         the metric's current device)."""
         for name, default in self._state_name_to_default.items():
             value = put_state(copy_state(default), self._device)
-            if isinstance(default, dict):
+            if isinstance(default, dict) and not isinstance(value, defaultdict):
+                # plain-dict defaults gain the reference's missing-key-is-zero
+                # semantics after reset (metric.py:139-147); registered
+                # defaultdicts keep their own factory (copy_state preserves it)
                 d = defaultdict(_zero_scalar)
                 d.update(value)
                 value = d
