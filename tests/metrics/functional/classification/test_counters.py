@@ -284,10 +284,6 @@ class TestClassCountsMethods(unittest.TestCase):
         np.testing.assert_allclose(np.asarray(wa), np.asarray(wb), rtol=1e-5)
 
 
-if __name__ == "__main__":
-    unittest.main()
-
-
 class TestConfusionOutOfRange(unittest.TestCase):
     def test_partial_out_of_range_sample_is_dropped(self):
         # a sample with one bad coordinate must not fold into a valid cell
@@ -297,3 +293,7 @@ class TestConfusionOutOfRange(unittest.TestCase):
         expected = np.zeros((3, 3), dtype=np.int32)
         expected[0, 0] = 1
         np.testing.assert_array_equal(np.asarray(mat), expected)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,101 @@
+"""Functional regression metrics vs sklearn oracles (SURVEY §4 tier 1)."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import mean_squared_error as sk_mse
+from sklearn.metrics import r2_score as sk_r2
+
+from torcheval_tpu.metrics.functional import mean_squared_error, r2_score
+
+
+class TestMeanSquaredError(unittest.TestCase):
+    def _check(self, input, target, sample_weight=None, multioutput="uniform_average"):
+        got = mean_squared_error(
+            input, target, sample_weight=sample_weight, multioutput=multioutput
+        )
+        sk_multi = "raw_values" if multioutput == "raw_values" else "uniform_average"
+        want = sk_mse(
+            target, input, sample_weight=sample_weight, multioutput=sk_multi
+        )
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    def test_1d(self):
+        rng = np.random.default_rng(0)
+        self._check(rng.random(100).astype(np.float32), rng.random(100).astype(np.float32))
+
+    def test_2d(self):
+        rng = np.random.default_rng(1)
+        x = rng.random((50, 3)).astype(np.float32)
+        y = rng.random((50, 3)).astype(np.float32)
+        self._check(x, y)
+        self._check(x, y, multioutput="raw_values")
+
+    def test_weighted(self):
+        rng = np.random.default_rng(2)
+        x = rng.random((40, 2)).astype(np.float32)
+        y = rng.random((40, 2)).astype(np.float32)
+        w = rng.random(40).astype(np.float32)
+        self._check(x, y, sample_weight=w)
+        self._check(x, y, sample_weight=w, multioutput="raw_values")
+
+    def test_docstring_values(self):
+        got = mean_squared_error(
+            np.array([0.9, 0.5, 0.3, 0.5]), np.array([0.5, 0.8, 0.2, 0.8])
+        )
+        np.testing.assert_allclose(float(got), 0.0875, rtol=1e-5)
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "multioutput"):
+            mean_squared_error(np.zeros(4), np.zeros(4), multioutput="bogus")
+        with self.assertRaisesRegex(ValueError, "same size"):
+            mean_squared_error(np.zeros(4), np.zeros(5))
+        with self.assertRaisesRegex(ValueError, "1D or 2D"):
+            mean_squared_error(np.zeros((2, 2, 2)), np.zeros((2, 2, 2)))
+        with self.assertRaisesRegex(ValueError, "sample_weight"):
+            mean_squared_error(
+                np.zeros(4), np.zeros(4), sample_weight=np.ones(3)
+            )
+
+
+class TestR2Score(unittest.TestCase):
+    def _check(self, input, target, multioutput="uniform_average"):
+        got = r2_score(input, target, multioutput=multioutput)
+        want = sk_r2(target, input, multioutput=multioutput)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-6)
+
+    def test_1d(self):
+        rng = np.random.default_rng(3)
+        y = rng.random(100).astype(np.float32)
+        x = y + 0.1 * rng.random(100).astype(np.float32)
+        self._check(x, y)
+
+    def test_2d_all_multioutput(self):
+        rng = np.random.default_rng(4)
+        y = rng.random((60, 3)).astype(np.float32)
+        x = y + 0.05 * rng.standard_normal((60, 3)).astype(np.float32)
+        for mo in ("uniform_average", "raw_values", "variance_weighted"):
+            self._check(x, y, multioutput=mo)
+
+    def test_adjusted(self):
+        got = r2_score(
+            np.array([1.2, 2.5, 3.6, 4.5, 6.0]),
+            np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+            multioutput="raw_values",
+            num_regressors=2,
+        )
+        np.testing.assert_allclose(float(got), 0.62, rtol=1e-4)
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "multioutput"):
+            r2_score(np.zeros(4), np.zeros(4), multioutput="bogus")
+        with self.assertRaisesRegex(ValueError, "num_regressors"):
+            r2_score(np.zeros(4), np.zeros(4), num_regressors=-1)
+        with self.assertRaisesRegex(ValueError, "num_regressors"):
+            r2_score(np.arange(4.0), np.arange(4.0), num_regressors=3)
+        with self.assertRaisesRegex(ValueError, "at least two samples"):
+            r2_score(np.zeros(1), np.zeros(1))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,114 @@
+"""Class regression metrics through the protocol harness (SURVEY §4 tier 2)."""
+
+import numpy as np
+from sklearn.metrics import mean_squared_error as sk_mse
+from sklearn.metrics import r2_score as sk_r2
+
+from torcheval_tpu.metrics import MeanSquaredError, R2Score
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+
+class TestMeanSquaredErrorClass(MetricClassTester):
+    def test_mse_1d(self):
+        rng = np.random.default_rng(10)
+        input = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        target = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={"input": input, "target": target},
+            compute_result=sk_mse(target.reshape(-1), input.reshape(-1)),
+        )
+
+    def test_mse_multioutput_raw(self):
+        rng = np.random.default_rng(11)
+        input = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 3)).astype(np.float32)
+        target = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 3)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(multioutput="raw_values"),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={"input": input, "target": target},
+            compute_result=sk_mse(
+                target.reshape(-1, 3), input.reshape(-1, 3), multioutput="raw_values"
+            ),
+        )
+
+    def test_mse_weighted(self):
+        rng = np.random.default_rng(12)
+        input = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        target = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        weight = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={
+                "input": input,
+                "target": target,
+                "sample_weight": weight,
+            },
+            compute_result=sk_mse(
+                target.reshape(-1), input.reshape(-1), sample_weight=weight.reshape(-1)
+            ),
+        )
+
+
+class TestR2ScoreClass(MetricClassTester):
+    def test_r2_1d(self):
+        rng = np.random.default_rng(13)
+        target = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        input = (target + 0.1 * rng.standard_normal(target.shape)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=R2Score(),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": input, "target": target},
+            compute_result=sk_r2(target.reshape(-1), input.reshape(-1)),
+        )
+
+    def test_r2_variance_weighted_multioutput(self):
+        rng = np.random.default_rng(14)
+        target = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 2)).astype(np.float32)
+        input = (target + 0.05 * rng.standard_normal(target.shape)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=R2Score(multioutput="variance_weighted"),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": input, "target": target},
+            compute_result=sk_r2(
+                target.reshape(-1, 2),
+                input.reshape(-1, 2),
+                multioutput="variance_weighted",
+            ),
+            atol=1e-4,
+        )
+
+    def test_r2_adjusted(self):
+        rng = np.random.default_rng(15)
+        target = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        input = (target + 0.1 * rng.standard_normal(target.shape)).astype(np.float32)
+        n = NUM_TOTAL_UPDATES * BATCH_SIZE
+        plain = sk_r2(target.reshape(-1), input.reshape(-1))
+        adjusted = 1 - (1 - plain) * (n - 1) / (n - 3 - 1)
+        self.run_class_implementation_tests(
+            metric=R2Score(num_regressors=3),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": input, "target": target},
+            compute_result=adjusted,
+        )

@@ -15,6 +15,7 @@ from torcheval_tpu.metrics.classification import (
     TopKMultilabelAccuracy,
 )
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
 from torcheval_tpu.metrics.state import Reduction
 
 __all__ = [
@@ -32,6 +33,7 @@ __all__ = [
     "Cat",
     "Max",
     "Mean",
+    "MeanSquaredError",
     "Min",
     "MulticlassAccuracy",
     "MulticlassConfusionMatrix",
@@ -39,6 +41,7 @@ __all__ = [
     "MulticlassPrecision",
     "MulticlassRecall",
     "MultilabelAccuracy",
+    "R2Score",
     "Sum",
     "Throughput",
     "TopKMultilabelAccuracy",

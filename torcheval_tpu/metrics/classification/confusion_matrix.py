@@ -40,7 +40,7 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
 
     def update(self, input, target) -> "MulticlassConfusionMatrix":
         input, target = self._input(input), self._input(target)
-        _confusion_matrix_input_check(input, target)
+        _confusion_matrix_input_check(input, target, self.num_classes)
         if input.ndim == 2:
             input = jnp.argmax(input, axis=1)
         self.confusion_matrix = self.confusion_matrix + confusion_matrix_counts(

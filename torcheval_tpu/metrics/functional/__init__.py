@@ -13,6 +13,7 @@ from torcheval_tpu.metrics.functional.classification import (
     multilabel_accuracy,
     topk_multilabel_accuracy,
 )
+from torcheval_tpu.metrics.functional.regression import mean_squared_error, r2_score
 
 __all__ = [
     "binary_accuracy",
@@ -21,12 +22,14 @@ __all__ = [
     "binary_precision",
     "binary_recall",
     "mean",
+    "mean_squared_error",
     "multiclass_accuracy",
     "multiclass_confusion_matrix",
     "multiclass_f1_score",
     "multiclass_precision",
     "multiclass_recall",
     "multilabel_accuracy",
+    "r2_score",
     "sum",
     "topk_multilabel_accuracy",
 ]

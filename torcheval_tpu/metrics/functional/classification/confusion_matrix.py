@@ -28,7 +28,9 @@ def _confusion_matrix_param_check(num_classes, normalize) -> None:
         )
 
 
-def _confusion_matrix_input_check(input: jax.Array, target: jax.Array) -> None:
+def _confusion_matrix_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int] = None
+) -> None:
     if input.shape[0] != target.shape[0]:
         raise ValueError(
             "The `input` and `target` should have the same first dimension, "
@@ -37,6 +39,13 @@ def _confusion_matrix_input_check(input: jax.Array, target: jax.Array) -> None:
     if target.ndim != 1:
         raise ValueError(
             f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not input.ndim == 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample,) or (num_sample, num_classes), "
+            f"got {input.shape}."
         )
 
 
@@ -51,7 +60,7 @@ def multiclass_confusion_matrix(
     ``(n,)`` or scores ``(n, c)`` (argmax applied)."""
     _confusion_matrix_param_check(num_classes, normalize)
     input, target = as_jax(input), as_jax(target)
-    _confusion_matrix_input_check(input, target)
+    _confusion_matrix_input_check(input, target, num_classes)
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
     return confusion_matrix_counts(input, target, num_classes, normalize=normalize)

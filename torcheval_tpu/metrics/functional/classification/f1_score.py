@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu.ops.confusion import class_counts
 from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.tracing import is_concrete
 
 _logger = logging.getLogger(__name__)
 
@@ -124,6 +125,8 @@ def _binary_f1_score_update(
 def _warn_empty_classes(num_label) -> None:
     import numpy as np
 
+    if not is_concrete(num_label):
+        return
     if np.asarray(num_label).ndim and (np.asarray(num_label) == 0).any():
         _logger.warning(
             "Some classes do not exist in the target. "

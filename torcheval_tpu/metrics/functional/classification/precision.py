@@ -17,6 +17,7 @@ import numpy as np
 
 from torcheval_tpu.ops.confusion import class_counts
 from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.tracing import is_concrete
 
 _logger = logging.getLogger(__name__)
 
@@ -113,6 +114,8 @@ def _binary_precision_update(
 
 
 def _warn_nan_classes(num_tp, num_fp, what: str) -> None:
+    if not (is_concrete(num_tp) and is_concrete(num_fp)):
+        return
     tp, fp = np.asarray(num_tp), np.asarray(num_fp)
     if tp.ndim and ((tp + fp) == 0).any():
         bad = np.nonzero((tp + fp) == 0)[0]

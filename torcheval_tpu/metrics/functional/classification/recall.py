@@ -18,6 +18,7 @@ import numpy as np
 
 from torcheval_tpu.ops.confusion import class_counts
 from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.tracing import is_concrete
 
 _logger = logging.getLogger(__name__)
 
@@ -116,6 +117,8 @@ def _binary_recall_update(
 
 
 def _warn_nan_recall(num_labels) -> None:
+    if not is_concrete(num_labels):
+        return
     labels = np.asarray(num_labels)
     if labels.ndim and (labels == 0).any():
         nan_classes = np.nonzero(labels == 0)[0]
@@ -167,7 +170,7 @@ def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
 
 
 def _binary_recall_compute(num_tp, num_true_labels) -> jax.Array:
-    if int(num_true_labels) == 0:
+    if is_concrete(num_true_labels) and int(num_true_labels) == 0:
         _logger.warning(
             "One or more NaNs identified, as no ground-truth instances have "
             "been seen. These have been converted to zero."

@@ -1,0 +1,4 @@
+from torcheval_tpu.metrics.regression.mean_squared_error import MeanSquaredError
+from torcheval_tpu.metrics.regression.r2_score import R2Score
+
+__all__ = ["MeanSquaredError", "R2Score"]

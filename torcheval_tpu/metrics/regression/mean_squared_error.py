@@ -1,0 +1,83 @@
+"""MeanSquaredError metric. Reference:
+``torcheval/metrics/regression/mean_squared_error.py``.
+
+The reference's ``sum_squared_error`` starts scalar and is lazily promoted to
+``(n_output,)`` on the first 2-D update (``mean_squared_error.py:80-84,
+108-113``); here JAX broadcasting performs the same promotion for free —
+``zeros(()) + vec`` yields ``vec``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_param_check,
+    _mean_squared_error_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class MeanSquaredError(Metric[jax.Array]):
+    """Streaming mean squared error with optional per-sample weights.
+
+    Args:
+        multioutput: ``"uniform_average"`` (default) or ``"raw_values"``.
+
+    Reference parity: ``regression/mean_squared_error.py:23-140``.
+    """
+
+    def __init__(
+        self,
+        *,
+        multioutput: str = "uniform_average",
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _mean_squared_error_param_check(multioutput)
+        self.multioutput = multioutput
+        self._add_state("sum_squared_error", jnp.zeros(()), reduction=Reduction.SUM)
+        # int32 while updates are unweighted (exact counting to 2**31);
+        # a weighted update promotes the accumulator to float32
+        self._add_state(
+            "sum_weight", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM
+        )
+
+    def update(
+        self,
+        input,
+        target,
+        *,
+        sample_weight: Optional[jax.Array] = None,
+    ) -> "MeanSquaredError":
+        input = self._input(input)
+        target = self._input(target)
+        if sample_weight is not None:
+            sample_weight = self._input(sample_weight)
+        sse, sw = _mean_squared_error_update(input, target, sample_weight)
+        self.sum_squared_error = self.sum_squared_error + sse
+        self.sum_weight = self.sum_weight + sw
+        return self
+
+    def compute(self) -> jax.Array:
+        return _mean_squared_error_compute(
+            self.sum_squared_error, self.multioutput, self.sum_weight
+        )
+
+    def merge_state(
+        self, metrics: Iterable["MeanSquaredError"]
+    ) -> "MeanSquaredError":
+        for metric in metrics:
+            self.sum_squared_error = self.sum_squared_error + jax.device_put(
+                metric.sum_squared_error, self.device
+            )
+            self.sum_weight = self.sum_weight + jax.device_put(
+                metric.sum_weight, self.device
+            )
+        return self

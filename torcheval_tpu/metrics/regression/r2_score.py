@@ -1,0 +1,86 @@
+"""R2Score metric. Reference: ``torcheval/metrics/regression/r2_score.py``."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.r2_score import (
+    _r2_score_compute,
+    _r2_score_param_check,
+    _r2_score_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+_STATE_NAMES = (
+    "sum_squared_obs",
+    "sum_obs",
+    "sum_squared_residual",
+    "num_obs",
+)
+
+
+class R2Score(Metric[jax.Array]):
+    """Streaming R-squared score over four sufficient statistics.
+
+    Args:
+        multioutput: ``"uniform_average"`` (default), ``"raw_values"``, or
+            ``"variance_weighted"``.
+        num_regressors: independent-variable count for adjusted R²
+            (0 = standard R²).
+
+    Reference parity: ``regression/r2_score.py:23-162``.
+    """
+
+    def __init__(
+        self,
+        *,
+        multioutput: str = "uniform_average",
+        num_regressors: int = 0,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _r2_score_param_check(multioutput, num_regressors)
+        self.multioutput = multioutput
+        self.num_regressors = num_regressors
+        for name in _STATE_NAMES:
+            # num_obs counts in int32 (exact to 2**31 samples)
+            default = (
+                jnp.zeros((), dtype=jnp.int32)
+                if name == "num_obs"
+                else jnp.zeros(())
+            )
+            self._add_state(name, default, reduction=Reduction.SUM)
+
+    def update(self, input, target) -> "R2Score":
+        input = self._input(input)
+        target = self._input(target)
+        stats = _r2_score_update(input, target)
+        for name, value in zip(_STATE_NAMES, stats):
+            setattr(self, name, getattr(self, name) + value)
+        return self
+
+    def compute(self) -> jax.Array:
+        return _r2_score_compute(
+            self.sum_squared_obs,
+            self.sum_obs,
+            self.sum_squared_residual,
+            self.num_obs,
+            self.multioutput,
+            self.num_regressors,
+        )
+
+    def merge_state(self, metrics: Iterable["R2Score"]) -> "R2Score":
+        for metric in metrics:
+            for name in _STATE_NAMES:
+                setattr(
+                    self,
+                    name,
+                    getattr(self, name)
+                    + jax.device_put(getattr(metric, name), self.device),
+                )
+        return self

@@ -1,13 +1,11 @@
 from torcheval_tpu.ops.confusion import (
     class_counts,
     confusion_matrix_counts,
-    topk_membership,
     topk_onehot,
 )
 
 __all__ = [
     "class_counts",
     "confusion_matrix_counts",
-    "topk_membership",
     "topk_onehot",
 ]

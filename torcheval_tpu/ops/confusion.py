@@ -114,21 +114,6 @@ def normalize_confusion_matrix(mat: jax.Array, normalize: Optional[str]) -> jax.
 
 
 @partial(jax.jit, static_argnames=("k",))
-def topk_membership(scores: jax.Array, k: int) -> jax.Array:
-    """Boolean (N, C) mask of whether each class is among the row's top-k
-    scores, computed rank-style (score > kth-largest) without materialising
-    ``jax.lax.top_k`` gather indices — stays dense and MXU/VPU-friendly.
-
-    Ties resolve like the reference's rank test (``accuracy.py:261-263``):
-    a class is in the top-k iff strictly fewer than k scores exceed it, which
-    is equivalent to ``score >= kth_largest`` (at most k-1 scores can be
-    strictly greater than the k-th largest).
-    """
-    kth = jax.lax.top_k(scores, k)[0][..., k - 1 : k]  # (N, 1) kth largest
-    return scores >= kth
-
-
-@partial(jax.jit, static_argnames=("k",))
 def topk_onehot(scores: jax.Array, k: int) -> jax.Array:
     """Exactly-k 0/1 membership matrix (N, C): 1 for the k top-scoring classes
     per row (ties broken by index, like ``torch.topk`` scatter — reference

@@ -1,0 +1,18 @@
+"""Trace-safety helpers.
+
+The reference logs warnings on degenerate values (e.g. NaN recall classes,
+``recall.py:195-202``), which requires reading values back to the host. Under
+``jax.jit`` those values are tracers with no concrete data, and even outside
+jit a read blocks the async dispatch stream. Callers gate every such warning
+on :func:`is_concrete` so jitted code stays pure and traceable; the warning
+simply does not fire inside a compiled computation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_concrete(x) -> bool:
+    """True when ``x`` holds real data (not a tracer inside jit/vmap/grad)."""
+    return not isinstance(x, jax.core.Tracer)
