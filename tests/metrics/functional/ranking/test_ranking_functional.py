@@ -1,0 +1,126 @@
+"""Functional ranking metrics (SURVEY §4 tier 1): reference docstring values
+plus a brute-force numpy oracle for random inputs."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import (
+    frequency_at_k,
+    hit_rate,
+    num_collisions,
+    reciprocal_rank,
+)
+
+INPUT = np.array(
+    [[0.3, 0.1, 0.6], [0.5, 0.2, 0.3], [0.2, 0.1, 0.7], [0.3, 0.3, 0.4]],
+    dtype=np.float32,
+)
+TARGET = np.array([2, 1, 1, 0])
+
+
+def _ranks(scores: np.ndarray, target: np.ndarray) -> np.ndarray:
+    y = scores[np.arange(len(target)), target]
+    return (scores > y[:, None]).sum(axis=1)
+
+
+class TestHitRate(unittest.TestCase):
+    def test_docstring(self):
+        np.testing.assert_allclose(
+            np.asarray(hit_rate(INPUT, TARGET, k=2)), [1.0, 0.0, 0.0, 1.0]
+        )
+
+    def test_k_none_all_hit(self):
+        np.testing.assert_allclose(
+            np.asarray(hit_rate(INPUT, TARGET)), np.ones(4)
+        )
+
+    def test_random_vs_oracle(self):
+        rng = np.random.default_rng(0)
+        scores = rng.random((200, 17)).astype(np.float32)
+        target = rng.integers(0, 17, 200)
+        for k in (1, 3, 16):
+            want = (_ranks(scores, target) < k).astype(np.float32)
+            np.testing.assert_allclose(
+                np.asarray(hit_rate(scores, target, k=k)), want
+            )
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "two-dimensional"):
+            hit_rate(np.zeros(4), TARGET)
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            hit_rate(INPUT, INPUT)
+        with self.assertRaisesRegex(ValueError, "minibatch"):
+            hit_rate(INPUT, np.array([0, 1]))
+        with self.assertRaisesRegex(ValueError, "positive"):
+            hit_rate(INPUT, TARGET, k=0)
+
+
+class TestReciprocalRank(unittest.TestCase):
+    def test_docstring(self):
+        np.testing.assert_allclose(
+            np.asarray(reciprocal_rank(INPUT, TARGET)),
+            [1.0, 1 / 3, 1 / 3, 0.5],
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(reciprocal_rank(INPUT, TARGET, k=2)),
+            [1.0, 0.0, 0.0, 0.5],
+            rtol=1e-6,
+        )
+
+    def test_random_vs_oracle(self):
+        rng = np.random.default_rng(1)
+        scores = rng.random((100, 9)).astype(np.float32)
+        target = rng.integers(0, 9, 100)
+        rank = _ranks(scores, target)
+        want = 1.0 / (rank + 1.0)
+        np.testing.assert_allclose(
+            np.asarray(reciprocal_rank(scores, target)), want, rtol=1e-6
+        )
+        want_k = np.where(rank >= 3, 0.0, want)
+        np.testing.assert_allclose(
+            np.asarray(reciprocal_rank(scores, target, k=3)), want_k, rtol=1e-6
+        )
+
+
+class TestNumCollisions(unittest.TestCase):
+    def test_docstring(self):
+        np.testing.assert_array_equal(
+            np.asarray(num_collisions(np.array([3, 4, 2, 3]))), [1, 0, 0, 1]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(num_collisions(np.array([3, 4, 1, 3, 1, 1, 5]))),
+            [1, 0, 2, 1, 2, 2, 0],
+        )
+
+    def test_random_vs_oracle(self):
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 50, 500)
+        counts = np.bincount(ids, minlength=50)
+        want = counts[ids] - 1
+        np.testing.assert_array_equal(np.asarray(num_collisions(ids)), want)
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "integer"):
+            num_collisions(np.array([0.5, 1.0]))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            num_collisions(np.zeros((2, 2), dtype=np.int32))
+
+
+class TestFrequencyAtK(unittest.TestCase):
+    def test_docstring(self):
+        np.testing.assert_allclose(
+            np.asarray(frequency_at_k(np.array([0.3, 0.1, 0.6]), k=0.5)),
+            [1.0, 1.0, 0.0],
+        )
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "negative"):
+            frequency_at_k(np.array([0.3]), k=-1.0)
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            frequency_at_k(np.zeros((2, 2)), k=0.5)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,72 @@
+"""Class ranking metrics through the protocol harness (SURVEY §4 tier 2).
+
+Per-sample-vector metrics: the N-way merge reorders samples (rank-major), so
+``merge_and_compute_result`` differs from the streaming result exactly like
+the reference's list-state tests.
+"""
+
+import numpy as np
+
+from torcheval_tpu.metrics import HitRate, ReciprocalRank
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_PROCESSES,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+NUM_CLASSES = 7
+
+
+def _ranks(scores: np.ndarray, target: np.ndarray) -> np.ndarray:
+    y = np.take_along_axis(scores, target[..., None], axis=-1)[..., 0]
+    return (scores > y[..., None]).sum(axis=-1)
+
+
+def _rank_major(per_update: np.ndarray) -> np.ndarray:
+    """Reorder a (num_updates, batch) result the way a NUM_PROCESSES-way merge
+    concatenates it: each rank's contiguous slice of updates, in rank order."""
+    per_rank = NUM_TOTAL_UPDATES // NUM_PROCESSES
+    chunks = [
+        per_update[r * per_rank : (r + 1) * per_rank].reshape(-1)
+        for r in range(NUM_PROCESSES)
+    ]
+    return np.concatenate(chunks)
+
+
+class TestHitRateClass(MetricClassTester):
+    def test_hit_rate(self):
+        rng = np.random.default_rng(20)
+        scores = rng.random(
+            (NUM_TOTAL_UPDATES, BATCH_SIZE, NUM_CLASSES)
+        ).astype(np.float32)
+        target = rng.integers(0, NUM_CLASSES, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        hits = (_ranks(scores, target) < 3).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=HitRate(k=3),
+            state_names={"scores"},
+            update_kwargs={"input": scores, "target": target},
+            compute_result=hits.reshape(-1),
+            merge_and_compute_result=_rank_major(hits),
+        )
+
+
+class TestReciprocalRankClass(MetricClassTester):
+    def test_reciprocal_rank(self):
+        rng = np.random.default_rng(21)
+        scores = rng.random(
+            (NUM_TOTAL_UPDATES, BATCH_SIZE, NUM_CLASSES)
+        ).astype(np.float32)
+        target = rng.integers(0, NUM_CLASSES, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        rr = 1.0 / (_ranks(scores, target) + 1.0)
+        self.run_class_implementation_tests(
+            metric=ReciprocalRank(),
+            state_names={"scores"},
+            update_kwargs={"input": scores, "target": target},
+            compute_result=rr.reshape(-1).astype(np.float32),
+            merge_and_compute_result=_rank_major(rr).astype(np.float32),
+        )
+
+    def test_empty_compute(self):
+        self.assertEqual(ReciprocalRank().compute().shape, (0,))
+        self.assertEqual(HitRate().compute().shape, (0,))

@@ -15,6 +15,7 @@ from torcheval_tpu.metrics.classification import (
     TopKMultilabelAccuracy,
 )
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.ranking import HitRate, ReciprocalRank
 from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
 from torcheval_tpu.metrics.state import Reduction
 
@@ -31,6 +32,7 @@ __all__ = [
     "BinaryPrecision",
     "BinaryRecall",
     "Cat",
+    "HitRate",
     "Max",
     "Mean",
     "MeanSquaredError",
@@ -42,6 +44,7 @@ __all__ = [
     "MulticlassRecall",
     "MultilabelAccuracy",
     "R2Score",
+    "ReciprocalRank",
     "Sum",
     "Throughput",
     "TopKMultilabelAccuracy",

@@ -8,16 +8,14 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class Cat(Metric[jax.Array]):
+class Cat(SampleCacheMetric[jax.Array]):
     """Concatenate all input arrays along ``dim``.
 
-    Sample-cache metric: state is a Python list of device arrays (appends are
-    O(1) host ops; no device work until :meth:`compute`).
     Reference parity: ``aggregation/cat.py:24-96``, including the quirk that
     merging concatenates each source metric's cache along *that metric's*
     ``dim`` before appending.
@@ -28,9 +26,10 @@ class Cat(Metric[jax.Array]):
         self.dim = dim
         # Reduction.CAT means axis-0 all_gather concat; for dim != 0 the sync
         # layer must fall back to merge_state, so declare CUSTOM there.
-        self._add_state(
-            "inputs", [], reduction=Reduction.CAT if dim == 0 else Reduction.CUSTOM
-        )
+        if dim == 0:
+            self._add_cache_state("inputs")
+        else:
+            self._add_state("inputs", [], reduction=Reduction.CUSTOM)
 
     def update(self, input: jax.Array) -> "Cat":
         self.inputs.append(self._input(input))

@@ -13,6 +13,12 @@ from torcheval_tpu.metrics.functional.classification import (
     multilabel_accuracy,
     topk_multilabel_accuracy,
 )
+from torcheval_tpu.metrics.functional.ranking import (
+    frequency_at_k,
+    hit_rate,
+    num_collisions,
+    reciprocal_rank,
+)
 from torcheval_tpu.metrics.functional.regression import mean_squared_error, r2_score
 
 __all__ = [
@@ -21,6 +27,8 @@ __all__ = [
     "binary_f1_score",
     "binary_precision",
     "binary_recall",
+    "frequency_at_k",
+    "hit_rate",
     "mean",
     "mean_squared_error",
     "multiclass_accuracy",
@@ -29,7 +37,9 @@ __all__ = [
     "multiclass_precision",
     "multiclass_recall",
     "multilabel_accuracy",
+    "num_collisions",
     "r2_score",
+    "reciprocal_rank",
     "sum",
     "topk_multilabel_accuracy",
 ]

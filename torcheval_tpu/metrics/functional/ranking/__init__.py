@@ -1,0 +1,11 @@
+from torcheval_tpu.metrics.functional.ranking.frequency import frequency_at_k
+from torcheval_tpu.metrics.functional.ranking.hit_rate import hit_rate
+from torcheval_tpu.metrics.functional.ranking.num_collisions import num_collisions
+from torcheval_tpu.metrics.functional.ranking.reciprocal_rank import reciprocal_rank
+
+__all__ = [
+    "frequency_at_k",
+    "hit_rate",
+    "num_collisions",
+    "reciprocal_rank",
+]

@@ -1,0 +1,30 @@
+"""Frequency threshold indicator. Reference:
+``torcheval/metrics/functional/ranking/frequency.py:13-43``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _frequency_input_check(input: jax.Array, k: float) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if k < 0:
+        raise ValueError(f"k should not be negative, got {k}.")
+
+
+def frequency_at_k(input, k: float) -> jax.Array:
+    """Binary indicator ``1.0`` where ``input < k`` (frequency below threshold).
+
+    Args:
+        input: 1-D frequencies.
+        k: non-negative threshold.
+    """
+    input = as_jax(input)
+    _frequency_input_check(input, k)
+    return (input < k).astype(jnp.float32)

@@ -1,0 +1,81 @@
+"""Hit rate @ k. Reference:
+``torcheval/metrics/functional/ranking/hit_rate.py:13-67``.
+
+The rank test gathers only the target's score and counts how many scores
+strictly exceed it — O(N·C) elementwise compare + row reduce, no top-k sort
+and no (N, k) gather, so XLA fuses it into one pass over the score matrix.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.tracing import is_concrete
+
+
+def _target_range_check(input: jax.Array, target: jax.Array) -> None:
+    """Reject out-of-range target indices, which ``take_along_axis`` would
+    otherwise silently clamp (torch's ``gather`` raises — parity). Only runs
+    on concrete arrays: inside jit the kernels NaN-poison invalid rows
+    instead, keeping the traced path pure and sync-free."""
+    if not is_concrete(target):
+        return
+    import numpy as np
+
+    t = np.asarray(target)
+    if t.size and (t.min() < 0 or t.max() >= input.shape[-1]):
+        raise ValueError(
+            f"target indices must be in [0, {input.shape[-1]}), got values in "
+            f"[{t.min()}, {t.max()}]."
+        )
+
+
+def _hit_rate_input_check(
+    input: jax.Array, target: jax.Array, k: Optional[int] = None
+) -> None:
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch dimension, "
+            f"got shapes {input.shape} and {target.shape}, respectively."
+        )
+    if k is not None and k <= 0:
+        raise ValueError(f"k should be None or positive, got {k}.")
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _hit_rate_kernel(input: jax.Array, target: jax.Array, k: int) -> jax.Array:
+    target = target.astype(jnp.int32)
+    y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+    rank = jnp.sum(input > y_score, axis=-1)
+    hit = (rank < k).astype(jnp.float32)
+    valid = (target >= 0) & (target < input.shape[-1])
+    return jnp.where(valid, hit, jnp.nan)
+
+
+def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
+    """Per-sample indicator of the target class ranking in the top ``k``.
+
+    Args:
+        input: scores/logits ``(num_samples, num_classes)``.
+        target: class indices ``(num_samples,)``.
+        k: top-k cutoff; ``None`` (or ``k >= num_classes``) hits everything.
+    """
+    input, target = as_jax(input), as_jax(target)
+    _hit_rate_input_check(input, target, k)
+    _target_range_check(input, target)
+    if k is None or k >= input.shape[-1]:
+        return jnp.ones(target.shape, dtype=jnp.float32)
+    return _hit_rate_kernel(input, target, k)

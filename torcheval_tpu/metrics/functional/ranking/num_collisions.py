@@ -1,0 +1,43 @@
+"""Per-id collision counts. Reference:
+``torcheval/metrics/functional/ranking/num_collisions.py:11-52``.
+
+The reference materialises an (N, N) equality matrix — O(N²) memory
+(``num_collisions.py:33-36``). The TPU kernel instead sorts once and binary-
+searches each id against the sorted array: ``count(id) = right - left``,
+O(N log N) compute, O(N) memory, all static-shape XLA ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _num_collisions_input_check(input: jax.Array) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if not jnp.issubdtype(input.dtype, jnp.integer):
+        raise ValueError(f"input should be an integer tensor, got {input.dtype}.")
+
+
+@jax.jit
+def _num_collisions_kernel(input: jax.Array) -> jax.Array:
+    sorted_ids = jnp.sort(input)
+    left = jnp.searchsorted(sorted_ids, input, side="left")
+    right = jnp.searchsorted(sorted_ids, input, side="right")
+    return (right - left - 1).astype(jnp.int32)
+
+
+def num_collisions(input) -> jax.Array:
+    """For each id, the number of *other* occurrences of the same id.
+
+    Args:
+        input: 1-D integer ids ``(num_samples,)``.
+    """
+    input = as_jax(input)
+    _num_collisions_input_check(input)
+    return _num_collisions_kernel(input)

@@ -1,0 +1,57 @@
+"""Reciprocal rank. Reference:
+``torcheval/metrics/functional/ranking/reciprocal_rank.py:13-63``."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.ranking.hit_rate import _target_range_check
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _reciprocal_rank_input_check(input: jax.Array, target: jax.Array) -> None:
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch dimension, "
+            f"got shapes {input.shape} and {target.shape}, respectively."
+        )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _reciprocal_rank_kernel(
+    input: jax.Array, target: jax.Array, k: Optional[int]
+) -> jax.Array:
+    target = target.astype(jnp.int32)
+    y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+    rank = jnp.sum(input > y_score, axis=-1)
+    score = 1.0 / (rank.astype(jnp.float32) + 1.0)
+    if k is not None:
+        score = jnp.where(rank >= k, 0.0, score)
+    valid = (target >= 0) & (target < input.shape[-1])
+    return jnp.where(valid, score, jnp.nan)
+
+
+def reciprocal_rank(input, target, *, k: Optional[int] = None) -> jax.Array:
+    """Per-sample ``1 / (rank+1)`` of the target class; 0 beyond the ``k`` cutoff.
+
+    Args:
+        input: scores/logits ``(num_samples, num_classes)``.
+        target: class indices ``(num_samples,)``.
+        k: optional top-k cutoff.
+    """
+    input, target = as_jax(input), as_jax(target)
+    _reciprocal_rank_input_check(input, target)
+    _target_range_check(input, target)
+    return _reciprocal_rank_kernel(input, target, k)

@@ -1,0 +1,4 @@
+from torcheval_tpu.metrics.ranking.hit_rate import HitRate
+from torcheval_tpu.metrics.ranking.reciprocal_rank import ReciprocalRank
+
+__all__ = ["HitRate", "ReciprocalRank"]

@@ -1,0 +1,43 @@
+"""HitRate metric. Reference: ``torcheval/metrics/ranking/hit_rate.py``.
+
+Per-sample scores are computed at update time (one fused kernel per batch)
+and cached as a list of device arrays; compute concatenates. The cache holds
+one float per *sample*, not per class, so memory is O(N) regardless of the
+class count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from torcheval_tpu.metrics.functional.ranking.hit_rate import hit_rate
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class HitRate(SampleCacheMetric[jax.Array]):
+    """Per-sample hit rate of the target class among the top-``k`` predictions.
+
+    Args:
+        k: top-k cutoff; ``None`` considers all classes (hit rate 1.0).
+
+    Reference parity: ``ranking/hit_rate.py:19-96``. ``compute()`` returns the
+    concatenated per-sample score vector (empty array before any update).
+    """
+
+    def __init__(self, *, k: Optional[int] = None, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        if k is not None and k <= 0:
+            raise ValueError(f"k should be None or positive, got {k}.")
+        self.k = k
+        self._add_cache_state("scores")
+
+    def update(self, input, target) -> "HitRate":
+        input, target = self._input(input), self._input(target)
+        self.scores.append(hit_rate(input, target, k=self.k))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self._concat_cache("scores")

@@ -1,0 +1,37 @@
+"""ReciprocalRank metric. Reference:
+``torcheval/metrics/ranking/reciprocal_rank.py``."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from torcheval_tpu.metrics.functional.ranking.reciprocal_rank import reciprocal_rank
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class ReciprocalRank(SampleCacheMetric[jax.Array]):
+    """Per-sample ``1 / (rank+1)`` of the target class (0 beyond ``k``).
+
+    Args:
+        k: optional top-k cutoff.
+
+    Reference parity: ``ranking/reciprocal_rank.py:20-100``.
+    """
+
+    def __init__(self, *, k: Optional[int] = None, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        if k is not None and k <= 0:
+            raise ValueError(f"k should be None or positive, got {k}.")
+        self.k = k
+        self._add_cache_state("scores")
+
+    def update(self, input, target) -> "ReciprocalRank":
+        input, target = self._input(input), self._input(target)
+        self.scores.append(reciprocal_rank(input, target, k=self.k))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self._concat_cache("scores")

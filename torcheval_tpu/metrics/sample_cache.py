@@ -1,0 +1,63 @@
+"""Shared base for sample-cache metrics (list-of-arrays state).
+
+The reference has four metrics whose state is an append-only cache of
+per-batch arrays merged by axis-0 concat — ``Cat``, ``HitRate``,
+``ReciprocalRank`` (``ranking/hit_rate.py:75-88``), ``BinaryAUROC`` and the
+PRC family (``classification/auroc.py:69-94``). Each re-implements the same
+append / concat-merge / compact-before-sync protocol. This base implements it
+once: subclasses register caches with :meth:`_add_cache_state` and only write
+``update`` / ``compute``.
+
+Appends are O(1) host-list ops; no device work happens until ``compute`` (or
+``_prepare_for_merge_state``, which compacts each cache to a single array so a
+sync collective moves one buffer per state).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, TypeVar
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+
+TComputeReturn = TypeVar("TComputeReturn")
+TSelf = TypeVar("TSelf", bound="SampleCacheMetric")
+
+
+class SampleCacheMetric(Metric[TComputeReturn]):
+    """Metric whose state variables are lists of arrays concatenated on axis 0."""
+
+    def _add_cache_state(self, name: str) -> None:
+        self._add_state(name, [], reduction=Reduction.CAT)
+
+    def _cache_names(self) -> List[str]:
+        return [
+            name
+            for name, default in self._state_name_to_default.items()
+            if isinstance(default, list)
+        ]
+
+    def _concat_cache(self, name: str, *, empty_shape=(0,)) -> jax.Array:
+        cache = getattr(self, name)
+        if not cache:
+            return jnp.empty(empty_shape)
+        return jnp.concatenate(cache, axis=0)
+
+    def merge_state(self: TSelf, metrics: Iterable[TSelf]) -> TSelf:
+        for metric in metrics:
+            for name in self._cache_names():
+                src = getattr(metric, name)
+                if src:
+                    getattr(self, name).append(
+                        jax.device_put(jnp.concatenate(src, axis=0), self.device)
+                    )
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        for name in self._cache_names():
+            cache = getattr(self, name)
+            if cache:
+                setattr(self, name, [jnp.concatenate(cache, axis=0)])
