@@ -1,0 +1,167 @@
+"""Curve/entropy class metrics through the protocol harness (tier 2)."""
+
+import numpy as np
+from sklearn.metrics import (
+    average_precision_score,
+    precision_recall_curve as sk_prc,
+    roc_auc_score,
+)
+
+from torcheval_tpu.metrics import (
+    BinaryAUPRC,
+    BinaryAUROC,
+    BinaryBinnedPrecisionRecallCurve,
+    BinaryNormalizedEntropy,
+    BinaryPrecisionRecallCurve,
+    MulticlassBinnedPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+)
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(30)
+
+
+def _binary_data():
+    x = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+    t = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+    return x, t
+
+
+class TestBinaryAUROCClass(MetricClassTester):
+    def test_auroc(self):
+        x, t = _binary_data()
+        self.run_class_implementation_tests(
+            metric=BinaryAUROC(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=roc_auc_score(t.reshape(-1), x.reshape(-1)),
+        )
+
+    def test_empty_compute(self):
+        self.assertEqual(float(BinaryAUROC().compute()), 0.5)
+
+
+class TestBinaryAUPRCClass(MetricClassTester):
+    def test_auprc(self):
+        x, t = _binary_data()
+        self.run_class_implementation_tests(
+            metric=BinaryAUPRC(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=average_precision_score(t.reshape(-1), x.reshape(-1)),
+        )
+
+
+class TestBinaryPRCClass(MetricClassTester):
+    def test_prc(self):
+        x, t = _binary_data()
+        skp, skr, skt = sk_prc(t.reshape(-1), x.reshape(-1))
+        self.run_class_implementation_tests(
+            metric=BinaryPrecisionRecallCurve(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=(skp, skr, skt),
+        )
+
+
+class TestMulticlassPRCClass(MetricClassTester):
+    def test_prc(self):
+        c = 4
+        x = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, c)).astype(np.float32)
+        t = RNG.integers(0, c, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        flat_x, flat_t = x.reshape(-1, c), t.reshape(-1)
+        ps, rs, ts = [], [], []
+        for k in range(c):
+            p, r, th = sk_prc((flat_t == k).astype(int), flat_x[:, k])
+            ps.append(p)
+            rs.append(r)
+            ts.append(th)
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecisionRecallCurve(num_classes=c),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=(ps, rs, ts),
+        )
+
+
+class TestBinnedPRCClasses(MetricClassTester):
+    def test_binary_binned(self):
+        x, t = _binary_data()
+        from torcheval_tpu.metrics.functional import (
+            binary_binned_precision_recall_curve,
+        )
+
+        p, r, th = binary_binned_precision_recall_curve(
+            x.reshape(-1), t.reshape(-1), threshold=10
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryBinnedPrecisionRecallCurve(threshold=10),
+            state_names={"threshold", "num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=(p, r, th),
+        )
+
+    def test_multiclass_binned(self):
+        c = 3
+        x = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, c)).astype(np.float32)
+        t = RNG.integers(0, c, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        from torcheval_tpu.metrics.functional import (
+            multiclass_binned_precision_recall_curve,
+        )
+
+        ps, rs, th = multiclass_binned_precision_recall_curve(
+            x.reshape(-1, c), t.reshape(-1), num_classes=c, threshold=7
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassBinnedPrecisionRecallCurve(c, threshold=7),
+            state_names={"threshold", "num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=(ps, rs, th),
+        )
+
+
+class TestBinaryNormalizedEntropyClass(MetricClassTester):
+    def test_ne(self):
+        x = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        t = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        flat_x, flat_t = x.reshape(-1).astype(np.float64), t.reshape(-1).astype(np.float64)
+        ce = -np.mean(flat_t * np.log(flat_x) + (1 - flat_t) * np.log1p(-flat_x))
+        p = flat_t.mean()
+        baseline = -p * np.log(p) - (1 - p) * np.log(1 - p)
+        self.run_class_implementation_tests(
+            metric=BinaryNormalizedEntropy(),
+            state_names={"total_entropy", "num_examples", "num_positive"},
+            update_kwargs={"input": x, "target": t},
+            compute_result=np.array([ce / baseline]),
+            atol=1e-4,
+            rtol=1e-3,
+        )
+
+    def test_ne_weighted_logits_multitask(self):
+        x = RNG.standard_normal((NUM_TOTAL_UPDATES, 2, BATCH_SIZE)).astype(np.float32)
+        t = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, 2, BATCH_SIZE)).astype(np.float32)
+        w = RNG.random((NUM_TOTAL_UPDATES, 2, BATCH_SIZE)).astype(np.float32)
+        prob = 1 / (1 + np.exp(-x.astype(np.float64)))
+        ce_terms = -(t * np.log(prob) + (1 - t) * np.log1p(-prob)) * w
+        # fold over (updates, samples) per task
+        tot = ce_terms.transpose(1, 0, 2).reshape(2, -1).sum(1)
+        wsum = w.transpose(1, 0, 2).reshape(2, -1).sum(1)
+        wpos = (w * t).transpose(1, 0, 2).reshape(2, -1).sum(1)
+        pr = np.clip(wpos / wsum, 1e-12, 1 - 1e-12)
+        baseline = -pr * np.log(pr) - (1 - pr) * np.log(1 - pr)
+        expected = (tot / wsum) / baseline
+        self.run_class_implementation_tests(
+            metric=BinaryNormalizedEntropy(from_logits=True, num_tasks=2),
+            state_names={"total_entropy", "num_examples", "num_positive"},
+            update_kwargs={"input": x, "target": t, "weight": w},
+            compute_result=expected,
+            atol=1e-4,
+            rtol=1e-3,
+        )
+
+    def test_empty_compute(self):
+        self.assertEqual(BinaryNormalizedEntropy().compute().shape, (0,))

@@ -1,0 +1,241 @@
+"""Curve-based functional metrics vs sklearn oracles and reference docstring
+values (SURVEY §4 tier 1)."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import (
+    average_precision_score,
+    precision_recall_curve as sk_prc,
+    roc_auc_score,
+)
+
+import torcheval_tpu.metrics.functional as F
+
+RNG = np.random.default_rng(0)
+
+
+class TestBinaryAUROC(unittest.TestCase):
+    def test_docstring(self):
+        self.assertAlmostEqual(
+            float(F.binary_auroc(np.array([0.1, 0.5, 0.7, 0.8]), np.array([1, 0, 1, 1]))),
+            2 / 3,
+            places=5,
+        )
+        # tied scores integrate along the tie diagonal
+        self.assertAlmostEqual(
+            float(F.binary_auroc(np.array([1.0, 1, 1, 0]), np.array([1, 0, 1, 0]))),
+            0.75,
+            places=6,
+        )
+
+    def test_random_vs_sklearn(self):
+        for n in (10, 1000, 4097):
+            x = RNG.random(n).astype(np.float32)
+            t = RNG.integers(0, 2, n)
+            if t.min() == t.max():
+                t[0] = 1 - t[0]
+            self.assertAlmostEqual(
+                float(F.binary_auroc(x, t)), roc_auc_score(t, x), places=5
+            )
+
+    def test_heavy_ties_vs_sklearn(self):
+        x = RNG.integers(0, 5, 500).astype(np.float32) / 4.0
+        t = RNG.integers(0, 2, 500)
+        self.assertAlmostEqual(
+            float(F.binary_auroc(x, t)), roc_auc_score(t, x), places=5
+        )
+
+    def test_degenerate_returns_half(self):
+        self.assertEqual(float(F.binary_auroc(np.array([0.3, 0.7]), np.array([1, 1]))), 0.5)
+        self.assertEqual(float(F.binary_auroc(np.array([0.3, 0.7]), np.array([0, 0]))), 0.5)
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            F.binary_auroc(np.zeros((2, 2)), np.zeros(2))
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            F.binary_auroc(np.zeros(3), np.zeros(4))
+
+
+class TestBinaryAUPRC(unittest.TestCase):
+    def test_random_vs_sklearn(self):
+        for n in (10, 1000):
+            x = RNG.random(n).astype(np.float32)
+            t = RNG.integers(0, 2, n)
+            if t.max() == 0:
+                t[0] = 1
+            self.assertAlmostEqual(
+                float(F.binary_auprc(x, t)),
+                average_precision_score(t, x),
+                places=5,
+            )
+
+    def test_ties_vs_sklearn(self):
+        x = RNG.integers(0, 4, 300).astype(np.float32)
+        t = RNG.integers(0, 2, 300)
+        self.assertAlmostEqual(
+            float(F.binary_auprc(x, t)), average_precision_score(t, x), places=5
+        )
+
+
+class TestBinaryPRC(unittest.TestCase):
+    def test_docstring(self):
+        p, r, t = F.binary_precision_recall_curve(
+            np.array([0.1, 0.5, 0.7, 0.8]), np.array([0, 0, 1, 1])
+        )
+        np.testing.assert_allclose(
+            np.asarray(p), [0.5, 2 / 3, 1.0, 1.0, 1.0], rtol=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(r), [1.0, 1.0, 1.0, 0.5, 0.0])
+        np.testing.assert_allclose(np.asarray(t), [0.1, 0.5, 0.7, 0.8], rtol=1e-6)
+
+    def test_random_vs_sklearn(self):
+        x = RNG.random(500).astype(np.float32)
+        t = RNG.integers(0, 2, 500)
+        p, r, th = F.binary_precision_recall_curve(x, t)
+        skp, skr, skt = sk_prc(t, x)
+        np.testing.assert_allclose(np.asarray(p), skp, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(r), skr, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(th), skt, rtol=1e-5)
+
+    def test_no_positives_recall_one(self):
+        p, r, t = F.binary_precision_recall_curve(
+            np.array([0.2, 0.8]), np.array([0, 0])
+        )
+        np.testing.assert_allclose(np.asarray(r)[:-1], [1.0, 1.0])
+
+
+class TestMulticlassPRC(unittest.TestCase):
+    def test_docstring(self):
+        inp = np.tile(np.array([[0.1], [0.5], [0.7], [0.8]], dtype=np.float32), (1, 4))
+        tg = np.array([0, 1, 2, 3])
+        ps, rs, ts = F.multiclass_precision_recall_curve(inp, tg, num_classes=4)
+        np.testing.assert_allclose(
+            np.asarray(ps[0]), [0.25, 0.0, 0.0, 0.0, 1.0], rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ps[3]), [0.25, 1 / 3, 0.5, 1.0, 1.0], rtol=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(rs[1]), [1.0, 1.0, 0.0, 0.0, 0.0])
+        np.testing.assert_allclose(np.asarray(ts[0]), [0.1, 0.5, 0.7, 0.8], rtol=1e-6)
+
+    def test_random_vs_sklearn_per_class(self):
+        n, c = 300, 5
+        inp = RNG.random((n, c)).astype(np.float32)
+        tg = RNG.integers(0, c, n)
+        ps, rs, ts = F.multiclass_precision_recall_curve(inp, tg)
+        for k in range(c):
+            skp, skr, skt = sk_prc((tg == k).astype(int), inp[:, k])
+            np.testing.assert_allclose(np.asarray(ps[k]), skp, rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(rs[k]), skr, rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(ts[k]), skt, rtol=1e-5)
+
+
+class TestBinnedPRC(unittest.TestCase):
+    def test_docstring_binary(self):
+        p, r, t = F.binary_binned_precision_recall_curve(
+            np.array([0.2, 0.8, 0.5, 0.9]), np.array([0, 1, 0, 1]), threshold=5
+        )
+        np.testing.assert_allclose(
+            np.asarray(p), [0.5, 2 / 3, 2 / 3, 1.0, 1.0, 1.0], rtol=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(r), [1, 1, 1, 1, 0, 0])
+        np.testing.assert_allclose(np.asarray(t), [0, 0.25, 0.5, 0.75, 1.0])
+
+    def test_docstring_binary_tensor_threshold(self):
+        p, r, t = F.binary_binned_precision_recall_curve(
+            np.array([0.2, 0.3, 0.4, 0.5]),
+            np.array([0, 0, 1, 1]),
+            threshold=np.array([0.0, 0.25, 0.75, 1.0]),
+        )
+        np.testing.assert_allclose(np.asarray(p), [0.5, 2 / 3, 1.0, 1.0, 1.0], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(r), [1.0, 1.0, 0.0, 0.0, 0.0])
+
+    def test_docstring_multiclass(self):
+        inp = np.tile(np.array([[0.1], [0.5], [0.7], [0.8]], dtype=np.float32), (1, 4))
+        tg = np.array([0, 1, 2, 3])
+        thr = [0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9]
+        ps, rs, t = F.multiclass_binned_precision_recall_curve(
+            inp, tg, num_classes=4, threshold=thr
+        )
+        np.testing.assert_allclose(
+            np.asarray(ps[0]),
+            [0.25, 0, 0, 0, 0, 0, 0, 0, 1.0, 1.0],
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ps[3]),
+            [0.25, 1 / 3, 1 / 3, 1 / 3, 1 / 3, 0.5, 0.5, 1.0, 1.0, 1.0],
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(rs[1]), [1, 1, 1, 1, 1, 0, 0, 0, 0, 0]
+        )
+
+    def test_invalid_threshold(self):
+        with self.assertRaisesRegex(ValueError, "sorted"):
+            F.binary_binned_precision_recall_curve(
+                np.zeros(2), np.zeros(2), threshold=np.array([0.5, 0.2])
+            )
+        with self.assertRaisesRegex(ValueError, "range"):
+            F.binary_binned_precision_recall_curve(
+                np.zeros(2), np.zeros(2), threshold=np.array([0.5, 1.2])
+            )
+
+
+class TestBinaryNormalizedEntropy(unittest.TestCase):
+    def test_docstring(self):
+        self.assertAlmostEqual(
+            float(F.binary_normalized_entropy(np.array([0.2, 0.3]), np.array([1.0, 0.0]))),
+            1.4183,
+            places=3,
+        )
+        self.assertAlmostEqual(
+            float(
+                F.binary_normalized_entropy(
+                    np.array([0.2, 0.3]),
+                    np.array([1.0, 0.0]),
+                    weight=np.array([5.0, 1.0]),
+                )
+            ),
+            3.1087,
+            places=3,
+        )
+        self.assertAlmostEqual(
+            float(
+                F.binary_normalized_entropy(
+                    np.array([-1.3863, -0.8473]),
+                    np.array([1.0, 0.0]),
+                    from_logits=True,
+                )
+            ),
+            1.4183,
+            places=3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(
+                F.binary_normalized_entropy(
+                    np.array([[0.2, 0.3], [0.5, 0.1]]),
+                    np.array([[1.0, 0.0], [0.0, 1.0]]),
+                    num_tasks=2,
+                )
+            ),
+            [1.4183, 2.1610],
+            rtol=1e-4,
+        )
+
+    def test_invalid(self):
+        with self.assertRaisesRegex(ValueError, "different from"):
+            F.binary_normalized_entropy(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            F.binary_normalized_entropy(np.zeros((2, 3)), np.zeros((2, 3)))
+        with self.assertRaisesRegex(ValueError, "num_tasks = 2"):
+            F.binary_normalized_entropy(np.zeros(3), np.zeros(3), num_tasks=2)
+        with self.assertRaisesRegex(ValueError, "probability"):
+            F.binary_normalized_entropy(
+                np.array([1.5, 0.2]), np.array([1.0, 0.0])
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -2,14 +2,21 @@ from torcheval_tpu.metrics import functional
 from torcheval_tpu.metrics.aggregation import Cat, Max, Mean, Min, Sum, Throughput
 from torcheval_tpu.metrics.classification import (
     BinaryAccuracy,
+    BinaryAUPRC,
+    BinaryAUROC,
+    BinaryBinnedPrecisionRecallCurve,
     BinaryConfusionMatrix,
     BinaryF1Score,
+    BinaryNormalizedEntropy,
     BinaryPrecision,
+    BinaryPrecisionRecallCurve,
     BinaryRecall,
     MulticlassAccuracy,
+    MulticlassBinnedPrecisionRecallCurve,
     MulticlassConfusionMatrix,
     MulticlassF1Score,
     MulticlassPrecision,
+    MulticlassPrecisionRecallCurve,
     MulticlassRecall,
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
@@ -27,9 +34,14 @@ __all__ = [
     "functional",
     # class metrics
     "BinaryAccuracy",
+    "BinaryAUPRC",
+    "BinaryAUROC",
+    "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",
+    "BinaryNormalizedEntropy",
     "BinaryPrecision",
+    "BinaryPrecisionRecallCurve",
     "BinaryRecall",
     "Cat",
     "HitRate",
@@ -38,9 +50,11 @@ __all__ = [
     "MeanSquaredError",
     "Min",
     "MulticlassAccuracy",
+    "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",
     "MulticlassPrecision",
+    "MulticlassPrecisionRecallCurve",
     "MulticlassRecall",
     "MultilabelAccuracy",
     "R2Score",

@@ -4,6 +4,14 @@ from torcheval_tpu.metrics.classification.accuracy import (
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
+from torcheval_tpu.metrics.classification.auroc import BinaryAUPRC, BinaryAUROC
+from torcheval_tpu.metrics.classification.binary_normalized_entropy import (
+    BinaryNormalizedEntropy,
+)
+from torcheval_tpu.metrics.classification.binned_precision_recall_curve import (
+    BinaryBinnedPrecisionRecallCurve,
+    MulticlassBinnedPrecisionRecallCurve,
+)
 from torcheval_tpu.metrics.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     MulticlassConfusionMatrix,
@@ -16,18 +24,29 @@ from torcheval_tpu.metrics.classification.precision import (
     BinaryPrecision,
     MulticlassPrecision,
 )
+from torcheval_tpu.metrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+)
 from torcheval_tpu.metrics.classification.recall import BinaryRecall, MulticlassRecall
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryAUPRC",
+    "BinaryAUROC",
+    "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",
+    "BinaryNormalizedEntropy",
     "BinaryPrecision",
+    "BinaryPrecisionRecallCurve",
     "BinaryRecall",
     "MulticlassAccuracy",
+    "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",
     "MulticlassPrecision",
+    "MulticlassPrecisionRecallCurve",
     "MulticlassRecall",
     "MultilabelAccuracy",
     "TopKMultilabelAccuracy",

@@ -1,0 +1,71 @@
+"""BinaryAUROC / BinaryAUPRC metrics. Reference:
+``torcheval/metrics/classification/auroc.py:23-94``.
+
+Sample-cache metrics: update appends the batch (O(1) host op, no device
+work); all cost lives in ``compute()``'s single fused sort kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _auroc_update_input_check,
+)
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.ops.curves import binary_auprc_kernel, binary_auroc_kernel
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class BinaryAUROC(SampleCacheMetric[jax.Array]):
+    """Streaming area under the ROC curve (exact, sort-based).
+
+    State is the full sample cache (reference design, ``auroc.py:55-71``);
+    for bounded state use the binned PRC metrics instead.
+    """
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_cache_state("inputs")
+        self._add_cache_state("targets")
+
+    def update(self, input, target) -> "BinaryAUROC":
+        input, target = self._input(input), self._input(target)
+        _auroc_update_input_check(input, target)
+        self.inputs.append(input)
+        self.targets.append(target)
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            return jnp.asarray(0.5)
+        return binary_auroc_kernel(
+            self._concat_cache("inputs"), self._concat_cache("targets")
+        )
+
+
+class BinaryAUPRC(SampleCacheMetric[jax.Array]):
+    """Streaming area under the PR curve (average precision).
+
+    Framework extension (not in the reference snapshot v0.0.3; required by
+    BASELINE.md config 2)."""
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_cache_state("inputs")
+        self._add_cache_state("targets")
+
+    def update(self, input, target) -> "BinaryAUPRC":
+        input, target = self._input(input), self._input(target)
+        _auroc_update_input_check(input, target)
+        self.inputs.append(input)
+        self.targets.append(target)
+        return self
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            return jnp.asarray(0.0)
+        return binary_auprc_kernel(
+            self._concat_cache("inputs"), self._concat_cache("targets")
+        )

@@ -1,0 +1,91 @@
+"""BinaryNormalizedEntropy metric. Reference:
+``torcheval/metrics/classification/binary_normalized_entropy.py:22-147``."""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _baseline_entropy,
+    _binary_normalized_entropy_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+_STATE_NAMES = ("total_entropy", "num_examples", "num_positive")
+
+
+class BinaryNormalizedEntropy(Metric[jax.Array]):
+    """Streaming normalized binary cross entropy (CTR calibration metric).
+
+    Args:
+        from_logits: interpret update inputs as logits rather than
+            probabilities.
+        num_tasks: number of parallel tasks; state has shape ``(num_tasks,)``.
+
+    Reference parity: ``classification/binary_normalized_entropy.py:22-147``
+    (float32 accumulators instead of float64 — TPU has no fast fp64; see the
+    functional module's note).
+    """
+
+    def __init__(
+        self,
+        *,
+        from_logits: bool = False,
+        num_tasks: int = 1,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        self.from_logits = from_logits
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}."
+            )
+        self.num_tasks = num_tasks
+        for name in _STATE_NAMES:
+            self._add_state(
+                name,
+                jnp.zeros((num_tasks,), dtype=jnp.float32),
+                reduction=Reduction.SUM,
+            )
+
+    def update(
+        self, input, target, *, weight: Optional[jax.Array] = None
+    ) -> "BinaryNormalizedEntropy":
+        input, target = self._input(input), self._input(target)
+        if weight is not None:
+            weight = self._input(weight)
+        cross_entropy, num_positive, num_examples = (
+            _binary_normalized_entropy_update(
+                input, target, self.from_logits, self.num_tasks, weight
+            )
+        )
+        self.total_entropy = self.total_entropy + cross_entropy
+        self.num_examples = self.num_examples + num_examples
+        self.num_positive = self.num_positive + num_positive
+        return self
+
+    def compute(self) -> jax.Array:
+        if np.any(np.asarray(self.num_examples) == 0.0):
+            return jnp.empty((0,))
+        baseline = _baseline_entropy(self.num_positive, self.num_examples)
+        return (self.total_entropy / self.num_examples) / baseline
+
+    def merge_state(
+        self, metrics: Iterable["BinaryNormalizedEntropy"]
+    ) -> "BinaryNormalizedEntropy":
+        for metric in metrics:
+            for name in _STATE_NAMES:
+                setattr(
+                    self,
+                    name,
+                    getattr(self, name)
+                    + jax.device_put(getattr(metric, name), self.device),
+                )
+        return self

@@ -1,0 +1,153 @@
+"""Binned PRC class metrics. Reference:
+``torcheval/metrics/classification/binned_precision_recall_curve.py:27-247``.
+
+The bounded-state streaming PR curve: counters of static shape
+``(n_thresholds,)`` / ``(n_thresholds, num_classes)``, SUM-merged. This is
+the recommended PRC form for the TPU hot path and for distributed sync.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    ThresholdSpec,
+    _binary_binned_compute,
+    _binary_binned_update,
+    _binned_precision_recall_curve_param_check,
+    _create_threshold_tensor,
+    _multiclass_binned_compute,
+    _multiclass_binned_update,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_update_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+_COUNTER_NAMES = ("num_tp", "num_fp", "num_fn")
+
+
+class BinaryBinnedPrecisionRecallCurve(
+    Metric[Tuple[jax.Array, jax.Array, jax.Array]]
+):
+    """Streaming binary PR curve over fixed thresholds.
+
+    Args:
+        threshold: bin count (int → ``linspace(0, 1)``), list, or array of
+            sorted thresholds in ``[0, 1]``.
+    """
+
+    def __init__(
+        self, *, threshold: ThresholdSpec = 100, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        threshold = _create_threshold_tensor(threshold)
+        _binned_precision_recall_curve_param_check(threshold)
+        # threshold is configuration, not mergeable state — but the reference
+        # registers it as state (:77), so we mirror that with MAX reduction
+        # (identical across replicas; max is a no-op combiner)
+        self._add_state("threshold", threshold, reduction=Reduction.MAX)
+        n = threshold.shape[0]
+        for name in _COUNTER_NAMES:
+            self._add_state(
+                name, jnp.zeros((n,), dtype=jnp.int32), reduction=Reduction.SUM
+            )
+
+    def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        _binary_precision_recall_curve_update_input_check(input, target)
+        tp, fp, fn = _binary_binned_update(input, target, self.threshold)
+        self.num_tp = self.num_tp + tp
+        self.num_fp = self.num_fp + fp
+        self.num_fn = self.num_fn + fn
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        precision, recall = _binary_binned_compute(
+            self.num_tp, self.num_fp, self.num_fn
+        )
+        return precision, recall, self.threshold
+
+    def merge_state(
+        self, metrics: Iterable["BinaryBinnedPrecisionRecallCurve"]
+    ) -> "BinaryBinnedPrecisionRecallCurve":
+        for metric in metrics:
+            for name in _COUNTER_NAMES:
+                setattr(
+                    self,
+                    name,
+                    getattr(self, name)
+                    + jax.device_put(getattr(metric, name), self.device),
+                )
+        return self
+
+
+class MulticlassBinnedPrecisionRecallCurve(
+    Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
+):
+    """Streaming one-vs-all PR curves over fixed thresholds.
+
+    Args:
+        num_classes: number of classes (static; sizes the counter state).
+        threshold: bin count, list, or sorted array in ``[0, 1]``.
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        *,
+        threshold: ThresholdSpec = 100,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_classes is None or num_classes < 2:
+            raise ValueError(f"num_classes must be at least 2, got {num_classes}.")
+        threshold = _create_threshold_tensor(threshold)
+        _binned_precision_recall_curve_param_check(threshold)
+        self.num_classes = num_classes
+        self._add_state("threshold", threshold, reduction=Reduction.MAX)
+        n = threshold.shape[0]
+        for name in _COUNTER_NAMES:
+            self._add_state(
+                name,
+                jnp.zeros((n, num_classes), dtype=jnp.int32),
+                reduction=Reduction.SUM,
+            )
+
+    def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        _multiclass_precision_recall_curve_update_input_check(
+            input, target, self.num_classes
+        )
+        tp, fp, fn = _multiclass_binned_update(
+            input, target, self.threshold, self.num_classes
+        )
+        self.num_tp = self.num_tp + tp
+        self.num_fp = self.num_fp + fp
+        self.num_fn = self.num_fn + fn
+        return self
+
+    def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+        precision, recall = _multiclass_binned_compute(
+            self.num_tp, self.num_fp, self.num_fn
+        )
+        return list(precision.T), list(recall.T), self.threshold
+
+    def merge_state(
+        self, metrics: Iterable["MulticlassBinnedPrecisionRecallCurve"]
+    ) -> "MulticlassBinnedPrecisionRecallCurve":
+        for metric in metrics:
+            for name in _COUNTER_NAMES:
+                setattr(
+                    self,
+                    name,
+                    getattr(self, name)
+                    + jax.device_put(getattr(metric, name), self.device),
+                )
+        return self

@@ -1,0 +1,77 @@
+"""PRC class metrics. Reference:
+``torcheval/metrics/classification/precision_recall_curve.py:29-220``."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_update_input_check,
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+)
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.utils.devices import DeviceLike
+
+_CurveResult = Tuple[jax.Array, jax.Array, jax.Array]
+
+
+class BinaryPrecisionRecallCurve(SampleCacheMetric[_CurveResult]):
+    """Streaming binary precision-recall curve (sample-cache state)."""
+
+    def __init__(self, *, device: DeviceLike = None) -> None:
+        super().__init__(device=device)
+        self._add_cache_state("inputs")
+        self._add_cache_state("targets")
+
+    def update(self, input, target) -> "BinaryPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        _binary_precision_recall_curve_update_input_check(input, target)
+        self.inputs.append(input)
+        self.targets.append(target)
+        return self
+
+    def compute(self) -> _CurveResult:
+        if not self.inputs:
+            return jnp.empty((0,)), jnp.empty((0,)), jnp.empty((0,))
+        return binary_precision_recall_curve(
+            self._concat_cache("inputs"), self._concat_cache("targets")
+        )
+
+
+class MulticlassPrecisionRecallCurve(
+    SampleCacheMetric[Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]
+):
+    """Streaming one-vs-all precision-recall curves per class."""
+
+    def __init__(
+        self, *, num_classes: Optional[int] = None, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        self.num_classes = num_classes
+        self._add_cache_state("inputs")
+        self._add_cache_state("targets")
+
+    def update(self, input, target) -> "MulticlassPrecisionRecallCurve":
+        input, target = self._input(input), self._input(target)
+        if self.num_classes is None and input.ndim == 2:
+            self.num_classes = input.shape[1]
+        _multiclass_precision_recall_curve_update_input_check(
+            input, target, self.num_classes
+        )
+        self.inputs.append(input)
+        self.targets.append(target)
+        return self
+
+    def compute(self):
+        if not self.inputs:
+            return [], [], []
+        return multiclass_precision_recall_curve(
+            jnp.concatenate(self.inputs, axis=0),
+            self._concat_cache("targets"),
+            num_classes=self.num_classes,
+        )

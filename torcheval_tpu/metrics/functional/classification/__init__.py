@@ -4,6 +4,17 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
     multilabel_accuracy,
     topk_multilabel_accuracy,
 )
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    binary_auprc,
+    binary_auroc,
+)
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    binary_normalized_entropy,
+)
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    binary_binned_precision_recall_curve,
+    multiclass_binned_precision_recall_curve,
+)
 from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     binary_confusion_matrix,
     multiclass_confusion_matrix,
@@ -16,6 +27,10 @@ from torcheval_tpu.metrics.functional.classification.precision import (
     binary_precision,
     multiclass_precision,
 )
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+)
 from torcheval_tpu.metrics.functional.classification.recall import (
     binary_recall,
     multiclass_recall,
@@ -23,14 +38,21 @@ from torcheval_tpu.metrics.functional.classification.recall import (
 
 __all__ = [
     "binary_accuracy",
+    "binary_auprc",
+    "binary_auroc",
+    "binary_binned_precision_recall_curve",
     "binary_confusion_matrix",
     "binary_f1_score",
+    "binary_normalized_entropy",
     "binary_precision",
+    "binary_precision_recall_curve",
     "binary_recall",
     "multiclass_accuracy",
+    "multiclass_binned_precision_recall_curve",
     "multiclass_confusion_matrix",
     "multiclass_f1_score",
     "multiclass_precision",
+    "multiclass_precision_recall_curve",
     "multiclass_recall",
     "multilabel_accuracy",
     "topk_multilabel_accuracy",

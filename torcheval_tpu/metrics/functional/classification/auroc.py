@@ -1,0 +1,44 @@
+"""Binary AUROC. Reference:
+``torcheval/metrics/functional/classification/auroc.py:11-89``.
+
+The compute kernel lives in :mod:`torcheval_tpu.ops.curves` — a static-shape
+redesign of the reference's sort + dedup-mask + cumsum + trapz pipeline.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check as _auroc_update_input_check,
+)
+from torcheval_tpu.ops.curves import binary_auprc_kernel, binary_auroc_kernel
+from torcheval_tpu.utils.convert import as_jax
+
+
+def binary_auroc(input, target) -> jax.Array:
+    """Area under the ROC curve for binary classification.
+
+    Args:
+        input: predicted labels / probabilities / logits, shape ``(n_sample,)``.
+        target: ground-truth binary labels, shape ``(n_sample,)``.
+
+    Returns 0.5 when the target is all-ones or all-zeros (degenerate guard,
+    reference ``auroc.py:60-66``).
+    """
+    input, target = as_jax(input), as_jax(target)
+    _auroc_update_input_check(input, target)
+    return binary_auroc_kernel(input, target)
+
+
+def binary_auprc(input, target) -> jax.Array:
+    """Area under the precision-recall curve (average precision) for binary
+    classification.
+
+    Framework extension (not in the reference snapshot v0.0.3; required by
+    BASELINE.md config 2). Step integration matching sklearn's
+    ``average_precision_score``.
+    """
+    input, target = as_jax(input), as_jax(target)
+    _auroc_update_input_check(input, target)
+    return binary_auprc_kernel(input, target)

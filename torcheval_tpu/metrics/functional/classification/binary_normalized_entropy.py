@@ -1,0 +1,143 @@
+"""Binary normalized (cross-)entropy. Reference:
+``torcheval/metrics/functional/classification/binary_normalized_entropy.py``.
+
+NE = (observed cross entropy) / (entropy of the base positive rate) — the
+standard CTR-prediction calibration metric. Sufficient statistics per task:
+``total_entropy``, ``num_examples``, ``num_positive`` — all SUM-mergeable.
+
+The reference accumulates in float64 (``binary_normalized_entropy.py:76-87``).
+TPU has no fast fp64, so we accumulate in float32 and note that per-batch
+summation keeps error at O(sqrt(num_batches)) ulp; exactness-critical users
+can pre-sum on host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.tracing import is_concrete
+
+_EPS = 1.1920929e-07  # float32 eps, mirroring the reference's float64 clamp
+
+
+def _ne_input_check(
+    input: jax.Array,
+    target: jax.Array,
+    from_logits: bool,
+    num_tasks: int,
+    weight: Optional[jax.Array] = None,
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            f"`input` shape ({input.shape}) is different from `target` shape "
+            f"({target.shape})"
+        )
+    if weight is not None and input.shape != weight.shape:
+        raise ValueError(
+            f"`weight` shape ({weight.shape}) is different from `input` shape "
+            f"({input.shape})"
+        )
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )
+    # value check: probabilities must live in [0, 1]; concrete arrays only
+    # (host read) — inside jit the log-clamp below keeps the math finite
+    if not from_logits and is_concrete(input):
+        import numpy as np
+
+        arr = np.asarray(input)
+        if arr.size and (arr.max() > 1.0 or arr.min() < 0.0):
+            raise ValueError(
+                f"`from_logits`={from_logits}, `input` should be probability "
+                f"in range [0., 1.], but got `input` ranging from {arr.min()} "
+                f"to {arr.max()}. Please set `from_logits = True` or convert "
+                "`input` into valid probability value."
+            )
+
+
+@partial(jax.jit, static_argnames=("from_logits",))
+def _ne_fold(
+    input: jax.Array,
+    target: jax.Array,
+    from_logits: bool,
+    weight: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    input = input.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if from_logits:
+        # stable BCE-with-logits: softplus(x) - x*z
+        ce = jax.nn.softplus(input) - input * target
+    else:
+        # torch.binary_cross_entropy clamps log terms at -100
+        ce = -(
+            target * jnp.clip(jnp.log(input), -100.0)
+            + (1.0 - target) * jnp.clip(jnp.log1p(-input), -100.0)
+        )
+    if weight is not None:
+        ce = ce * weight
+        w = weight.astype(jnp.float32)
+    else:
+        w = jnp.ones_like(target)
+    cross_entropy = jnp.sum(ce, axis=-1)
+    num_examples = jnp.sum(w, axis=-1)
+    num_positive = jnp.sum(w * target, axis=-1)
+    return cross_entropy, num_positive, num_examples
+
+
+def _binary_normalized_entropy_update(
+    input: jax.Array,
+    target: jax.Array,
+    from_logits: bool,
+    num_tasks: int,
+    weight: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _ne_input_check(input, target, from_logits, num_tasks, weight)
+    return _ne_fold(input, target, from_logits, weight)
+
+
+@jax.jit
+def _baseline_entropy(num_positive: jax.Array, num_examples: jax.Array) -> jax.Array:
+    p = jnp.clip(num_positive / num_examples, _EPS, 1.0 - _EPS)
+    return -p * jnp.log(p) - (1.0 - p) * jnp.log(1.0 - p)
+
+
+def binary_normalized_entropy(
+    input,
+    target,
+    *,
+    weight=None,
+    num_tasks: int = 1,
+    from_logits: bool = False,
+) -> jax.Array:
+    """Normalized binary cross entropy: observed CE over base-rate entropy.
+
+    Args:
+        input: probabilities (or logits with ``from_logits=True``),
+            shape ``(num_samples,)`` or ``(num_tasks, num_samples)``.
+        target: binary labels, same shape.
+        weight: optional rescaling weights, same shape.
+        num_tasks: number of parallel tasks (leading axis when > 1).
+        from_logits: interpret ``input`` as logits.
+    """
+    input, target = as_jax(input), as_jax(target)
+    if weight is not None:
+        weight = as_jax(weight)
+    cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
+        input, target, from_logits, num_tasks, weight
+    )
+    return (cross_entropy / num_examples) / _baseline_entropy(
+        num_positive, num_examples
+    )

@@ -1,0 +1,154 @@
+"""Binned precision-recall curves (static-shape streaming PRC). Reference:
+``torcheval/metrics/functional/classification/binned_precision_recall_curve.py``.
+
+This is the TPU hot path for PR curves: counter state of shape
+``(n_thresholds,)`` / ``(n_thresholds, num_classes)``, fixed at trace time,
+SUM-mergeable, so the streaming update is one fused compare-and-reduce kernel
+and distributed sync is a single ``psum``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_update_input_check,
+)
+from torcheval_tpu.utils.convert import as_jax
+
+ThresholdSpec = Union[int, List[float], jax.Array]
+
+
+def _create_threshold_tensor(threshold: ThresholdSpec) -> jax.Array:
+    if isinstance(threshold, int):
+        return jnp.linspace(0.0, 1.0, threshold)
+    return as_jax(threshold)
+
+
+def _binned_precision_recall_curve_param_check(threshold: jax.Array) -> None:
+    import numpy as np
+
+    t = np.asarray(threshold)
+    if (np.diff(t) < 0.0).any():
+        raise ValueError("The `threshold` should be a sorted array.")
+    if (t < 0.0).any() or (t > 1.0).any():
+        raise ValueError("The values in `threshold` should be in the range of [0, 1].")
+
+
+@jax.jit
+def _binary_binned_update(
+    input: jax.Array, target: jax.Array, threshold: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    target = target.astype(jnp.int32)
+    pred_label = input[None, :] >= threshold[:, None]  # (T, N)
+    num_tp = jnp.sum(pred_label * target[None, :], axis=1, dtype=jnp.int32)
+    num_fp = jnp.sum(pred_label, axis=1, dtype=jnp.int32) - num_tp
+    num_fn = jnp.sum(target, dtype=jnp.int32) - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _binary_binned_compute(
+    num_tp: jax.Array, num_fp: jax.Array, num_fn: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    tp = num_tp.astype(jnp.float32)
+    fp = num_fp.astype(jnp.float32)
+    fn = num_fn.astype(jnp.float32)
+    # precision 1.0 when nothing is predicted positive (reference nan_to_num)
+    precision = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1.0), 1.0)
+    recall = jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1.0), jnp.nan)
+    precision = jnp.concatenate([precision, jnp.ones(1)])
+    recall = jnp.concatenate([recall, jnp.zeros(1)])
+    return precision, recall
+
+
+def binary_binned_precision_recall_curve(
+    input, target, *, threshold: ThresholdSpec = 100
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Precision-recall curve at fixed thresholds (binary).
+
+    Args:
+        input: probabilities / logits, shape ``(n_sample,)``.
+        target: binary labels, shape ``(n_sample,)``.
+        threshold: bin count (int → ``linspace(0, 1)``), list, or array of
+            sorted thresholds in ``[0, 1]``.
+
+    Returns:
+        ``(precision, recall, thresholds)`` of shapes
+        ``(T+1,), (T+1,), (T,)``.
+    """
+    input, target = as_jax(input), as_jax(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _binary_precision_recall_curve_update_input_check(input, target)
+    num_tp, num_fp, num_fn = _binary_binned_update(input, target, threshold)
+    precision, recall = _binary_binned_compute(num_tp, num_fp, num_fn)
+    return precision, recall, threshold
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _multiclass_binned_update(
+    input: jax.Array, target: jax.Array, threshold: jax.Array, num_classes: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    onehot = (
+        target[:, None] == jnp.arange(num_classes)[None, :]
+    ).astype(jnp.int32)  # (N, C)
+    labels = (
+        input[None, :, :] >= threshold[:, None, None]
+    )  # (T, N, C) — one compare+reduce pass, XLA fuses the broadcast
+    num_tp = jnp.sum(labels * onehot[None, :, :], axis=1, dtype=jnp.int32)
+    num_fp = jnp.sum(labels, axis=1, dtype=jnp.int32) - num_tp
+    num_fn = jnp.sum(onehot, axis=0, dtype=jnp.int32)[None, :] - num_tp
+    return num_tp, num_fp, num_fn
+
+
+@jax.jit
+def _multiclass_binned_compute(
+    num_tp: jax.Array, num_fp: jax.Array, num_fn: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    tp = num_tp.astype(jnp.float32)
+    fp = num_fp.astype(jnp.float32)
+    fn = num_fn.astype(jnp.float32)
+    precision = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1.0), 1.0)
+    recall = jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1.0), jnp.nan)
+    num_classes = tp.shape[1]
+    precision = jnp.concatenate([precision, jnp.ones((1, num_classes))], axis=0)
+    recall = jnp.concatenate([recall, jnp.zeros((1, num_classes))], axis=0)
+    return precision, recall
+
+
+def multiclass_binned_precision_recall_curve(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    threshold: ThresholdSpec = 100,
+) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+    """One-vs-all precision-recall curves at fixed thresholds.
+
+    Args:
+        input: scores/logits ``(n_sample, num_classes)``.
+        target: class indices ``(n_sample,)``.
+        num_classes: defaults to ``input.shape[1]``.
+        threshold: bin count, list, or array of sorted thresholds in [0, 1].
+
+    Returns:
+        ``(precision, recall, thresholds)`` — precision/recall are lists with
+        one ``(T+1,)`` array per class (reference layout).
+    """
+    input, target = as_jax(input), as_jax(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    if num_classes is None and input.ndim == 2:
+        num_classes = input.shape[1]
+    _multiclass_precision_recall_curve_update_input_check(input, target, num_classes)
+    num_tp, num_fp, num_fn = _multiclass_binned_update(
+        input, target, threshold, num_classes
+    )
+    precision, recall = _multiclass_binned_compute(num_tp, num_fp, num_fn)
+    return list(precision.T), list(recall.T), threshold

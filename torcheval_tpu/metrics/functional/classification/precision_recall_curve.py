@@ -1,0 +1,133 @@
+"""Precision-recall curves (binary / multiclass). Reference:
+``torcheval/metrics/functional/classification/precision_recall_curve.py``.
+
+Curve lengths are data-dependent (one point per distinct threshold), which
+JAX cannot express inside jit. Strategy per SURVEY §7: the device kernel
+(:func:`torcheval_tpu.ops.curves.prc_points_kernel`) produces full-length
+curves plus a validity mask in one compiled sort pass; the API boundary trims
+and flips on the host. The hot path for streaming/binned evaluation is the
+static-shaped :mod:`binned_precision_recall_curve` family.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.ops.curves import multiclass_prc_points_kernel, prc_points_kernel
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _binary_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array
+) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same shape, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+
+
+def _multiclass_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample, num_classes), "
+            f"got {input.shape} and num_classes={num_classes}."
+        )
+
+
+def _trim_curve(
+    thresholds: np.ndarray,
+    precision: np.ndarray,
+    recall: np.ndarray,
+    last: np.ndarray,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Host-side: select tie-group ends, flip to ascending-threshold order,
+    append the (precision=1, recall=0) graph-origin point (reference
+    ``precision_recall_curve.py:224-230``)."""
+    p = precision[last][::-1]
+    r = recall[last][::-1]
+    t = thresholds[last][::-1]
+    p = np.concatenate([p, np.ones(1, dtype=p.dtype)])
+    r = np.concatenate([r, np.zeros(1, dtype=r.dtype)])
+    return jnp.asarray(p), jnp.asarray(r), jnp.asarray(t)
+
+
+def binary_precision_recall_curve(
+    input, target
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Precision-recall pairs and thresholds for binary classification.
+
+    Args:
+        input: probabilities / logits, shape ``(n_sample,)``.
+        target: binary labels, shape ``(n_sample,)``.
+
+    Returns:
+        ``(precision, recall, thresholds)`` with shapes
+        ``(k+1,), (k+1,), (k,)`` for ``k`` distinct thresholds; recall is 1.0
+        everywhere when the target has no positives.
+    """
+    input, target = as_jax(input), as_jax(target)
+    _binary_precision_recall_curve_update_input_check(input, target)
+    s, p, r, last = prc_points_kernel(input, target)
+    return _trim_curve(
+        np.asarray(s), np.asarray(p), np.asarray(r), np.asarray(last)
+    )
+
+
+def multiclass_precision_recall_curve(
+    input, target, *, num_classes: Optional[int] = None
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """One-vs-all precision-recall curves for each class.
+
+    Args:
+        input: scores/logits ``(n_sample, num_classes)``.
+        target: class indices ``(n_sample,)``.
+        num_classes: defaults to ``input.shape[1]``.
+
+    Returns:
+        ``(precision, recall, thresholds)`` — each a list with one
+        variable-length array per class (reference layout).
+    """
+    input, target = as_jax(input), as_jax(target)
+    if num_classes is None and input.ndim == 2:
+        num_classes = input.shape[1]
+    _multiclass_precision_recall_curve_update_input_check(
+        input, target, num_classes
+    )
+    onehot = (target[None, :] == jnp.arange(num_classes)[:, None]).astype(
+        jnp.float32
+    )
+    s, p, r, last = multiclass_prc_points_kernel(input.T, onehot)
+    s, p, r, last = map(np.asarray, (s, p, r, last))
+    precisions, recalls, thresholds = [], [], []
+    for c in range(num_classes):
+        pc, rc, tc = _trim_curve(s[c], p[c], r[c], last[c])
+        precisions.append(pc)
+        recalls.append(rc)
+        thresholds.append(tc)
+    return precisions, recalls, thresholds

@@ -3,9 +3,19 @@ from torcheval_tpu.ops.confusion import (
     confusion_matrix_counts,
     topk_onehot,
 )
+from torcheval_tpu.ops.curves import (
+    binary_auprc_kernel,
+    binary_auroc_kernel,
+    multiclass_prc_points_kernel,
+    prc_points_kernel,
+)
 
 __all__ = [
+    "binary_auprc_kernel",
+    "binary_auroc_kernel",
     "class_counts",
     "confusion_matrix_counts",
+    "multiclass_prc_points_kernel",
+    "prc_points_kernel",
     "topk_onehot",
 ]

@@ -1,0 +1,102 @@
+"""Sort-based ROC / PR curve kernels.
+
+The reference's curve math (``torcheval/metrics/functional/classification/
+auroc.py:50-67``, ``precision_recall_curve.py:207-230``) deduplicates tied
+thresholds with boolean masking — a data-dependent shape JAX cannot trace.
+These kernels keep **static shapes** via group-end propagation:
+
+Sort scores descending and take cumulative TP/FP counts. For every position
+``i``, replace its cumulative counts with those at ``j(i)``, the *last* index
+of ``i``'s tie group (found with one ``searchsorted`` against the ascending
+view). Intra-group points then coincide exactly with the group-end point, so:
+
+* trapezoidal ROC integration gets zero-width segments inside a group and the
+  correct tie-diagonal across groups — identical to integrating the deduped
+  curve;
+* step (average-precision) integration gets zero ``ΔTP`` inside a group;
+* PR-curve extraction keeps a boolean "last of group" mask for the host-side
+  trim at the API boundary (SURVEY §7 "variable-length results under jit").
+
+Everything is one sort + one searchsorted + elementwise ops: O(N log N)
+compute, O(N) memory, fully fused by XLA, no host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _group_end_cumsums(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort desc and return (thresholds, tp, fp, last_of_group) with cumulative
+    counts propagated to each tie group's end."""
+    n = input.shape[0]
+    order = jnp.argsort(-input)
+    s = input[order]
+    # int32 cumulative counts: a float32 running sum silently stops
+    # incrementing at 2**24 samples (repo exactness rule, ops/confusion.py);
+    # callers cast to float only at the final divisions/integration
+    t = target[order].astype(jnp.int32)
+    ctp = jnp.cumsum(t, dtype=jnp.int32)
+    cfp = jnp.cumsum(1 - t, dtype=jnp.int32)
+    # last index of each tie group: (# elements >= s_i) - 1, via one
+    # searchsorted on the ascending view
+    j = n - jnp.searchsorted(s[::-1], s, side="left") - 1
+    last = jnp.arange(n) == j
+    return s, ctp[j], cfp[j], last
+
+
+@jax.jit
+def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    """Exact trapezoidal AUROC; 0.5 when targets are all-one or all-zero
+    (reference degenerate guard, ``auroc.py:60-66``)."""
+    _, tp, fp, _ = _group_end_cumsums(input, target)
+    tp = jnp.concatenate([jnp.zeros(1, jnp.int32), tp]).astype(jnp.float32)
+    fp = jnp.concatenate([jnp.zeros(1, jnp.int32), fp]).astype(jnp.float32)
+    factor = tp[-1] * fp[-1]
+    auc = jnp.trapezoid(tp, fp)
+    return jnp.where(factor == 0, 0.5, auc / jnp.maximum(factor, 1.0))
+
+
+@jax.jit
+def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    """Average-precision (step) integration of the PR curve:
+    ``AP = sum(ΔTP_k * precision_k) / TP_total`` over descending thresholds.
+    Matches sklearn's ``average_precision_score``; 0.0 when there are no
+    positives (the recall axis is undefined)."""
+    _, itp, ifp, _ = _group_end_cumsums(input, target)
+    tp = itp.astype(jnp.float32)
+    fp = ifp.astype(jnp.float32)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    delta_tp = jnp.diff(itp, prepend=0).astype(jnp.float32)
+    total = tp[-1]
+    ap = jnp.sum(delta_tp * precision) / jnp.maximum(total, 1.0)
+    return jnp.where(total == 0, 0.0, ap)
+
+
+@jax.jit
+def prc_points_kernel(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Full-length PR-curve points in descending-threshold order plus the
+    "last of tie group" validity mask. The caller selects ``mask`` rows on the
+    host and flips to ascending order (reference layout,
+    ``precision_recall_curve.py:207-230``)."""
+    s, itp, ifp, last = _group_end_cumsums(input, target)
+    tp = itp.astype(jnp.float32)
+    fp = ifp.astype(jnp.float32)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    total_pos = tp[-1]
+    # no positives seen => recall defined as 1.0 (reference nan_to_num(1.0))
+    recall = jnp.where(total_pos > 0, tp / jnp.maximum(total_pos, 1.0), 1.0)
+    return s, precision, recall, last
+
+
+# (C, N) batched variant for multiclass one-vs-all curves: vmap over classes.
+multiclass_prc_points_kernel = jax.jit(
+    jax.vmap(prc_points_kernel, in_axes=(0, 0), out_axes=0)
+)
