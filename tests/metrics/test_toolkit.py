@@ -1,0 +1,225 @@
+"""Toolkit + SPMD sync tests on the forced 8-device CPU mesh (SURVEY §4
+tier 3: multi-node semantics simulated as multi-device single-process SPMD)."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    Cat,
+    Max,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    Sum,
+    Throughput,
+)
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.toolkit import (
+    _fold_states,
+    clone_metric,
+    clone_metrics,
+    get_synced_metric,
+    merge_metrics,
+    reset_metrics,
+    sync_and_compute,
+    sync_and_compute_collection,
+    to_device,
+)
+from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh, shard_batch
+
+RNG = np.random.default_rng(40)
+
+
+class TestLocalToolkit(unittest.TestCase):
+    def test_clone_and_reset(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0, 2.0]))
+        c = clone_metric(m)
+        self.assertEqual(float(c.compute()), 3.0)
+        c.update(jnp.asarray([5.0]))
+        self.assertEqual(float(m.compute()), 3.0)  # clone is independent
+        (r,) = reset_metrics([c])
+        self.assertEqual(float(r.compute()), 0.0)
+        cs = clone_metrics([m, Max()])
+        self.assertEqual(len(cs), 2)
+
+    def test_to_device(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        (moved,) = to_device([m], "cpu")
+        self.assertEqual(float(moved.compute()), 1.0)
+
+    def test_merge_metrics_does_not_mutate(self):
+        a, b = Sum(), Sum()
+        a.update(jnp.asarray([1.0]))
+        b.update(jnp.asarray([2.0]))
+        merged = merge_metrics([a, b])
+        self.assertEqual(float(merged.compute()), 3.0)
+        self.assertEqual(float(a.compute()), 1.0)
+        self.assertEqual(float(b.compute()), 2.0)
+
+    def test_world_size_one_sync_returns_input(self):
+        m = Sum()
+        m.update(jnp.asarray([4.0]))
+        with self.assertLogs(level="WARNING"):
+            synced = get_synced_metric(m, recipient_rank=0)
+        self.assertIs(synced, m)
+        with self.assertLogs(level="WARNING"):
+            self.assertEqual(float(sync_and_compute(m)), 4.0)
+
+    def test_invalid_recipient(self):
+        with self.assertRaisesRegex(ValueError, "recipient_rank"):
+            get_synced_metric(Sum(), recipient_rank="some")
+
+    def test_collection(self):
+        a, b = Sum(), Max()
+        a.update(jnp.asarray([1.0]))
+        b.update(jnp.asarray([7.0]))
+        with self.assertLogs(level="WARNING"):
+            out = sync_and_compute_collection({"s": a, "m": b})
+        self.assertEqual(float(out["s"]), 1.0)
+        self.assertEqual(float(out["m"]), 7.0)
+
+
+class TestFoldStates(unittest.TestCase):
+    """The typed reduction fold is the core of cross-process sync; exercise it
+    with simulated rank state-dicts for every Reduction."""
+
+    def test_sum_max_min_cat_none(self):
+        ranks = [
+            {
+                "s": jnp.asarray(float(i + 1)),
+                "mx": jnp.asarray(float(i)),
+                "mn": jnp.asarray(float(i)),
+                "c": [jnp.arange(i + 1, dtype=jnp.float32)],
+                "t": jnp.asarray([0.5]),
+            }
+            for i in range(4)
+        ]
+        reductions = {
+            "s": Reduction.SUM,
+            "mx": Reduction.MAX,
+            "mn": Reduction.MIN,
+            "c": Reduction.CAT,
+            "t": Reduction.NONE,
+        }
+        folded = _fold_states(ranks, reductions)
+        self.assertEqual(float(folded["s"]), 10.0)
+        self.assertEqual(float(folded["mx"]), 3.0)
+        self.assertEqual(float(folded["mn"]), 0.0)
+        self.assertEqual(folded["c"][0].shape, (10,))
+        np.testing.assert_allclose(np.asarray(folded["t"]), [0.5])
+
+    def test_custom_raises(self):
+        with self.assertRaises(NotImplementedError):
+            _fold_states(
+                [{"x": jnp.zeros(())}], {"x": Reduction.CUSTOM}
+            )
+
+    def test_fold_matches_merge_state_for_real_metrics(self):
+        """Typed fold of per-rank states == the metric's own merge_state."""
+        n_ranks, batches_per_rank = 4, 2
+        replicas = [MulticlassF1Score(num_classes=5, average="macro") for _ in range(n_ranks)]
+        all_x, all_t = [], []
+        for rep in replicas:
+            for _ in range(batches_per_rank):
+                x = RNG.random((32, 5)).astype(np.float32)
+                t = RNG.integers(0, 5, 32)
+                rep.update(x, t)
+                all_x.append(x)
+                all_t.append(t)
+        gathered = [rep.state_dict() for rep in replicas]
+        folded = _fold_states(gathered, replicas[0]._state_name_to_reduction)
+        merged = merge_metrics(replicas)
+        for name, value in folded.items():
+            np.testing.assert_allclose(
+                np.asarray(value),
+                np.asarray(getattr(merged, name)),
+            )
+
+    def test_fold_throughput_max_elapsed(self):
+        reps = [Throughput() for _ in range(3)]
+        for i, r in enumerate(reps):
+            r.update(num_processed=100 * (i + 1), elapsed_time_sec=float(i + 1))
+        gathered = [r.state_dict() for r in reps]
+        folded = _fold_states(gathered, reps[0]._state_name_to_reduction)
+        self.assertEqual(float(folded["num_total"]), 600.0)
+        self.assertEqual(float(folded["elapsed_time_sec"]), 3.0)  # max, not sum
+
+
+class TestShardedEvaluator(unittest.TestCase):
+    """Implicit SPMD sync: sharded batches + replicated state on the 8-device
+    CPU mesh — the code path that rides ICI on a real pod."""
+
+    def setUp(self):
+        self.assertEqual(len(jax.devices()), 8, "conftest must force 8 devices")
+        self.mesh = data_parallel_mesh()
+
+    def test_sharded_accuracy_matches_host(self):
+        ev = ShardedEvaluator(MulticlassAccuracy(num_classes=10), mesh=self.mesh)
+        xs, ts = [], []
+        for _ in range(4):
+            x = RNG.random((64, 10)).astype(np.float32)
+            t = RNG.integers(0, 10, 64)
+            xs.append(x)
+            ts.append(t)
+            ev.update(x, t)
+        result = float(ev.compute())
+        X, T = np.concatenate(xs), np.concatenate(ts)
+        self.assertAlmostEqual(result, float((X.argmax(1) == T).mean()), places=6)
+
+    def test_batch_really_sharded(self):
+        batch = shard_batch(self.mesh, np.zeros((64, 4), dtype=np.float32))
+        self.assertEqual(len(batch.sharding.device_set), 8)
+        shard_shapes = {s.data.shape for s in batch.addressable_shards}
+        self.assertEqual(shard_shapes, {(8, 4)})
+
+    def test_sharded_collection_and_state_correct(self):
+        ev = ShardedEvaluator(
+            {
+                "acc": MulticlassAccuracy(num_classes=5),
+                "f1": MulticlassF1Score(num_classes=5, average="macro"),
+            },
+            mesh=self.mesh,
+        )
+        x = RNG.random((80, 5)).astype(np.float32)
+        t = RNG.integers(0, 5, 80)
+        out = ev.update(x, t).compute()
+        host_acc = MulticlassAccuracy(num_classes=5).update(x, t).compute()
+        self.assertAlmostEqual(float(out["acc"]), float(host_acc), places=6)
+        from torcheval_tpu.metrics import functional as F
+
+        self.assertAlmostEqual(
+            float(out["f1"]),
+            float(F.multiclass_f1_score(x, t, num_classes=5, average="macro")),
+            places=5,
+        )
+
+    def test_sharded_auroc_sample_cache(self):
+        ev = ShardedEvaluator(BinaryAUROC(), mesh=self.mesh)
+        xs, ts = [], []
+        for _ in range(3):
+            x = RNG.random(64).astype(np.float32)
+            t = RNG.integers(0, 2, 64)
+            xs.append(x)
+            ts.append(t)
+            ev.update(x, t)
+        got = float(ev.compute())
+        want = roc_auc_score(np.concatenate(ts), np.concatenate(xs))
+        self.assertAlmostEqual(got, want, places=5)
+
+    def test_sharded_cat(self):
+        ev = ShardedEvaluator(Cat(), mesh=self.mesh)
+        ev.update(np.arange(16, dtype=np.float32))
+        ev.update(np.arange(16, 32, dtype=np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(ev.compute()), np.arange(32, dtype=np.float32)
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

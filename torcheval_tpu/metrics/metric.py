@@ -111,11 +111,20 @@ class Metric(Generic[TComputeReturn], ABC):
 
         arr = as_jax(x)
         if isinstance(arr, jax.Array) and arr.committed:
-            try:
-                if self._device in arr.devices():
+            if isinstance(self._device, jax.sharding.Sharding):
+                # mesh-placed metric: keep the caller's batch sharding when it
+                # already lives on the metric's mesh — re-placing a
+                # data-sharded batch with the metric's (replicated) sharding
+                # would silently all-gather it. Arrays committed elsewhere
+                # (e.g. CPU-committed torch imports) still need the transfer.
+                if arr.sharding.device_set <= self._device.device_set:
                     return arr
-            except Exception:
-                pass
+            else:
+                try:
+                    if self._device in arr.devices():
+                        return arr
+                except Exception:
+                    pass
         return jax.device_put(arr, self._device)
 
     # --------------------------------------------------------------- protocol

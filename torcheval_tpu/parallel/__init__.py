@@ -1,0 +1,14 @@
+from torcheval_tpu.parallel.evaluator import ShardedEvaluator, eval_shardings
+from torcheval_tpu.parallel.mesh import (
+    data_parallel_mesh,
+    replicate,
+    shard_batch,
+)
+
+__all__ = [
+    "ShardedEvaluator",
+    "data_parallel_mesh",
+    "eval_shardings",
+    "replicate",
+    "shard_batch",
+]

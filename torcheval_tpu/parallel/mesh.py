@@ -1,0 +1,62 @@
+"""Mesh construction and batch-sharding helpers.
+
+The reference's distributed surface is data parallelism: shard the eval
+stream over ranks, merge metric states at the end (SURVEY §2.7). On TPU the
+idiomatic equivalent is a 1-D ``jax.sharding.Mesh`` over a ``"data"`` axis:
+batches are global arrays sharded along axis 0, metric state is replicated,
+and XLA's SPMD partitioner inserts the psum/all-gather collectives over ICI
+when an update kernel reduces across the batch axis. Multi-host pods use the
+same code — ``jax.devices()`` spans all hosts after
+``jax.distributed.initialize()``, and each host feeds its local shard via
+``make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices with axis name ``"data"``."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), axis_names=("data",))
+
+
+def shard_batch(mesh: Mesh, *arrays: jax.Array):
+    """Place arrays as global jax.Arrays sharded along axis 0 of ``mesh``'s
+    ``"data"`` axis. Single-process: a plain device_put with a NamedSharding.
+    Multi-host callers should build global arrays with
+    ``jax.make_array_from_process_local_data`` instead.
+
+    A batch whose axis 0 is not divisible by the mesh size (the last partial
+    batch of an epoch) falls back to a fully-replicated placement — results
+    stay correct (replicated in, replicated out), only that batch loses the
+    data-parallel speedup. Keep batch sizes a multiple of the device count
+    for the hot path.
+    """
+    from torcheval_tpu.utils.convert import as_jax
+
+    n_dev = mesh.devices.size
+    converted = [as_jax(a) for a in arrays]
+    out = tuple(
+        jax.device_put(
+            a,
+            NamedSharding(
+                mesh,
+                P("data", *([None] * (a.ndim - 1)))
+                if a.shape[0] % n_dev == 0
+                else P(),
+            ),
+        )
+        for a in converted
+    )
+    return out[0] if len(out) == 1 else out
+
+
+def replicate(mesh: Mesh, value):
+    """Fully-replicated placement for metric state on ``mesh``."""
+    return jax.device_put(value, NamedSharding(mesh, P()))
