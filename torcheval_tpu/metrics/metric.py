@@ -113,11 +113,12 @@ class Metric(Generic[TComputeReturn], ABC):
         if isinstance(arr, jax.Array) and arr.committed:
             if isinstance(self._device, jax.sharding.Sharding):
                 # mesh-placed metric: keep the caller's batch sharding when it
-                # already lives on the metric's mesh — re-placing a
-                # data-sharded batch with the metric's (replicated) sharding
-                # would silently all-gather it. Arrays committed elsewhere
-                # (e.g. CPU-committed torch imports) still need the transfer.
-                if arr.sharding.device_set <= self._device.device_set:
+                # spans the metric's mesh — re-placing a data-sharded batch
+                # with the metric's (replicated) sharding would silently
+                # all-gather it. Arrays committed elsewhere (CPU-committed
+                # torch imports, single-device subsets) still need the
+                # transfer.
+                if arr.sharding.device_set == self._device.device_set:
                     return arr
             else:
                 try:

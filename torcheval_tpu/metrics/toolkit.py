@@ -127,6 +127,40 @@ def _fold_states(
 
 
 # ------------------------------------------------------------ process world
+# CAT-cache wire descriptor: [n_local, ndim, dtype_code, dim1..dim4]
+_CAT_DTYPES = (
+    jnp.float32,
+    jnp.int32,
+    jnp.bool_,
+    jnp.bfloat16,
+    jnp.float16,
+    jnp.int8,
+    jnp.uint8,
+    jnp.uint32,
+)
+_MAX_CAT_RANK = 5
+
+
+def _encode_cat_descriptor(local) -> "jnp.ndarray":
+    if local is None:
+        return jnp.zeros((3 + _MAX_CAT_RANK - 1,), dtype=jnp.int32)
+    dtype_code = next(
+        (i for i, d in enumerate(_CAT_DTYPES) if jnp.dtype(d) == local.dtype), 0
+    )
+    dims = list(local.shape[1:]) + [0] * (_MAX_CAT_RANK - 1 - (local.ndim - 1))
+    return jnp.asarray(
+        [local.shape[0], local.ndim, dtype_code] + dims, dtype=jnp.int32
+    )
+
+
+def _decode_cat_descriptor(desc: np.ndarray):
+    ndim = int(desc[1])
+    dtype = jnp.dtype(_CAT_DTYPES[int(desc[2])])
+    trailing = tuple(int(d) for d in desc[3 : 3 + ndim - 1])
+    return trailing, dtype
+
+
+
 def _world_size() -> int:
     return jax.process_count()
 
@@ -157,21 +191,32 @@ def _gather_state_dicts(metric: Metric) -> List[Dict[str, TState]]:
         if red is Reduction.CAT:
             cache = list(value) if isinstance(value, (list, deque)) else [value]
             nonempty = [v for v in cache if v.ndim and v.shape[0]]
-            local = (
-                jnp.concatenate(nonempty, axis=0) if nonempty else jnp.empty((0,))
-            )
+            local = jnp.concatenate(nonempty, axis=0) if nonempty else None
+            # descriptor exchange first: a rank whose cache is empty does not
+            # know the trailing dims/dtype, but the collective requires
+            # identical shape+dtype on every rank — adopt them from a
+            # data-bearing rank before padding
+            desc = _encode_cat_descriptor(local)
+            all_desc = np.asarray(multihost_utils.process_allgather(desc))
+            lengths = all_desc[:, 0]
+            max_len = int(lengths.max())
+            if max_len == 0:
+                for rank in range(world):
+                    gathered[rank][name] = []
+                continue
+            ref_desc = all_desc[int(np.argmax(lengths > 0))]
+            trailing, dtype = _decode_cat_descriptor(ref_desc)
+            if local is None:
+                local = jnp.zeros((0,) + trailing, dtype=dtype)
             n_local = local.shape[0]
-            lengths = multihost_utils.process_allgather(
-                jnp.asarray(n_local, dtype=jnp.int32)
-            )
-            max_len = int(np.max(np.asarray(lengths)))
             pad = [(0, max_len - n_local)] + [(0, 0)] * (local.ndim - 1)
             padded = jnp.pad(local, pad) if max_len > n_local else local
             all_vals = multihost_utils.process_allgather(padded)
             for rank in range(world):
-                gathered[rank][name] = [
-                    jnp.asarray(all_vals[rank][: int(np.asarray(lengths)[rank])])
-                ]
+                n_rank = int(lengths[rank])
+                gathered[rank][name] = (
+                    [jnp.asarray(all_vals[rank][:n_rank])] if n_rank else []
+                )
         else:
             all_vals = multihost_utils.process_allgather(jnp.asarray(value))
             for rank in range(world):
