@@ -1,0 +1,123 @@
+"""Tools tests: exact parameter/FLOP counts on hand-sized stacks (SURVEY §4,
+reference ``tests/tools/test_module_summary.py:35-100``). FLOP expectations
+use XLA conventions: multiply and add counted separately (a dot of
+(m,k)x(k,n) is 2mkn; the reference's hand mapping counts mkn MACs)."""
+
+import unittest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.tools import (
+    get_module_summary,
+    get_summary_table,
+    module_flops,
+    prune_module_summary,
+)
+
+
+class Block(nn.Module):
+    feat: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.feat)(x)
+        return nn.relu(x)
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = Block(16)(x)
+        x = Block(8)(x)
+        return nn.Dense(2)(x)
+
+
+class ConvNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(8, (3, 3), padding="VALID", name="conv")(x)
+
+
+class TestModuleSummary(unittest.TestCase):
+    def test_param_counts(self):
+        ms = get_module_summary(
+            MLP(), (jnp.ones((4, 32)),), compute_flops=False
+        )
+        # 32*16+16 + 16*8+8 + 8*2+2 = 528 + 136 + 18
+        self.assertEqual(ms.num_parameters, 682)
+        self.assertEqual(ms.num_trainable_parameters, 682)
+        self.assertEqual(ms.size_bytes, 682 * 4)
+        self.assertFalse(ms.has_uninitialized_param)
+        # compute_flops=False => no FLOP analysis, like the reference
+        # when no input is given
+        self.assertEqual(ms.flops_forward, -1)
+        # flax modules need example inputs; the error says so
+        with self.assertRaisesRegex(TypeError, "example inputs"):
+            get_module_summary(MLP())
+
+    def test_submodule_tree(self):
+        ms = get_module_summary(MLP(), (jnp.ones((4, 32)),))
+        names = set(ms.submodule_summaries)
+        self.assertEqual(names, {"Block_0", "Block_1", "Dense_0"})
+        b0 = ms.submodule_summaries["Block_0"]
+        self.assertEqual(b0.module_type, "Block")
+        self.assertEqual(b0.num_parameters, 528)
+        inner = b0.submodule_summaries["Block_0.Dense_0"]
+        self.assertEqual(inner.module_type, "Dense")
+        self.assertEqual(inner.num_parameters, 528)
+
+    def test_exact_flops_dense(self):
+        ms = get_module_summary(MLP(), (jnp.ones((4, 32)),))
+        d0 = ms.submodule_summaries["Block_0"].submodule_summaries[
+            "Block_0.Dense_0"
+        ]
+        # dot 2*4*32*16 + bias 4*16
+        self.assertEqual(d0.flops_forward, 2 * 4 * 32 * 16 + 64)
+        # block adds the relu elementwise max
+        self.assertEqual(
+            ms.submodule_summaries["Block_0"].flops_forward,
+            d0.flops_forward + 64,
+        )
+        # root >= sum of direct work; backward computed
+        self.assertGreater(ms.flops_forward, 0)
+        self.assertGreater(ms.flops_backward, ms.flops_forward * 0.5)
+
+    def test_exact_flops_conv(self):
+        ms = get_module_summary(ConvNet(), (jnp.ones((1, 8, 8, 3)),))
+        # reference fixture: Conv2d(3,8,3) on 1x3x8x8 = 7,776 MACs
+        # (tests/tools/test_module_summary.py:55); XLA counts 2x + 288 bias adds
+        self.assertEqual(ms.flops_forward, 2 * 7776 + 288)
+
+    def test_prune(self):
+        ms = get_module_summary(MLP(), (jnp.ones((4, 32)),))
+        prune_module_summary(ms, max_depth=2)
+        for child in ms.submodule_summaries.values():
+            self.assertEqual(len(child.submodule_summaries), 0)
+        with self.assertRaises(ValueError):
+            prune_module_summary(ms, max_depth=0)
+
+    def test_summary_table(self):
+        ms = get_module_summary(MLP(), (jnp.ones((4, 32)),))
+        table = get_summary_table(ms)
+        self.assertIn("Name", table)
+        self.assertIn("Forward FLOPs", table)
+        self.assertIn("Block_0.Dense_0", table)
+        raw = get_summary_table(ms, human_readable_nums=False)
+        self.assertIn("682", raw)
+
+    def test_module_flops_accumulates_repeated_calls(self):
+        class Twice(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                inner = nn.Dense(4, name="inner")
+                return inner(inner(x))
+
+        flops = module_flops(Twice(), jnp.ones((2, 4)))
+        # inner called twice: 2 * (2*2*4*4 + 8)
+        self.assertEqual(flops[("inner",)].forward, 2 * (2 * 2 * 4 * 4 + 8))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -144,9 +144,14 @@ _MAX_CAT_RANK = 5
 def _encode_cat_descriptor(local) -> "jnp.ndarray":
     if local is None:
         return jnp.zeros((3 + _MAX_CAT_RANK - 1,), dtype=jnp.int32)
-    dtype_code = next(
-        (i for i, d in enumerate(_CAT_DTYPES) if jnp.dtype(d) == local.dtype), 0
-    )
+    codes = [i for i, d in enumerate(_CAT_DTYPES) if jnp.dtype(d) == local.dtype]
+    if not codes:
+        raise NotImplementedError(
+            f"CAT-state dtype {local.dtype} is not in the sync wire-format "
+            f"allowlist {[jnp.dtype(d).name for d in _CAT_DTYPES]}; cast the "
+            "cache or extend _CAT_DTYPES."
+        )
+    dtype_code = codes[0]
     dims = list(local.shape[1:]) + [0] * (_MAX_CAT_RANK - 1 - (local.ndim - 1))
     return jnp.asarray(
         [local.shape[0], local.ndim, dtype_code] + dims, dtype=jnp.int32

@@ -5,10 +5,12 @@ auroc.py:50-67``, ``precision_recall_curve.py:207-230``) deduplicates tied
 thresholds with boolean masking — a data-dependent shape JAX cannot trace.
 These kernels keep **static shapes** via group-end propagation:
 
-Sort scores descending and take cumulative TP/FP counts. For every position
-``i``, replace its cumulative counts with those at ``j(i)``, the *last* index
-of ``i``'s tie group (found with one ``searchsorted`` against the ascending
-view). Intra-group points then coincide exactly with the group-end point, so:
+Sort scores descending (``lax.sort`` carries the targets with the keys) and
+take cumulative TP/FP counts. For every position ``i``, replace its
+cumulative counts with those at the *last* index of ``i``'s tie group
+(boundary mask + reverse ``cummin`` propagation — log-depth scans, no
+gathers). Intra-group points then coincide exactly with the group-end point,
+so:
 
 * trapezoidal ROC integration gets zero-width segments inside a group and the
   correct tie-diagonal across groups — identical to integrating the deduped
@@ -17,8 +19,8 @@ view). Intra-group points then coincide exactly with the group-end point, so:
 * PR-curve extraction keeps a boolean "last of group" mask for the host-side
   trim at the API boundary (SURVEY §7 "variable-length results under jit").
 
-Everything is one sort + one searchsorted + elementwise ops: O(N log N)
-compute, O(N) memory, fully fused by XLA, no host sync.
+Everything is one sort + two scans + elementwise ops: O(N log N) compute,
+O(N) memory, fully fused by XLA, no host sync, no random gathers.
 """
 
 from __future__ import annotations
@@ -53,7 +55,10 @@ def _group_end_cumsums(
     # tie-group ends sit where the sorted key changes (plus the last element);
     # each position takes the cumsum of its group's end = the min over future
     # boundary values (cumsums are nondecreasing)
-    last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+    if s.shape[0] == 0:
+        last = jnp.zeros((0,), bool)
+    else:
+        last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
     big = jnp.iinfo(jnp.int32).max
     tp = jax.lax.cummin(jnp.where(last, ctp, big), reverse=True)
     fp = jax.lax.cummin(jnp.where(last, cfp, big), reverse=True)
@@ -78,6 +83,8 @@ def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     ``AP = sum(ΔTP_k * precision_k) / TP_total`` over descending thresholds.
     Matches sklearn's ``average_precision_score``; 0.0 when there are no
     positives (the recall axis is undefined)."""
+    if input.shape[0] == 0:  # static shape — resolved at trace time
+        return jnp.asarray(0.0)
     _, itp, ifp, _ = _group_end_cumsums(input, target)
     tp = itp.astype(jnp.float32)
     fp = ifp.astype(jnp.float32)
@@ -96,6 +103,9 @@ def prc_points_kernel(
     "last of tie group" validity mask. The caller selects ``mask`` rows on the
     host and flips to ascending order (reference layout,
     ``precision_recall_curve.py:207-230``)."""
+    if input.shape[0] == 0:  # static shape — resolved at trace time
+        empty = jnp.empty((0,))
+        return empty, empty, empty, jnp.zeros((0,), bool)
     s, itp, ifp, last = _group_end_cumsums(input, target)
     tp = itp.astype(jnp.float32)
     fp = ifp.astype(jnp.float32)

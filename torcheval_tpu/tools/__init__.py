@@ -1,0 +1,15 @@
+from torcheval_tpu.tools.flops import module_flops
+from torcheval_tpu.tools.module_summary import (
+    ModuleSummary,
+    get_module_summary,
+    get_summary_table,
+    prune_module_summary,
+)
+
+__all__ = [
+    "ModuleSummary",
+    "get_module_summary",
+    "get_summary_table",
+    "module_flops",
+    "prune_module_summary",
+]
