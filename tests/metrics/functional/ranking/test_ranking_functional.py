@@ -3,6 +3,7 @@ plus a brute-force numpy oracle for random inputs."""
 
 import unittest
 
+import jax.numpy as jnp
 import numpy as np
 
 from torcheval_tpu.metrics.functional import (
@@ -44,6 +45,18 @@ class TestHitRate(unittest.TestCase):
             np.testing.assert_allclose(
                 np.asarray(hit_rate(scores, target, k=k)), want
             )
+
+    def test_k_none_nan_poisons_invalid_targets_under_jit(self):
+        # the k=None fast path must apply the same NaN validity mask as the
+        # k-set kernel when tracing suppresses the eager range check
+        import jax
+
+        out = jax.jit(lambda i, t: hit_rate(i, t))(
+            jnp.ones((3, 4)), jnp.asarray([0, 5, -1])
+        )
+        got = np.asarray(out)
+        self.assertEqual(got[0], 1.0)
+        self.assertTrue(np.isnan(got[1]) and np.isnan(got[2]))
 
     def test_invalid(self):
         with self.assertRaisesRegex(ValueError, "two-dimensional"):

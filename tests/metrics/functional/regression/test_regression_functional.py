@@ -57,6 +57,14 @@ class TestMeanSquaredError(unittest.TestCase):
                 np.zeros(4), np.zeros(4), sample_weight=np.ones(3)
             )
 
+    def test_2d_sample_weight_rejected(self):
+        # a (n, d) weight would mis-broadcast in the weighted fold; the
+        # documented shape is (n_sample,) only
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            mean_squared_error(
+                np.zeros((4, 2)), np.zeros((4, 2)), sample_weight=np.ones((4, 2))
+            )
+
 
 class TestR2Score(unittest.TestCase):
     def _check(self, input, target, multioutput="uniform_average"):

@@ -120,6 +120,26 @@ class TestFoldStates(unittest.TestCase):
                 [{"x": jnp.zeros(())}], {"x": Reduction.CUSTOM}
             )
 
+    def test_cat_descriptor_rank_guard(self):
+        # a rank-6 cache cannot fit the fixed wire layout; its descriptor
+        # records the oversized ndim and the post-exchange check raises
+        # uniformly on every rank (a pre-collective raise would hang the
+        # empty-cache ranks inside process_allgather)
+        from torcheval_tpu.metrics.toolkit import (
+            _check_cat_descriptors,
+            _encode_cat_descriptor,
+        )
+
+        desc = _encode_cat_descriptor(jnp.zeros((2,) * 6))
+        self.assertEqual(int(desc[1]), 6)
+        all_desc = np.stack([np.zeros(7, np.int32), np.asarray(desc)])
+        with self.assertRaisesRegex(NotImplementedError, "rank 6"):
+            _check_cat_descriptors("inputs", all_desc)
+        # in-range descriptors pass
+        _check_cat_descriptors(
+            "inputs", np.asarray(_encode_cat_descriptor(jnp.zeros((3, 2))))[None]
+        )
+
     def test_fold_matches_merge_state_for_real_metrics(self):
         """Typed fold of per-rank states == the metric's own merge_state."""
         n_ranks, batches_per_rank = 4, 2

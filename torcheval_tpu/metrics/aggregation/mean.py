@@ -13,6 +13,8 @@ from torcheval_tpu.metrics.functional.aggregation.sum import _weight_check
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
+from torcheval_tpu.utils.numerics import safe_div
+from torcheval_tpu.utils.tracing import is_concrete
 
 _logger = logging.getLogger(__name__)
 
@@ -45,10 +47,12 @@ class Mean(Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        if float(self.weights) == 0.0:
+        # trace-safe: the no-update warning reads the value back to the host,
+        # so it only fires on concrete state; the returned expression itself is
+        # branch-free and jit-embeddable (no-update => 0.0 either way)
+        if is_concrete(self.weights) and float(self.weights) == 0.0:
             _logger.warning("No calls to update() have been made - returning 0.0")
-            return jnp.zeros(())
-        return self.weighted_sum / self.weights
+        return safe_div(self.weighted_sum, self.weights)
 
     def merge_state(self, metrics: Iterable["Mean"]) -> "Mean":
         for metric in metrics:

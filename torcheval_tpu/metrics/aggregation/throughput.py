@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
+from torcheval_tpu.utils.numerics import safe_div
+from torcheval_tpu.utils.tracing import is_concrete
 
 _logger = logging.getLogger(__name__)
 
@@ -47,10 +49,10 @@ class Throughput(Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        if float(self.elapsed_time_sec) == 0.0:
+        # trace-safe warning + branch-free result, as in Mean.compute
+        if is_concrete(self.elapsed_time_sec) and float(self.elapsed_time_sec) == 0.0:
             _logger.warning("No calls to update() have been made - returning 0.0")
-            return jnp.zeros(())
-        return self.num_total / self.elapsed_time_sec
+        return safe_div(self.num_total, self.elapsed_time_sec)
 
     def merge_state(self, metrics: Iterable["Throughput"]) -> "Throughput":
         for metric in metrics:

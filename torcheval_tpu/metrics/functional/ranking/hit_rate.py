@@ -77,5 +77,9 @@ def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
     _hit_rate_input_check(input, target, k)
     _target_range_check(input, target)
     if k is None or k >= input.shape[-1]:
-        return jnp.ones(target.shape, dtype=jnp.float32)
+        # same NaN-poisoning as the k-set kernel so invalid-target semantics
+        # match between the two paths under tracing
+        target = target.astype(jnp.int32)
+        valid = (target >= 0) & (target < input.shape[-1])
+        return jnp.where(valid, 1.0, jnp.nan).astype(jnp.float32)
     return _hit_rate_kernel(input, target, k)

@@ -40,12 +40,21 @@ def _mean_squared_error_update_input_check(
             "The `input` and `target` should have the same size, "
             f"got shapes {input.shape} and {target.shape}."
         )
-    if sample_weight is not None and target.shape[0] != sample_weight.shape[0]:
-        raise ValueError(
-            "The first dimension of `input`, `target` and `sample_weight` should "
-            f"be the same size, got shapes {input.shape}, {target.shape} and "
-            f"{sample_weight.shape}."
-        )
+    if sample_weight is not None:
+        # the documented shape is (n_sample,); a 2-D weight would silently
+        # mis-broadcast (n, d) * (n, 1, d) in the weighted fold (torch raises
+        # a broadcast error for the same input — parity, but eager)
+        if sample_weight.ndim != 1:
+            raise ValueError(
+                "The `sample_weight` should be a one-dimensional tensor of "
+                f"shape (n_sample,), got shape {sample_weight.shape}."
+            )
+        if target.shape[0] != sample_weight.shape[0]:
+            raise ValueError(
+                "The first dimension of `input`, `target` and `sample_weight` should "
+                f"be the same size, got shapes {input.shape}, {target.shape} and "
+                f"{sample_weight.shape}."
+            )
 
 
 @jax.jit

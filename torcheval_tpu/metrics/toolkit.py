@@ -144,6 +144,16 @@ _MAX_CAT_RANK = 5
 def _encode_cat_descriptor(local) -> "jnp.ndarray":
     if local is None:
         return jnp.zeros((3 + _MAX_CAT_RANK - 1,), dtype=jnp.int32)
+    if local.ndim > _MAX_CAT_RANK:
+        # the wire descriptor has a fixed 7-element layout and cannot carry
+        # this cache's dims. Do NOT raise here: a one-sided pre-collective
+        # raise would leave empty-cache ranks blocked inside process_allgather.
+        # Emit a descriptor recording the oversized ndim; every rank raises
+        # uniformly after the exchange (_check_cat_descriptors).
+        return jnp.asarray(
+            [local.shape[0], local.ndim, 0] + [0] * (_MAX_CAT_RANK - 1),
+            dtype=jnp.int32,
+        )
     codes = [i for i, d in enumerate(_CAT_DTYPES) if jnp.dtype(d) == local.dtype]
     if not codes:
         raise NotImplementedError(
@@ -156,6 +166,19 @@ def _encode_cat_descriptor(local) -> "jnp.ndarray":
     return jnp.asarray(
         [local.shape[0], local.ndim, dtype_code] + dims, dtype=jnp.int32
     )
+
+
+def _check_cat_descriptors(name: str, all_desc: np.ndarray) -> None:
+    """Post-exchange validation: runs on every rank on identical gathered
+    descriptors, so a failure raises everywhere instead of hanging the
+    collective."""
+    max_rank = int(all_desc[:, 1].max()) if all_desc.size else 0
+    if max_rank > _MAX_CAT_RANK:
+        raise NotImplementedError(
+            f"CAT-state {name!r} has a cache of rank {max_rank} on some "
+            f"process, above the sync wire-format limit {_MAX_CAT_RANK}; "
+            "reshape the cache or extend _MAX_CAT_RANK."
+        )
 
 
 def _decode_cat_descriptor(desc: np.ndarray):
@@ -203,6 +226,7 @@ def _gather_state_dicts(metric: Metric) -> List[Dict[str, TState]]:
             # data-bearing rank before padding
             desc = _encode_cat_descriptor(local)
             all_desc = np.asarray(multihost_utils.process_allgather(desc))
+            _check_cat_descriptors(name, all_desc)
             lengths = all_desc[:, 0]
             max_len = int(lengths.max())
             if max_len == 0:

@@ -13,11 +13,15 @@ same code — ``jax.devices()`` spans all hosts after
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_logger = logging.getLogger(__name__)
+_warned_uneven_batch = False
 
 
 def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -40,8 +44,20 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
     """
     from torcheval_tpu.utils.convert import as_jax
 
+    global _warned_uneven_batch
     n_dev = mesh.devices.size
     converted = [as_jax(a) for a in arrays]
+    if not _warned_uneven_batch and any(
+        a.shape[0] % n_dev != 0 for a in converted
+    ):
+        _warned_uneven_batch = True
+        _logger.warning(
+            "shard_batch: batch axis not divisible by the %d-device mesh; "
+            "falling back to a replicated placement for such batches (correct "
+            "but not data-parallel). Pad batches to a multiple of the device "
+            "count for full speed. (warned once)",
+            n_dev,
+        )
     out = tuple(
         jax.device_put(
             a,

@@ -1,0 +1,17 @@
+"""Small shared numeric helpers for trace-safe kernels."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def safe_div(num, den, *, fill=0.0) -> jax.Array:
+    """``num / den`` with ``fill`` where ``den == 0``.
+
+    Branch-free and jit-embeddable: the guarded denominator keeps the untaken
+    division from producing inf/nan (which would still propagate through
+    ``jnp.where`` gradients and debug-nan checks).
+    """
+    zero = den == 0
+    return jnp.where(zero, fill, num / jnp.where(zero, 1.0, den))
