@@ -1,0 +1,144 @@
+"""Worker executed by ``test_multiprocess_sync.py`` in 4 real OS processes.
+
+Each process joins a ``jax.distributed`` CPU world (Gloo collectives), streams
+its rank's shard into local metric replicas, and drives the *explicit* sync
+path — ``sync_and_compute`` / ``get_synced_metric`` / ``get_synced_state_dict``
+— exactly as a multi-host TPU pod would. This is the JAX equivalent of the
+reference's tier-3 strategy (``torcheval/utils/test_utils/
+metric_class_tester.py:272-311``: ``elastic_launch`` of 4 local processes).
+
+Run:  python mp_sync_worker.py <rank> <world> <port> <outdir>
+Writes <outdir>/rank<r>.json with every scenario's results.
+
+The data-generation helpers live here (imported by the parent test for its
+single-stream oracles) and must stay deterministic in (rank, world).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+NUM_CLASSES = 5
+ACC_BATCH = 64
+# deliberately uneven AUROC shard sizes, including one empty rank (rank 2):
+# exercises the CAT descriptor exchange's empty-rank adoption path
+AUROC_SIZES = [37, 11, 0, 52]
+
+
+def make_acc_shard(rank: int):
+    rng = np.random.default_rng(100 + rank)
+    scores = rng.random((ACC_BATCH, NUM_CLASSES)).astype(np.float32)
+    labels = rng.integers(0, NUM_CLASSES, ACC_BATCH)
+    return scores, labels
+
+
+def make_auroc_shard(rank: int):
+    n = AUROC_SIZES[rank]
+    rng = np.random.default_rng(200 + rank)
+    scores = rng.random(n).astype(np.float32)
+    targets = (rng.random(n) < 0.4).astype(np.float32)
+    return scores, targets
+
+
+def make_dict_updates(rank: int):
+    # overlapping and rank-unique keys
+    return [("shared", float(rank + 1)), (f"rank{rank}", 10.0 * (rank + 1))]
+
+
+def _jsonable(x):
+    arr = np.asarray(x)
+    return arr.tolist() if arr.ndim else float(arr)
+
+
+def main() -> None:
+    rank, world, port, outdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}", num_processes=world, process_id=rank
+    )
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics import (
+        BinaryAUROC,
+        MulticlassAccuracy,
+        Sum,
+        Throughput,
+    )
+    from torcheval_tpu.metrics.toolkit import (
+        get_synced_metric,
+        get_synced_state_dict,
+        sync_and_compute,
+    )
+    from torcheval_tpu.utils.test_utils import DummySumDictStateMetric
+
+    assert jax.process_count() == world
+
+    results = {"rank": rank}
+
+    # --- SUM-state: Sum, recipient permutations 0 / 1 / "all"
+    s = Sum()
+    s.update(jnp.asarray([float(rank + 1), 2.0 * (rank + 1)]))
+    for recipient in (0, 1, "all"):
+        r = sync_and_compute(s, recipient_rank=recipient)
+        results[f"sum_r{recipient}"] = None if r is None else _jsonable(r)
+
+    # --- SUM-state with per-class counters: MulticlassAccuracy
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    scores, labels = make_acc_shard(rank)
+    acc.update(jnp.asarray(scores), jnp.asarray(labels))
+    r = sync_and_compute(acc, recipient_rank="all")
+    results["acc_all"] = _jsonable(r)
+
+    # --- MAX-state: Throughput (sum counts, max elapsed)
+    t = Throughput()
+    t.update(num_processed=100 * (rank + 1), elapsed_time_sec=float(rank + 1))
+    r = sync_and_compute(t, recipient_rank="all")
+    results["throughput_all"] = _jsonable(r)
+
+    # --- CAT-state, uneven lengths incl. an empty rank: BinaryAUROC
+    auroc = BinaryAUROC()
+    a_scores, a_targets = make_auroc_shard(rank)
+    if a_scores.size:  # rank 2 never updates — its cache stays empty
+        auroc.update(jnp.asarray(a_scores), jnp.asarray(a_targets))
+    r = sync_and_compute(auroc, recipient_rank="all")
+    results["auroc_all"] = _jsonable(r)
+    r0 = sync_and_compute(auroc, recipient_rank=0)
+    results["auroc_r0"] = None if r0 is None else _jsonable(r0)
+
+    # --- synced metric object + synced state dict on recipient 1
+    synced = get_synced_metric(acc, recipient_rank=1)
+    results["synced_metric_r1"] = (
+        None if synced is None else _jsonable(synced.compute())
+    )
+    sd = get_synced_state_dict(acc, recipient_rank=1)
+    results["synced_sd_r1_keys"] = sorted(sd)
+    if sd:
+        results["synced_sd_r1_num_total"] = _jsonable(sd["num_total"])
+
+    # --- dict-state metric through the object-gather fallback
+    d = DummySumDictStateMetric()
+    for key, val in make_dict_updates(rank):
+        d.update(key, val)
+    r = sync_and_compute(d, recipient_rank="all")
+    results["dict_all"] = _jsonable(r)
+    synced_d = get_synced_metric(d, recipient_rank=0)
+    results["dict_keys_r0"] = (
+        None if synced_d is None else sorted(synced_d.x)
+    )
+
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+
+
+if __name__ == "__main__":
+    main()

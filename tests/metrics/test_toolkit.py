@@ -140,6 +140,32 @@ class TestFoldStates(unittest.TestCase):
             "inputs", np.asarray(_encode_cat_descriptor(jnp.zeros((3, 2))))[None]
         )
 
+    def test_cat_descriptor_dtype_guard_is_post_exchange(self):
+        # unsupported dtypes encode the -1 sentinel (no one-sided raise that
+        # would hang empty-cache peers) and fail uniformly after the exchange
+        from torcheval_tpu.metrics.toolkit import (
+            _check_cat_descriptors,
+            _encode_cat_descriptor,
+        )
+
+        desc = _encode_cat_descriptor(jnp.zeros((4,), dtype=jnp.int16))
+        self.assertEqual(int(desc[2]), -1)
+        with self.assertRaisesRegex(NotImplementedError, "dtype"):
+            _check_cat_descriptors("inputs", np.asarray(desc)[None])
+
+    def test_tree_host_roundtrip_preserves_container_metadata(self):
+        from collections import defaultdict, deque
+
+        from torcheval_tpu.metrics.metric import _zero_scalar
+        from torcheval_tpu.metrics.toolkit import _tree_to_device, _tree_to_host
+
+        d = defaultdict(_zero_scalar, {"a": jnp.asarray(1.0)})
+        q = deque([jnp.asarray([1.0])], maxlen=3)
+        back = _tree_to_device(_tree_to_host({"d": d, "q": q}))
+        self.assertIsInstance(back["d"], defaultdict)
+        self.assertEqual(float(back["d"]["missing"]), 0.0)
+        self.assertEqual(back["q"].maxlen, 3)
+
     def test_fold_matches_merge_state_for_real_metrics(self):
         """Typed fold of per-rank states == the metric's own merge_state."""
         n_ranks, batches_per_rank = 4, 2

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import copy
 import logging
-from collections import deque
+from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, TypeVar, Union
 
 import jax
@@ -155,13 +155,10 @@ def _encode_cat_descriptor(local) -> "jnp.ndarray":
             dtype=jnp.int32,
         )
     codes = [i for i, d in enumerate(_CAT_DTYPES) if jnp.dtype(d) == local.dtype]
-    if not codes:
-        raise NotImplementedError(
-            f"CAT-state dtype {local.dtype} is not in the sync wire-format "
-            f"allowlist {[jnp.dtype(d).name for d in _CAT_DTYPES]}; cast the "
-            "cache or extend _CAT_DTYPES."
-        )
-    dtype_code = codes[0]
+    # an unsupported dtype must not raise here either (same one-sided-hang
+    # class as the oversized ndim): encode the sentinel -1 and fail uniformly
+    # post-exchange in _check_cat_descriptors
+    dtype_code = codes[0] if codes else -1
     dims = list(local.shape[1:]) + [0] * (_MAX_CAT_RANK - 1 - (local.ndim - 1))
     return jnp.asarray(
         [local.shape[0], local.ndim, dtype_code] + dims, dtype=jnp.int32
@@ -179,6 +176,12 @@ def _check_cat_descriptors(name: str, all_desc: np.ndarray) -> None:
             f"process, above the sync wire-format limit {_MAX_CAT_RANK}; "
             "reshape the cache or extend _MAX_CAT_RANK."
         )
+    if all_desc.size and int(all_desc[:, 2].min()) < 0:
+        raise NotImplementedError(
+            f"CAT-state {name!r} has a cache dtype outside the sync "
+            f"wire-format allowlist {[jnp.dtype(d).name for d in _CAT_DTYPES]} "
+            "on some process; cast the cache or extend _CAT_DTYPES."
+        )
 
 
 def _decode_cat_descriptor(desc: np.ndarray):
@@ -195,6 +198,78 @@ def _world_size() -> int:
 
 def _process_index() -> int:
     return jax.process_index()
+
+
+# ------------------------------------------------------- object-gather lane
+def _tree_to_host(value):
+    """Recursively convert a TState container's arrays to host numpy so the
+    pickled wire payload is backend-independent. Container metadata
+    (defaultdict factory, deque maxlen) is preserved so the round trip
+    through :func:`_tree_to_device` is the identity on structure."""
+    if isinstance(value, dict):
+        out = {k: _tree_to_host(v) for k, v in value.items()}
+        if isinstance(value, defaultdict):
+            d = defaultdict(value.default_factory)
+            d.update(out)
+            return d
+        return out
+    if isinstance(value, deque):
+        return deque((_tree_to_host(v) for v in value), maxlen=value.maxlen)
+    if isinstance(value, list):
+        return [_tree_to_host(v) for v in value]
+    if isinstance(value, jax.Array):
+        return np.asarray(value)
+    return value
+
+
+def _tree_to_device(value):
+    """Inverse of :func:`_tree_to_host`: numpy leaves back to jax arrays."""
+    if isinstance(value, dict):
+        out = {k: _tree_to_device(v) for k, v in value.items()}
+        if isinstance(value, defaultdict):
+            d = defaultdict(value.default_factory)
+            d.update(out)
+            return d
+        return out
+    if isinstance(value, deque):
+        return deque((_tree_to_device(v) for v in value), maxlen=value.maxlen)
+    if isinstance(value, list):
+        return [_tree_to_device(v) for v in value]
+    if isinstance(value, np.ndarray):
+        return jnp.asarray(value)
+    return value
+
+
+def _allgather_object(obj: Any) -> List[Any]:
+    """All-gather an arbitrary picklable object across JAX processes.
+
+    This is the reference's ``dist.all_gather_object`` (``toolkit.py:235-257``)
+    rebuilt on typed XLA collectives: pickle → uint8 payload → length exchange
+    → pad to the max → ``process_allgather`` → trim + unpickle per rank. Used
+    only for states the typed lanes cannot carry (dict-keyed state, CUSTOM
+    reductions); array/list states always travel as typed arrays.
+    """
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    world = _world_size()
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    lengths = np.asarray(
+        multihost_utils.process_allgather(
+            jnp.asarray([payload.size], dtype=jnp.int32)
+        )
+    ).reshape(world)
+    max_len = int(lengths.max())
+    padded = np.zeros(max(max_len, 1), dtype=np.uint8)
+    padded[: payload.size] = payload
+    all_bytes = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(padded))
+    ).reshape(world, -1)
+    return [
+        pickle.loads(all_bytes[rank, : lengths[rank]].tobytes())
+        for rank in range(world)
+    ]
 
 
 def _gather_state_dicts(metric: Metric) -> List[Dict[str, TState]]:
@@ -253,6 +328,34 @@ def _gather_state_dicts(metric: Metric) -> List[Dict[str, TState]]:
     return gathered
 
 
+def _needs_object_sync(metric: Metric) -> bool:
+    """True when some state cannot travel on the typed lanes: dict-keyed
+    state (arbitrary keys) or a CUSTOM reduction (only the metric's own
+    ``merge_state`` knows how to fold it)."""
+    for name, red in metric._state_name_to_reduction.items():
+        if red is Reduction.CUSTOM or isinstance(getattr(metric, name), dict):
+            return True
+    return False
+
+
+def _object_synced_metric(
+    metric: TMetric, recipient_rank: _RecipientRank
+) -> Optional[TMetric]:
+    """Fallback sync for dict/CUSTOM states: all-gather the whole state_dict
+    as a pickled payload (over typed uint8 collectives) and fold with the
+    metric's own ``merge_state`` — the reference's object-gather semantics
+    (``toolkit.py:217-257``) without ``torch.distributed``."""
+    gathered_sds = _allgather_object(_tree_to_host(metric.state_dict()))
+    if recipient_rank != "all" and _process_index() != recipient_rank:
+        return None
+    replicas = []
+    for sd in gathered_sds:
+        rep = clone_metric(metric)
+        rep.load_state_dict(_tree_to_device(sd))
+        replicas.append(rep)
+    return replicas[0].merge_state(replicas[1:])
+
+
 def get_synced_metric(
     metric: TMetric,
     recipient_rank: _RecipientRank = 0,
@@ -264,6 +367,9 @@ def get_synced_metric(
 
     Reference parity: ``toolkit.py:145-232`` — world size 1 returns the input
     metric with a warning; ``recipient_rank="all"`` returns on every rank.
+    Array/list states travel as typed per-state collectives; dict-keyed and
+    CUSTOM-reduction states fall back to a pickled object gather
+    (:func:`_allgather_object`) folded by the metric's own ``merge_state``.
     """
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
@@ -278,6 +384,8 @@ def get_synced_metric(
         )
         return metric
     metric._prepare_for_merge_state()
+    if _gathered is None and _needs_object_sync(metric):
+        return _object_synced_metric(metric, recipient_rank)
     gathered = _gathered if _gathered is not None else _gather_state_dicts(metric)
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None

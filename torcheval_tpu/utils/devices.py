@@ -23,13 +23,15 @@ def canonical_device(device: DeviceLike) -> Union[jax.Device, jax.sharding.Shard
     (``"cpu"``, ``"tpu"``), or ``None`` (default device).
     """
     if device is None:
-        return jax.devices()[0]
+        # local_devices, not devices: in a multi-process world the global
+        # list leads with rank 0's device, which other ranks cannot address
+        return jax.local_devices()[0]
     if isinstance(device, jax.sharding.Sharding):
         return device
     if isinstance(device, jax.Device):
         return device
     if isinstance(device, str):
-        devs = jax.devices(device)
+        devs = [d for d in jax.devices(device) if d.process_index == jax.process_index()]
         if not devs:
             raise ValueError(f"No devices found for platform {device!r}.")
         return devs[0]
