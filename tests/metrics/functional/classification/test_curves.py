@@ -239,3 +239,77 @@ class TestBinaryNormalizedEntropy(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestCompactCounts(unittest.TestCase):
+    """Unit tests for the threshold-summary compaction kernel
+    (``ops/summary.py``): static shapes, tie merging, padding discipline."""
+
+    def _run(self, scores, tp, fp):
+        import jax.numpy as jnp
+
+        from torcheval_tpu.ops.summary import compact_counts
+
+        return compact_counts(
+            jnp.asarray(scores, jnp.float32),
+            jnp.asarray(tp, jnp.int32),
+            jnp.asarray(fp, jnp.int32),
+        )
+
+    def test_merges_ties_and_pads(self):
+        s, tp, fp, n = self._run(
+            [0.5, 0.2, 0.5, 0.9, 0.2, 0.2],
+            [1, 0, 0, 1, 1, 0],
+            [0, 1, 1, 0, 0, 1],
+        )
+        self.assertEqual(int(n), 3)
+        np.testing.assert_allclose(np.asarray(s[:3]), [0.9, 0.5, 0.2])
+        np.testing.assert_array_equal(np.asarray(tp[:3]), [1, 1, 1])
+        np.testing.assert_array_equal(np.asarray(fp[:3]), [0, 1, 2])
+        # padding: NaN scores, zero counts, static length preserved
+        self.assertEqual(s.shape, (6,))
+        self.assertTrue(np.all(np.isnan(np.asarray(s[3:]))))
+        self.assertEqual(int(np.asarray(tp[3:]).sum()), 0)
+
+    def test_existing_padding_recompacts_to_padding(self):
+        s, tp, fp, n = self._run(
+            [0.3, np.nan, 0.3, np.nan], [1, 0, 0, 0], [0, 0, 1, 0]
+        )
+        self.assertEqual(int(n), 1)
+        np.testing.assert_allclose(np.asarray(s[:1]), [0.3])
+        self.assertTrue(np.all(np.isnan(np.asarray(s[1:]))))
+
+    def test_neg_inf_is_a_legal_score_not_padding(self):
+        # -inf scores (log(0) log-probs) must survive compaction: they sort
+        # after every finite score but BEFORE the NaN padding block
+        s, tp, fp, n = self._run(
+            [0.5, -np.inf, -np.inf, np.nan], [1, 1, 0, 0], [0, 0, 1, 0]
+        )
+        self.assertEqual(int(n), 2)
+        np.testing.assert_allclose(np.asarray(s[:2]), [0.5, -np.inf])
+        np.testing.assert_array_equal(np.asarray(tp[:2]), [1, 1])
+        np.testing.assert_array_equal(np.asarray(fp[:2]), [0, 1])
+        self.assertTrue(np.all(np.isnan(np.asarray(s[2:]))))
+
+    def test_summary_feeds_curve_kernels_exactly(self):
+        from sklearn.metrics import average_precision_score, roc_auc_score
+
+        from torcheval_tpu.ops.curves import (
+            binary_auprc_counts_kernel,
+            binary_auroc_counts_kernel,
+        )
+
+        rng = np.random.default_rng(7)
+        scores = (rng.random(5000) * 50).astype(np.int32) / 50.0  # heavy ties
+        target = (rng.random(5000) < 0.4).astype(np.int32)
+        s, tp, fp, _ = self._run(scores, target, 1 - target)
+        auc = float(binary_auroc_counts_kernel(s, tp, fp))
+        ap = float(binary_auprc_counts_kernel(s, tp, fp))
+        self.assertAlmostEqual(auc, roc_auc_score(target, scores), places=6)
+        self.assertAlmostEqual(
+            ap, average_precision_score(target, scores), places=5
+        )
+
+    def test_empty(self):
+        s, tp, fp, n = self._run([], [], [])
+        self.assertEqual((s.shape, int(n)), ((0,), 0))

@@ -2,10 +2,18 @@
 ``torcheval/metrics/classification/auroc.py:23-94``.
 
 Sample-cache metrics: update appends the batch (O(1) host op, no device
-work); all cost lives in ``compute()``'s single fused sort kernel.
+work). With the default configuration all cost lives in ``compute()``'s
+single fused sort kernel, exactly like the reference. For the 1B-sample
+regime (BASELINE north star) pass ``compaction_threshold``: once the raw
+cache holds that many samples it is folded into a bounded **exact**
+per-unique-threshold summary (``ops/summary.py``) — float32 scores admit at
+most 2^24 distinct values per unit range, so memory stays ~constant while
+results remain bit-identical to the all-samples sort.
 """
 
 from __future__ import annotations
+
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -14,58 +22,174 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _auroc_update_input_check,
 )
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
-from torcheval_tpu.ops.curves import binary_auprc_kernel, binary_auroc_kernel
+from torcheval_tpu.ops.curves import (
+    binary_auprc_counts_kernel,
+    binary_auroc_counts_kernel,
+)
+from torcheval_tpu.ops.summary import PAD_SCORE, compact_counts
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class BinaryAUROC(SampleCacheMetric[jax.Array]):
-    """Streaming area under the ROC curve (exact, sort-based).
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
 
-    State is the full sample cache (reference design, ``auroc.py:55-71``);
-    for bounded state use the binned PRC metrics instead.
+
+class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
+    """Shared cache + compaction machinery for the binary curve metrics.
+
+    State is five CAT caches: raw ``inputs``/``targets`` plus a summary of
+    (score, tp, fp) columns — ``summary_scores`` (float, ``NaN`` padding)
+    and ``summary_tp``/``summary_fp`` (int32 counts — exact while the
+    stream's TOTAL positives and negatives each stay below 2^31; see
+    ``ops/summary.py``). CAT reduction is correct for the summary too:
+    concatenated summaries (across replicas or processes) may repeat a
+    threshold, and the weighted curve kernels merge tied scores by
+    construction — no re-compaction is needed for correctness.
     """
 
-    def __init__(self, *, device: DeviceLike = None) -> None:
+    def __init__(
+        self,
+        *,
+        compaction_threshold: Optional[int] = None,
+        device: DeviceLike = None,
+    ) -> None:
         super().__init__(device=device)
+        if compaction_threshold is not None and compaction_threshold <= 0:
+            raise ValueError(
+                f"compaction_threshold must be positive or None, got "
+                f"{compaction_threshold}."
+            )
+        self._compaction_threshold = compaction_threshold
+        self._cached_samples = 0
         self._add_cache_state("inputs")
         self._add_cache_state("targets")
+        self._add_cache_state("summary_scores")
+        self._add_cache_state("summary_tp")
+        self._add_cache_state("summary_fp")
 
-    def update(self, input, target) -> "BinaryAUROC":
+    def update(self, input, target) -> "_BinaryCurveMetric":
         input, target = self._input(input), self._input(target)
         _auroc_update_input_check(input, target)
         self.inputs.append(input)
         self.targets.append(target)
+        self._cached_samples += input.shape[0]
+        if (
+            self._compaction_threshold is not None
+            and self._cached_samples >= self._compaction_threshold
+        ):
+            self._compact()
         return self
 
-    def compute(self) -> jax.Array:
-        if not self.inputs:
-            return jnp.asarray(0.5)
-        return binary_auroc_kernel(
-            self._concat_cache("inputs"), self._concat_cache("targets")
+    # ------------------------------------------------------------ compaction
+    def _all_counts(self) -> Optional[Tuple[jax.Array, jax.Array, jax.Array]]:
+        """Every cached row as (score, tp, fp) count columns: raw samples are
+        unit counts, summary rows are pre-aggregated."""
+        scores, tps, fps = [], [], []
+        if self.inputs:
+            s = jnp.concatenate(self.inputs)
+            t = jnp.concatenate(self.targets).astype(jnp.int32)
+            scores.append(s)
+            tps.append(t)
+            fps.append(1 - t)
+        if self.summary_scores:
+            scores.append(jnp.concatenate(self.summary_scores))
+            tps.append(jnp.concatenate(self.summary_tp))
+            fps.append(jnp.concatenate(self.summary_fp))
+        if not scores:
+            return None
+        return (
+            jnp.concatenate(scores),
+            jnp.concatenate(tps),
+            jnp.concatenate(fps),
         )
 
+    def _compact(self) -> None:
+        """Fold raw cache + summary into one padded unique-threshold summary.
 
-class BinaryAUPRC(SampleCacheMetric[jax.Array]):
+        The buffer is padded to the next power of two so XLA compiles O(log)
+        distinct shapes over a metric's lifetime, not one per chunk size.
+        """
+        counts = self._all_counts()
+        if counts is None:
+            return
+        s, tp, fp = counts
+        n = s.shape[0]
+        cap = _next_pow2(n)
+        if cap > n:
+            s = jnp.concatenate([s, jnp.full((cap - n,), PAD_SCORE, s.dtype)])
+            tp = jnp.concatenate([tp, jnp.zeros((cap - n,), jnp.int32)])
+            fp = jnp.concatenate([fp, jnp.zeros((cap - n,), jnp.int32)])
+        s, tp, fp, n_unique = compact_counts(s, tp, fp)
+        # trim to the tightest power of two that holds the unique rows, so a
+        # low-cardinality stream keeps a small buffer (host sync once per
+        # compaction — the cold path)
+        keep = min(cap, _next_pow2(max(int(n_unique), 1)))
+        self.inputs = []
+        self.targets = []
+        self.summary_scores = [s[:keep]]
+        self.summary_tp = [tp[:keep]]
+        self.summary_fp = [fp[:keep]]
+        self._cached_samples = 0
+
+    def _prepare_for_merge_state(self) -> None:
+        # compacting metrics ship their bounded summary (one buffer per
+        # state), not the raw cache; reference hook semantics
+        # (metric.py:112-121)
+        if self._compaction_threshold is not None:
+            self._compact()
+        super()._prepare_for_merge_state()
+
+    # -------------------------------------------- cache-counter maintenance
+    # every path that rewrites the raw cache must keep _cached_samples true,
+    # or merge-fed accumulators would never compact (unbounded growth) and
+    # reset metrics would compact spuriously
+    def _recount_cache(self) -> None:
+        self._cached_samples = sum(int(a.shape[0]) for a in self.inputs)
+        if (
+            self._compaction_threshold is not None
+            and self._cached_samples >= self._compaction_threshold
+        ):
+            self._compact()
+
+    def merge_state(self, metrics):
+        super().merge_state(metrics)
+        self._recount_cache()
+        return self
+
+    def reset(self):
+        super().reset()
+        self._cached_samples = 0
+        return self
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        super().load_state_dict(state_dict, strict)
+        self._recount_cache()
+
+
+class BinaryAUROC(_BinaryCurveMetric):
+    """Streaming area under the ROC curve (exact, sort-based).
+
+    By default state is the full sample cache (reference design,
+    ``auroc.py:55-71``); with ``compaction_threshold`` set, state is a
+    bounded exact unique-threshold summary. For fixed-size approximate state
+    use the binned PRC metrics instead.
+    """
+
+    def compute(self) -> jax.Array:
+        counts = self._all_counts()
+        if counts is None:
+            return jnp.asarray(0.5)
+        return binary_auroc_counts_kernel(*counts)
+
+
+class BinaryAUPRC(_BinaryCurveMetric):
     """Streaming area under the PR curve (average precision).
 
     Framework extension (not in the reference snapshot v0.0.3; required by
     BASELINE.md config 2)."""
 
-    def __init__(self, *, device: DeviceLike = None) -> None:
-        super().__init__(device=device)
-        self._add_cache_state("inputs")
-        self._add_cache_state("targets")
-
-    def update(self, input, target) -> "BinaryAUPRC":
-        input, target = self._input(input), self._input(target)
-        _auroc_update_input_check(input, target)
-        self.inputs.append(input)
-        self.targets.append(target)
-        return self
-
     def compute(self) -> jax.Array:
-        if not self.inputs:
+        counts = self._all_counts()
+        if counts is None:
             return jnp.asarray(0.0)
-        return binary_auprc_kernel(
-            self._concat_cache("inputs"), self._concat_cache("targets")
-        )
+        return binary_auprc_counts_kernel(*counts)

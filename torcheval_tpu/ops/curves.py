@@ -34,27 +34,41 @@ import jax.numpy as jnp
 def _group_end_cumsums(
     input: jax.Array, target: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Sort desc and return (thresholds, tp, fp, last_of_group) with cumulative
-    counts propagated to each tie group's end.
+    """Raw-sample (unit count) case of :func:`_group_end_count_cumsums`."""
+    t = target.astype(jnp.int32)
+    return _group_end_count_cumsums(input, t, 1 - t)
 
-    TPU-tuned lowering: ``lax.sort`` carries the targets alongside the keys
-    (no 10M-element random gather), and group-end propagation is a reverse
+
+def _group_end_count_cumsums(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Weighted-count generalisation of :func:`_group_end_cumsums`.
+
+    Each row is a (score, tp_count, fp_count) triple — a raw sample is the
+    unit case ``(s, t, 1-t)``; a compacted summary row carries per-unique
+    aggregated counts (``ops/summary.py``). Rows with ``NaN`` score are
+    padding: XLA's total order sorts them behind every real score (including
+    ``-inf``) and ``NaN != NaN`` keeps them out of real tie groups, so with
+    their zero counts they add only zero-width curve segments — no validity
+    mask needed.
+
+    TPU-tuned lowering: ``lax.sort`` carries the counts alongside the keys
+    (no N-element random gather), and group-end propagation is a reverse
     ``cummin`` over boundary-masked cumsums (a log-depth scan) instead of a
     ``searchsorted`` (which lowers to ~log2(N) gather passes). Measured 40x
     faster than the argsort+searchsorted formulation at N=10M on v5e.
+
+    int32 cumulative counts: exact while total positives and negatives each
+    stay below 2^31 (~2.1e9); a float32 running sum would instead silently
+    stall at 2^24 (repo exactness rule, ops/confusion.py). Streams beyond
+    2^31 per class would wrap — out of scope for the 1B north star.
     """
-    neg, t = jax.lax.sort(
-        (-input, target.astype(jnp.int32)), num_keys=1
-    )  # ascending on -input == descending on input
+    neg, tp_c, fp_c = jax.lax.sort(
+        (-scores, tp_w.astype(jnp.int32), fp_w.astype(jnp.int32)), num_keys=1
+    )
     s = -neg
-    # int32 cumulative counts: a float32 running sum silently stops
-    # incrementing at 2**24 samples (repo exactness rule, ops/confusion.py);
-    # callers cast to float only at the final divisions/integration
-    ctp = jnp.cumsum(t, dtype=jnp.int32)
-    cfp = jnp.cumsum(1 - t, dtype=jnp.int32)
-    # tie-group ends sit where the sorted key changes (plus the last element);
-    # each position takes the cumsum of its group's end = the min over future
-    # boundary values (cumsums are nondecreasing)
+    ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
+    cfp = jnp.cumsum(fp_c, dtype=jnp.int32)
     if s.shape[0] == 0:
         last = jnp.zeros((0,), bool)
     else:
@@ -66,10 +80,13 @@ def _group_end_cumsums(
 
 
 @jax.jit
-def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
-    """Exact trapezoidal AUROC; 0.5 when targets are all-one or all-zero
-    (reference degenerate guard, ``auroc.py:60-66``)."""
-    _, tp, fp, _ = _group_end_cumsums(input, target)
+def binary_auroc_counts_kernel(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> jax.Array:
+    """Exact trapezoidal AUROC over (score, tp_count, fp_count) rows; 0.5
+    when targets are all-one or all-zero (reference degenerate guard,
+    ``auroc.py:60-66``)."""
+    _, tp, fp, _ = _group_end_count_cumsums(scores, tp_w, fp_w)
     tp = jnp.concatenate([jnp.zeros(1, jnp.int32), tp]).astype(jnp.float32)
     fp = jnp.concatenate([jnp.zeros(1, jnp.int32), fp]).astype(jnp.float32)
     factor = tp[-1] * fp[-1]
@@ -78,14 +95,16 @@ def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
-    """Average-precision (step) integration of the PR curve:
+def binary_auprc_counts_kernel(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> jax.Array:
+    """Average-precision (step) integration over (score, tp, fp) count rows:
     ``AP = sum(ΔTP_k * precision_k) / TP_total`` over descending thresholds.
     Matches sklearn's ``average_precision_score``; 0.0 when there are no
     positives (the recall axis is undefined)."""
-    if input.shape[0] == 0:  # static shape — resolved at trace time
+    if scores.shape[0] == 0:  # static shape — resolved at trace time
         return jnp.asarray(0.0)
-    _, itp, ifp, _ = _group_end_cumsums(input, target)
+    _, itp, ifp, _ = _group_end_count_cumsums(scores, tp_w, fp_w)
     tp = itp.astype(jnp.float32)
     fp = ifp.astype(jnp.float32)
     precision = tp / jnp.maximum(tp + fp, 1.0)
@@ -93,6 +112,20 @@ def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     total = tp[-1]
     ap = jnp.sum(delta_tp * precision) / jnp.maximum(total, 1.0)
     return jnp.where(total == 0, 0.0, ap)
+
+
+@jax.jit
+def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    """Exact trapezoidal AUROC on raw samples (unit counts)."""
+    t = target.astype(jnp.int32)
+    return binary_auroc_counts_kernel(input, t, 1 - t)
+
+
+@jax.jit
+def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    """Average precision on raw samples (unit counts)."""
+    t = target.astype(jnp.int32)
+    return binary_auprc_counts_kernel(input, t, 1 - t)
 
 
 @jax.jit

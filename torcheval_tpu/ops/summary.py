@@ -1,0 +1,85 @@
+"""Threshold-summary compaction: the bounded-memory path to 1B-sample curves.
+
+The reference's AUROC/PRC metrics cache every sample and sort once at compute
+(``torcheval/metrics/classification/auroc.py:55-71``) — at 1B predictions the
+cache alone is ~8 GB and the sort workspace more, beyond a single chip's HBM.
+But the *sufficient statistic* for every threshold-curve metric is far
+smaller: per unique score, the aggregated (tp_count, fp_count). float32
+scores have at most 2^24 distinct values in any unit range, so a summary of
+(score, tp, fp) rows is bounded at ~200 MB regardless of sample count — and
+it is **exact**, not a binned approximation: feeding summary rows to the
+weighted curve kernels (``ops/curves.py``) reproduces the raw-sample result
+bit-for-bit because tied scores collapse into one cumsum step either way.
+
+The compaction kernel keeps **static shapes** (SURVEY §7 "variable-length
+results under jit"): input rows in, same-length rows out, with unique entries
+compacted to the front (sorted descending) and padding rows
+(``score == -inf``, zero counts) pushed to the end. Callers round buffer
+capacities to powers of two so XLA compiles a handful of shapes, not one per
+chunk size.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+# NaN, not -inf: XLA's sort totally orders NaNs after every real float, so
+# padding lands behind genuine scores INCLUDING -inf (a legal score, e.g.
+# log(0) log-probs). NaN also never equals anything, so padding rows can
+# never merge into a real tie group. NaN scores are thereby reserved: a NaN
+# model output would be meaningless to rank anyway.
+PAD_SCORE = jnp.nan
+
+
+@jax.jit
+def compact_counts(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Merge rows with tied scores into one (score, Σtp, Σfp) row each.
+
+    Returns ``(scores, tp, fp, n_unique)`` of the same static length: unique
+    rows first in descending score order, then ``(NaN, 0, 0)`` padding.
+    ``n_unique`` counts rows carrying a nonzero count (existing padding and
+    zero-count groups compact back into padding).
+
+    Counts are int32: exact while the stream's TOTAL positives and negatives
+    each stay below 2^31 (~2.1e9); beyond that the cumsums in here and in
+    ``ops/curves.py`` would wrap. The 1B north star fits; document-level
+    guard, not runtime-checked.
+
+    Two sorts + two log-depth scans, no gathers/scatters: sort descending
+    carrying the counts, per-group delta via shifted cummax of group-end
+    cumsums, then a second sort on the masked keys pushes non-end rows (keyed
+    ``NaN``) behind the compacted entries.
+    """
+    tp_w = tp_w.astype(jnp.int32)
+    fp_w = fp_w.astype(jnp.int32)
+    neg, tp_c, fp_c = jax.lax.sort((-scores, tp_w, fp_w), num_keys=1)
+    s = -neg
+    n = s.shape[0]
+    if n == 0:
+        zero = jnp.zeros((0,), jnp.int32)
+        return s, zero, zero, jnp.asarray(0, jnp.int32)
+    ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
+    cfp = jnp.cumsum(fp_c, dtype=jnp.int32)
+    last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+    # cumulative count at the end of the PREVIOUS tie group: inclusive cummax
+    # of the group-end-masked cumsum, shifted right one (cumsums are
+    # nondecreasing and >= 0, so 0 is a neutral mask fill)
+    prev_tp = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jax.lax.cummax(jnp.where(last, ctp, 0))[:-1]]
+    )
+    prev_fp = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jax.lax.cummax(jnp.where(last, cfp, 0))[:-1]]
+    )
+    delta_tp = jnp.where(last, ctp - prev_tp, 0)
+    delta_fp = jnp.where(last, cfp - prev_fp, 0)
+    # a group whose delta is all-zero is padding (or contributes nothing);
+    # key it NaN so it joins the padding block in the second sort
+    real = last & ((delta_tp > 0) | (delta_fp > 0))
+    key = jnp.where(real, s, PAD_SCORE)
+    neg2, tp_out, fp_out = jax.lax.sort((-key, delta_tp, delta_fp), num_keys=1)
+    return -neg2, tp_out, fp_out, jnp.sum(real.astype(jnp.int32))
