@@ -1,11 +1,20 @@
-"""Benchmark: preds/sec/chip on the BASELINE north-star workload —
-streaming MulticlassAccuracy + BinaryAUROC over 10M predictions
-(BASELINE.json: "preds/sec/chip on 1B-sample MulticlassAccuracy+AUROC").
+"""Driver benchmark: one JSON line per record, headline (north star) first.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is the speedup over the reference torcheval implementation
-(/root/reference, torch CPU — the only backend it runs on here) on the same
-workload sizes.
+Headline: preds/sec/chip on streaming MulticlassAccuracy + BinaryAUROC
+(BASELINE.json: "preds/sec/chip on 1B-sample MulticlassAccuracy+AUROC"),
+reported at 10M (with the reference leg for ``vs_baseline``), 100M and the
+full 1B — the 1B row runs on bounded memory via exact unique-threshold
+summary compaction (``torcheval_tpu/ops/summary.py``).
+
+Then the five BASELINE.md configs (1-5). ``vs_baseline`` is the speedup over
+the reference torcheval (/root/reference, torch CPU — the only backend it
+runs on here) on the identical workload; ``null`` marks "reference leg not
+run" (never fabricated): the 100M/1B rows (CPU-torch would need the full 8+ GB
+cache the compaction path exists to avoid) and config 5 (needs a multi-GPU
+NCCL cluster).
+
+A persistent XLA compile cache (.jax_cache/) keeps recompiles out of repeat
+runs; timed sections always run on pre-warmed shapes either way.
 """
 
 import json
@@ -13,86 +22,294 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
 
-NUM_CLASSES = 5
-TOTAL = 10_000_000
-CHUNK = 1_000_000
-N_CHUNKS = TOTAL // CHUNK
+import numpy as np
 
 
-def bench_tpu() -> float:
+def _jax():
     import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def _time(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def _ref_time(fn):
+    try:
+        fn()  # warmup
+        return _time(fn)
+    except Exception:
+        return None  # never fabricate a parity number
+
+
+def _emit(metric, preds, tpu_s, ref_s, unit="preds/s"):
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(preds / tpu_s, 1),
+                "unit": unit,
+                "vs_baseline": round(ref_s / tpu_s, 3) if ref_s else None,
+            }
+        ),
+        flush=True,
+    )
+
+
+# ----------------------------------------------------------------- headline
+NUM_CLASSES = 5
+CHUNK = 1_000_000
+BIG_CHUNK = 16_777_216  # 2^24
+
+
+def _headline_data(jax, n):
     import jax.numpy as jnp
 
-    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
-
-    key = jax.random.PRNGKey(0)
-    kx, ky, kl = jax.random.split(key, 3)
-    scores = jax.random.uniform(kx, (CHUNK, NUM_CLASSES), jnp.float32)
-    labels = jax.random.randint(ky, (CHUNK,), 0, NUM_CLASSES, jnp.int32)
-    logits = jax.random.uniform(kl, (CHUNK,), jnp.float32)
+    kx, ky, kl = jax.random.split(jax.random.PRNGKey(0), 3)
+    scores = jax.random.uniform(kx, (n, NUM_CLASSES), jnp.float32)
+    labels = jax.random.randint(ky, (n,), 0, NUM_CLASSES, jnp.int32)
+    logits = jax.random.uniform(kl, (n,), jnp.float32)
     binary = (labels == 0).astype(jnp.float32)
     jax.block_until_ready((scores, labels, logits, binary))
+    return scores, labels, logits, binary
 
-    def run() -> float:
+
+def headline_10m():
+    jax = _jax()
+    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+
+    total, n_chunks = 10_000_000, 10
+    scores, labels, logits, binary = _headline_data(jax, CHUNK)
+
+    def run():
         acc, auroc = MulticlassAccuracy(num_classes=NUM_CLASSES), BinaryAUROC()
-        for _ in range(N_CHUNKS):
+        for _ in range(n_chunks):
             acc.update(scores, labels)
             auroc.update(logits, binary)
         return float(acc.compute()), float(auroc.compute())
 
     run()  # warmup: compile every kernel
-    t0 = time.perf_counter()
-    run()
-    elapsed = time.perf_counter() - t0
-    return TOTAL / elapsed
+    tpu_s = _time(run)
+
+    def ref():
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics import BinaryAUROC as RB
+        from torcheval.metrics import MulticlassAccuracy as RA
+
+        g = torch.Generator().manual_seed(0)
+        ts = torch.rand((CHUNK, NUM_CLASSES), generator=g)
+        tl = torch.randint(0, NUM_CLASSES, (CHUNK,), generator=g)
+        tx = torch.rand((CHUNK,), generator=g)
+        tb = (tl == 0).float()
+        acc, auroc = RA(), RB()
+        for _ in range(n_chunks):
+            acc.update(ts, tl)
+            auroc.update(tx, tb)
+        return float(acc.compute()), float(auroc.compute())
+
+    _emit("preds_per_sec_per_chip_acc_plus_auroc_10M", total, tpu_s, _ref_time(ref))
 
 
-def bench_reference() -> float:
-    sys.path.insert(0, "/root/reference")
-    import torch
+def headline_scaled(total, label):
+    """100M / 1B rows: compaction keeps AUROC state bounded and exact."""
+    jax = _jax()
+    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
 
-    from torcheval.metrics import BinaryAUROC, MulticlassAccuracy
+    scores, labels, logits, binary = _headline_data(jax, BIG_CHUNK)
+    n_chunks = total // BIG_CHUNK
+    thresh = 2 * BIG_CHUNK
 
-    g = torch.Generator().manual_seed(0)
-    scores = torch.rand((CHUNK, NUM_CLASSES), generator=g)
-    labels = torch.randint(0, NUM_CLASSES, (CHUNK,), generator=g)
-    logits = torch.rand((CHUNK,), generator=g)
-    binary = (labels == 0).float()
-
-    def run():
-        acc, auroc = MulticlassAccuracy(), BinaryAUROC()
-        for _ in range(N_CHUNKS):
+    def run(n):
+        acc = MulticlassAccuracy(num_classes=NUM_CLASSES)
+        auroc = BinaryAUROC(compaction_threshold=thresh)
+        for _ in range(n):
             acc.update(scores, labels)
             auroc.update(logits, binary)
         return float(acc.compute()), float(auroc.compute())
 
-    run()  # warmup
-    t0 = time.perf_counter()
-    run()
-    elapsed = time.perf_counter() - t0
-    return TOTAL / elapsed
+    run(5)  # warmup: covers first-compact and steady-state shapes + compute
+    tpu_s = _time(lambda: run(n_chunks))
+    _emit(f"preds_per_sec_per_chip_acc_plus_auroc_{label}", n_chunks * BIG_CHUNK, tpu_s, None)
+
+
+# ------------------------------------------------------- BASELINE configs 1-5
+def config1_simple_accuracy():
+    """MulticlassAccuracy, num_classes=5, simple_example-style streaming."""
+    jax = _jax()
+    from torcheval_tpu.metrics import MulticlassAccuracy
+
+    rng = np.random.default_rng(0)
+    n_batches, batch = 200, 8192
+    scores = rng.random((batch, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, batch)
+    js, jl = jax.device_put(scores), jax.device_put(labels)
+    jax.block_until_ready((js, jl))
+
+    def tpu():
+        m = MulticlassAccuracy(num_classes=5)
+        for _ in range(n_batches):
+            m.update(js, jl)
+        return float(m.compute())
+
+    def ref():
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics import MulticlassAccuracy as RefAcc
+
+        ts, tl = torch.from_numpy(scores), torch.from_numpy(labels)
+        m = RefAcc()
+        for _ in range(n_batches):
+            m.update(ts, tl)
+        return float(m.compute())
+
+    tpu()
+    _emit("config1_multiclass_accuracy_c5", n_batches * batch, _time(tpu), _ref_time(ref))
+
+
+def config2_auroc_auprc():
+    """BinaryAUROC + BinaryAUPRC, functional API, 10M logits."""
+    jax = _jax()
+    import torcheval_tpu.metrics.functional as F
+
+    n = 10_000_000
+    x = jax.random.uniform(jax.random.PRNGKey(0), (n,))
+    t = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) > 0.5).astype(np.float32)
+    jax.block_until_ready((x, t))
+
+    def tpu():
+        return float(F.binary_auroc(x, t)), float(F.binary_auprc(x, t))
+
+    def ref():
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics.functional import binary_auroc as ref_auroc
+
+        tx = torch.from_numpy(np.asarray(x))
+        tt = torch.from_numpy(np.asarray(t))
+        # the reference snapshot has no binary_auprc; time AUROC twice to
+        # keep the work comparable
+        return float(ref_auroc(tx, tt)), float(ref_auroc(tx, tt))
+
+    tpu()
+    _emit("config2_auroc_auprc_10M", 2 * n, _time(tpu), _ref_time(ref))
+
+
+def config3_confusion_f1_imagenet():
+    """MulticlassConfusionMatrix + F1, num_classes=1000, ImageNet-eval scale."""
+    jax = _jax()
+    from torcheval_tpu.metrics import MulticlassConfusionMatrix, MulticlassF1Score
+
+    n_batches, batch, c = 13, 100_000, 1000  # 1.3M preds ~ ImageNet val x26
+    pred = jax.random.randint(jax.random.PRNGKey(0), (batch,), 0, c, np.int32)
+    label = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, c, np.int32)
+    jax.block_until_ready((pred, label))
+
+    def tpu():
+        cm = MulticlassConfusionMatrix(c)
+        f1 = MulticlassF1Score(num_classes=c, average="macro")
+        for _ in range(n_batches):
+            cm.update(pred, label)
+            f1.update(pred, label)
+        return np.asarray(cm.compute()).sum(), float(f1.compute())
+
+    def ref():
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics import MulticlassF1Score as RefF1
+
+        # reference snapshot has no confusion-matrix metric; F1 only
+        tp = torch.from_numpy(np.asarray(pred))
+        tl = torch.from_numpy(np.asarray(label))
+        f1 = RefF1(num_classes=c, average="macro")
+        for _ in range(n_batches):
+            f1.update(tp, tl)
+        return float(f1.compute())
+
+    tpu()
+    _emit("config3_confusion_f1_c1000", n_batches * batch, _time(tpu), _ref_time(ref))
+
+
+def config4_topk_multilabel():
+    """TopKMultilabelAccuracy, k=5, num_labels=10k."""
+    jax = _jax()
+    from torcheval_tpu.metrics import TopKMultilabelAccuracy
+
+    n_batches, batch, labels = 4, 8192, 10_000
+    scores = jax.random.uniform(jax.random.PRNGKey(0), (batch, labels))
+    target = (
+        jax.random.uniform(jax.random.PRNGKey(1), (batch, labels)) > 0.999
+    ).astype(np.int32)
+    jax.block_until_ready((scores, target))
+
+    def tpu():
+        m = TopKMultilabelAccuracy(k=5, criteria="contain")
+        for _ in range(n_batches):
+            m.update(scores, target)
+        return float(m.compute())
+
+    def ref():
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics import TopKMultilabelAccuracy as RefTopK
+
+        ts = torch.from_numpy(np.asarray(scores))
+        tt = torch.from_numpy(np.asarray(target).astype(np.float32))
+        m = RefTopK(k=5, criteria="contain")
+        for _ in range(n_batches):
+            m.update(ts, tt)
+        return float(m.compute())
+
+    tpu()
+    _emit("config4_topk_multilabel_k5_L10k", n_batches * batch, _time(tpu), _ref_time(ref))
+
+
+def config5_sharded_sync():
+    """sync_and_compute-equivalent: MulticlassAccuracy over the device mesh
+    (implicit-SPMD sync; 32-rank ICI on a pod, every local device here).
+    The reference leg needs a multi-GPU NCCL cluster — not runnable here."""
+    jax = _jax()
+    from torcheval_tpu.metrics import MulticlassAccuracy
+    from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh
+
+    n_batches, batch = 50, 65536
+    mesh = data_parallel_mesh()
+    rng = np.random.default_rng(0)
+    scores = rng.random((batch, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, batch)
+
+    def tpu():
+        ev = ShardedEvaluator(MulticlassAccuracy(num_classes=5), mesh=mesh)
+        for _ in range(n_batches):
+            ev.update(scores, labels)
+        return float(ev.compute())
+
+    tpu()
+    _emit(
+        f"config5_sharded_sync_accuracy_{mesh.devices.size}dev",
+        n_batches * batch,
+        _time(tpu),
+        None,
+    )
 
 
 def main() -> None:
-    tpu_pps = bench_tpu()
-    try:
-        ref_pps = bench_reference()
-        vs_baseline = round(tpu_pps / ref_pps, 3)
-    except Exception:
-        # never fabricate a parity number: null marks "reference leg not run"
-        vs_baseline = None
-    print(
-        json.dumps(
-            {
-                "metric": "preds_per_sec_per_chip_acc_plus_auroc_10M",
-                "value": round(tpu_pps, 1),
-                "unit": "preds/s",
-                "vs_baseline": vs_baseline,
-            }
-        )
-    )
+    headline_10m()
+    headline_scaled(100_000_000, "100M")
+    headline_scaled(1_000_000_000, "1B")
+    config1_simple_accuracy()
+    config2_auroc_auprc()
+    config3_confusion_f1_imagenet()
+    config4_topk_multilabel()
+    config5_sharded_sync()
 
 
 if __name__ == "__main__":

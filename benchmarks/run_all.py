@@ -1,230 +1,19 @@
-"""All five BASELINE.json benchmark configs. Prints one JSON line each.
+"""All benchmark configs — thin wrapper over the driver bench.
 
-Run: python benchmarks/run_all.py  (real chip; ~2-4 min)
+Run: python benchmarks/run_all.py  (real chip; ~3-6 min, first run adds
+one-time XLA compiles that land in the persistent .jax_cache/)
 
-Each record: {"config", "metric", "value", "unit", "vs_baseline"} where
-vs_baseline is the speedup over the reference torcheval implementation
-(/root/reference) on torch CPU — the only backend it runs on here — on the
-same workload; null when the reference leg cannot run.
+Every record and its methodology live in ``bench.py`` at the repo root (the
+driver entry point); this file exists so `benchmarks/` stays a discoverable
+home for perf work.
 """
 
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-import numpy as np
-
-
-def _run_tpu(fn, *args):
-    fn(*args)  # warmup/compile
-    t0 = time.perf_counter()
-    fn(*args)
-    return time.perf_counter() - t0
-
-
-def _run_ref(fn, *args):
-    try:
-        fn(*args)
-        t0 = time.perf_counter()
-        fn(*args)
-        return time.perf_counter() - t0
-    except Exception:
-        return None
-
-
-def _report(config, preds, tpu_s, ref_s):
-    print(
-        json.dumps(
-            {
-                "config": config,
-                "metric": "preds_per_sec",
-                "value": round(preds / tpu_s, 1),
-                "unit": "preds/s",
-                "vs_baseline": round(ref_s / tpu_s, 3) if ref_s else None,
-            }
-        )
-    )
-
-
-def config1_simple_accuracy():
-    """MulticlassAccuracy, num_classes=5, simple_example-style streaming."""
-    import jax
-
-    from torcheval_tpu.metrics import MulticlassAccuracy
-
-    rng = np.random.default_rng(0)
-    n_batches, batch = 200, 8192
-    scores = rng.random((batch, 5)).astype(np.float32)
-    labels = rng.integers(0, 5, batch)
-    js, jl = jax.device_put(scores), jax.device_put(labels)
-    jax.block_until_ready((js, jl))
-
-    def tpu():
-        m = MulticlassAccuracy(num_classes=5)
-        for _ in range(n_batches):
-            m.update(js, jl)
-        return float(m.compute())
-
-    def ref():
-        sys.path.insert(0, "/root/reference")
-        import torch
-        from torcheval.metrics import MulticlassAccuracy as RefAcc
-
-        ts, tl = torch.from_numpy(scores), torch.from_numpy(labels)
-        m = RefAcc()
-        for _ in range(n_batches):
-            m.update(ts, tl)
-        return float(m.compute())
-
-    _report(
-        "1_multiclass_accuracy_c5",
-        n_batches * batch,
-        _run_tpu(tpu),
-        _run_ref(ref),
-    )
-
-
-def config2_auroc_auprc():
-    """BinaryAUROC + BinaryAUPRC, functional API, 10M logits."""
-    import jax
-
-    import torcheval_tpu.metrics.functional as F
-
-    n = 10_000_000
-    key = jax.random.PRNGKey(0)
-    x = jax.random.uniform(key, (n,))
-    t = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) > 0.5).astype(np.float32)
-    jax.block_until_ready((x, t))
-
-    def tpu():
-        return float(F.binary_auroc(x, t)), float(F.binary_auprc(x, t))
-
-    def ref():
-        sys.path.insert(0, "/root/reference")
-        import torch
-        from torcheval.metrics.functional import binary_auroc as ref_auroc
-
-        tx = torch.from_numpy(np.asarray(x))
-        tt = torch.from_numpy(np.asarray(t))
-        # the reference snapshot has no binary_auprc; time AUROC twice to
-        # keep the work comparable
-        return float(ref_auroc(tx, tt)), float(ref_auroc(tx, tt))
-
-    _report("2_auroc_auprc_10M", 2 * n, _run_tpu(tpu), _run_ref(ref))
-
-
-def config3_confusion_f1_imagenet():
-    """MulticlassConfusionMatrix + F1, num_classes=1000, ImageNet-eval scale."""
-    import jax
-
-    from torcheval_tpu.metrics import MulticlassConfusionMatrix, MulticlassF1Score
-
-    n_batches, batch, c = 13, 100_000, 1000  # 1.3M preds ~ ImageNet val x26
-    key = jax.random.PRNGKey(0)
-    pred = jax.random.randint(key, (batch,), 0, c, np.int32)
-    label = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, c, np.int32)
-    jax.block_until_ready((pred, label))
-
-    def tpu():
-        cm = MulticlassConfusionMatrix(c)
-        f1 = MulticlassF1Score(num_classes=c, average="macro")
-        for _ in range(n_batches):
-            cm.update(pred, label)
-            f1.update(pred, label)
-        return np.asarray(cm.compute()).sum(), float(f1.compute())
-
-    def ref():
-        sys.path.insert(0, "/root/reference")
-        import torch
-        from torcheval.metrics import MulticlassF1Score as RefF1
-
-        # reference snapshot has no confusion-matrix metric; F1 only
-        tp, tl = torch.from_numpy(np.asarray(pred)), torch.from_numpy(
-            np.asarray(label)
-        )
-        f1 = RefF1(num_classes=c, average="macro")
-        for _ in range(n_batches):
-            f1.update(tp, tl)
-        return float(f1.compute())
-
-    _report(
-        "3_confusion_f1_c1000", n_batches * batch, _run_tpu(tpu), _run_ref(ref)
-    )
-
-
-def config4_topk_multilabel():
-    """TopKMultilabelAccuracy, k=5, num_labels=10k."""
-    import jax
-
-    from torcheval_tpu.metrics import TopKMultilabelAccuracy
-
-    n_batches, batch, labels = 4, 8192, 10_000
-    key = jax.random.PRNGKey(0)
-    scores = jax.random.uniform(key, (batch, labels))
-    target = (jax.random.uniform(jax.random.PRNGKey(1), (batch, labels)) > 0.999).astype(np.int32)
-    jax.block_until_ready((scores, target))
-
-    def tpu():
-        m = TopKMultilabelAccuracy(k=5, criteria="contain")
-        for _ in range(n_batches):
-            m.update(scores, target)
-        return float(m.compute())
-
-    def ref():
-        sys.path.insert(0, "/root/reference")
-        import torch
-        from torcheval.metrics import TopKMultilabelAccuracy as RefTopK
-
-        ts = torch.from_numpy(np.asarray(scores))
-        tt = torch.from_numpy(np.asarray(target).astype(np.float32))
-        m = RefTopK(k=5, criteria="contain")
-        for _ in range(n_batches):
-            m.update(ts, tt)
-        return float(m.compute())
-
-    _report(
-        "4_topk_multilabel_k5_L10k",
-        n_batches * batch,
-        _run_tpu(tpu),
-        _run_ref(ref),
-    )
-
-
-def config5_sharded_sync():
-    """sync_and_compute-equivalent: MulticlassAccuracy over the device mesh
-    (the implicit-SPMD sync path; 32-rank ICI on a pod, every local device
-    here)."""
-    import jax
-
-    from torcheval_tpu.metrics import MulticlassAccuracy
-    from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh
-
-    n_batches, batch = 50, 65536
-    mesh = data_parallel_mesh()
-    rng = np.random.default_rng(0)
-    scores = rng.random((batch, 5)).astype(np.float32)
-    labels = rng.integers(0, 5, batch)
-
-    def tpu():
-        ev = ShardedEvaluator(MulticlassAccuracy(num_classes=5), mesh=mesh)
-        for _ in range(n_batches):
-            ev.update(scores, labels)
-        return float(ev.compute())
-
-    _report(
-        f"5_sharded_sync_accuracy_{mesh.devices.size}dev",
-        n_batches * batch,
-        _run_tpu(tpu),
-        None,  # reference needs a multi-GPU NCCL cluster; not runnable here
-    )
-
+from bench import main
 
 if __name__ == "__main__":
-    config1_simple_accuracy()
-    config2_auroc_auprc()
-    config3_confusion_f1_imagenet()
-    config4_topk_multilabel()
-    config5_sharded_sync()
+    main()

@@ -257,7 +257,7 @@ class TestCompactCounts(unittest.TestCase):
         )
 
     def test_merges_ties_and_pads(self):
-        s, tp, fp, n = self._run(
+        s, tp, fp, n, _ = self._run(
             [0.5, 0.2, 0.5, 0.9, 0.2, 0.2],
             [1, 0, 0, 1, 1, 0],
             [0, 1, 1, 0, 0, 1],
@@ -272,7 +272,7 @@ class TestCompactCounts(unittest.TestCase):
         self.assertEqual(int(np.asarray(tp[3:]).sum()), 0)
 
     def test_existing_padding_recompacts_to_padding(self):
-        s, tp, fp, n = self._run(
+        s, tp, fp, n, _ = self._run(
             [0.3, np.nan, 0.3, np.nan], [1, 0, 0, 0], [0, 0, 1, 0]
         )
         self.assertEqual(int(n), 1)
@@ -282,7 +282,7 @@ class TestCompactCounts(unittest.TestCase):
     def test_neg_inf_is_a_legal_score_not_padding(self):
         # -inf scores (log(0) log-probs) must survive compaction: they sort
         # after every finite score but BEFORE the NaN padding block
-        s, tp, fp, n = self._run(
+        s, tp, fp, n, _ = self._run(
             [0.5, -np.inf, -np.inf, np.nan], [1, 1, 0, 0], [0, 0, 1, 0]
         )
         self.assertEqual(int(n), 2)
@@ -302,7 +302,7 @@ class TestCompactCounts(unittest.TestCase):
         rng = np.random.default_rng(7)
         scores = (rng.random(5000) * 50).astype(np.int32) / 50.0  # heavy ties
         target = (rng.random(5000) < 0.4).astype(np.int32)
-        s, tp, fp, _ = self._run(scores, target, 1 - target)
+        s, tp, fp, _, _ = self._run(scores, target, 1 - target)
         auc = float(binary_auroc_counts_kernel(s, tp, fp))
         ap = float(binary_auprc_counts_kernel(s, tp, fp))
         self.assertAlmostEqual(auc, roc_auc_score(target, scores), places=6)
@@ -311,5 +311,30 @@ class TestCompactCounts(unittest.TestCase):
         )
 
     def test_empty(self):
-        s, tp, fp, n = self._run([], [], [])
+        s, tp, fp, n, _ = self._run([], [], [])
         self.assertEqual((s.shape, int(n)), ((0,), 0))
+
+
+class TestCompactNanHandling(unittest.TestCase):
+    def test_nan_sample_rows_are_counted_not_silently_dropped(self):
+        import jax.numpy as jnp
+
+        from torcheval_tpu.ops.summary import compact_counts
+
+        s, tp, fp, n, nan_dropped = compact_counts(
+            jnp.asarray([0.5, np.nan, 0.2], jnp.float32),
+            jnp.asarray([1, 1, 0], jnp.int32),
+            jnp.asarray([0, 0, 1], jnp.int32),
+        )
+        self.assertEqual(int(n), 2)
+        self.assertEqual(int(nan_dropped), 1)
+
+    def test_compacting_metric_raises_on_nan_scores(self):
+        from torcheval_tpu.metrics import BinaryAUROC
+
+        m = BinaryAUROC(compaction_threshold=4)
+        with self.assertRaisesRegex(ValueError, "NaN"):
+            m.update(
+                np.array([0.1, np.nan, 0.3, 0.4], np.float32),
+                np.array([0, 1, 0, 1], np.float32),
+            )

