@@ -21,6 +21,7 @@ from torcheval_tpu.metrics.classification import (
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
+from torcheval_tpu.metrics.collection import MetricCollection
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.ranking import HitRate, ReciprocalRank
 from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
@@ -29,6 +30,7 @@ from torcheval_tpu.metrics.state import Reduction
 __all__ = [
     # base interface
     "Metric",
+    "MetricCollection",
     "Reduction",
     # functional metrics
     "functional",

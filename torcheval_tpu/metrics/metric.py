@@ -109,6 +109,10 @@ class Metric(Generic[TComputeReturn], ABC):
         them with HBM-resident state legal."""
         from torcheval_tpu.utils.convert import as_jax
 
+        if isinstance(x, jax.core.Tracer):
+            # already inside a trace (MetricCollection's fused step): placement
+            # happened before the jit boundary; pass straight through
+            return x
         arr = as_jax(x)
         if isinstance(arr, jax.Array) and arr.committed:
             if isinstance(self._device, jax.sharding.Sharding):
@@ -212,10 +216,14 @@ class Metric(Generic[TComputeReturn], ABC):
         new = cls.__new__(cls)
         memo[id(self)] = new
         for k, v in self.__dict__.items():
-            if isinstance(v, jax.Array) or k == "_device":
-                # arrays are immutable and devices are process singletons:
-                # share, don't copy.
+            if k == "_device":
+                # devices are process singletons: share, don't copy
                 new.__dict__[k] = v
+            elif isinstance(v, jax.Array):
+                # real buffer copy, not an alias: a donated-state update
+                # (metrics/collection.py) on the source would otherwise
+                # invalidate the clone's state too
+                new.__dict__[k] = jnp.copy(v)
             else:
                 new.__dict__[k] = copy.deepcopy(v, memo)
         return new

@@ -85,17 +85,29 @@ def put_state(value: TState, device) -> TState:
     return jax.device_put(jnp.asarray(value), device)
 
 
+def _copy_leaf(value):
+    # real buffer copies, not aliases: donated-state updates
+    # (metrics/collection.py) invalidate live buffers, so a default snapshot
+    # or state_dict that merely shared the array would die with it. Arrays
+    # are immutable, but buffer LIFETIME is not.
+    if isinstance(value, jax.Array):
+        return jnp.copy(value)
+    if hasattr(value, "copy"):
+        return value.copy()  # numpy leaf: also guards against host mutation
+    return value
+
+
 def copy_state(value: TState) -> TState:
-    """Structural copy of a state value. jax.Arrays are immutable, so the
-    arrays themselves are shared; containers are shallow-copied."""
+    """Deep copy of a state value: fresh array buffers, copied containers
+    (the reference's detach+clone semantics, ``metric.py:158-219``)."""
     if isinstance(value, list):
-        return list(value)
+        return [_copy_leaf(v) for v in value]
     if isinstance(value, deque):
-        return deque(value, maxlen=value.maxlen)
+        return deque((_copy_leaf(v) for v in value), maxlen=value.maxlen)
     if isinstance(value, defaultdict):
         d = defaultdict(value.default_factory)
-        d.update(value)
+        d.update({k: _copy_leaf(v) for k, v in value.items()})
         return d
     if isinstance(value, dict):
-        return dict(value)
-    return value
+        return {k: _copy_leaf(v) for k, v in value.items()}
+    return _copy_leaf(value)

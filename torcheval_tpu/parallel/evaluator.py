@@ -53,34 +53,36 @@ class ShardedEvaluator:
         *,
         mesh: Mesh = None,
     ) -> None:
+        from torcheval_tpu.metrics.collection import MetricCollection
+
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
-        self._single = isinstance(metrics, Metric)
-        self.metrics: Dict[str, Metric] = (
-            {"metric": metrics} if self._single else dict(metrics)
-        )
+        # the collection owns single-vs-dict wrapping, fuses every fusable
+        # metric's update into one jitted donated-state dispatch per batch,
+        # and is the delegate for compute/reset; cache metrics stay eager
+        # inside it
+        self._collection = MetricCollection(metrics)
+        self.metrics: Dict[str, Metric] = self._collection.metrics
         replicated = NamedSharding(self.mesh, P())
         for m in self.metrics.values():
             m.to(replicated)
 
     def update(self, *args: Any, **kwargs: Any) -> "ShardedEvaluator":
         """Shard positional array arguments along the mesh data axis and fold
-        them into every metric. Keyword arguments pass through unsharded
-        (weights etc. follow their positional companions' sharding via XLA)."""
+        them into every metric — one fused dispatch for all array-state
+        metrics. Keyword arguments pass through unsharded (weights etc.
+        follow their positional companions' sharding via XLA)."""
         sharded = tuple(
             shard_batch(self.mesh, a) if _is_batch_arraylike(a) else a
             for a in args
         )
-        for m in self.metrics.values():
-            m.update(*sharded, **kwargs)
+        self._collection.update(*sharded, **kwargs)
         return self
 
     def compute(self) -> Any:
-        out = {name: m.compute() for name, m in self.metrics.items()}
-        return out["metric"] if self._single else out
+        return self._collection.compute()
 
     def reset(self) -> "ShardedEvaluator":
-        for m in self.metrics.values():
-            m.reset()
+        self._collection.reset()
         return self
 
 
