@@ -179,3 +179,49 @@ class TestFunctionalAggregation(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestAggregationSpecMatrix(MetricClassTester):
+    """Extra shape/weight permutations per aggregation metric."""
+
+    def test_sum_2d_weighted_spec(self):
+        rng = np.random.default_rng(50)
+        x = rng.random((NUM_TOTAL_UPDATES, 8, 3)).astype(np.float32)
+        w = rng.random((NUM_TOTAL_UPDATES, 8, 3)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Sum(),
+            state_names={"weighted_sum"},
+            update_kwargs={"input": jnp.asarray(x), "weight": jnp.asarray(w)},
+            compute_result=(x * w).sum(),
+        )
+
+    def test_mean_vector_weight_spec(self):
+        rng = np.random.default_rng(51)
+        x = rng.random((NUM_TOTAL_UPDATES, 16)).astype(np.float32)
+        w = rng.random((NUM_TOTAL_UPDATES, 16)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Mean(),
+            state_names={"weighted_sum", "weights"},
+            update_kwargs={"input": jnp.asarray(x), "weight": jnp.asarray(w)},
+            compute_result=(x * w).sum() / w.sum(),
+        )
+
+    def test_max_min_2d_spec(self):
+        rng = np.random.default_rng(52)
+        x = rng.standard_normal((NUM_TOTAL_UPDATES, 4, 4)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Max(),
+            state_names={"max"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.max(),
+        )
+        self.run_class_implementation_tests(
+            metric=Min(),
+            state_names={"min"},
+            update_kwargs={"input": jnp.asarray(x)},
+            compute_result=x.min(),
+        )
+
+    def test_sum_non_numeric_weight_rejected(self):
+        with self.assertRaises((ValueError, TypeError)):
+            Sum().update(jnp.asarray([1.0]), weight="x")

@@ -179,3 +179,198 @@ class TestConfusionMatrixClass(MetricClassTester):
                 FLAT_TARGET, FLAT_PRED, labels=np.arange(C)
             ),
         )
+
+    def test_binary_confusion_matrix(self):
+        from torcheval_tpu.metrics import BinaryConfusionMatrix
+
+        self.run_class_implementation_tests(
+            metric=BinaryConfusionMatrix(),
+            state_names={"confusion_matrix"},
+            update_kwargs={
+                "input": jnp.asarray((BIN_SCORES >= 0.5).astype(np.int32)),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=sk_confusion_matrix(
+                FLAT_BIN_TARGET, FLAT_BIN_PRED, labels=[0, 1]
+            ),
+        )
+
+    def test_binary_confusion_matrix_threshold_and_normalize(self):
+        from torcheval_tpu.metrics import BinaryConfusionMatrix
+
+        m = BinaryConfusionMatrix(threshold=0.3, normalize="true")
+        m.update(jnp.asarray(BIN_SCORES[0]), jnp.asarray(BIN_TARGET[0]))
+        pred = (BIN_SCORES[0] >= 0.3).astype(np.int64)
+        want = sk_confusion_matrix(
+            BIN_TARGET[0], pred, labels=[0, 1], normalize="true"
+        )
+        np.testing.assert_allclose(np.asarray(m.compute()), want, rtol=1e-5)
+
+    def test_multiclass_confusion_matrix_normalize_modes(self):
+        for mode in ("all", "pred", "true"):
+            m = MulticlassConfusionMatrix(C, normalize=mode)
+            m.update(jnp.asarray(SCORES[0]), jnp.asarray(TARGET[0]))
+            want = sk_confusion_matrix(
+                TARGET[0],
+                SCORES[0].argmax(1),
+                labels=np.arange(C),
+                normalize=mode,
+            )
+            np.testing.assert_allclose(
+                np.asarray(m.compute()), want, rtol=1e-5, err_msg=mode
+            )
+
+
+class TestAccuracySpecMatrix(MetricClassTester):
+    """Reference-style per-metric spec matrix
+    (``tests/metrics/classification/test_accuracy.py:25-61``): k>1, per-class
+    averaging, macro over scores."""
+
+    def test_multiclass_accuracy_k3(self):
+        k = 3
+        topk = np.argsort(-SCORES.reshape(-1, C), axis=1)[:, :k]
+        want = float((topk == FLAT_TARGET[:, None]).any(1).mean())
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(num_classes=C, k=k),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=want,
+        )
+
+    def test_multiclass_accuracy_average_none(self):
+        correct = np.zeros(C)
+        total = np.zeros(C)
+        for cls in range(C):
+            mask = FLAT_TARGET == cls
+            total[cls] = mask.sum()
+            correct[cls] = (FLAT_PRED[mask] == cls).sum()
+        want = np.where(total > 0, correct / np.maximum(total, 1), np.nan)
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(num_classes=C, average=None),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=want,
+        )
+
+    def test_binary_accuracy_threshold(self):
+        thr = 0.7
+        self.run_class_implementation_tests(
+            metric=BinaryAccuracy(threshold=thr),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={
+                "input": jnp.asarray(BIN_SCORES),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=accuracy_score(
+                FLAT_BIN_TARGET, (BIN_SCORES.reshape(-1) >= thr).astype(int)
+            ),
+        )
+
+
+class TestPrecisionRecallSpecMatrix(MetricClassTester):
+    def test_multiclass_precision_none(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecision(num_classes=C, average=None),
+            state_names={"num_tp", "num_fp", "num_label"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=sk_precision(
+                FLAT_TARGET, FLAT_PRED, average=None, zero_division=0
+            ),
+        )
+
+    def test_multiclass_precision_weighted(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecision(num_classes=C, average="weighted"),
+            state_names={"num_tp", "num_fp", "num_label"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=sk_precision(
+                FLAT_TARGET, FLAT_PRED, average="weighted", zero_division=0
+            ),
+        )
+
+    def test_multiclass_recall_weighted(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassRecall(num_classes=C, average="weighted"),
+            state_names={"num_tp", "num_labels", "num_predictions"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=sk_recall(
+                FLAT_TARGET, FLAT_PRED, average="weighted", zero_division=0
+            ),
+        )
+
+    def test_multiclass_f1_micro_and_none(self):
+        self.run_class_implementation_tests(
+            metric=MulticlassF1Score(num_classes=C),  # micro default
+            state_names={"num_tp", "num_label", "num_prediction"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=sk_f1(
+                FLAT_TARGET, FLAT_PRED, average="micro", zero_division=0
+            ),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassF1Score(num_classes=C, average=None),
+            state_names={"num_tp", "num_label", "num_prediction"},
+            update_kwargs={
+                "input": jnp.asarray(SCORES),
+                "target": jnp.asarray(TARGET),
+            },
+            compute_result=sk_f1(
+                FLAT_TARGET, FLAT_PRED, average=None, zero_division=0
+            ),
+        )
+
+
+class TestMultilabelSpecMatrix(MetricClassTester):
+    ML_SCORES = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 4)).astype(np.float32)
+    ML_TARGET = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
+
+    def _expected(self, criteria):
+        pred = (self.ML_SCORES.reshape(-1, 4) >= 0.5).astype(np.int64)
+        tg = self.ML_TARGET.reshape(-1, 4)
+        inter = (pred & tg).sum(1)
+        if criteria == "exact_match":
+            return float((pred == tg).all(1).mean())
+        if criteria == "hamming":
+            return float((pred == tg).mean())
+        if criteria == "overlap":
+            return float((inter > 0).mean())
+        if criteria == "contain":
+            return float((inter == tg.sum(1)).mean())
+        if criteria == "belong":
+            return float((inter == pred.sum(1)).mean())
+        raise AssertionError(criteria)
+
+    def test_all_criteria(self):
+        for criteria in ("exact_match", "hamming", "overlap", "contain", "belong"):
+            with self.subTest(criteria=criteria):
+                self.run_class_implementation_tests(
+                    metric=MultilabelAccuracy(criteria=criteria),
+                    state_names={"num_correct", "num_total"},
+                    update_kwargs={
+                        "input": jnp.asarray(self.ML_SCORES),
+                        "target": jnp.asarray(self.ML_TARGET),
+                    },
+                    compute_result=self._expected(criteria),
+                )
+
+    def test_invalid_criteria(self):
+        with self.assertRaisesRegex(ValueError, "criteria"):
+            MultilabelAccuracy(criteria="bogus")

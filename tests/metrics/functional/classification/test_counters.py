@@ -297,3 +297,54 @@ class TestConfusionOutOfRange(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestThresholdVariants(unittest.TestCase):
+    """Threshold permutations for binary counter functionals."""
+
+    def test_binary_precision_threshold(self):
+        rng = np.random.default_rng(70)
+        x = rng.random(300).astype(np.float32)
+        t = rng.integers(0, 2, 300)
+        for thr in (0.2, 0.5, 0.8):
+            pred = (x >= thr).astype(int)
+            got = F.binary_precision(jnp.asarray(pred), jnp.asarray(t))
+            want = sk_precision(t, pred, zero_division=0)
+            np.testing.assert_allclose(float(got), want, rtol=1e-5)
+
+    def test_binary_recall_int_inputs(self):
+        rng = np.random.default_rng(71)
+        pred = rng.integers(0, 2, 200)
+        t = rng.integers(0, 2, 200)
+        got = F.binary_recall(jnp.asarray(pred), jnp.asarray(t))
+        np.testing.assert_allclose(
+            float(got), sk_recall(t, pred, zero_division=0), rtol=1e-5
+        )
+
+    def test_binary_f1_threshold_sweep(self):
+        rng = np.random.default_rng(72)
+        x = rng.random(300).astype(np.float32)
+        t = rng.integers(0, 2, 300)
+        for thr in (0.3, 0.6):
+            got = F.binary_f1_score(jnp.asarray(x), jnp.asarray(t), threshold=thr)
+            want = sk_f1(t, (x >= thr).astype(int), zero_division=0)
+            np.testing.assert_allclose(float(got), want, rtol=1e-5)
+
+    def test_binary_confusion_matrix_threshold_normalize(self):
+        rng = np.random.default_rng(73)
+        x = rng.random(200).astype(np.float32)
+        t = rng.integers(0, 2, 200)
+        got = F.binary_confusion_matrix(
+            jnp.asarray(x), jnp.asarray(t), threshold=0.4, normalize="all"
+        )
+        want = sk_confusion_matrix(t, (x >= 0.4).astype(int), labels=[0, 1], normalize="all")
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_multiclass_accuracy_label_input_form(self):
+        # 1-D integer predictions (already-argmaxed) are a documented input
+        # form alongside (N, C) scores
+        rng = np.random.default_rng(74)
+        pred = rng.integers(0, 4, 150)
+        t = rng.integers(0, 4, 150)
+        got = F.multiclass_accuracy(jnp.asarray(pred), jnp.asarray(t))
+        np.testing.assert_allclose(float(got), (pred == t).mean(), rtol=1e-6)

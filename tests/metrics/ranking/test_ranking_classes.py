@@ -70,3 +70,38 @@ class TestReciprocalRankClass(MetricClassTester):
     def test_empty_compute(self):
         self.assertEqual(ReciprocalRank().compute().shape, (0,))
         self.assertEqual(HitRate().compute().shape, (0,))
+
+
+class TestRankingKVariants(MetricClassTester):
+    def test_hit_rate_k1(self):
+        rng = np.random.default_rng(41)
+        scores = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 6)).astype(np.float32)
+        target = rng.integers(0, 6, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        hits = (_ranks(scores, target) < 1).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=HitRate(k=1),
+            state_names={"scores"},
+            update_kwargs={"input": scores, "target": target},
+            compute_result=hits.reshape(-1),
+            merge_and_compute_result=_rank_major(hits),
+        )
+
+    def test_reciprocal_rank_k2(self):
+        rng = np.random.default_rng(42)
+        scores = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 6)).astype(np.float32)
+        target = rng.integers(0, 6, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        ranks = _ranks(scores, target)
+        rr = np.where(ranks < 2, 1.0 / (ranks + 1), 0.0).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=ReciprocalRank(k=2),
+            state_names={"scores"},
+            update_kwargs={"input": scores, "target": target},
+            compute_result=rr.reshape(-1),
+            merge_and_compute_result=_rank_major(rr),
+        )
+
+    def test_invalid_update_shapes(self):
+        with self.assertRaisesRegex(ValueError, "two-dimensional"):
+            HitRate().update(np.zeros(3), np.zeros(3, dtype=np.int64))
+        with self.assertRaisesRegex(ValueError, "minibatch"):
+            ReciprocalRank().update(np.zeros((3, 2)), np.zeros(4, dtype=np.int64))

@@ -1,5 +1,6 @@
 """Class regression metrics through the protocol harness (SURVEY §4 tier 2)."""
 
+import jax.numpy as jnp
 import numpy as np
 from sklearn.metrics import mean_squared_error as sk_mse
 from sklearn.metrics import r2_score as sk_r2
@@ -112,3 +113,33 @@ class TestR2ScoreClass(MetricClassTester):
             update_kwargs={"input": input, "target": target},
             compute_result=adjusted,
         )
+
+
+class TestRegressionSpecMatrix(MetricClassTester):
+    def test_r2_raw_values_multioutput(self):
+        rng = np.random.default_rng(60)
+        x = rng.random((NUM_TOTAL_UPDATES, 16, 3)).astype(np.float32)
+        y = (x + 0.1 * rng.standard_normal(x.shape)).astype(np.float32)
+        flat_x, flat_y = x.reshape(-1, 3), y.reshape(-1, 3)
+        want = sk_r2(flat_y, flat_x, multioutput="raw_values")
+        self.run_class_implementation_tests(
+            metric=R2Score(multioutput="raw_values"),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": jnp.asarray(x), "target": jnp.asarray(y)},
+            compute_result=want,
+        )
+
+    def test_mse_invalid_multioutput(self):
+        with self.assertRaisesRegex(ValueError, "multioutput"):
+            MeanSquaredError(multioutput="bogus")
+
+    def test_r2_invalid_params(self):
+        with self.assertRaisesRegex(ValueError, "multioutput"):
+            R2Score(multioutput="bogus")
+        with self.assertRaisesRegex(ValueError, "num_regressors"):
+            R2Score(num_regressors=-1)

@@ -1,0 +1,79 @@
+"""Metric state placement: devices, shardings, committed-input routing."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torcheval_tpu.metrics import MulticlassAccuracy, Sum
+from torcheval_tpu.parallel import data_parallel_mesh
+
+
+class TestDevicePlacement(unittest.TestCase):
+    def test_constructor_device_string(self):
+        m = Sum(device="cpu")
+        self.assertEqual(m.device.platform, "cpu")
+
+    def test_to_explicit_device(self):
+        dev = jax.devices()[-1]
+        m = Sum().to(dev)
+        m.update(jnp.asarray([1.0]))
+        self.assertIn(dev, m.weighted_sum.devices())
+
+    def test_update_moves_committed_inputs(self):
+        # a batch committed to device 0 must fold into state on device 1
+        d0, d1 = jax.devices()[0], jax.devices()[1]
+        m = Sum().to(d1)
+        x = jax.device_put(jnp.asarray([2.0, 3.0]), d0)
+        m.update(x)
+        self.assertEqual(float(m.compute()), 5.0)
+        self.assertIn(d1, m.weighted_sum.devices())
+
+    def test_invalid_device_spec(self):
+        with self.assertRaises((ValueError, TypeError)):
+            Sum(device=123)
+
+    def test_reset_keeps_device(self):
+        dev = jax.devices()[-1]
+        m = Sum().to(dev)
+        m.update(jnp.asarray([1.0]))
+        m.reset()
+        self.assertIn(dev, m.weighted_sum.devices())
+
+
+class TestShardingPlacement(unittest.TestCase):
+    def test_to_sharding_replicates_state(self):
+        mesh = data_parallel_mesh()
+        repl = NamedSharding(mesh, P())
+        m = MulticlassAccuracy(num_classes=4).to(repl)
+        self.assertEqual(
+            len(m.num_total.sharding.device_set), len(jax.devices())
+        )
+        m.update(jnp.eye(4), jnp.arange(4))
+        self.assertEqual(float(m.compute()), 1.0)
+
+    def test_sharded_batch_kept_sharded_by_input(self):
+        from torcheval_tpu.parallel import shard_batch
+
+        mesh = data_parallel_mesh()
+        repl = NamedSharding(mesh, P())
+        m = MulticlassAccuracy(num_classes=4).to(repl)
+        x = shard_batch(mesh, np.eye(4, dtype=np.float32).repeat(2, axis=0))
+        routed = m._input(x)
+        # the data-sharded batch must NOT be re-placed (that would all-gather)
+        self.assertEqual(routed.sharding, x.sharding)
+
+    def test_pickle_restores_to_local_device(self):
+        import pickle
+
+        m = Sum().to(jax.devices()[0])
+        m.update(jnp.asarray([7.0]))
+        m2 = pickle.loads(pickle.dumps(m))
+        self.assertEqual(float(m2.compute()), 7.0)
+        self.assertIsInstance(m2.device, jax.Device)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,146 @@
+"""Toolkit helper surface: world-size-1 semantics, batch helpers, guards.
+
+Complements test_toolkit.py (fold core / mesh) and
+test_multiprocess_sync.py (real 4-process world).
+"""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    Mean,
+    MetricCollection,
+    MulticlassAccuracy,
+    Sum,
+    Throughput,
+)
+from torcheval_tpu.metrics.toolkit import (
+    clone_metric,
+    clone_metrics,
+    get_synced_metric,
+    get_synced_state_dict,
+    merge_metrics,
+    reset_metrics,
+    sync_and_compute,
+    sync_and_compute_collection,
+    to_device,
+)
+
+
+class TestWorldSizeOne(unittest.TestCase):
+    """Single-process semantics (reference toolkit.py:199-215: warn + return
+    the input)."""
+
+    def test_sync_and_compute_returns_local(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0, 2.0]))
+        with self.assertLogs(level="WARNING"):
+            self.assertEqual(float(sync_and_compute(m)), 3.0)
+
+    def test_get_synced_metric_identity(self):
+        m = Sum()
+        with self.assertLogs(level="WARNING"):
+            self.assertIs(get_synced_metric(m), m)
+
+    def test_get_synced_state_dict(self):
+        m = Sum()
+        m.update(jnp.asarray([4.0]))
+        with self.assertLogs(level="WARNING"):
+            sd = get_synced_state_dict(m)
+        self.assertEqual(float(sd["weighted_sum"]), 4.0)
+
+    def test_sync_collection(self):
+        ms = {"a": Sum(), "b": Mean()}
+        ms["a"].update(jnp.asarray([2.0]))
+        ms["b"].update(jnp.asarray([3.0]))
+        with self.assertLogs(level="WARNING"):
+            out = sync_and_compute_collection(ms)
+        self.assertEqual(float(out["a"]), 2.0)
+        self.assertEqual(float(out["b"]), 3.0)
+
+    def test_invalid_recipient_rank(self):
+        with self.assertRaisesRegex(ValueError, "recipient_rank"):
+            get_synced_metric(Sum(), recipient_rank="some")
+
+
+class TestBatchHelpers(unittest.TestCase):
+    def test_clone_metrics_independent(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        clones = clone_metrics([m, m])
+        clones[0].update(jnp.asarray([9.0]))
+        self.assertEqual(float(m.compute()), 1.0)
+        self.assertEqual(float(clones[1].compute()), 1.0)
+        self.assertEqual(float(clones[0].compute()), 10.0)
+
+    def test_reset_metrics(self):
+        ms = [Sum(), Mean()]
+        ms[0].update(jnp.asarray([5.0]))
+        ms[1].update(jnp.asarray([5.0]))
+        reset_metrics(ms)
+        self.assertEqual(float(ms[0].compute()), 0.0)
+        self.assertEqual(float(ms[1].compute()), 0.0)
+
+    def test_to_device_moves_state(self):
+        devices = jax.devices()
+        ms = to_device([Sum(), Throughput()], devices[-1])
+        for m in ms:
+            self.assertEqual(m.device, devices[-1])
+            for v in m._states().values():
+                self.assertIn(devices[-1], v.devices())
+
+    def test_merge_metrics_empty_and_single(self):
+        self.assertIsNone(merge_metrics([]))
+        m = Sum()
+        m.update(jnp.asarray([2.0]))
+        merged = merge_metrics([m])
+        self.assertEqual(float(merged.compute()), 2.0)
+        merged.update(jnp.asarray([1.0]))
+        self.assertEqual(float(m.compute()), 2.0)  # source untouched
+
+    def test_merge_metrics_does_not_mutate_sources(self):
+        a, b = Sum(), Sum()
+        a.update(jnp.asarray([1.0]))
+        b.update(jnp.asarray([2.0]))
+        merged = merge_metrics([a, b])
+        self.assertEqual(float(merged.compute()), 3.0)
+        self.assertEqual(float(a.compute()), 1.0)
+        self.assertEqual(float(b.compute()), 2.0)
+
+
+class TestSampleCacheToolkitInteraction(unittest.TestCase):
+    def test_prepare_for_merge_state_compacts_cat_cache(self):
+        m = BinaryAUROC()
+        for _ in range(3):
+            m.update(jnp.asarray([0.1, 0.9]), jnp.asarray([0.0, 1.0]))
+        self.assertEqual(len(m.inputs), 3)
+        m._prepare_for_merge_state()
+        self.assertEqual(len(m.inputs), 1)
+        self.assertEqual(m.inputs[0].shape, (6,))
+
+    def test_clone_of_cache_metric_is_independent(self):
+        m = BinaryAUROC()
+        m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0.0, 1.0]))
+        c = clone_metric(m)
+        c.update(jnp.asarray([0.5]), jnp.asarray([1.0]))
+        self.assertEqual(sum(a.shape[0] for a in m.inputs), 2)
+        self.assertEqual(sum(a.shape[0] for a in c.inputs), 3)
+
+
+class TestCollectionWithToolkit(unittest.TestCase):
+    def test_sync_collection_of_fused_metrics_world1(self):
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3), "sum": Sum()}
+        )
+        col["acc"].update(jnp.eye(3), jnp.arange(3))
+        with self.assertLogs(level="WARNING"):
+            out = sync_and_compute_collection(col.metrics)
+        self.assertEqual(float(out["acc"]), 1.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

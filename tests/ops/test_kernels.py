@@ -1,0 +1,157 @@
+"""Direct unit tests for the shared XLA kernels in ``torcheval_tpu/ops``."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.ops.confusion import (
+    class_counts,
+    confusion_matrix_counts,
+    normalize_confusion_matrix,
+    topk_onehot,
+)
+
+RNG = np.random.default_rng(0)
+
+
+class TestClassCounts(unittest.TestCase):
+    def test_unweighted_matches_bincount(self):
+        labels = RNG.integers(0, 17, 500)
+        want = np.bincount(labels, minlength=17)
+        for method in ("matmul", "scatter", "auto"):
+            got = np.asarray(class_counts(jnp.asarray(labels), 17, method=method))
+            np.testing.assert_array_equal(got, want, err_msg=method)
+
+    def test_weighted(self):
+        labels = RNG.integers(0, 5, 100)
+        w = RNG.random(100).astype(np.float32)
+        want = np.bincount(labels, weights=w, minlength=5)
+        for method in ("matmul", "scatter"):
+            got = np.asarray(
+                class_counts(jnp.asarray(labels), 5, jnp.asarray(w), method=method)
+            )
+            np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=method)
+
+    def test_out_of_range_dropped(self):
+        labels = jnp.asarray([0, 1, 7, -1, 1])
+        got = np.asarray(class_counts(labels, 3, method="scatter"))
+        np.testing.assert_array_equal(got, [1, 2, 0])
+
+    def test_rejects_2d(self):
+        with self.assertRaisesRegex(ValueError, "1-D"):
+            class_counts(jnp.zeros((2, 2), jnp.int32), 3)
+
+
+class TestConfusionMatrixCounts(unittest.TestCase):
+    def test_matches_sklearn(self):
+        from sklearn.metrics import confusion_matrix as sk_cm
+
+        p = RNG.integers(0, 9, 400)
+        t = RNG.integers(0, 9, 400)
+        got = np.asarray(confusion_matrix_counts(jnp.asarray(p), jnp.asarray(t), 9))
+        np.testing.assert_array_equal(got, sk_cm(t, p, labels=np.arange(9)))
+
+    def test_one_bad_coordinate_drops_whole_sample(self):
+        p = jnp.asarray([0, 5, 1])   # 5 out of range for C=3
+        t = jnp.asarray([0, 1, -2])  # -2 out of range
+        got = np.asarray(confusion_matrix_counts(p, t, 3))
+        self.assertEqual(int(got.sum()), 1)
+        self.assertEqual(int(got[0, 0]), 1)
+
+    def test_normalize_modes(self):
+        mat = jnp.asarray([[2, 0], [1, 1]], jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(normalize_confusion_matrix(mat, "all")).sum(), 1.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(normalize_confusion_matrix(mat, "true")).sum(axis=1), [1, 1]
+        )
+        pred_norm = np.asarray(normalize_confusion_matrix(mat, "pred"))
+        np.testing.assert_allclose(pred_norm.sum(axis=0), [1, 1])
+        with self.assertRaisesRegex(ValueError, "normalize"):
+            normalize_confusion_matrix(mat, "bogus")
+
+
+class TestTopkOnehot(unittest.TestCase):
+    def test_exactly_k_per_row(self):
+        scores = jnp.asarray(RNG.random((32, 10)).astype(np.float32))
+        out = np.asarray(topk_onehot(scores, 3))
+        np.testing.assert_array_equal(out.sum(axis=1), np.full(32, 3))
+
+    def test_selects_top_scores(self):
+        scores = jnp.asarray([[0.1, 0.9, 0.5, 0.7]])
+        out = np.asarray(topk_onehot(scores, 2))
+        np.testing.assert_array_equal(out[0], [0, 1, 0, 1])
+
+    def test_ties_broken_by_index(self):
+        scores = jnp.asarray([[1.0, 1.0, 1.0]])
+        out = np.asarray(topk_onehot(scores, 2))
+        np.testing.assert_array_equal(out[0], [1, 1, 0])
+
+
+class TestCurveKernelEdges(unittest.TestCase):
+    def test_empty_inputs(self):
+        from torcheval_tpu.ops.curves import (
+            binary_auprc_kernel,
+            binary_auroc_kernel,
+        )
+
+        e = jnp.zeros((0,))
+        self.assertEqual(float(binary_auroc_kernel(e, e)), 0.5)
+        self.assertEqual(float(binary_auprc_kernel(e, e)), 0.0)
+
+    def test_single_sample(self):
+        from torcheval_tpu.ops.curves import binary_auroc_kernel
+
+        # degenerate single-class input -> 0.5 guard
+        self.assertEqual(
+            float(binary_auroc_kernel(jnp.asarray([0.7]), jnp.asarray([1.0]))),
+            0.5,
+        )
+
+    def test_counts_kernels_match_unit_expansion(self):
+        from sklearn.metrics import roc_auc_score
+
+        from torcheval_tpu.ops.curves import binary_auroc_counts_kernel
+
+        # aggregated rows == expanded per-sample rows
+        scores = jnp.asarray([0.9, 0.5, 0.1])
+        tp = jnp.asarray([3, 0, 2], jnp.int32)
+        fp = jnp.asarray([1, 4, 0], jnp.int32)
+        got = float(binary_auroc_counts_kernel(scores, tp, fp))
+        exp_scores = np.repeat([0.9, 0.5, 0.1], [4, 4, 2])
+        exp_target = np.concatenate([[1] * 3 + [0], [0] * 4, [1] * 2])
+        self.assertAlmostEqual(got, roc_auc_score(exp_target, exp_scores), places=6)
+
+
+class TestParallelHelpers(unittest.TestCase):
+    def test_replicate_and_eval_shardings(self):
+        from torcheval_tpu.parallel import data_parallel_mesh, replicate
+        from torcheval_tpu.parallel.evaluator import eval_shardings
+
+        mesh = data_parallel_mesh()
+        x = replicate(mesh, jnp.arange(4.0))
+        self.assertEqual(len(x.sharding.device_set), len(jax.devices()))
+        repl, sharded = eval_shardings(mesh)
+        self.assertTrue(repl.is_fully_replicated)
+        self.assertFalse(sharded.is_fully_replicated)
+
+    def test_shard_batch_uneven_warns_once(self):
+        import logging
+
+        from torcheval_tpu.parallel import data_parallel_mesh, shard_batch
+        from torcheval_tpu.parallel import mesh as mesh_mod
+
+        mesh = data_parallel_mesh()
+        mesh_mod._warned_uneven_batch = False
+        with self.assertLogs(level=logging.WARNING):
+            shard_batch(mesh, np.zeros((9, 2), np.float32))  # 9 % 8 != 0
+        # second time: no warning (warned-once flag)
+        with self.assertNoLogs(level=logging.WARNING):
+            shard_batch(mesh, np.zeros((9, 2), np.float32))
+
+
+if __name__ == "__main__":
+    unittest.main()

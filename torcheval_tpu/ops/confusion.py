@@ -65,7 +65,12 @@ def class_counts(
             w.astype(jnp.float32), onehot, preferred_element_type=jnp.float32
         )
         return counts.astype(w.dtype)
-    # scatter path: drop out-of-range labels via mode="drop"
+    # scatter path: drop out-of-range labels. mode="drop" only catches
+    # indices past the end — negative indices would WRAP (numpy semantics)
+    # and silently count against the last classes, diverging from the matmul
+    # path's compare (which matches nothing) — so push them out of bounds
+    # first.
+    labels = jnp.where(labels < 0, num_classes, labels)
     return jnp.zeros((num_classes,), dtype=w.dtype).at[labels].add(
         w, mode="drop"
     )

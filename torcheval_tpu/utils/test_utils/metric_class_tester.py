@@ -103,6 +103,45 @@ class MetricClassTester(unittest.TestCase):
             rtol,
             stream_result=compute_result,
         )
+        self._test_cross_device_merge(
+            metric, update_kwargs, expected_merge, num_total_updates,
+            num_processes, atol, rtol,
+        )
+
+    def _test_cross_device_merge(
+        self, metric, update_kwargs, compute_result, n, num_processes, atol, rtol
+    ) -> None:
+        """Replicas living on different devices must merge correctly, with the
+        merged state landing on the destination's device (reference:
+        ``metric_class_tester.py:177-270`` exercises CPU↔CUDA)."""
+        import jax
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            return
+        per_rank = n // num_processes
+        replicas = [
+            copy.deepcopy(metric).to(devices[rank % len(devices)])
+            for rank in range(num_processes)
+        ]
+        for rank, rep in enumerate(replicas):
+            for i in range(rank * per_rank, (rank + 1) * per_rank):
+                rep.update(**_slice_kwargs(update_kwargs, i))
+        merged = replicas[0].merge_state(replicas[1:])
+        assert_result_close(merged.compute(), compute_result, atol=atol, rtol=rtol)
+        # merged state BUFFERS must land on the destination's device (the
+        # _device attribute alone would be vacuous — merge never touches it)
+        for name, value in merged._states().items():
+            leaves = (
+                list(value.values()) if isinstance(value, dict)
+                else list(value) if isinstance(value, (list, tuple)) or type(value).__name__ == "deque"
+                else [value]
+            )
+            for leaf in leaves:
+                self.assertIn(
+                    devices[0], leaf.devices(),
+                    f"state {name!r} not on destination device after cross-device merge",
+                )
 
     def _test_init(self, metric: Metric, state_names) -> None:
         self.assertEqual(set(metric.state_names), set(state_names))
