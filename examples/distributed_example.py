@@ -13,9 +13,11 @@ the same compiled computation as the update math. ``compute()`` is globally
 correct on every chip with no sync step.
 
 On a multi-host pod, run this same script on every host after
-``jax.distributed.initialize()`` — ``jax.devices()`` then spans all hosts and
-each host feeds its local shard (``jax.make_array_from_process_local_data``);
-use ``torcheval_tpu.metrics.toolkit.sync_and_compute`` only for the
+``torcheval_tpu.parallel.init_from_env()`` (reads COORDINATOR_ADDRESS / the
+torch-elastic MASTER_ADDR+RANK+WORLD_SIZE vars, or auto-detects on Cloud
+TPU) — ``jax.devices()`` then spans all hosts and each host feeds its local
+shard (``jax.make_array_from_process_local_data``); use
+``torcheval_tpu.metrics.toolkit.sync_and_compute`` only for the
 multi-controller pattern where each process keeps a *local* metric.
 
 Run single-host with a simulated 8-chip mesh:

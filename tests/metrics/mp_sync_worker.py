@@ -62,9 +62,17 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address=f"localhost:{port}", num_processes=world, process_id=rank
-    )
+    # join the world through the public bootstrap helper, fed torch-elastic
+    # style env vars — exactly how a launch script written for the reference
+    # (torchrun setting MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE) would drive it
+    os.environ["MASTER_ADDR"] = "localhost"
+    os.environ["MASTER_PORT"] = port
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["RANK"] = str(rank)
+    from torcheval_tpu.parallel import init_from_env
+
+    got_rank, got_world = init_from_env()
+    assert (got_rank, got_world) == (rank, world)
     import jax.numpy as jnp
 
     from torcheval_tpu.metrics import (

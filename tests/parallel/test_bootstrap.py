@@ -1,0 +1,150 @@
+"""Unit tests for the multi-host bootstrap helper.
+
+The real multi-process join (4 OS processes over jax.distributed) is covered
+by ``tests/metrics/test_multiprocess_sync.py``, whose worker now boots through
+``init_from_env`` with torch-elastic env vars. Here: the env-resolution logic
+and the single-process fallbacks, which need no cluster.
+"""
+
+import os
+import sys
+import unittest
+from unittest import mock
+
+from torcheval_tpu.parallel import init_from_env, is_initialized
+from torcheval_tpu.parallel.bootstrap import _resolve_env
+
+
+class TestResolveEnv(unittest.TestCase):
+    def test_jax_style(self):
+        env = {
+            "COORDINATOR_ADDRESS": "10.0.0.1:1234",
+            "NUM_PROCESSES": "8",
+            "PROCESS_ID": "3",
+        }
+        self.assertEqual(_resolve_env(env), ("10.0.0.1:1234", 8, 3))
+
+    def test_torch_elastic_style(self):
+        env = {
+            "MASTER_ADDR": "head-node",
+            "MASTER_PORT": "29500",
+            "WORLD_SIZE": "4",
+            "RANK": "1",
+        }
+        self.assertEqual(_resolve_env(env), ("head-node:29500", 4, 1))
+
+    def test_jax_style_wins_over_elastic(self):
+        env = {
+            "COORDINATOR_ADDRESS": "jax-coord:1",
+            "MASTER_ADDR": "torch-coord",
+            "MASTER_PORT": "2",
+            "NUM_PROCESSES": "16",
+            "WORLD_SIZE": "4",
+            "PROCESS_ID": "5",
+            "RANK": "1",
+        }
+        self.assertEqual(_resolve_env(env), ("jax-coord:1", 16, 5))
+
+    def test_master_addr_without_port_raises(self):
+        with self.assertRaisesRegex(ValueError, "MASTER_ADDR and MASTER_PORT"):
+            _resolve_env({"MASTER_ADDR": "head-node"})
+        with self.assertRaisesRegex(ValueError, "MASTER_ADDR and MASTER_PORT"):
+            _resolve_env({"MASTER_PORT": "29500"})
+
+    def test_empty_env(self):
+        self.assertEqual(_resolve_env({}), (None, None, None))
+
+    def test_non_integer_raises(self):
+        with self.assertRaisesRegex(ValueError, "WORLD_SIZE='four'"):
+            _resolve_env({"WORLD_SIZE": "four"})
+
+
+class TestAutoDetectable(unittest.TestCase):
+    """_auto_detectable delegates to jax's own cluster probes (which read the
+    real ``os.environ``), so these tests patch the process environment."""
+
+    def test_this_single_host_environment_is_not_a_cluster(self):
+        from torcheval_tpu.parallel.bootstrap import _auto_detectable
+
+        # the regression this guards: single-host TPU VMs export
+        # TPU_WORKER_HOSTNAMES=localhost, which must not look like a pod
+        self.assertFalse(_auto_detectable())
+
+    @mock.patch.dict(
+        os.environ,
+        {
+            "SLURM_JOB_ID": "1234",
+            "SLURM_STEP_NODELIST": "node[0-3]",
+            "SLURM_NTASKS": "4",
+            "SLURM_PROCID": "0",
+            "SLURM_LOCALID": "0",
+        },
+        clear=True,
+    )
+    def test_multiprocess_slurm_allocation_is_detected(self):
+        from torcheval_tpu.parallel.bootstrap import _auto_detectable
+
+        self.assertTrue(_auto_detectable())
+
+    @mock.patch.dict(
+        os.environ,
+        {
+            "SLURM_JOB_ID": "1234",
+            "SLURM_STEP_NODELIST": "node0",
+            "SLURM_NTASKS": "1",
+            "SLURM_PROCID": "0",
+            "SLURM_LOCALID": "0",
+        },
+        clear=True,
+    )
+    def test_single_process_slurm_allocation_is_not_a_cluster(self):
+        # a probe that is "present" but resolves world size 1 has nothing to
+        # join (same filter keeps mere-package-presence probes like mpi4py out)
+        from torcheval_tpu.parallel.bootstrap import _auto_detectable
+
+        self.assertFalse(_auto_detectable())
+
+    def test_fallback_heuristic_when_probes_unavailable(self):
+        from torcheval_tpu.parallel import bootstrap
+
+        fb = bootstrap._fallback_auto_detect
+        self.assertFalse(fb({"TPU_WORKER_HOSTNAMES": "localhost"}))
+        self.assertTrue(fb({"TPU_WORKER_HOSTNAMES": "host0,host1"}))
+        self.assertFalse(fb({"SLURM_NTASKS": "1"}))
+        self.assertTrue(fb({"SLURM_NTASKS": "8"}))
+        self.assertFalse(fb({}))
+
+        # the probe-API-moved path routes to the fallback
+        with mock.patch.dict(sys.modules, {"jax._src.clusters": None}):
+            self.assertFalse(bootstrap._auto_detectable())
+
+
+class TestInitFromEnvSingleProcess(unittest.TestCase):
+    # the ambient environment must not leak in: a stale torchrun shell's
+    # WORLD_SIZE/RANK (or a SLURM allocation) would otherwise send these
+    # tests down the real-initialize path
+    @mock.patch.dict(os.environ, {}, clear=True)
+    def test_no_coordinator_stays_single_process(self):
+        # conftest never initializes jax.distributed, and this test must not
+        # either: with nothing configured the helper is a pure no-op
+        self.assertFalse(is_initialized())
+        self.assertEqual(init_from_env(), (0, 1))
+        self.assertFalse(is_initialized())
+
+    @mock.patch.dict(os.environ, {}, clear=True)
+    def test_world_size_without_coordinator_raises(self):
+        with self.assertRaisesRegex(ValueError, "no coordinator"):
+            init_from_env(num_processes=4)
+
+    @mock.patch.dict(
+        os.environ, {"WORLD_SIZE": "4", "RANK": "3"}, clear=True
+    )
+    def test_rank_without_coordinator_raises(self):
+        # half-configured launcher: every worker silently becoming rank 0 of 1
+        # is the failure mode this guard exists for
+        with self.assertRaisesRegex(ValueError, "no coordinator"):
+            init_from_env()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,3 +1,4 @@
+from torcheval_tpu.parallel.bootstrap import init_from_env, is_initialized, shutdown
 from torcheval_tpu.parallel.evaluator import ShardedEvaluator, eval_shardings
 from torcheval_tpu.parallel.mesh import (
     data_parallel_mesh,
@@ -9,6 +10,9 @@ __all__ = [
     "ShardedEvaluator",
     "data_parallel_mesh",
     "eval_shardings",
+    "init_from_env",
+    "is_initialized",
     "replicate",
     "shard_batch",
+    "shutdown",
 ]
