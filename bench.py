@@ -36,12 +36,10 @@ def _jax():
     return jax
 
 
-def _time(fn, repeats=3):
-    """median-of-k wall time. The shared/tunneled chip has bursty co-tenant
-    stalls (min would hide them unfairly vs the single-run reference) AND the
-    first post-warmup iteration can report bogus-fast (observed 6 ms for a
-    10M-sort workload whose steady state is ~170 ms); the median is robust to
-    both."""
+def _time_host(fn, repeats=3):
+    """median-of-k wall time for host-side (torch CPU reference) legs: no
+    device barrier, no RTT correction — the work is synchronous on this
+    host."""
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -51,18 +49,59 @@ def _time(fn, repeats=3):
     return times[len(times) // 2]
 
 
+def _time(fn, repeats=3):
+    """median-of-k wall time with a host-readback barrier, minus tunnel RTT.
+
+    Two tunneled-chip artifacts to defend against: (a) bursty co-tenant
+    stalls (median, not min, so they aren't hidden unfairly vs the single-run
+    reference); (b) ``block_until_ready`` has been observed to return BEFORE
+    execution completes when the host is loaded (a 10M-sort run reporting
+    ~5 ms against a ~180 ms steady state — across every repeat, so the median
+    alone doesn't save it). ``_block`` therefore ends every timed run with
+    ``jax.device_get``, which cannot return without the bytes. That readback
+    pays the tunnel's flat ~0.1 s round trip — pure transport that a real
+    host pays microseconds for — so the same barrier is timed empty and its
+    median subtracted."""
+    import jax
+    import jax.numpy as jnp
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    rtts = []
+    for i in range(repeats):
+        fresh = jnp.float32(i) + 1.0  # fresh value: defeats host-side caching
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        jax.device_get(fresh)
+        rtts.append(time.perf_counter() - t0)
+    times.sort()
+    rtts.sort()
+    return max(
+        times[len(times) // 2] - rtts[len(rtts) // 2],
+        1e-9,
+    )
+
+
 def _block(*values):
+    """End-of-run barrier: host readback of the results (leaf arrays are
+    small — scalars and curves). See ``_time`` for why ``block_until_ready``
+    alone is not trustworthy here."""
     import jax
 
     jax.block_until_ready(values)
-    return values
+    return jax.device_get(values)
 
 
 def _ref_time(fn):
-    """Same warmup + median-of-k policy as the TPU leg, for a fair ratio."""
+    """Same warmup + median-of-k policy as the TPU leg, for a fair ratio
+    (host-clocked: the torch leg runs synchronously on this CPU, so it gets
+    neither the device barrier nor the RTT correction)."""
     try:
         fn()  # warmup
-        return _time(fn)
+        return _time_host(fn)
     except Exception:
         return None  # never fabricate a parity number
 
@@ -252,13 +291,18 @@ def config3_confusion_f1_imagenet():
     label = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, c, np.int32)
     jax.block_until_ready((pred, label))
 
+    import jax.numpy as jnp
+
     def tpu():
         cm = MulticlassConfusionMatrix(c)
         f1 = MulticlassF1Score(num_classes=c, average="macro")
         for _ in range(n_batches):
             cm.update(pred, label)
             f1.update(pred, label)
-        return _block(cm.compute(), f1.compute())
+        # sum the 1000x1000 matrix on device: forces the full compute while
+        # keeping the readback barrier payload scalar (the tunnel moves
+        # ~8.5 MB/s — pulling 4 MB would time transport, not the metric)
+        return _block(jnp.sum(cm.compute()), f1.compute())
 
     def ref():
         sys.path.insert(0, "/root/reference")
@@ -290,7 +334,8 @@ def config3_confusion_f1_imagenet():
         col.reset()
         for _ in range(n_batches):
             col.update(pred, label)
-        return _block(col.compute())
+        r = col.compute()
+        return _block(jnp.sum(r["cm"]), r["f1"])  # scalar barrier, as above
 
     tpu_fused()
     _emit(
@@ -372,7 +417,52 @@ def config5_sharded_sync():
     )
 
 
+def env_dispatch_floor():
+    """Record the tunnel's per-dispatch execution cost at bench time.
+
+    Configs that stream many small updates (1 and 3) are bound by this
+    environmental floor, which swings 0.7-5 ms with co-tenant load on the
+    tunneled chip (a directly-attached TPU dispatches in tens of µs). One
+    chained trivial kernel per dispatch; the drain time divided by calls is
+    the floor. Emitted so each round's record is interpretable."""
+    jax = _jax()
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(s):
+        return s + 1
+
+    s = jnp.int32(0)
+    s = step(s)
+    jax.block_until_ready(s)
+    t0 = time.perf_counter()
+    for _ in range(100):
+        s = step(s)
+    jax.device_get(s)
+    elapsed = time.perf_counter() - t0
+    # the terminal readback's flat tunnel RTT is not per-dispatch cost;
+    # measure and subtract it (same policy as _time)
+    fresh = jnp.int32(123) + 1
+    jax.block_until_ready(fresh)
+    t0 = time.perf_counter()
+    jax.device_get(fresh)
+    rtt = time.perf_counter() - t0
+    per_call = max(elapsed - rtt, 1e-9) / 100
+    print(
+        json.dumps(
+            {
+                "metric": "env_dispatch_floor",
+                "value": round(per_call * 1e3, 3),
+                "unit": "ms/dispatch",
+                "vs_baseline": None,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
+    env_dispatch_floor()
     headline_10m()
     headline_scaled(100_000_000, "100M")
     headline_scaled(1_000_000_000, "1B")

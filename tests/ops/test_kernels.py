@@ -20,9 +20,51 @@ class TestClassCounts(unittest.TestCase):
     def test_unweighted_matches_bincount(self):
         labels = RNG.integers(0, 17, 500)
         want = np.bincount(labels, minlength=17)
-        for method in ("matmul", "scatter", "auto"):
+        for method in ("matmul", "scatter", "sort", "auto"):
             got = np.asarray(class_counts(jnp.asarray(labels), 17, method=method))
             np.testing.assert_array_equal(got, want, err_msg=method)
+
+    def test_sort_path_drops_out_of_range(self):
+        labels = jnp.asarray([0, 1, 7, -1, 1, 2])
+        got = np.asarray(class_counts(labels, 3, method="sort"))
+        np.testing.assert_array_equal(got, [1, 2, 1])
+
+    def test_sort_path_rejects_weights(self):
+        with self.assertRaisesRegex(ValueError, "unweighted"):
+            class_counts(
+                jnp.asarray([0, 1]), 2, jnp.asarray([1.0, 2.0]), method="sort"
+            )
+
+    def test_pallas_kernel_matches_bincount(self):
+        # interpret mode on the CPU suite; the same kernel compiles for real
+        # on a TPU backend (class_counts flips interpret off there)
+        for n, c in ((0, 3), (5, 1), (500, 17), (300, 129), (1000, 1000)):
+            labels = RNG.integers(-1, c + 2, n)  # includes out-of-range
+            want = np.bincount(labels[(labels >= 0) & (labels < c)], minlength=c)
+            got = np.asarray(
+                class_counts(jnp.asarray(labels, jnp.int32), c, method="pallas")
+            )
+            np.testing.assert_array_equal(got, want, err_msg=f"n={n} c={c}")
+
+    def test_pallas_rejects_weights(self):
+        with self.assertRaisesRegex(ValueError, "unweighted"):
+            class_counts(
+                jnp.asarray([0, 1]), 2, jnp.asarray([1.0, 2.0]), method="pallas"
+            )
+
+    def test_auto_pick_respects_exactness_and_size(self):
+        from torcheval_tpu.ops.confusion import _pick_method
+
+        self.assertEqual(_pick_method(100_000, 1000, "auto", False), "matmul")
+        # huge virtual one-hot: unweighted goes to sort, weighted to scatter
+        self.assertEqual(_pick_method(1_000_000, 10_000, "auto", False), "sort")
+        self.assertEqual(_pick_method(1_000_000, 10_000, "auto", True), "scatter")
+        # n >= 2**24 would overflow exact f32 accumulation in one batch
+        self.assertEqual(_pick_method(1 << 24, 2, "auto", False), "sort")
+
+    def test_unknown_method_rejected(self):
+        with self.assertRaisesRegex(ValueError, "method must be one of"):
+            class_counts(jnp.asarray([0, 1]), 2, method="Sort")
 
     def test_weighted(self):
         labels = RNG.integers(0, 5, 100)
@@ -59,6 +101,28 @@ class TestConfusionMatrixCounts(unittest.TestCase):
         got = np.asarray(confusion_matrix_counts(p, t, 3))
         self.assertEqual(int(got.sum()), 1)
         self.assertEqual(int(got[0, 0]), 1)
+
+    def test_matmul_and_scatter_lowerings_agree(self):
+        # both sides of the N·C² auto-pick produce identical counts,
+        # including dropped out-of-range coordinates
+        from torcheval_tpu.ops import confusion
+
+        p = np.concatenate([RNG.integers(0, 9, 400), [-1, 9, 3]])
+        t = np.concatenate([RNG.integers(0, 9, 400), [2, 2, 12]])
+        jp, jt = jnp.asarray(p), jnp.asarray(t)
+        via_matmul = np.asarray(confusion_matrix_counts(jp, jt, 9))
+        orig = confusion._CONFUSION_MATMUL_BUDGET
+        try:
+            confusion._CONFUSION_MATMUL_BUDGET = 0  # force the scatter path
+            via_scatter = np.asarray(
+                jax.jit(
+                    confusion.confusion_matrix_counts.__wrapped__,
+                    static_argnames=("num_classes", "normalize"),
+                )(jp, jt, 9)
+            )
+        finally:
+            confusion._CONFUSION_MATMUL_BUDGET = orig
+        np.testing.assert_array_equal(via_matmul, via_scatter)
 
     def test_normalize_modes(self):
         mat = jnp.asarray([[2, 0], [1, 1]], jnp.int32)

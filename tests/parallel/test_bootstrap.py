@@ -145,6 +145,15 @@ class TestInitFromEnvSingleProcess(unittest.TestCase):
         with self.assertRaisesRegex(ValueError, "no coordinator"):
             init_from_env()
 
+    @mock.patch.dict(
+        os.environ, {"WORLD_SIZE": "1", "RANK": "0"}, clear=True
+    )
+    def test_consistent_single_process_env_stays_single_process(self):
+        # RANK=0/WORLD_SIZE=1 is a common container default, not a
+        # misconfiguration — must not raise
+        self.assertEqual(init_from_env(), (0, 1))
+        self.assertFalse(is_initialized())
+
 
 if __name__ == "__main__":
     unittest.main()

@@ -88,7 +88,14 @@ class MetricCollection:
                     m._set_states(saved)
             return out
 
-        return jax.jit(step, donate_argnums=0)
+        from torcheval_tpu.utils.platform import donation_pipelines
+
+        # donation keeps the accumulators updating in place in HBM; on a
+        # tunneled backend it serialises dispatches instead (7x slower
+        # measured) — see utils/platform.py
+        if donation_pipelines():
+            return jax.jit(step, donate_argnums=0)
+        return jax.jit(step)
 
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
         if self._step is not None:

@@ -3,14 +3,26 @@
 The reference's hot kernel is a 1-D ``scatter_(0, labels, w, reduce="add")``
 (``/root/reference/torcheval/metrics/functional/classification/f1_score.py:182-190``,
 ``accuracy.py:271-273``). XLA:TPU lowers scatter poorly (serialised updates),
-so the TPU-first design offers two lowerings and picks by size:
+so the TPU-first design offers three lowerings and picks by size:
 
 * ``matmul`` — weights-vector × one-hot matrix product. The one-hot is
   ``labels[:, None] == iota`` fused by XLA into the dot; the contraction rides
   the MXU. Exact for integer-valued weights below 2**24 per batch (float32
-  accumulation). Preferred while the virtual one-hot stays small.
+  accumulation).
+* ``sort`` — sort labels, then per-class run lengths via binary search of the
+  class edges into the sorted array. O(N log N) but bandwidth-friendly;
+  unweighted only. Wins when the virtual one-hot gets huge.
 * ``scatter`` — ``zeros(C).at[labels].add(w)``; O(N) updates, no N×C
-  intermediate. Wins for very large ``num_classes × batch``.
+  intermediate. Never wins on TPU (serialised updates) but is the general
+  weighted fallback when the one-hot is over budget.
+* ``pallas`` — opt-in hand kernel (``ops/pallas_hist.py``): VMEM-resident
+  accumulator over a sequential sample-block grid; unweighted only. Not in
+  the auto-pick until a clean measurement window shows it beating the matmul
+  (tunnel noise has so far allowed only parity-to-better readings).
+
+Auto-pick thresholds are measured on a v5e chip (2026-07): matmul beats
+scatter 4.3× at (N=1M, C=1000) and stays ahead through N·C ≈ 2**30; the sort
+path beats both ~3× at (N=1M, C=10k) and 13× at (N=8k, C=10k).
 
 Counts accumulate into int32 when unweighted (exact to 2**31 ≈ 2.1e9 samples —
 covers the 1B-pred BASELINE configs; float32 would lose exactness at 16.7M).
@@ -24,14 +36,36 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# Above this many virtual one-hot elements (N * C), switch to scatter.
-_MATMUL_ELEMENT_BUDGET = 1 << 24
+# Above this many virtual one-hot elements (N * C), stop using the MXU
+# one-hot contraction (measured crossover vs the sort path, v5e).
+_MATMUL_ELEMENT_BUDGET = 1 << 30
+# Above this many virtual one-hot elements (N * C * C), lower the joint
+# (confusion) one-hot contraction to a flat scatter instead (measured
+# crossover: matmul 4.15 ms vs scatter 5.38 ms at N=100k·C=1000 = 1e11;
+# scatter ahead by 1.5× at N=1.3M·C=1000).
+_CONFUSION_MATMUL_BUDGET = 2 * 10**11
+# The matmul path also MATERIALISES two (N, C) bf16 one-hot operands (XLA
+# cannot fuse the compare into both sides of a dot_general); cap their
+# footprint (2 × 2 B × N·C) at ~2 GB so a small-C/large-N input inside the
+# MAC budget cannot OOM where the O(N) scatter handles it fine.
+_CONFUSION_MATMUL_ONEHOT_ELEMS = 1 << 29
 
 
-def _pick_method(n: int, num_classes: int, method: str) -> str:
+_METHODS = ("auto", "matmul", "scatter", "sort", "pallas")
+
+
+def _pick_method(n: int, num_classes: int, method: str, weighted: bool) -> str:
+    if method not in _METHODS:
+        raise ValueError(f"method must be one of {_METHODS}, got {method!r}.")
     if method != "auto":
         return method
-    return "matmul" if n * num_classes <= _MATMUL_ELEMENT_BUDGET else "scatter"
+    # n < 2**24 keeps unweighted per-class counts (≤ n) exact in the float32
+    # accumulator; weighted exactness is the caller's documented contract, so
+    # the same bound is applied as a proxy for "sum of weights stays small"
+    if n * num_classes <= _MATMUL_ELEMENT_BUDGET and n < (1 << 24):
+        return "matmul"
+    # sort path is unweighted-only; weighted over-budget falls to scatter
+    return "scatter" if weighted else "sort"
 
 
 @partial(jax.jit, static_argnames=("num_classes", "method", "dtype"))
@@ -55,7 +89,7 @@ def class_counts(
         w = jnp.ones((n,), dtype=jnp.int32 if dtype is None else dtype)
     else:
         w = weights if dtype is None else weights.astype(dtype)
-    resolved = _pick_method(n, num_classes, method)
+    resolved = _pick_method(n, num_classes, method, weighted=weights is not None)
     if resolved == "matmul":
         # (N, C) virtual one-hot contracted against (N,) weights on the MXU.
         onehot = (labels[:, None] == jnp.arange(num_classes)[None, :]).astype(
@@ -65,6 +99,24 @@ def class_counts(
             w.astype(jnp.float32), onehot, preferred_element_type=jnp.float32
         )
         return counts.astype(w.dtype)
+    if resolved == "pallas":
+        if weights is not None:
+            raise ValueError("method='pallas' supports only unweighted counts.")
+        from torcheval_tpu.ops.pallas_hist import pallas_class_counts
+
+        interpret = jax.default_backend() != "tpu"
+        return pallas_class_counts(
+            labels, num_classes, interpret=interpret
+        ).astype(w.dtype)
+    if resolved == "sort":
+        if weights is not None:
+            raise ValueError("method='sort' supports only unweighted counts.")
+        # run lengths of each class in the sorted labels; out-of-range labels
+        # sort to the ends, outside every [edge_c, edge_c+1) span
+        s = jnp.sort(labels.astype(jnp.int32))
+        edges = jnp.arange(num_classes + 1, dtype=jnp.int32)
+        starts = jnp.searchsorted(s, edges, side="left")
+        return (starts[1:] - starts[:-1]).astype(w.dtype)
     # scatter path: drop out-of-range labels. mode="drop" only catches
     # indices past the end — negative indices would WRAP (numpy semantics)
     # and silently count against the last classes, diverging from the matmul
@@ -86,21 +138,44 @@ def confusion_matrix_counts(
 ) -> jax.Array:
     """``out[t, p] = #{i : target[i] == t and pred[i] == p}``.
 
-    Lowered as a single O(N) scatter on the joint index ``t * C + p`` (a joint
-    one-hot matmul would cost N·C² MACs — prohibitive at C=1000).
+    Two lowerings, picked by the N·C² MAC volume of the one-hot contraction:
+
+    * ``T^T @ P`` where T/P are (N, C) one-hot matrices in bfloat16 (0/1 are
+      exact in bf16) accumulated in float32 — the contraction over samples
+      rides the MXU. Measured 20× faster than scatter at C=100 and still
+      ahead at (N=100k, C=1000); exact while every cell count < 2**24.
+    * a single O(N) flat scatter on the joint index ``t * C + p`` for larger
+      volumes, where the MAC count outgrows the MXU win.
+
     Out-of-range labels in either coordinate contribute nothing (a sample with
-    only one bad coordinate must not fold into a valid cell, so validity is
-    masked explicitly before the joint index is formed).
+    only one bad coordinate must not fold into a valid cell: the matmul row is
+    all-zero in the invalid coordinate's one-hot; the scatter path masks
+    validity explicitly before forming the joint index).
     ``normalize``: None | "all" | "pred" | "true" (matching sklearn semantics).
     """
     p = pred.astype(jnp.int32)
     t = target.astype(jnp.int32)
-    valid = (p >= 0) & (p < num_classes) & (t >= 0) & (t < num_classes)
-    joint = jnp.where(valid, t * num_classes + p, num_classes * num_classes)
-    flat = jnp.zeros((num_classes * num_classes,), dtype=jnp.int32).at[joint].add(
-        1, mode="drop"
-    )
-    mat = flat.reshape(num_classes, num_classes)
+    n = p.shape[0]
+    # n < 2**24 keeps every cell count (≤ n) exactly representable in the
+    # float32 accumulator; bigger batches take the integer scatter
+    if (
+        n * num_classes * num_classes <= _CONFUSION_MATMUL_BUDGET
+        and n * num_classes <= _CONFUSION_MATMUL_ONEHOT_ELEMS
+        and n < (1 << 24)
+    ):
+        classes = jnp.arange(num_classes, dtype=jnp.int32)[None, :]
+        t_onehot = (t[:, None] == classes).astype(jnp.bfloat16)
+        p_onehot = (p[:, None] == classes).astype(jnp.bfloat16)
+        mat = jnp.matmul(
+            t_onehot.T, p_onehot, preferred_element_type=jnp.float32
+        ).astype(jnp.int32)
+    else:
+        valid = (p >= 0) & (p < num_classes) & (t >= 0) & (t < num_classes)
+        joint = jnp.where(valid, t * num_classes + p, num_classes * num_classes)
+        flat = jnp.zeros(
+            (num_classes * num_classes,), dtype=jnp.int32
+        ).at[joint].add(1, mode="drop")
+        mat = flat.reshape(num_classes, num_classes)
     return normalize_confusion_matrix(mat, normalize)
 
 

@@ -153,10 +153,13 @@ def init_from_env(
     process_id = process_id if process_id is not None else env_rank
 
     if coordinator_address is None and not _auto_detectable():
-        if (num_processes or 1) > 1 or process_id is not None:
-            # a rank/world-size without a coordinator is a half-configured
-            # launcher, not a single-process run — degrading silently would
-            # leave every worker believing it is rank 0 of 1
+        if (num_processes or 1) > 1 or (process_id or 0) > 0:
+            # a multi-process world-size or nonzero rank without a
+            # coordinator is a half-configured launcher, not a
+            # single-process run — degrading silently would leave every
+            # worker believing it is rank 0 of 1. (RANK=0/WORLD_SIZE=1,
+            # a common container default, IS a consistent single-process
+            # configuration and falls through.)
             raise ValueError(
                 "init_from_env: WORLD_SIZE/NUM_PROCESSES/RANK configured but "
                 "no coordinator address (set COORDINATOR_ADDRESS or "

@@ -1,0 +1,28 @@
+"""Backend capability probes.
+
+One quirk matters enough to gate on: buffer donation through a *tunneled*
+device client (the ``axon`` PJRT plugin that proxies a remote TPU chip)
+breaks execution pipelining — a chain of donated-state dispatches was
+measured at 5.2 ms/step against 0.7 ms/step for the identical chain without
+donation (the client must confirm the donated buffer's hand-back before it
+can enqueue the next step, so every dispatch pays a tunnel round trip).
+On directly-attached TPUs donation is a straight win (no allocation, state
+updates in place in HBM) and stays on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def donation_pipelines() -> bool:
+    """False when the default backend is a tunneled client on which donated
+    dispatches serialise; True on real local devices (TPU/CPU/GPU)."""
+    import jax._src.xla_bridge as xb
+
+    try:
+        version = getattr(xb.get_backend(), "platform_version", "") or ""
+    except Exception:
+        return True
+    return "axon" not in version
