@@ -79,10 +79,14 @@ def _time(fn, repeats=3):
         rtts.append(time.perf_counter() - t0)
     times.sort()
     rtts.sort()
-    return max(
-        times[len(times) // 2] - rtts[len(rtts) // 2],
-        1e-9,
-    )
+    med, rtt = times[len(times) // 2], rtts[len(rtts) // 2]
+    if med <= rtt:
+        # RTT probes caught a co-tenant burst the timed legs missed; the
+        # corrected value would be meaningless (or fabricate preds/1e-9).
+        # Fall back to the UNCORRECTED median: conservative (includes the
+        # readback transport), never fabricated.
+        return med
+    return med - rtt
 
 
 def _block(*values):
@@ -441,13 +445,17 @@ def env_dispatch_floor():
     jax.device_get(s)
     elapsed = time.perf_counter() - t0
     # the terminal readback's flat tunnel RTT is not per-dispatch cost;
-    # measure and subtract it (same policy as _time)
-    fresh = jnp.int32(123) + 1
-    jax.block_until_ready(fresh)
-    t0 = time.perf_counter()
-    jax.device_get(fresh)
-    rtt = time.perf_counter() - t0
-    per_call = max(elapsed - rtt, 1e-9) / 100
+    # measure (median of 3 — single probes catch co-tenant bursts) and
+    # subtract it, same policy as _time
+    rtts = []
+    for i in range(3):
+        fresh = jnp.int32(123) + i
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        jax.device_get(fresh)
+        rtts.append(time.perf_counter() - t0)
+    rtts.sort()
+    per_call = max(elapsed - rtts[1], 0.0) / 100
     print(
         json.dumps(
             {

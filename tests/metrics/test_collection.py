@@ -122,6 +122,30 @@ class TestCollectionTorchBridge(unittest.TestCase):
         col.update(torch.eye(3), torch.arange(3))
         self.assertEqual(float(col.compute()), 1.0)
 
+    def test_non_donated_step_on_tunneled_backend(self):
+        # on a tunneled backend the donation gate compiles the fused step
+        # WITHOUT donate_argnums (utils/platform.py); results must be
+        # identical and repeated updates must not touch deleted buffers
+        from unittest import mock
+
+        import torcheval_tpu.metrics.collection as collection_mod
+
+        with mock.patch(
+            "torcheval_tpu.utils.platform.donation_pipelines", return_value=False
+        ):
+            col = collection_mod.MetricCollection(
+                MulticlassAccuracy(num_classes=4)
+            )
+            rng = np.random.default_rng(7)
+            scores = rng.random((32, 4)).astype(np.float32)
+            labels = rng.integers(0, 4, 32)
+            for _ in range(3):
+                col.update(jnp.asarray(scores), jnp.asarray(labels))
+            want = float(
+                np.mean(scores.argmax(1) == labels)
+            )
+            self.assertAlmostEqual(float(col.compute()), want, places=6)
+
     def test_clone_survives_donation(self):
         # clone_metric between fused updates must own its buffers
         from torcheval_tpu.metrics.toolkit import clone_metric

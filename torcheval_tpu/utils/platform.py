@@ -19,10 +19,11 @@ import functools
 def donation_pipelines() -> bool:
     """False when the default backend is a tunneled client on which donated
     dispatches serialise; True on real local devices (TPU/CPU/GPU)."""
-    import jax._src.xla_bridge as xb
-
     try:
+        import jax._src.xla_bridge as xb
+
         version = getattr(xb.get_backend(), "platform_version", "") or ""
     except Exception:
+        # private API may move between jax versions; default to donating
         return True
     return "axon" not in version
