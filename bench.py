@@ -470,7 +470,8 @@ def env_dispatch_floor():
 
 
 def main() -> None:
-    env_dispatch_floor()
+    # headline (north star) FIRST: round 1's driver record parsed the first
+    # JSON line as the round's number — keep that contract
     headline_10m()
     headline_scaled(100_000_000, "100M")
     headline_scaled(1_000_000_000, "1B")
@@ -479,6 +480,7 @@ def main() -> None:
     config3_confusion_f1_imagenet()
     config4_topk_multilabel()
     config5_sharded_sync()
+    env_dispatch_floor()
 
 
 if __name__ == "__main__":
