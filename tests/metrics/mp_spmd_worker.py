@@ -1,0 +1,126 @@
+"""Worker for ``test_multihost_spmd.py``: REAL multi-host SPMD evaluation.
+
+4 OS processes × 2 local CPU devices each = one 8-device global mesh. Every
+process runs the SAME program in lockstep (standard multi-controller JAX):
+``ShardedEvaluator`` folds globally-sharded batches into replicated metric
+state, and the curve metric's compute runs as one partitioned program over a
+cache whose shards are mostly NON-addressable from any single process — the
+exact situation the docs' multi-host story (docs/distributed.md "Lane 1")
+claims to handle with no host-side shard touching.
+
+Batch construction uses ``jax.make_array_from_process_local_data`` fed only
+this host's shard (the per-host data-loader idiom — the only legal one
+multi-host). The worker also asserts ``shard_batch`` REJECTS host-local data
+in this world with guidance pointing at that idiom: scattering host values
+across hosts would need cross-host transfers the backend doesn't provide.
+
+Run:  python mp_spmd_worker.py <rank> <world> <port> <outdir>
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+NUM_CLASSES = 5
+GLOBAL_BATCH = 64  # divisible by the 8-device mesh
+N_BATCHES = 3
+LOCAL_DEVICES = 2
+
+
+def make_global_batch(b: int):
+    rng = np.random.default_rng(500 + b)
+    scores = rng.random((GLOBAL_BATCH, NUM_CLASSES)).astype(np.float32)
+    labels = rng.integers(0, NUM_CLASSES, GLOBAL_BATCH)
+    logits = rng.random(GLOBAL_BATCH).astype(np.float32)
+    binary = (rng.random(GLOBAL_BATCH) < 0.4).astype(np.float32)
+    return scores, labels, logits, binary
+
+
+def _jsonable(x):
+    arr = np.asarray(x)
+    return arr.tolist() if arr.ndim else float(arr)
+
+
+def main() -> None:
+    rank, world, port, outdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    from torcheval_tpu.parallel import init_from_env
+
+    os.environ["MASTER_ADDR"] = "localhost"
+    os.environ["MASTER_PORT"] = port
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["RANK"] = str(rank)
+    got_rank, got_world = init_from_env()
+    assert (got_rank, got_world) == (rank, world)
+    assert len(jax.devices()) == world * LOCAL_DEVICES, jax.devices()
+    assert len(jax.local_devices()) == LOCAL_DEVICES
+
+    from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+    from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh
+
+    mesh = data_parallel_mesh()  # all 8 global devices
+    assert mesh.devices.size == world * LOCAL_DEVICES
+
+    results = {"rank": rank}
+
+    # host-local data through shard_batch must fail loudly on a multi-process
+    # world (device_put cannot scatter host values across hosts)
+    from torcheval_tpu.parallel import shard_batch
+
+    try:
+        shard_batch(mesh, np.zeros((GLOBAL_BATCH, 2), np.float32))
+        results["host_data_guard"] = "MISSING"
+    except ValueError as e:
+        results["host_data_guard"] = (
+            "ok" if "make_array_from_process_local_data" in str(e) else str(e)
+        )
+
+    # global batches built from each host's LOCAL shard (the per-host
+    # data-loader idiom); ShardedEvaluator accepts them as-is. acc and auroc
+    # take different inputs, so each gets its own evaluator (a collection
+    # broadcasts one update signature to all members).
+    ev = ShardedEvaluator(MulticlassAccuracy(num_classes=NUM_CLASSES), mesh=mesh)
+    ev_auroc = ShardedEvaluator(BinaryAUROC(), mesh=mesh)
+    for b in range(N_BATCHES):
+        scores, labels, logits, binary = make_global_batch(b)
+        ev.update(*_global_from_local(mesh, rank, scores, labels))
+        ev_auroc.update(*_global_from_local(mesh, rank, logits, binary))
+    results["acc"] = _jsonable(ev.compute())
+    results["auroc"] = _jsonable(ev_auroc.compute())
+
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+
+
+def _global_from_local(mesh, rank, *full_arrays):
+    """Lane 2: build the global array from THIS process's local shard only
+    (``make_array_from_process_local_data``) — the per-host data-loader idiom.
+    The full array is deterministic in every process; each host slices its
+    own quarter, and the resulting global jax.Array has non-addressable
+    shards everywhere else."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    world = jax.process_count()
+    out = []
+    for full in full_arrays:
+        per = full.shape[0] // world
+        local = full[rank * per : (rank + 1) * per]
+        sharding = NamedSharding(mesh, P("data"))
+        out.append(jax.make_array_from_process_local_data(sharding, local))
+    return tuple(out)
+
+
+if __name__ == "__main__":
+    main()

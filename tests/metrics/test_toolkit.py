@@ -224,6 +224,23 @@ class TestShardedEvaluator(unittest.TestCase):
         shard_shapes = {s.data.shape for s in batch.addressable_shards}
         self.assertEqual(shard_shapes, {(8, 4)})
 
+    def test_replicated_input_gets_resharded(self):
+        # a REPLICATED array on the mesh (e.g. a jitted forward pass with
+        # replicated output) must still be re-placed to P("data") — the
+        # already-global fast path may only bypass the exact target sharding
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = jax.device_put(
+            jnp.zeros((64, 4), jnp.float32), NamedSharding(self.mesh, P())
+        )
+        out = shard_batch(self.mesh, replicated)
+        shard_shapes = {s.data.shape for s in out.addressable_shards}
+        self.assertEqual(shard_shapes, {(8, 4)})
+
+    def test_presharded_input_passes_through_untouched(self):
+        presharded = shard_batch(self.mesh, np.zeros((64, 4), dtype=np.float32))
+        self.assertIs(shard_batch(self.mesh, presharded), presharded)
+
     def test_sharded_collection_and_state_correct(self):
         ev = ShardedEvaluator(
             {

@@ -68,21 +68,46 @@ def check_state_type(name: str, value: Any) -> None:
     )
 
 
+def _put_leaf(value, device):
+    import numpy as np
+
+    value = jnp.asarray(value) if not hasattr(value, "dtype") else value
+    if (
+        isinstance(device, jax.sharding.Sharding)
+        and not device.is_fully_addressable
+    ):
+        # multi-process mesh: device_put would need a cross-host transfer,
+        # which backends may not support. State placed through .to() is
+        # process-local (replicated-identical on every host by SPMD
+        # lockstep), so build the global array from each host's own copy —
+        # no bytes cross hosts.
+        if (
+            isinstance(value, jax.Array)
+            and getattr(value.sharding, "device_set", None) == device.device_set
+        ):
+            return value  # already global on this mesh
+        host = np.asarray(value)
+        return jax.make_array_from_callback(
+            host.shape, device, lambda idx: host[idx]
+        )
+    return jax.device_put(value, device)
+
+
 def put_state(value: TState, device) -> TState:
     """Place a state value (any container type) on ``device``."""
     if isinstance(value, (list, deque)):
-        moved = [jax.device_put(v, device) for v in value]
+        moved = [_put_leaf(v, device) for v in value]
         if isinstance(value, deque):
             return deque(moved, maxlen=value.maxlen)
         return moved
     if isinstance(value, dict):
-        out = {k: jax.device_put(v, device) for k, v in value.items()}
+        out = {k: _put_leaf(v, device) for k, v in value.items()}
         if isinstance(value, defaultdict) and value.default_factory is not None:
             d = defaultdict(value.default_factory)
             d.update(out)
             return d
         return out
-    return jax.device_put(jnp.asarray(value), device)
+    return _put_leaf(jnp.asarray(value), device)
 
 
 def _copy_leaf(value):

@@ -46,7 +46,34 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
 
     global _warned_uneven_batch
     n_dev = mesh.devices.size
+    mesh_devices = set(mesh.devices.flat)
     converted = [as_jax(a) for a in arrays]
+
+    def _already_placed(a) -> bool:
+        if not isinstance(a, jax.Array):
+            return False
+        if jax.process_count() > 1:
+            # multi-process: any global array on this mesh is accepted as-is
+            # (re-placing would need a cross-host transfer); layout is the
+            # caller's choice via make_array_from_process_local_data
+            return getattr(a.sharding, "device_set", None) == mesh_devices
+        # single-controller: bypass ONLY when the array already has the
+        # target data sharding — a replicated array must still be re-placed
+        # to P("data") or every device would process the full batch
+        target = NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))
+        return a.sharding.is_equivalent_to(target, a.ndim)
+
+    if all(_already_placed(a) for a in converted):
+        out = tuple(converted)
+        return out[0] if len(out) == 1 else out
+    if jax.process_count() > 1:
+        raise ValueError(
+            "shard_batch received host-local data in a multi-process world; "
+            "device_put cannot scatter host values across hosts. Build the "
+            "global batch with jax.make_array_from_process_local_data("
+            "NamedSharding(mesh, P('data', ...)), local_shard) and pass the "
+            "resulting jax.Array instead."
+        )
     if not _warned_uneven_batch and any(
         a.shape[0] % n_dev != 0 for a in converted
     ):
@@ -74,5 +101,13 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
 
 
 def replicate(mesh: Mesh, value):
-    """Fully-replicated placement for metric state on ``mesh``."""
-    return jax.device_put(value, NamedSharding(mesh, P()))
+    """Fully-replicated placement for metric state on ``mesh``.
+
+    Multi-process meshes build the global array from each host's local copy
+    (every host holds the same value in SPMD lockstep) instead of
+    ``device_put``, which would demand a cross-host transfer most backends
+    don't provide — same policy as metric state placement
+    (``metrics/state.py::_put_leaf``)."""
+    from torcheval_tpu.metrics.state import _put_leaf
+
+    return _put_leaf(value, NamedSharding(mesh, P()))
