@@ -44,26 +44,38 @@ class TestMultihostSPMD(unittest.TestCase):
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.pop("XLA_FLAGS", None)
+        # worker output goes to files, not pipes: draining pipes one rank at
+        # a time can deadlock the whole group if another rank fills its 64KB
+        # pipe buffer while rank 0 blocks inside a collective
+        logs = [os.path.join(cls.tmpdir, f"rank{r}.log") for r in range(WORLD)]
+        handles = [open(path, "wb") for path in logs]
         procs = [
             subprocess.Popen(
                 [sys.executable, _WORKER, str(r), str(WORLD), str(port), cls.tmpdir],
                 env=env,
-                stdout=subprocess.PIPE,
+                stdout=handles[r],
                 stderr=subprocess.STDOUT,
             )
             for r in range(WORLD)
         ]
         cls.outputs = []
         try:
-            for p in procs:
-                out, _ = p.communicate(timeout=300)
-                cls.outputs.append((p.returncode, out.decode(errors="replace")))
+            for r, p in enumerate(procs):
+                try:
+                    p.wait(timeout=300)
+                except subprocess.TimeoutExpired:
+                    pass
+                with open(logs[r], "rb") as f:
+                    out = f.read().decode(errors="replace")
+                cls.outputs.append((p.returncode, out))
         finally:
             # a hung rank (e.g. a peer crashed before joining the collective)
             # must not leave orphans holding the port for 4x the timeout
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+            for h in handles:
+                h.close()
 
     def _results(self):
         for rc, out in self.outputs:

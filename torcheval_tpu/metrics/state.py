@@ -85,7 +85,19 @@ def _put_leaf(value, device):
             isinstance(value, jax.Array)
             and getattr(value.sharding, "device_set", None) == device.device_set
         ):
-            return value  # already global on this mesh
+            if value.sharding.is_equivalent_to(device, value.ndim):
+                return value  # already global in the requested layout
+            # same mesh, different layout: re-placement would need a
+            # cross-host transfer — fail loudly rather than hand back the
+            # wrong layout (e.g. a data-sharded array where replicated was
+            # requested)
+            raise ValueError(
+                f"cannot re-place a global array (sharding {value.sharding}) "
+                f"to {device} on a multi-process mesh: cross-host transfers "
+                "are not available. Build the value in the target layout "
+                "(jax.make_array_from_process_local_data / a jitted "
+                "computation with the right out_shardings) instead."
+            )
         host = np.asarray(value)
         return jax.make_array_from_callback(
             host.shape, device, lambda idx: host[idx]

@@ -31,12 +31,39 @@ import jax
 import jax.numpy as jnp
 
 
+def _propagate_group_ends(
+    s: jax.Array, ctp: jax.Array, cfp: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Replace each position's cumulative counts with its tie group's END
+    values: boundary mask + reverse ``cummin`` (log-depth scan, no gathers —
+    cumulative counts are nondecreasing, so masking non-ends to +inf and
+    scanning min backwards lands every row on its group-end value)."""
+    if s.shape[0] == 0:
+        last = jnp.zeros((0,), bool)
+    else:
+        last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+    big = jnp.iinfo(jnp.int32).max
+    tp = jax.lax.cummin(jnp.where(last, ctp, big), reverse=True)
+    fp = jax.lax.cummin(jnp.where(last, cfp, big), reverse=True)
+    return tp, fp, last
+
+
 def _group_end_cumsums(
     input: jax.Array, target: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Raw-sample (unit count) case of :func:`_group_end_count_cumsums`."""
+    """Raw-sample (unit count) case of :func:`_group_end_count_cumsums`,
+    with less sort traffic: every raw row contributes exactly one count
+    (``fp = 1 - tp``), so only the target rides the sort and the FP cumsum
+    is recovered as ``rank+1 - cumsum(tp)`` — 8 bytes/row through the sort
+    instead of 12. Assumes every row is a real sample (raw caches carry no
+    padding; padded summaries take the counts path)."""
     t = target.astype(jnp.int32)
-    return _group_end_count_cumsums(input, t, 1 - t)
+    neg, tp_c = jax.lax.sort((-input, t), num_keys=1)
+    s = -neg
+    ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
+    cfp = jnp.arange(1, s.shape[0] + 1, dtype=jnp.int32) - ctp
+    tp, fp, last = _propagate_group_ends(s, ctp, cfp)
+    return s, tp, fp, last
 
 
 def _group_end_count_cumsums(
@@ -69,42 +96,25 @@ def _group_end_count_cumsums(
     s = -neg
     ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
     cfp = jnp.cumsum(fp_c, dtype=jnp.int32)
-    if s.shape[0] == 0:
-        last = jnp.zeros((0,), bool)
-    else:
-        last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
-    big = jnp.iinfo(jnp.int32).max
-    tp = jax.lax.cummin(jnp.where(last, ctp, big), reverse=True)
-    fp = jax.lax.cummin(jnp.where(last, cfp, big), reverse=True)
+    tp, fp, last = _propagate_group_ends(s, ctp, cfp)
     return s, tp, fp, last
 
 
-@jax.jit
-def binary_auroc_counts_kernel(
-    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
-) -> jax.Array:
-    """Exact trapezoidal AUROC over (score, tp_count, fp_count) rows; 0.5
-    when targets are all-one or all-zero (reference degenerate guard,
-    ``auroc.py:60-66``)."""
-    _, tp, fp, _ = _group_end_count_cumsums(scores, tp_w, fp_w)
-    tp = jnp.concatenate([jnp.zeros(1, jnp.int32), tp]).astype(jnp.float32)
-    fp = jnp.concatenate([jnp.zeros(1, jnp.int32), fp]).astype(jnp.float32)
+def _auroc_from_group_ends(itp: jax.Array, ifp: jax.Array) -> jax.Array:
+    """Trapezoidal integration over group-end TP/FP counts; 0.5 when targets
+    are all-one or all-zero (reference degenerate guard, ``auroc.py:60-66``)."""
+    tp = jnp.concatenate([jnp.zeros(1, jnp.int32), itp]).astype(jnp.float32)
+    fp = jnp.concatenate([jnp.zeros(1, jnp.int32), ifp]).astype(jnp.float32)
     factor = tp[-1] * fp[-1]
     auc = jnp.trapezoid(tp, fp)
     return jnp.where(factor == 0, 0.5, auc / jnp.maximum(factor, 1.0))
 
 
-@jax.jit
-def binary_auprc_counts_kernel(
-    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
-) -> jax.Array:
-    """Average-precision (step) integration over (score, tp, fp) count rows:
+def _auprc_from_group_ends(itp: jax.Array, ifp: jax.Array) -> jax.Array:
+    """Average-precision (step) integration over group-end TP/FP counts:
     ``AP = sum(ΔTP_k * precision_k) / TP_total`` over descending thresholds.
     Matches sklearn's ``average_precision_score``; 0.0 when there are no
     positives (the recall axis is undefined)."""
-    if scores.shape[0] == 0:  # static shape — resolved at trace time
-        return jnp.asarray(0.0)
-    _, itp, ifp, _ = _group_end_count_cumsums(scores, tp_w, fp_w)
     tp = itp.astype(jnp.float32)
     fp = ifp.astype(jnp.float32)
     precision = tp / jnp.maximum(tp + fp, 1.0)
@@ -115,17 +125,40 @@ def binary_auprc_counts_kernel(
 
 
 @jax.jit
+def binary_auroc_counts_kernel(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> jax.Array:
+    """Exact trapezoidal AUROC over (score, tp_count, fp_count) rows."""
+    _, tp, fp, _ = _group_end_count_cumsums(scores, tp_w, fp_w)
+    return _auroc_from_group_ends(tp, fp)
+
+
+@jax.jit
+def binary_auprc_counts_kernel(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> jax.Array:
+    """Average precision over (score, tp, fp) count rows."""
+    if scores.shape[0] == 0:  # static shape — resolved at trace time
+        return jnp.asarray(0.0)
+    _, tp, fp, _ = _group_end_count_cumsums(scores, tp_w, fp_w)
+    return _auprc_from_group_ends(tp, fp)
+
+
+@jax.jit
 def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
-    """Exact trapezoidal AUROC on raw samples (unit counts)."""
-    t = target.astype(jnp.int32)
-    return binary_auroc_counts_kernel(input, t, 1 - t)
+    """Exact trapezoidal AUROC on raw samples — the reduced-sort-traffic
+    unit-count path (:func:`_group_end_cumsums`)."""
+    _, tp, fp, _ = _group_end_cumsums(input, target)
+    return _auroc_from_group_ends(tp, fp)
 
 
 @jax.jit
 def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
-    """Average precision on raw samples (unit counts)."""
-    t = target.astype(jnp.int32)
-    return binary_auprc_counts_kernel(input, t, 1 - t)
+    """Average precision on raw samples (unit-count sort path)."""
+    if input.shape[0] == 0:
+        return jnp.asarray(0.0)
+    _, tp, fp, _ = _group_end_cumsums(input, target)
+    return _auprc_from_group_ends(tp, fp)
 
 
 @jax.jit

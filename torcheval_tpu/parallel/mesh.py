@@ -46,13 +46,22 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
 
     global _warned_uneven_batch
     n_dev = mesh.devices.size
-    mesh_devices = set(mesh.devices.flat)
     converted = [as_jax(a) for a in arrays]
+    multiprocess = jax.process_count() > 1  # hoisted: hot path, one call
+    mesh_devices = set(mesh.devices.flat) if multiprocess else None
+    target_cache = {}  # ndim -> NamedSharding, avoids re-building per array
+
+    def _target(ndim: int) -> NamedSharding:
+        if ndim not in target_cache:
+            target_cache[ndim] = NamedSharding(
+                mesh, P("data", *([None] * (ndim - 1)))
+            )
+        return target_cache[ndim]
 
     def _already_placed(a) -> bool:
         if not isinstance(a, jax.Array):
             return False
-        if jax.process_count() > 1:
+        if multiprocess:
             # multi-process: any global array on this mesh is accepted as-is
             # (re-placing would need a cross-host transfer); layout is the
             # caller's choice via make_array_from_process_local_data
@@ -60,13 +69,12 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
         # single-controller: bypass ONLY when the array already has the
         # target data sharding — a replicated array must still be re-placed
         # to P("data") or every device would process the full batch
-        target = NamedSharding(mesh, P("data", *([None] * (a.ndim - 1))))
-        return a.sharding.is_equivalent_to(target, a.ndim)
+        return a.sharding.is_equivalent_to(_target(a.ndim), a.ndim)
 
     if all(_already_placed(a) for a in converted):
         out = tuple(converted)
         return out[0] if len(out) == 1 else out
-    if jax.process_count() > 1:
+    if multiprocess:
         raise ValueError(
             "shard_batch received host-local data in a multi-process world; "
             "device_put cannot scatter host values across hosts. Build the "
@@ -88,12 +96,9 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
     out = tuple(
         jax.device_put(
             a,
-            NamedSharding(
-                mesh,
-                P("data", *([None] * (a.ndim - 1)))
-                if a.shape[0] % n_dev == 0
-                else P(),
-            ),
+            _target(a.ndim)
+            if a.shape[0] % n_dev == 0
+            else NamedSharding(mesh, P()),
         )
         for a in converted
     )
