@@ -46,6 +46,27 @@ class TestClassCounts(unittest.TestCase):
             )
             np.testing.assert_array_equal(got, want, err_msg=f"n={n} c={c}")
 
+    def test_pallas_tile_plan_is_mosaic_legal(self):
+        # block second-to-last dim must be a multiple of 8 (f32 sublanes) and
+        # the (rows, 128, c_tile) one-hot must fit the VMEM budget; a C large
+        # enough to shrink the block exposed a non-divisible 207-row block on
+        # real TPU lowering, and an unshrunk one-hot OOM'd VMEM at C=10k
+        from torcheval_tpu.ops.pallas_hist import (
+            _VMEM_BUDGET_BYTES,
+            _round_up,
+            _tile_plan,
+        )
+
+        for c in (1, 100, 1000, 1290, 10_000, 65_536, 500_000):
+            c_pad = _round_up(c, 128)
+            rows, c_tile = _tile_plan(c_pad)
+            self.assertEqual(rows % 8, 0, f"c={c} -> rows={rows}")
+            self.assertGreaterEqual(rows, 8)
+            self.assertEqual(c_tile % 128, 0)
+            self.assertLessEqual(
+                rows * 128 * c_tile * 4, 2 * _VMEM_BUDGET_BYTES, f"c={c}"
+            )
+
     def test_pallas_rejects_weights(self):
         with self.assertRaisesRegex(ValueError, "unweighted"):
             class_counts(
@@ -65,6 +86,31 @@ class TestClassCounts(unittest.TestCase):
     def test_unknown_method_rejected(self):
         with self.assertRaisesRegex(ValueError, "method must be one of"):
             class_counts(jnp.asarray([0, 1]), 2, method="Sort")
+
+    def test_auto_picks_pallas_only_on_tpu_and_large(self):
+        from unittest import mock
+
+        from torcheval_tpu.ops import confusion
+
+        big_n = 16_777_215  # < 2**24, n*C over the Pallas threshold
+        # this suite runs on the CPU backend: auto must never route to the
+        # interpret-mode Pallas kernel
+        self.assertNotEqual(
+            confusion._pick_method(big_n, 1000, "auto", False), "pallas"
+        )
+        with mock.patch.object(
+            confusion.jax, "default_backend", return_value="tpu"
+        ):
+            self.assertEqual(
+                confusion._pick_method(big_n, 1000, "auto", False), "pallas"
+            )
+            # small workloads and weighted counts keep the XLA lowerings
+            self.assertEqual(
+                confusion._pick_method(1_000_000, 1000, "auto", False), "matmul"
+            )
+            self.assertEqual(
+                confusion._pick_method(big_n, 1000, "auto", True), "scatter"
+            )
 
     def test_weighted(self):
         labels = RNG.integers(0, 5, 100)

@@ -68,7 +68,21 @@ def check_state_type(name: str, value: Any) -> None:
     )
 
 
-def _put_leaf(value, device):
+def _put_leaf(value, device, *, strict_layout: bool = False):
+    """Place one array leaf on ``device``.
+
+    ``strict_layout`` distinguishes two callers on multi-process meshes,
+    where a global array in a *different* layout cannot be re-placed
+    (cross-host transfer):
+
+    * state placement (``put_state`` / ``Metric.to``), ``strict_layout=False``
+      — any global array on the same mesh passes through unchanged. Correct:
+      CAT caches are legitimately data-sharded and every compute kernel
+      consumes them in whatever layout they carry.
+    * layout-promising APIs (``parallel.replicate``), ``strict_layout=True``
+      — a mismatched layout raises rather than silently returning something
+      other than what the API name promises.
+    """
     import numpy as np
 
     value = jnp.asarray(value) if not hasattr(value, "dtype") else value
@@ -85,12 +99,10 @@ def _put_leaf(value, device):
             isinstance(value, jax.Array)
             and getattr(value.sharding, "device_set", None) == device.device_set
         ):
-            if value.sharding.is_equivalent_to(device, value.ndim):
-                return value  # already global in the requested layout
-            # same mesh, different layout: re-placement would need a
-            # cross-host transfer — fail loudly rather than hand back the
-            # wrong layout (e.g. a data-sharded array where replicated was
-            # requested)
+            if not strict_layout or value.sharding.is_equivalent_to(
+                device, value.ndim
+            ):
+                return value  # already global on this mesh
             raise ValueError(
                 f"cannot re-place a global array (sharding {value.sharding}) "
                 f"to {device} on a multi-process mesh: cross-host transfers "

@@ -15,10 +15,10 @@ so the TPU-first design offers three lowerings and picks by size:
 * ``scatter`` — ``zeros(C).at[labels].add(w)``; O(N) updates, no N×C
   intermediate. Never wins on TPU (serialised updates) but is the general
   weighted fallback when the one-hot is over budget.
-* ``pallas`` — opt-in hand kernel (``ops/pallas_hist.py``): VMEM-resident
-  accumulator over a sequential sample-block grid; unweighted only. Not in
-  the auto-pick until a clean measurement window shows it beating the matmul
-  (tunnel noise has so far allowed only parity-to-better readings).
+* ``pallas`` — hand kernel (``ops/pallas_hist.py``): VMEM-resident
+  accumulator tiles streamed over sample blocks; unweighted only. Auto-picked
+  on real TPU backends for N·C >= 2**33 (measured 1.84x vs matmul at
+  N=16.7M·C=1000, 1.42x vs sort at N=1M·C=10k); parity within noise below.
 
 Auto-pick thresholds are measured on a v5e chip (2026-07): matmul beats
 scatter 4.3× at (N=1M, C=1000) and stays ahead through N·C ≈ 2**30; the sort
@@ -52,6 +52,11 @@ _CONFUSION_MATMUL_ONEHOT_ELEMS = 1 << 29
 
 
 _METHODS = ("auto", "matmul", "scatter", "sort", "pallas")
+# Above this many virtual one-hot elements, the Pallas histogram kernel
+# (ops/pallas_hist.py) beats the XLA lowerings on real TPU: measured 1.84x
+# vs matmul at (N=16.7M, C=1000) = 1.7e10 and 1.42x vs sort at
+# (N=1M, C=10k) = 1e10; parity within tunnel noise below ~1e9.
+_PALLAS_ELEMENT_MIN = 1 << 33
 
 
 def _pick_method(n: int, num_classes: int, method: str, weighted: bool) -> str:
@@ -62,6 +67,13 @@ def _pick_method(n: int, num_classes: int, method: str, weighted: bool) -> str:
     # n < 2**24 keeps unweighted per-class counts (≤ n) exact in the float32
     # accumulator; weighted exactness is the caller's documented contract, so
     # the same bound is applied as a proxy for "sum of weights stays small"
+    if (
+        not weighted
+        and n < (1 << 24)
+        and n * num_classes >= _PALLAS_ELEMENT_MIN
+        and jax.default_backend() == "tpu"
+    ):
+        return "pallas"
     if n * num_classes <= _MATMUL_ELEMENT_BUDGET and n < (1 << 24):
         return "matmul"
     # sort path is unweighted-only; weighted over-budget falls to scatter

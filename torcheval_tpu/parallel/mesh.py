@@ -115,4 +115,4 @@ def replicate(mesh: Mesh, value):
     (``metrics/state.py::_put_leaf``)."""
     from torcheval_tpu.metrics.state import _put_leaf
 
-    return _put_leaf(value, NamedSharding(mesh, P()))
+    return _put_leaf(value, NamedSharding(mesh, P()), strict_layout=True)
