@@ -101,6 +101,74 @@ class TestAccuracyClasses(MetricClassTester):
             compute_result=expected,
         )
 
+    def test_binary_accuracy_nondefault_threshold(self):
+        thr = 0.7
+        pred = (BIN_SCORES.reshape(-1) >= thr).astype(np.int64)
+        self.run_class_implementation_tests(
+            metric=BinaryAccuracy(threshold=thr),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={
+                "input": jnp.asarray(BIN_SCORES),
+                "target": jnp.asarray(BIN_TARGET),
+            },
+            compute_result=accuracy_score(FLAT_BIN_TARGET, pred),
+        )
+
+    def test_multilabel_accuracy_criteria_matrix(self):
+        target = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
+        scores = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 4)).astype(np.float32)
+        pred = (scores.reshape(-1, 4) >= 0.5).astype(np.int64)
+        tgt = target.reshape(-1, 4)
+        # oracle formulas matching the reference's 5 criteria
+        # (functional/classification/accuracy.py:399-432)
+        expectations = {
+            "hamming": (pred == tgt).mean(),
+            "overlap": (
+                ((pred == tgt) & (pred == 1)).max(axis=1)
+                | ((pred == 0) & (tgt == 0)).all(axis=1)
+            ).mean(),
+            "contain": ((pred - tgt) >= 0).all(axis=1).mean(),
+            "belong": ((pred - tgt) <= 0).all(axis=1).mean(),
+        }
+        for criteria, expected in expectations.items():
+            self.run_class_implementation_tests(
+                metric=MultilabelAccuracy(criteria=criteria),
+                state_names={"num_correct", "num_total"},
+                update_kwargs={
+                    "input": jnp.asarray(scores),
+                    "target": jnp.asarray(target),
+                },
+                compute_result=expected,
+            )
+
+    def test_topk_multilabel_criteria_matrix(self):
+        k = 2
+        target = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, C))
+        flat = SCORES.reshape(-1, C)
+        idx = np.argsort(-flat, axis=1, kind="stable")[:, :k]
+        pred = np.zeros_like(target.reshape(-1, C))
+        np.put_along_axis(pred, idx, 1, axis=1)
+        tgt = target.reshape(-1, C)
+        expectations = {
+            "hamming": (pred == tgt).mean(),
+            "overlap": (
+                ((pred == tgt) & (pred == 1)).max(axis=1)
+                | ((pred == 0) & (tgt == 0)).all(axis=1)
+            ).mean(),
+            "contain": ((pred - tgt) >= 0).all(axis=1).mean(),
+            "belong": ((pred - tgt) <= 0).all(axis=1).mean(),
+        }
+        for criteria, expected in expectations.items():
+            self.run_class_implementation_tests(
+                metric=TopKMultilabelAccuracy(k=k, criteria=criteria),
+                state_names={"num_correct", "num_total"},
+                update_kwargs={
+                    "input": jnp.asarray(SCORES),
+                    "target": jnp.asarray(target),
+                },
+                compute_result=expected,
+            )
+
 
 class TestF1Classes(MetricClassTester):
     def test_multiclass_f1_weighted(self):

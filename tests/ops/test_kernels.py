@@ -101,16 +101,25 @@ class TestClassCounts(unittest.TestCase):
         with mock.patch.object(
             confusion.jax, "default_backend", return_value="tpu"
         ):
-            self.assertEqual(
+            # pallas_call has no GSPMD partitioning rule: this 8-device
+            # world must NOT route auto to pallas even on a "tpu" backend
+            self.assertNotEqual(
                 confusion._pick_method(big_n, 1000, "auto", False), "pallas"
             )
-            # small workloads and weighted counts keep the XLA lowerings
-            self.assertEqual(
-                confusion._pick_method(1_000_000, 1000, "auto", False), "matmul"
-            )
-            self.assertEqual(
-                confusion._pick_method(big_n, 1000, "auto", True), "scatter"
-            )
+            with mock.patch.object(
+                confusion.jax, "devices", return_value=[object()]
+            ):
+                self.assertEqual(
+                    confusion._pick_method(big_n, 1000, "auto", False), "pallas"
+                )
+                # small workloads and weighted counts keep the XLA lowerings
+                self.assertEqual(
+                    confusion._pick_method(1_000_000, 1000, "auto", False),
+                    "matmul",
+                )
+                self.assertEqual(
+                    confusion._pick_method(big_n, 1000, "auto", True), "scatter"
+                )
 
     def test_weighted(self):
         labels = RNG.integers(0, 5, 100)

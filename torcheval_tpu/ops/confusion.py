@@ -3,7 +3,7 @@
 The reference's hot kernel is a 1-D ``scatter_(0, labels, w, reduce="add")``
 (``/root/reference/torcheval/metrics/functional/classification/f1_score.py:182-190``,
 ``accuracy.py:271-273``). XLA:TPU lowers scatter poorly (serialised updates),
-so the TPU-first design offers three lowerings and picks by size:
+so the TPU-first design offers four lowerings and picks by size:
 
 * ``matmul`` — weights-vector × one-hot matrix product. The one-hot is
   ``labels[:, None] == iota`` fused by XLA into the dot; the contraction rides
@@ -72,7 +72,14 @@ def _pick_method(n: int, num_classes: int, method: str, weighted: bool) -> str:
         and n < (1 << 24)
         and n * num_classes >= _PALLAS_ELEMENT_MIN
         and jax.default_backend() == "tpu"
+        and len(jax.devices()) == 1
     ):
+        # single-device worlds only: pallas_call has no GSPMD partitioning
+        # rule, so on a mesh it would force replicating a sharded operand —
+        # multi-chip sticks with the partitionable XLA lowerings (the
+        # ShardedEvaluator psum design). The lowering itself is further
+        # platform-dispatched in class_counts so a CPU-committed array on a
+        # TPU host takes the sort path instead of a Mosaic kernel.
         return "pallas"
     if n * num_classes <= _MATMUL_ELEMENT_BUDGET and n < (1 << 24):
         return "matmul"
@@ -102,6 +109,13 @@ def class_counts(
     else:
         w = weights if dtype is None else weights.astype(dtype)
     resolved = _pick_method(n, num_classes, method, weighted=weights is not None)
+
+    def _sort_counts(ls: jax.Array) -> jax.Array:
+        s = jnp.sort(ls.astype(jnp.int32))
+        edges = jnp.arange(num_classes + 1, dtype=jnp.int32)
+        starts = jnp.searchsorted(s, edges, side="left")
+        return (starts[1:] - starts[:-1]).astype(w.dtype)
+
     if resolved == "matmul":
         # (N, C) virtual one-hot contracted against (N,) weights on the MXU.
         onehot = (labels[:, None] == jnp.arange(num_classes)[None, :]).astype(
@@ -116,6 +130,17 @@ def class_counts(
             raise ValueError("method='pallas' supports only unweighted counts.")
         from torcheval_tpu.ops.pallas_hist import pallas_class_counts
 
+        if method == "auto":
+            # dispatch per LOWERING platform, not per process default: a
+            # CPU-committed array on a TPU host must take an XLA lowering,
+            # not a Mosaic kernel it cannot compile
+            return jax.lax.platform_dependent(
+                labels,
+                tpu=lambda ls: pallas_class_counts(
+                    ls, num_classes, interpret=False
+                ).astype(w.dtype),
+                default=_sort_counts,
+            )
         interpret = jax.default_backend() != "tpu"
         return pallas_class_counts(
             labels, num_classes, interpret=interpret
@@ -125,10 +150,7 @@ def class_counts(
             raise ValueError("method='sort' supports only unweighted counts.")
         # run lengths of each class in the sorted labels; out-of-range labels
         # sort to the ends, outside every [edge_c, edge_c+1) span
-        s = jnp.sort(labels.astype(jnp.int32))
-        edges = jnp.arange(num_classes + 1, dtype=jnp.int32)
-        starts = jnp.searchsorted(s, edges, side="left")
-        return (starts[1:] - starts[:-1]).astype(w.dtype)
+        return _sort_counts(labels)
     # scatter path: drop out-of-range labels. mode="drop" only catches
     # indices past the end — negative indices would WRAP (numpy semantics)
     # and silently count against the last classes, diverging from the matmul
