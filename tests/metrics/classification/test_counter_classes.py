@@ -114,32 +114,8 @@ class TestAccuracyClasses(MetricClassTester):
             compute_result=accuracy_score(FLAT_BIN_TARGET, pred),
         )
 
-    def test_multilabel_accuracy_criteria_matrix(self):
-        target = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
-        scores = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 4)).astype(np.float32)
-        pred = (scores.reshape(-1, 4) >= 0.5).astype(np.int64)
-        tgt = target.reshape(-1, 4)
-        # oracle formulas matching the reference's 5 criteria
-        # (functional/classification/accuracy.py:399-432)
-        expectations = {
-            "hamming": (pred == tgt).mean(),
-            "overlap": (
-                ((pred == tgt) & (pred == 1)).max(axis=1)
-                | ((pred == 0) & (tgt == 0)).all(axis=1)
-            ).mean(),
-            "contain": ((pred - tgt) >= 0).all(axis=1).mean(),
-            "belong": ((pred - tgt) <= 0).all(axis=1).mean(),
-        }
-        for criteria, expected in expectations.items():
-            self.run_class_implementation_tests(
-                metric=MultilabelAccuracy(criteria=criteria),
-                state_names={"num_correct", "num_total"},
-                update_kwargs={
-                    "input": jnp.asarray(scores),
-                    "target": jnp.asarray(target),
-                },
-                compute_result=expected,
-            )
+    # (MultilabelAccuracy's criteria matrix lives in TestMultilabelSpecMatrix
+    # below — including the overlap empty-sets-match clause)
 
     def test_topk_multilabel_criteria_matrix(self):
         k = 2
@@ -409,6 +385,11 @@ class TestPrecisionRecallSpecMatrix(MetricClassTester):
 class TestMultilabelSpecMatrix(MetricClassTester):
     ML_SCORES = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 4)).astype(np.float32)
     ML_TARGET = RNG.integers(0, 2, size=(NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
+    # plant a guaranteed all-zero (pred AND target) row: overlap's
+    # empty-sets-match clause must actually be exercised, not left to the
+    # ~0.4%/row chance of the random draw
+    ML_SCORES[0, 0] = 0.0
+    ML_TARGET[0, 0] = 0
 
     def _expected(self, criteria):
         pred = (self.ML_SCORES.reshape(-1, 4) >= 0.5).astype(np.int64)
@@ -419,7 +400,10 @@ class TestMultilabelSpecMatrix(MetricClassTester):
         if criteria == "hamming":
             return float((pred == tg).mean())
         if criteria == "overlap":
-            return float((inter > 0).mean())
+            # empty prediction AND empty target is a match (reference
+            # accuracy.py overlap semantics)
+            both_empty = (pred.sum(1) == 0) & (tg.sum(1) == 0)
+            return float(((inter > 0) | both_empty).mean())
         if criteria == "contain":
             return float((inter == tg.sum(1)).mean())
         if criteria == "belong":
