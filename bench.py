@@ -179,14 +179,22 @@ def headline_10m():
     _emit("preds_per_sec_per_chip_acc_plus_auroc_10M", total, tpu_s, _ref_time(ref))
 
 
-def headline_scaled(total, label):
+def headline_scaled(total, label, thresh_mult):
     """100M / 1B rows: compaction keeps AUROC state bounded and exact."""
     jax = _jax()
     from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
 
     scores, labels, logits, binary = _headline_data(jax, BIG_CHUNK)
     n_chunks = total // BIG_CHUNK
-    thresh = 2 * BIG_CHUNK
+    # per-leg threshold so the compaction path ACTUALLY FIRES on every leg
+    # this function claims to measure. Swept on-chip (2026-07-30), identical
+    # exact values at every setting: 1B leg (59 chunks) — 2x 33.8M, 6x 53.2M,
+    # 8x 36.7M preds/s -> 6x (compacts ~every 6 chunks; worst-case state ~7
+    # chunk-rows of (score, tp, fp) columns ≈ 1.4 GB). 100M leg (5 chunks) —
+    # 3x 68M with compaction firing; 6x would never compact and silently
+    # measure the raw full-cache path instead.
+    assert thresh_mult < n_chunks, "compaction must fire within the leg"
+    thresh = thresh_mult * BIG_CHUNK
 
     def run(n):
         acc = MulticlassAccuracy(num_classes=NUM_CLASSES)
@@ -196,7 +204,9 @@ def headline_scaled(total, label):
             auroc.update(logits, binary)
         return _block(acc.compute(), auroc.compute())
 
-    run(5)  # warmup: covers first-compact and steady-state shapes + compute
+    # warmup past the first compaction so _compact_parts and the
+    # post-compaction compute shapes compile outside the timed region
+    run(thresh_mult + 2)
     tpu_s = _time(lambda: run(n_chunks), repeats=3)
     _emit(f"preds_per_sec_per_chip_acc_plus_auroc_{label}", n_chunks * BIG_CHUNK, tpu_s, None)
 
@@ -473,8 +483,8 @@ def main() -> None:
     # headline (north star) FIRST: round 1's driver record parsed the first
     # JSON line as the round's number — keep that contract
     headline_10m()
-    headline_scaled(100_000_000, "100M")
-    headline_scaled(1_000_000_000, "1B")
+    headline_scaled(100_000_000, "100M", thresh_mult=3)
+    headline_scaled(1_000_000_000, "1B", thresh_mult=6)
     config1_simple_accuracy()
     config2_auroc_auprc()
     config3_confusion_f1_imagenet()
