@@ -89,6 +89,37 @@ def _time(fn, repeats=3):
     return med - rtt
 
 
+def _time_chain(fn, n=5):
+    """Amortised timing for dispatch-light legs: queue ``n`` independent runs
+    (``fn`` returns device values WITHOUT reading back), then pay ONE
+    host-readback barrier and divide. The tunnel's ~0.1 s round trip — whose
+    run-to-run variance dwarfs a 10-40 ms signal — is paid once for n runs
+    instead of once per run, cutting its noise contribution by n. The final
+    ``device_get`` guarantees every queued run actually finished
+    (``block_until_ready`` alone is not trustworthy here; see ``_time``)."""
+    import jax
+
+    t0 = time.perf_counter()
+    outs = [fn() for _ in range(n)]
+    jax.block_until_ready(outs)
+    jax.device_get(outs)
+    elapsed = time.perf_counter() - t0
+    rtts = []
+    import jax.numpy as jnp
+
+    for i in range(3):
+        fresh = jnp.float32(i) + 2.0
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        jax.device_get(fresh)
+        rtts.append(time.perf_counter() - t0)
+    rtts.sort()
+    corrected = elapsed - rtts[1]
+    if corrected <= 0:
+        corrected = elapsed  # burst caught by the probe: stay conservative
+    return corrected / n
+
+
 def _block(*values):
     """End-of-run barrier: host readback of the results (leaf arrays are
     small — scalars and curves). See ``_time`` for why ``block_until_ready``
@@ -225,10 +256,13 @@ def config1_simple_accuracy():
     jax.block_until_ready((js, jl))
 
     def tpu():
+        # returns the device scalar WITHOUT reading back: _time_chain queues
+        # several runs and pays one barrier (the per-run readback otherwise
+        # costs a full tunnel RTT whose variance swamps this leg's signal)
         m = MulticlassAccuracy(num_classes=5)
         for _ in range(n_batches):
             m.update(js, jl)
-        return _block(m.compute())
+        return m.compute()
 
     def ref():
         sys.path.insert(0, "/root/reference")
@@ -241,13 +275,18 @@ def config1_simple_accuracy():
             m.update(ts, tl)
         return float(m.compute())
 
-    tpu()
+    _block(tpu())
     ref_s = _ref_time(ref)
-    _emit("config1_multiclass_accuracy_c5", n_batches * batch, _time(tpu), ref_s)
+    _emit(
+        "config1_multiclass_accuracy_c5", n_batches * batch, _time_chain(tpu), ref_s
+    )
 
-    # fused path: the whole update is ONE jitted donated-state dispatch.
-    # The collection is long-lived (its jitted step is per-instance), exactly
-    # as in a real eval loop; reset between runs, don't reconstruct.
+    # collection path. Since round 3 counter metrics DEFER: update() is an
+    # O(1) host append and the counting kernel folds the concatenated
+    # pending batches in bulk — the row name keeps the r01/r02 "_fused"
+    # label for round-over-round comparability, but the mechanism measured
+    # here is the deferred-fold lane (metrics/deferred.py), which replaced
+    # per-batch fusion for these metrics.
     from torcheval_tpu.metrics import MetricCollection
 
     col = MetricCollection(MulticlassAccuracy(num_classes=5))
@@ -256,13 +295,13 @@ def config1_simple_accuracy():
         col.reset()
         for _ in range(n_batches):
             col.update(js, jl)
-        return _block(col.compute())
+        return col.compute()
 
-    tpu_fused()
+    _block(tpu_fused())
     _emit(
         "config1_multiclass_accuracy_c5_fused",
         n_batches * batch,
-        _time(tpu_fused),
+        _time_chain(tpu_fused),
         ref_s,
     )
 
@@ -284,12 +323,20 @@ def config2_auroc_auprc():
         sys.path.insert(0, "/root/reference")
         import torch
         from torcheval.metrics.functional import binary_auroc as ref_auroc
+        from torcheval.metrics.functional import (
+            binary_precision_recall_curve as ref_prc,
+        )
 
         tx = torch.from_numpy(np.asarray(x))
         tt = torch.from_numpy(np.asarray(t))
-        # the reference snapshot has no binary_auprc; time AUROC twice to
-        # keep the work comparable
-        return float(ref_auroc(tx, tt)), float(ref_auroc(tx, tt))
+        # the reference snapshot has no binary_auprc metric; build average
+        # precision from ITS OWN PRC kernel (precision_recall_curve.py:155-181)
+        # + the standard step-sum, so the ratio compares real AP work on both
+        # sides (round-2 verdict Weak #5)
+        auroc = float(ref_auroc(tx, tt))
+        p, r, _ = ref_prc(tx, tt)
+        ap = float(torch.sum((r[:-1] - r[1:]) * p[:-1]))
+        return auroc, ap
 
     tpu()
     _emit("config2_auroc_auprc_10M", 2 * n, _time(tpu), _ref_time(ref))
@@ -316,25 +363,35 @@ def config3_confusion_f1_imagenet():
         # sum the 1000x1000 matrix on device: forces the full compute while
         # keeping the readback barrier payload scalar (the tunnel moves
         # ~8.5 MB/s — pulling 4 MB would time transport, not the metric)
-        return _block(jnp.sum(cm.compute()), f1.compute())
+        return jnp.sum(cm.compute()), f1.compute()
 
     def ref():
         sys.path.insert(0, "/root/reference")
         import torch
         from torcheval.metrics import MulticlassF1Score as RefF1
 
-        # reference snapshot has no confusion-matrix metric; F1 only
         tp = torch.from_numpy(np.asarray(pred))
         tl = torch.from_numpy(np.asarray(label))
+        # the reference snapshot has no confusion-matrix metric; stream the
+        # same counting work in its own idiom (a per-batch torch scatter-add
+        # state update — the reference's hot-kernel pattern,
+        # f1_score.py:182-190) so both sides do CM + F1 (round-2 verdict
+        # named this leg's one-sided work as the gap to close honestly)
+        cm_state = torch.zeros(c * c, dtype=torch.int64)
         f1 = RefF1(num_classes=c, average="macro")
         for _ in range(n_batches):
+            cm_state += torch.bincount(tl * c + tp, minlength=c * c)
             f1.update(tp, tl)
-        return float(f1.compute())
+        return float(cm_state.sum()), float(f1.compute())
 
-    tpu()
+    _block(tpu())
     ref_s = _ref_time(ref)
-    _emit("config3_confusion_f1_c1000", n_batches * batch, _time(tpu), ref_s)
+    _emit(
+        "config3_confusion_f1_c1000", n_batches * batch, _time_chain(tpu), ref_s
+    )
 
+    # collection path — like config 1, this now measures the deferred-fold
+    # lane (appends + one bulk fold) under the legacy "_fused" row name
     from torcheval_tpu.metrics import MetricCollection
 
     col = MetricCollection(
@@ -349,11 +406,14 @@ def config3_confusion_f1_imagenet():
         for _ in range(n_batches):
             col.update(pred, label)
         r = col.compute()
-        return _block(jnp.sum(r["cm"]), r["f1"])  # scalar barrier, as above
+        return jnp.sum(r["cm"]), r["f1"]  # scalar barrier payload, as above
 
-    tpu_fused()
+    _block(tpu_fused())
     _emit(
-        "config3_confusion_f1_c1000_fused", n_batches * batch, _time(tpu_fused), ref_s
+        "config3_confusion_f1_c1000_fused",
+        n_batches * batch,
+        _time_chain(tpu_fused),
+        ref_s,
     )
 
 

@@ -1,4 +1,16 @@
-"""MetricCollection: one fused jitted dispatch must equal the eager paths."""
+"""MetricCollection routing and equivalence.
+
+Three lanes now exist (metrics/collection.py + metrics/deferred.py):
+
+* deferred counter metrics — O(1) appends, bulk fold at read time; the
+  collection must NOT re-fuse them (that would drag them back to
+  one-dispatch-per-batch);
+* fusable array-state metrics (regression/aggregation) — traced into one
+  jitted donated-state dispatch;
+* cache metrics (AUROC etc.) — eager appends.
+
+All lanes must agree with the standalone eager metrics bit-for-bit.
+"""
 
 import unittest
 
@@ -10,17 +22,21 @@ from sklearn.metrics import roc_auc_score
 from torcheval_tpu.metrics import (
     BinaryAccuracy,
     BinaryAUROC,
+    Mean,
+    MeanSquaredError,
     MetricCollection,
     MulticlassAccuracy,
     MulticlassConfusionMatrix,
     MulticlassF1Score,
+    Sum,
 )
 
 RNG = np.random.default_rng(0)
 
 
 class TestMetricCollection(unittest.TestCase):
-    def test_fused_matches_eager(self):
+    def test_deferred_counters_match_eager(self):
+        # counter metrics defer: collection routes them to the append path
         col = MetricCollection(
             {
                 "acc": MulticlassAccuracy(num_classes=7),
@@ -33,7 +49,8 @@ class TestMetricCollection(unittest.TestCase):
             "f1": MulticlassF1Score(num_classes=7, average="macro"),
             "cm": MulticlassConfusionMatrix(7),
         }
-        self.assertEqual(set(col._fused), {"acc", "f1", "cm"})
+        self.assertEqual(col._fused, [])
+        self.assertEqual(set(col._eager), {"acc", "f1", "cm"})
         for _ in range(4):
             x = RNG.random((64, 7)).astype(np.float32)
             t = RNG.integers(0, 7, 64)
@@ -46,14 +63,29 @@ class TestMetricCollection(unittest.TestCase):
                 np.asarray(out[name]), np.asarray(m.compute()), rtol=1e-6
             )
 
-    def test_mixed_fused_and_cache_metric(self):
-        # BinaryAccuracy (array state, fuses) + BinaryAUROC (cache, eager)
+    def test_fused_array_state_metrics(self):
+        # regression/aggregation metrics still take the fused one-dispatch
+        # lane; results must match the standalone metrics
+        col = MetricCollection({"sum": Sum(), "mean": Mean()})
+        self.assertEqual(set(col._fused), {"sum", "mean"})
+        ref_sum, ref_mean = Sum(), Mean()
+        for _ in range(4):
+            x = RNG.random(128).astype(np.float32)
+            col.update(x)
+            ref_sum.update(x)
+            ref_mean.update(x)
+        out = col.compute()
+        self.assertAlmostEqual(float(out["sum"]), float(ref_sum.compute()), places=4)
+        self.assertAlmostEqual(float(out["mean"]), float(ref_mean.compute()), places=5)
+
+    def test_mixed_deferred_and_cache_metric(self):
+        # BinaryAccuracy (deferred counters) + BinaryAUROC (cache, eager)
         # share the same (input, target) update signature
         col = MetricCollection(
             {"bacc": BinaryAccuracy(), "auroc": BinaryAUROC()}
         )
-        self.assertEqual(col._fused, ["bacc"])
-        self.assertEqual(col._eager, ["auroc"])
+        self.assertEqual(col._fused, [])
+        self.assertEqual(set(col._eager), {"bacc", "auroc"})
         xs, ts = [], []
         for _ in range(3):
             x = RNG.random(128).astype(np.float32)
@@ -79,14 +111,15 @@ class TestMetricCollection(unittest.TestCase):
         col.reset()
         self.assertEqual(float(col["metric"].num_total), 0.0)
 
-    def test_repeated_updates_after_donation(self):
-        # donated buffers must be transparently replaced between calls
+    def test_repeated_updates_then_read(self):
         col = MetricCollection(MulticlassAccuracy(num_classes=4))
         x = RNG.random((32, 4)).astype(np.float32)
         t = RNG.integers(0, 4, 32)
         for _ in range(5):
             col.update(x, t)
-        self.assertEqual(float(col["metric"].num_total), 160.0)
+        # state_dicts folds pending deferred batches before snapshotting
+        sd = col.state_dicts()["metric"]
+        self.assertEqual(float(sd["num_total"]), 160.0)
 
     def test_empty_collection_rejected(self):
         with self.assertRaisesRegex(ValueError, "at least one"):
@@ -98,28 +131,46 @@ class TestMetricCollection(unittest.TestCase):
         sd = col.state_dicts()["metric"]
         self.assertEqual(float(sd["num_total"]), 3.0)
 
-    def test_state_dict_snapshot_survives_donation(self):
-        # a state_dict taken between updates must be a real buffer copy: the
-        # next fused update donates the live buffers it was taken from
+    def test_state_dict_snapshot_is_a_copy(self):
+        # a state_dict taken between updates must be a real buffer copy,
+        # unaffected by later folds (and, for fused metrics, donation)
         col = MetricCollection(MulticlassAccuracy(num_classes=3))
         col.update(jnp.eye(3), jnp.arange(3))
         sd = col.state_dicts()["metric"]
-        col.update(jnp.eye(3), jnp.arange(3))  # donates previous live state
+        col.update(jnp.eye(3), jnp.arange(3))
         self.assertEqual(float(sd["num_total"]), 3.0)  # snapshot intact
-        # and reset after donation re-creates usable state
+        self.assertEqual(float(col.state_dicts()["metric"]["num_total"]), 6.0)
+        # and reset re-creates usable state
         col.reset()
         col.update(jnp.eye(3), jnp.arange(3))
-        self.assertEqual(float(col["metric"].num_total), 3.0)
+        self.assertEqual(float(col.state_dicts()["metric"]["num_total"]), 3.0)
 
-
+    def test_fused_state_dict_snapshot_survives_donation(self):
+        # fused lane: the next fused update donates the live buffers the
+        # snapshot was taken from; the snapshot must be a real copy
+        col = MetricCollection(Sum())
+        col.update(jnp.arange(3.0))
+        sd = col.state_dicts()["metric"]
+        col.update(jnp.arange(3.0))  # donates previous live state
+        self.assertEqual(float(sd["weighted_sum"]), 3.0)
+        col.reset()
+        col.update(jnp.arange(3.0))
+        self.assertEqual(float(col.compute()), 3.0)
 
 
 class TestCollectionTorchBridge(unittest.TestCase):
-    def test_torch_tensors_through_fused_path(self):
+    def test_torch_tensors_through_collection(self):
         import torch
 
         col = MetricCollection(MulticlassAccuracy(num_classes=3))
         col.update(torch.eye(3), torch.arange(3))
+        self.assertEqual(float(col.compute()), 1.0)
+
+    def test_torch_tensors_through_fused_path(self):
+        import torch
+
+        col = MetricCollection(MeanSquaredError())
+        col.update(torch.zeros(4), torch.ones(4))
         self.assertEqual(float(col.compute()), 1.0)
 
     def test_non_donated_step_on_tunneled_backend(self):
@@ -133,29 +184,27 @@ class TestCollectionTorchBridge(unittest.TestCase):
         with mock.patch(
             "torcheval_tpu.utils.platform.donation_pipelines", return_value=False
         ):
-            col = collection_mod.MetricCollection(
-                MulticlassAccuracy(num_classes=4)
-            )
+            col = collection_mod.MetricCollection(Mean())
             rng = np.random.default_rng(7)
-            scores = rng.random((32, 4)).astype(np.float32)
-            labels = rng.integers(0, 4, 32)
-            for _ in range(3):
-                col.update(jnp.asarray(scores), jnp.asarray(labels))
-            want = float(
-                np.mean(scores.argmax(1) == labels)
+            xs = rng.random((3, 32)).astype(np.float32)
+            for row in xs:
+                col.update(jnp.asarray(row))
+            self.assertAlmostEqual(
+                float(col.compute()), float(xs.mean()), places=6
             )
-            self.assertAlmostEqual(float(col.compute()), want, places=6)
 
-    def test_clone_survives_donation(self):
-        # clone_metric between fused updates must own its buffers
+    def test_clone_survives_later_folds(self):
+        # clone_metric between updates must own its buffers (deferred lane
+        # folds on deepcopy; fused lane donates on the next update)
         from torcheval_tpu.metrics.toolkit import clone_metric
 
         m = MulticlassAccuracy(num_classes=3)
         col = MetricCollection(m)
         col.update(jnp.eye(3), jnp.arange(3))
         snap = clone_metric(m)
-        col.update(jnp.eye(3), jnp.arange(3))  # donates m's previous buffers
+        col.update(jnp.eye(3), jnp.arange(3))
         self.assertEqual(float(snap.num_total), 3.0)
+
 
 if __name__ == "__main__":
     unittest.main()

@@ -14,7 +14,7 @@ from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 from torcheval_tpu.utils.numerics import safe_div
-from torcheval_tpu.utils.tracing import is_concrete
+from torcheval_tpu.utils.tracing import async_value_warn
 
 _logger = logging.getLogger(__name__)
 
@@ -47,11 +47,17 @@ class Mean(Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        # trace-safe: the no-update warning reads the value back to the host,
-        # so it only fires on concrete state; the returned expression itself is
-        # branch-free and jit-embeddable (no-update => 0.0 either way)
-        if is_concrete(self.weights) and float(self.weights) == 0.0:
-            _logger.warning("No calls to update() have been made - returning 0.0")
+        # trace-safe + async: the no-update warning reads the value back on a
+        # daemon thread (utils/tracing.py) so compute never blocks on the
+        # device stream; the returned expression itself is branch-free and
+        # jit-embeddable (no-update => 0.0 either way)
+        def _check(w) -> None:
+            if w == 0.0:
+                _logger.warning(
+                    "No calls to update() have been made - returning 0.0"
+                )
+
+        async_value_warn(_check, self.weights)
         return safe_div(self.weighted_sum, self.weights)
 
     def merge_state(self, metrics: Iterable["Mean"]) -> "Mean":

@@ -17,7 +17,7 @@ from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 from torcheval_tpu.utils.numerics import safe_div
-from torcheval_tpu.utils.tracing import is_concrete
+from torcheval_tpu.utils.tracing import async_value_warn
 
 _logger = logging.getLogger(__name__)
 
@@ -49,9 +49,14 @@ class Throughput(Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        # trace-safe warning + branch-free result, as in Mean.compute
-        if is_concrete(self.elapsed_time_sec) and float(self.elapsed_time_sec) == 0.0:
-            _logger.warning("No calls to update() have been made - returning 0.0")
+        # trace-safe + async warning, branch-free result, as in Mean.compute
+        def _check(t) -> None:
+            if t == 0.0:
+                _logger.warning(
+                    "No calls to update() have been made - returning 0.0"
+                )
+
+        async_value_warn(_check, self.elapsed_time_sec)
         return safe_div(self.num_total, self.elapsed_time_sec)
 
     def merge_state(self, metrics: Iterable["Throughput"]) -> "Throughput":

@@ -3,6 +3,12 @@
 Reference: ``torcheval/metrics/classification/accuracy.py`` — thin streaming
 accumulators over the pure kernels in
 ``torcheval_tpu.metrics.functional.classification.accuracy``.
+
+Updates are **deferred** (``metrics/deferred.py``): each ``update()`` is an
+O(1) host append, and the counting kernel runs over the concatenated pending
+batches in one fused dispatch at read time or on a memory budget — the TPU
+replacement for the reference's per-batch eager scatter
+(``accuracy.py:271-273``).
 """
 
 from __future__ import annotations
@@ -24,6 +30,7 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
     _topk_multilabel_accuracy_param_check,
     _topk_multilabel_accuracy_update,
 )
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
@@ -31,12 +38,43 @@ from torcheval_tpu.utils.devices import DeviceLike
 TAccuracy = TypeVar("TAccuracy", bound="MulticlassAccuracy")
 
 
-class MulticlassAccuracy(Metric[jax.Array]):
+# module-level fold functions: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py)
+def _acc_fold(input, target, average, num_classes, k):
+    num_correct, num_total = _multiclass_accuracy_update(
+        input, target, average, num_classes, k
+    )
+    return {"num_correct": num_correct, "num_total": num_total}
+
+
+def _binacc_fold(input, target, threshold):
+    num_correct, num_total = _binary_accuracy_update(input, target, threshold)
+    return {"num_correct": num_correct, "num_total": num_total}
+
+
+def _mlacc_fold(input, target, threshold, criteria):
+    num_correct, num_total = _multilabel_accuracy_update(
+        input, target, threshold, criteria
+    )
+    return {"num_correct": num_correct, "num_total": num_total}
+
+
+def _topk_fold(input, target, criteria, k):
+    num_correct, num_total = _topk_multilabel_accuracy_update(
+        input, target, criteria, k
+    )
+    return {"num_correct": num_correct, "num_total": num_total}
+
+
+class MulticlassAccuracy(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming multiclass accuracy.
 
     Reference parity: ``classification/accuracy.py:32-144``. State is a
     scalar pair (micro) or per-class ``(num_classes,)`` int32 counters.
     """
+
+    _fold_fn = staticmethod(_acc_fold)
+
 
     def __init__(
         self,
@@ -58,21 +96,24 @@ class MulticlassAccuracy(Metric[jax.Array]):
         self._add_state(
             "num_total", jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
         )
+        self._init_deferred()
+        self._fold_params = (self.average, self.num_classes, self.k)
 
     def update(self, input, target) -> "MulticlassAccuracy":
         input, target = self._input(input), self._input(target)
         _accuracy_update_input_check(input, target, self.num_classes, self.k)
-        num_correct, num_total = _multiclass_accuracy_update(
-            input, target, self.average, self.num_classes, self.k
-        )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return _accuracy_compute(self.num_correct, self.num_total, self.average)
 
     def merge_state(self, metrics: Iterable["MulticlassAccuracy"]) -> "MulticlassAccuracy":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.num_correct = self.num_correct + jax.device_put(
                 metric.num_correct, self.device
@@ -89,11 +130,14 @@ class BinaryAccuracy(MulticlassAccuracy):
     Reference parity: ``classification/accuracy.py:147-204``.
     """
 
+    _fold_fn = staticmethod(_binacc_fold)
+
     def __init__(
         self, *, threshold: float = 0.5, device: DeviceLike = None
     ) -> None:
         super().__init__(device=device)
         self.threshold = threshold
+        self._fold_params = (threshold,)
 
     def update(self, input, target) -> "BinaryAccuracy":
         input, target = self._input(input), self._input(target)
@@ -102,9 +146,7 @@ class BinaryAccuracy(MulticlassAccuracy):
             raise ValueError(
                 f"target should be a one-dimensional tensor, got shape {target.shape}."
             )
-        num_correct, num_total = _binary_accuracy_update(input, target, self.threshold)
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
+        self._defer(input, target)
         return self
 
 
@@ -113,6 +155,9 @@ class MultilabelAccuracy(MulticlassAccuracy):
 
     Reference parity: ``classification/accuracy.py:207-302``.
     """
+
+    _fold_fn = staticmethod(_mlacc_fold)
+
 
     def __init__(
         self,
@@ -125,14 +170,12 @@ class MultilabelAccuracy(MulticlassAccuracy):
         super().__init__(device=device)
         self.threshold = threshold
         self.criteria = criteria
+        self._fold_params = (threshold, criteria)
 
     def update(self, input, target) -> "MultilabelAccuracy":
         input, target = self._input(input), self._input(target)
-        num_correct, num_total = _multilabel_accuracy_update(
-            input, target, self.threshold, self.criteria
-        )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
+        _multilabel_shape_check(input, target)
+        self._defer(input, target)
         return self
 
 
@@ -142,6 +185,9 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
     Reference parity: ``classification/accuracy.py:305-394``, with the
     hardcoded ``topk(k=2)`` bug (``functional/.../accuracy.py:394``) fixed.
     """
+
+    _fold_fn = staticmethod(_topk_fold)
+
 
     def __init__(
         self,
@@ -154,12 +200,15 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
         super().__init__(device=device)
         self.criteria = criteria
         self.k = k
+        self._fold_params = (criteria, k)
 
     def update(self, input, target) -> "TopKMultilabelAccuracy":
         input, target = self._input(input), self._input(target)
-        num_correct, num_total = _topk_multilabel_accuracy_update(
-            input, target, self.criteria, self.k
-        )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
+        _multilabel_shape_check(input, target)
+        if input.ndim != 2:
+            raise ValueError(
+                "input should have shape (num_sample, num_classes) for k > 1, "
+                f"got shape {input.shape}."
+            )
+        self._defer(input, target)
         return self

@@ -1,5 +1,12 @@
 """Confusion-matrix class metrics (framework extension; see the functional
-module for provenance — required by BASELINE config 3)."""
+module for provenance — required by BASELINE config 3).
+
+Updates are deferred (``metrics/deferred.py``): the joint-index count kernel
+runs once over the concatenated pending batches, which lands it in the
+large-N regime where the flat scatter lowering wins on TPU
+(``ops/confusion.py`` crossover table) instead of 10-100 small per-batch
+one-hot contractions.
+"""
 
 from __future__ import annotations
 
@@ -8,6 +15,7 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _confusion_matrix_input_check,
     _confusion_matrix_param_check,
@@ -18,8 +26,24 @@ from torcheval_tpu.ops.confusion import confusion_matrix_counts, normalize_confu
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class MulticlassConfusionMatrix(Metric[jax.Array]):
+def _cm_fold(input, target, num_classes):
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    return {
+        "confusion_matrix": confusion_matrix_counts(input, target, num_classes)
+    }
+
+
+def _bincm_fold(input, target, threshold):
+    pred = jnp.where(input < threshold, 0, 1)
+    return {"confusion_matrix": confusion_matrix_counts(pred, target, 2)}
+
+
+class MulticlassConfusionMatrix(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming (num_classes, num_classes) confusion counts; rows = true."""
+
+    _fold_fn = staticmethod(_cm_fold)
+
 
     def __init__(
         self,
@@ -37,23 +61,26 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             jnp.zeros((num_classes, num_classes), dtype=jnp.int32),
             reduction=Reduction.SUM,
         )
+        self._init_deferred()
+        self._fold_params = (num_classes,)
 
     def update(self, input, target) -> "MulticlassConfusionMatrix":
         input, target = self._input(input), self._input(target)
         _confusion_matrix_input_check(input, target, self.num_classes)
-        if input.ndim == 2:
-            input = jnp.argmax(input, axis=1)
-        self.confusion_matrix = self.confusion_matrix + confusion_matrix_counts(
-            input, target, self.num_classes
-        )
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return normalize_confusion_matrix(self.confusion_matrix, self.normalize)
 
     def merge_state(
         self, metrics: Iterable["MulticlassConfusionMatrix"]
     ) -> "MulticlassConfusionMatrix":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.confusion_matrix = self.confusion_matrix + jax.device_put(
                 metric.confusion_matrix, self.device
@@ -64,6 +91,9 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
 class BinaryConfusionMatrix(MulticlassConfusionMatrix):
     """Streaming 2x2 confusion counts after thresholding scores."""
 
+    _fold_fn = staticmethod(_bincm_fold)
+
+
     def __init__(
         self,
         *,
@@ -73,12 +103,10 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
     ) -> None:
         super().__init__(2, normalize=normalize, device=device)
         self.threshold = threshold
+        self._fold_params = (threshold,)
 
     def update(self, input, target) -> "BinaryConfusionMatrix":
         input, target = self._input(input), self._input(target)
         _confusion_matrix_input_check(input, target)
-        pred = jnp.where(input < self.threshold, 0, 1)
-        self.confusion_matrix = self.confusion_matrix + confusion_matrix_counts(
-            pred, target, 2
-        )
+        self._defer(input, target)
         return self

@@ -18,17 +18,43 @@ from torcheval_tpu.metrics.functional.classification.f1_score import (
     _f1_score_update,
     _warn_empty_classes,
 )
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class MulticlassF1Score(Metric[jax.Array]):
+def _f1_fold(input, target, num_classes, average):
+    num_tp, num_label, num_prediction = _f1_score_update(
+        input, target, num_classes, average
+    )
+    return {
+        "num_tp": num_tp,
+        "num_label": num_label,
+        "num_prediction": num_prediction,
+    }
+
+
+def _binf1_fold(input, target, threshold):
+    num_tp, num_label, num_prediction = _binary_f1_score_update(
+        input, target, threshold
+    )
+    return {
+        "num_tp": num_tp,
+        "num_label": num_label,
+        "num_prediction": num_prediction,
+    }
+
+
+class MulticlassF1Score(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming multiclass F1.
 
     Reference parity: ``classification/f1_score.py:26-155``. State triple
     (num_tp, num_label, num_prediction), scalar (micro) or per-class.
     """
+
+    _fold_fn = staticmethod(_f1_fold)
+
 
     def __init__(
         self,
@@ -46,19 +72,17 @@ class MulticlassF1Score(Metric[jax.Array]):
             self._add_state(
                 name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
             )
+        self._init_deferred()
+        self._fold_params = (self.num_classes, self.average)
 
     def update(self, input, target) -> "MulticlassF1Score":
         input, target = self._input(input), self._input(target)
         _f1_input_check(input, target, self.num_classes, "multiclass f1 score")
-        num_tp, num_label, num_prediction = _f1_score_update(
-            input, target, self.num_classes, self.average
-        )
-        self.num_tp = self.num_tp + num_tp
-        self.num_label = self.num_label + num_label
-        self.num_prediction = self.num_prediction + num_prediction
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         if self.average != "micro":
             _warn_empty_classes(self.num_label)
         return _f1_score_compute(
@@ -66,6 +90,10 @@ class MulticlassF1Score(Metric[jax.Array]):
         )
 
     def merge_state(self, metrics: Iterable["MulticlassF1Score"]) -> "MulticlassF1Score":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
             self.num_label = self.num_label + jax.device_put(
@@ -83,11 +111,15 @@ class BinaryF1Score(MulticlassF1Score):
     Reference parity: ``classification/f1_score.py:158-218``.
     """
 
+    _fold_fn = staticmethod(_binf1_fold)
+
+
     def __init__(
         self, *, threshold: float = 0.5, device: DeviceLike = None
     ) -> None:
         super().__init__(device=device)
         self.threshold = threshold
+        self._fold_params = (threshold,)
 
     def update(self, input, target) -> "BinaryF1Score":
         input, target = self._input(input), self._input(target)
@@ -96,10 +128,5 @@ class BinaryF1Score(MulticlassF1Score):
                 "input and target should be one-dimensional tensors of the same "
                 f"shape, got {input.shape} and {target.shape}."
             )
-        num_tp, num_label, num_prediction = _binary_f1_score_update(
-            input, target, self.threshold
-        )
-        self.num_tp = self.num_tp + num_tp
-        self.num_label = self.num_label + num_label
-        self.num_prediction = self.num_prediction + num_prediction
+        self._defer(input, target)
         return self

@@ -18,17 +18,35 @@ from torcheval_tpu.metrics.functional.classification.precision import (
     _precision_update,
     _warn_nan_classes,
 )
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class MulticlassPrecision(Metric[jax.Array]):
+def _prec_fold(input, target, num_classes, average):
+    num_tp, num_fp, num_label = _precision_update(
+        input, target, num_classes, average
+    )
+    return {"num_tp": num_tp, "num_fp": num_fp, "num_label": num_label}
+
+
+def _binprec_fold(input, target, threshold):
+    num_tp, num_fp, num_label = _binary_precision_update(
+        input, target, threshold
+    )
+    return {"num_tp": num_tp, "num_fp": num_fp, "num_label": num_label}
+
+
+class MulticlassPrecision(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming multiclass precision.
 
     Reference parity: ``classification/precision.py:25-160``. State triple
     (num_tp, num_fp, num_label).
     """
+
+    _fold_fn = staticmethod(_prec_fold)
+
 
     def __init__(
         self,
@@ -46,24 +64,26 @@ class MulticlassPrecision(Metric[jax.Array]):
             self._add_state(
                 name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
             )
+        self._init_deferred()
+        self._fold_params = (self.num_classes, self.average)
 
     def update(self, input, target) -> "MulticlassPrecision":
         input, target = self._input(input), self._input(target)
         _precision_input_check(input, target, self.num_classes)
-        num_tp, num_fp, num_label = _precision_update(
-            input, target, self.num_classes, self.average
-        )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_label = self.num_label + num_label
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         if self.average in (None, "None"):
             _warn_nan_classes(self.num_tp, self.num_fp, "Precision")
         return _precision_compute(self.num_tp, self.num_fp, self.num_label, self.average)
 
     def merge_state(self, metrics: Iterable["MulticlassPrecision"]) -> "MulticlassPrecision":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
             self.num_fp = self.num_fp + jax.device_put(metric.num_fp, self.device)
@@ -79,11 +99,15 @@ class BinaryPrecision(MulticlassPrecision):
     Reference parity: ``classification/precision.py:163-214``.
     """
 
+    _fold_fn = staticmethod(_binprec_fold)
+
+
     def __init__(
         self, *, threshold: float = 0.5, device: DeviceLike = None
     ) -> None:
         super().__init__(device=device)
         self.threshold = threshold
+        self._fold_params = (threshold,)
 
     def update(self, input, target) -> "BinaryPrecision":
         input, target = self._input(input), self._input(target)
@@ -96,10 +120,5 @@ class BinaryPrecision(MulticlassPrecision):
             raise ValueError(
                 f"target should be a one-dimensional tensor, got shape {target.shape}."
             )
-        num_tp, num_fp, num_label = _binary_precision_update(
-            input, target, self.threshold
-        )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_label = self.num_label + num_label
+        self._defer(input, target)
         return self

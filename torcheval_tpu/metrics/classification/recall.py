@@ -19,17 +19,37 @@ from torcheval_tpu.metrics.functional.classification.recall import (
     _recall_update,
     _warn_nan_recall,
 )
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class MulticlassRecall(Metric[jax.Array]):
+def _rec_fold(input, target, num_classes, average):
+    num_tp, num_labels, num_predictions = _recall_update(
+        input, target, num_classes, average
+    )
+    return {
+        "num_tp": num_tp,
+        "num_labels": num_labels,
+        "num_predictions": num_predictions,
+    }
+
+
+def _binrec_fold(input, target, threshold):
+    num_tp, num_true_labels = _binary_recall_update(input, target, threshold)
+    return {"num_tp": num_tp, "num_true_labels": num_true_labels}
+
+
+class MulticlassRecall(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming multiclass recall.
 
     Reference parity: ``classification/recall.py:103-245``. State triple
     (num_tp, num_labels, num_predictions).
     """
+
+    _fold_fn = staticmethod(_rec_fold)
+
 
     def __init__(
         self,
@@ -47,19 +67,17 @@ class MulticlassRecall(Metric[jax.Array]):
             self._add_state(
                 name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
             )
+        self._init_deferred()
+        self._fold_params = (self.num_classes, self.average)
 
     def update(self, input, target) -> "MulticlassRecall":
         input, target = self._input(input), self._input(target)
         _recall_input_check(input, target, self.num_classes)
-        num_tp, num_labels, num_predictions = _recall_update(
-            input, target, self.num_classes, self.average
-        )
-        self.num_tp = self.num_tp + num_tp
-        self.num_labels = self.num_labels + num_labels
-        self.num_predictions = self.num_predictions + num_predictions
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         if self.average != "micro":
             _warn_nan_recall(self.num_labels)
         return _recall_compute(
@@ -67,6 +85,10 @@ class MulticlassRecall(Metric[jax.Array]):
         )
 
     def merge_state(self, metrics: Iterable["MulticlassRecall"]) -> "MulticlassRecall":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
             self.num_labels = self.num_labels + jax.device_put(
@@ -78,12 +100,15 @@ class MulticlassRecall(Metric[jax.Array]):
         return self
 
 
-class BinaryRecall(Metric[jax.Array]):
+class BinaryRecall(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming binary recall with thresholding.
 
     Reference parity: ``classification/recall.py:26-100``. State pair
     (num_tp, num_true_labels).
     """
+
+    _fold_fn = staticmethod(_binrec_fold)
+
 
     def __init__(
         self, *, threshold: float = 0.5, device: DeviceLike = None
@@ -94,6 +119,8 @@ class BinaryRecall(Metric[jax.Array]):
         self._add_state(
             "num_true_labels", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM
         )
+        self._init_deferred()
+        self._fold_params = (threshold,)
 
     def update(self, input, target) -> "BinaryRecall":
         input, target = self._input(input), self._input(target)
@@ -106,15 +133,18 @@ class BinaryRecall(Metric[jax.Array]):
             raise ValueError(
                 f"target should be a one-dimensional tensor, got shape {target.shape}."
             )
-        num_tp, num_true_labels = _binary_recall_update(input, target, self.threshold)
-        self.num_tp = self.num_tp + num_tp
-        self.num_true_labels = self.num_true_labels + num_true_labels
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return _binary_recall_compute(self.num_tp, self.num_true_labels)
 
     def merge_state(self, metrics: Iterable["BinaryRecall"]) -> "BinaryRecall":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.num_tp = self.num_tp + jax.device_put(metric.num_tp, self.device)
             self.num_true_labels = self.num_true_labels + jax.device_put(

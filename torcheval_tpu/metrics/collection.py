@@ -1,26 +1,30 @@
-"""MetricCollection: fold many metric updates into ONE jitted dispatch.
+"""MetricCollection: drive many metrics from one batch with minimal dispatch.
 
 SURVEY §3.1 names the goal for the hot loop: "a single fused jit'd XLA
-computation (donated state in HBM)". Class metrics are convenient but eager:
-each ``update()`` costs several dispatches (input placement, kernel, state
-rebinds), and at small batches that host/dispatch overhead — not device math —
-dominates (measured ~3.8 ms/update for MulticlassAccuracy at batch 8192 on a
-tunneled v5e, where the kernel itself is 70 µs).
+computation (donated state in HBM)". Three lanes exist, picked per member:
 
-``MetricCollection`` traces every member metric's *existing* ``update``
-method once into a single jitted step over the joint state pytree, with the
-state **donated** so accumulators live in HBM and update in place. One
-dispatch per batch for the whole collection, async end to end.
+* **Deferred counter metrics** (``metrics/deferred.py``: accuracy family,
+  F1/precision/recall, confusion matrices) already make ``update`` an O(1)
+  host append with a bulk fused fold later — strictly better than
+  one-dispatch-per-batch fusion, so the collection leaves them on that path
+  (re-tracing them here would drag them back to per-batch kernels).
+* **Fusable array-state metrics** (regression, NE, Sum/Mean/Max/Min): traced
+  once into a single jitted step over the joint state pytree, with the state
+  **donated** so accumulators live in HBM and update in place — one dispatch
+  per batch for all of them together.
+* **Host-state metrics** (sample caches, dict/deque fixtures, Throughput's
+  host scalars): eager path; their updates are O(1) host appends and were
+  never dispatch-bound.
 
-Only array-state metrics fuse (counter metrics — the hot ones). Metrics with
-host-side state (sample caches, dict/deque fixtures, Throughput's host
-scalars) automatically stay on their eager path inside the same collection;
-their updates are O(1) host appends, so they were never dispatch-bound.
+Whatever the lane, the collection converts/places each batch argument ONCE
+(via the first metric's ``_input``) and hands every member the same placed
+arrays — k metrics never pay k host→device transfers, and deferring members'
+pending lists share one buffer per batch.
 
-Donation caveat: after an ``update()``, previously captured references to a
-fused metric's state arrays are invalid (their buffers were donated). Read
-state through the metric/collection (``compute``, ``state_dict``) instead of
-holding raw array refs across updates.
+Donation caveat: after an ``update()`` (fused lane) or a deferred fold,
+previously captured references to a member's state arrays are invalid (their
+buffers were donated). Read state through the metric/collection (``compute``,
+``state_dict``) instead of holding raw array refs across updates.
 """
 
 from __future__ import annotations
@@ -30,13 +34,21 @@ from typing import Any, Dict, Union
 
 import jax
 
+from torcheval_tpu.metrics.deferred import group_fold
 from torcheval_tpu.metrics.metric import Metric
 
 _logger = logging.getLogger(__name__)
 
 
 def _is_fusable(metric: Metric) -> bool:
-    """Array-state metrics trace; container-state metrics stay eager."""
+    """Array-state metrics trace; container-state metrics stay eager.
+
+    Deferred-fold metrics (``metrics/deferred.py``) are excluded: their
+    ``update`` is already an O(1) host append folded in bulk later, which
+    beats one-dispatch-per-batch fusion — re-tracing them here would only
+    drag them back to the eager per-batch kernel."""
+    if getattr(metric, "_defers", False):
+        return False
     return all(
         isinstance(v, jax.Array)
         for v in (metric._states() or {"": None}).values()
@@ -44,17 +56,19 @@ def _is_fusable(metric: Metric) -> bool:
 
 
 class MetricCollection:
-    """Drive several metrics with the same update arguments in one dispatch.
+    """Drive several metrics with the same update arguments, placing each
+    batch once and routing every member to its fastest lane (see module doc).
 
     Example::
 
         col = MetricCollection({
-            "acc": MulticlassAccuracy(num_classes=1000),
+            "acc": MulticlassAccuracy(num_classes=1000),   # deferred append
             "f1": MulticlassF1Score(num_classes=1000, average="macro"),
-            "auroc": BinaryAUROC(),       # cache metric: eager path, still fine
+            "mse": MeanSquaredError(),    # fusable: one jitted dispatch
+            "auroc": BinaryAUROC(),       # cache metric: eager append
         })
         for scores, labels in loader:
-            col.update(scores, labels)    # ONE jitted call for acc+f1
+            col.update(scores, labels)
         results = col.compute()
 
     All member metrics receive identical ``update(*args, **kwargs)``; build
@@ -70,6 +84,13 @@ class MetricCollection:
             raise ValueError("MetricCollection needs at least one metric.")
         self._fused = [n for n, m in self.metrics.items() if _is_fusable(m)]
         self._eager = [n for n in self.metrics if n not in self._fused]
+        # deferred members fold TOGETHER (one dispatch, shared subcomputations
+        # CSE'd by XLA) with the collection owning the fold trigger
+        self._deferred = {
+            n: m for n, m in self.metrics.items() if getattr(m, "_defers", False)
+        }
+        for m in self._deferred.values():
+            m._defer_managed = True
         self._step = self._build_step() if self._fused else None
 
     def _build_step(self):
@@ -98,33 +119,45 @@ class MetricCollection:
         return jax.jit(step)
 
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
+        # convert + place each batch argument ONCE for the whole collection:
+        # torch/numpy batches must land on the metrics' device before the jit
+        # boundary anyway (the traced update's _input is a passthrough for
+        # tracers), and eager/deferred members then hit _input's already-
+        # placed fast path instead of re-transferring per metric
+        place = next(iter(self.metrics.values()))._input
+        args = tuple(
+            place(a)
+            if hasattr(a, "__array__") or hasattr(a, "__dlpack__")
+            else a
+            for a in args
+        )
+        kwargs = {
+            k: place(v)
+            if hasattr(v, "__array__") or hasattr(v, "__dlpack__")
+            else v
+            for k, v in kwargs.items()
+        }
         if self._step is not None:
-            # torch/numpy batches must convert AND land on the metrics'
-            # device BEFORE the jit boundary (the traced update's _input is a
-            # passthrough for tracers); reuse the eager placement semantics
-            # of the first fused metric
-            place = self.metrics[self._fused[0]]._input
-            args = tuple(
-                place(a)
-                if hasattr(a, "__array__") or hasattr(a, "__dlpack__")
-                else a
-                for a in args
-            )
-            kwargs = {
-                k: place(v)
-                if hasattr(v, "__array__") or hasattr(v, "__dlpack__")
-                else v
-                for k, v in kwargs.items()
-            }
             states = {n: self.metrics[n]._states() for n in self._fused}
             new_states = self._step(states, args, kwargs)
             for name in self._fused:
                 self.metrics[name]._set_states(new_states[name])
         for name in self._eager:
             self.metrics[name].update(*args, **kwargs)
+        if self._deferred:
+            # collection-owned budget trigger: every deferred member carries
+            # the same pending arrays, so one member's budget speaks for all
+            probe = next(iter(self._deferred.values()))
+            if (
+                probe._pending_bytes >= probe._DEFER_BUDGET_BYTES
+                or len(probe._pending) >= probe._DEFER_MAX_CHUNKS
+            ):
+                group_fold(self._deferred)
         return self
 
     def compute(self) -> Any:
+        if self._deferred:
+            group_fold(self._deferred)
         out = {n: m.compute() for n, m in self.metrics.items()}
         return out["metric"] if self._single else out
 
@@ -134,6 +167,8 @@ class MetricCollection:
         return self
 
     def state_dicts(self) -> Dict[str, Dict[str, Any]]:
+        if self._deferred:
+            group_fold(self._deferred)
         return {n: m.state_dict() for n, m in self.metrics.items()}
 
     def __getitem__(self, name: str) -> Metric:

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu.ops.confusion import class_counts
 from torcheval_tpu.utils.convert import as_jax
-from torcheval_tpu.utils.tracing import is_concrete
+from torcheval_tpu.utils.tracing import async_value_warn
 
 _logger = logging.getLogger(__name__)
 
@@ -123,15 +123,16 @@ def _binary_f1_score_update(
 
 
 def _warn_empty_classes(num_label) -> None:
-    import numpy as np
+    # async: the readback otherwise blocks compute() on the device stream
+    # (a full tunnel RTT on this project's chip) — utils/tracing.py
+    def _check(labels) -> None:
+        if labels.ndim and (labels == 0).any():
+            _logger.warning(
+                "Some classes do not exist in the target. "
+                "F1 scores for these classes will be cast to zeros."
+            )
 
-    if not is_concrete(num_label):
-        return
-    if np.asarray(num_label).ndim and (np.asarray(num_label) == 0).any():
-        _logger.warning(
-            "Some classes do not exist in the target. "
-            "F1 scores for these classes will be cast to zeros."
-        )
+    async_value_warn(_check, num_label)
 
 
 def multiclass_f1_score(

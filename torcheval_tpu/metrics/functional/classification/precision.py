@@ -17,7 +17,7 @@ import numpy as np
 
 from torcheval_tpu.ops.confusion import class_counts
 from torcheval_tpu.utils.convert import as_jax
-from torcheval_tpu.utils.tracing import is_concrete
+from torcheval_tpu.utils.tracing import async_value_warn
 
 _logger = logging.getLogger(__name__)
 
@@ -114,15 +114,16 @@ def _binary_precision_update(
 
 
 def _warn_nan_classes(num_tp, num_fp, what: str) -> None:
-    if not (is_concrete(num_tp) and is_concrete(num_fp)):
-        return
-    tp, fp = np.asarray(num_tp), np.asarray(num_fp)
-    if tp.ndim and ((tp + fp) == 0).any():
-        bad = np.nonzero((tp + fp) == 0)[0]
-        _logger.warning(
-            f"{bad.tolist()} classes have zero instances in both the predictions "
-            f"and the ground truth labels. {what} is still logged as zero."
-        )
+    # async readback: see utils/tracing.py
+    def _check(tp, fp) -> None:
+        if tp.ndim and ((tp + fp) == 0).any():
+            bad = np.nonzero((tp + fp) == 0)[0]
+            _logger.warning(
+                f"{bad.tolist()} classes have zero instances in both the predictions "
+                f"and the ground truth labels. {what} is still logged as zero."
+            )
+
+    async_value_warn(_check, num_tp, num_fp)
 
 
 def multiclass_precision(

@@ -18,7 +18,7 @@ import numpy as np
 
 from torcheval_tpu.ops.confusion import class_counts
 from torcheval_tpu.utils.convert import as_jax
-from torcheval_tpu.utils.tracing import is_concrete
+from torcheval_tpu.utils.tracing import async_value_warn
 
 _logger = logging.getLogger(__name__)
 
@@ -117,15 +117,16 @@ def _binary_recall_update(
 
 
 def _warn_nan_recall(num_labels) -> None:
-    if not is_concrete(num_labels):
-        return
-    labels = np.asarray(num_labels)
-    if labels.ndim and (labels == 0).any():
-        nan_classes = np.nonzero(labels == 0)[0]
-        _logger.warning(
-            f"One or more NaNs identified, as no ground-truth instances of "
-            f"{nan_classes.tolist()} have been seen. These have been converted to zero."
-        )
+    # async readback: see utils/tracing.py
+    def _check(labels) -> None:
+        if labels.ndim and (labels == 0).any():
+            nan_classes = np.nonzero(labels == 0)[0]
+            _logger.warning(
+                f"One or more NaNs identified, as no ground-truth instances of "
+                f"{nan_classes.tolist()} have been seen. These have been converted to zero."
+            )
+
+    async_value_warn(_check, num_labels)
 
 
 def multiclass_recall(
@@ -170,11 +171,15 @@ def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
 
 
 def _binary_recall_compute(num_tp, num_true_labels) -> jax.Array:
-    if is_concrete(num_true_labels) and int(num_true_labels) == 0:
-        _logger.warning(
-            "One or more NaNs identified, as no ground-truth instances have "
-            "been seen. These have been converted to zero."
-        )
+    # async readback: see utils/tracing.py
+    def _check(n) -> None:
+        if n == 0:
+            _logger.warning(
+                "One or more NaNs identified, as no ground-truth instances "
+                "have been seen. These have been converted to zero."
+            )
+
+    async_value_warn(_check, num_true_labels)
     recall = num_tp.astype(jnp.float32) / jnp.maximum(
         num_true_labels.astype(jnp.float32), 1.0
     )

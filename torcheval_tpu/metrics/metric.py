@@ -147,7 +147,13 @@ class Metric(Generic[TComputeReturn], ABC):
 
     @abstractmethod
     def compute(self) -> TComputeReturn:
-        """Fold state into the final result. Idempotent; never mutates state."""
+        """Fold state into the final result. Idempotent on the logical state.
+
+        Deferred metrics (``metrics/deferred.py``) first fold pending batches
+        into their counters — a physical-representation change that rebinds
+        the state attributes (and, on donating backends, deletes the old
+        buffers) while preserving the logical value. Repeated ``compute``
+        calls return the same result either way."""
 
     @abstractmethod
     def merge_state(self: TSelf, metrics: Iterable[TSelf]) -> TSelf:
@@ -157,6 +163,13 @@ class Metric(Generic[TComputeReturn], ABC):
         """Pre-sync state compaction hook (e.g. concat a sample-cache list into
         one array so the collective moves one buffer). Reference:
         ``metric.py:112-121``."""
+        self._fold_now()
+
+    def _fold_now(self) -> None:
+        """Fold any deferred pending batches into the logical state. No-op
+        here; overridden by :class:`~torcheval_tpu.metrics.deferred.
+        DeferredFoldMixin`. Every read path that must observe the logical
+        state (``state_dict``, ``to``, pickling, sync) calls this first."""
 
     # ------------------------------------------------------------- life cycle
     def reset(self: TSelf) -> TSelf:
@@ -177,6 +190,7 @@ class Metric(Generic[TComputeReturn], ABC):
     def state_dict(self) -> Dict[str, TState]:
         """Snapshot state as a plain dict (arrays are immutable — no clone
         needed, unlike the reference's detach+clone dance)."""
+        self._fold_now()
         out: Dict[str, TState] = {}
         for name in self._state_name_to_default:
             value = getattr(self, name)
@@ -207,6 +221,7 @@ class Metric(Generic[TComputeReturn], ABC):
     def to(self: TSelf, device: DeviceLike, *args: Any, **kwargs: Any) -> TSelf:
         """Move all state to ``device`` (a jax.Device, platform string, or a
         ``Sharding`` for mesh-distributed state)."""
+        self._fold_now()  # pending batches live on the old device
         self._device = canonical_device(device)
         for name in self._state_name_to_default:
             setattr(self, name, put_state(getattr(self, name), self._device))
