@@ -1,0 +1,232 @@
+"""Error-path parity matrices: the rejection surface of every metric family.
+
+The reference pairs every functional metric with an exhaustive invalid-input
+``assertRaisesRegex`` block (pattern at
+``/root/reference/tests/metrics/functional/classification/test_accuracy.py:55-61``,
+replicated per family). This module is the parametrized equivalent: one table
+row per (callable, bad input, expected error), grouped by family, asserting
+the documented error strings — shapes, dtypes, ranges, and option combos.
+"""
+
+import re
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import metrics as M
+from torcheval_tpu.metrics import functional as F
+
+A = jnp.asarray
+
+
+def rows_1d(n):
+    return A(np.zeros(n, np.float32))
+
+
+def scores_2d(n, c):
+    return A(np.zeros((n, c), np.float32))
+
+
+def labels_1d(n, hi=2):
+    return A(np.zeros(n, np.int32))
+
+
+class _MatrixTester(unittest.TestCase):
+    """Each CASES row: (label, callable, ValueError/TypeError, regex)."""
+
+    CASES = ()
+
+    def test_matrix(self):
+        for label, fn, exc, pattern in self.CASES:
+            with self.subTest(label):
+                with self.assertRaisesRegex(exc, pattern):
+                    fn()
+
+
+class TestAccuracyFamilyErrors(_MatrixTester):
+    CASES = (
+        # ---- param checks (reference accuracy.py:290-310)
+        ("bad average", lambda: F.multiclass_accuracy(rows_1d(4), labels_1d(4), average="bogus"),
+         ValueError, r"`average` was not in the allowed value"),
+        ("macro needs num_classes", lambda: F.multiclass_accuracy(rows_1d(4), labels_1d(4), average="macro"),
+         ValueError, r"num_classes should be a positive number"),
+        ("macro bad num_classes", lambda: F.multiclass_accuracy(rows_1d(4), labels_1d(4), average="macro", num_classes=0),
+         ValueError, r"num_classes should be a positive number"),
+        ("k not int", lambda: F.multiclass_accuracy(scores_2d(4, 3), labels_1d(4), num_classes=3, k=2.5),
+         TypeError, r"Expected `k` to be an integer"),
+        ("k < 1", lambda: F.multiclass_accuracy(scores_2d(4, 3), labels_1d(4), num_classes=3, k=0),
+         ValueError, r"greater than 0"),
+        # ---- update input checks (reference accuracy.py:313-342)
+        ("first-dim mismatch", lambda: F.multiclass_accuracy(rows_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        ("target 2-D", lambda: F.multiclass_accuracy(scores_2d(4, 3), scores_2d(4, 3)),
+         ValueError, r"target should be a one-dimensional tensor"),
+        ("input 3-D", lambda: F.multiclass_accuracy(A(np.zeros((4, 3, 2), np.float32)), labels_1d(4)),
+         ValueError, re.escape("input should have shape of (num_sample,) or (num_sample, num_classes)")),
+        ("k>1 needs 2-D input", lambda: F.multiclass_accuracy(rows_1d(4), labels_1d(4), k=2),
+         ValueError, re.escape("input should have shape (num_sample, num_classes) for k > 1")),
+        ("class-width mismatch", lambda: F.multiclass_accuracy(scores_2d(4, 5), labels_1d(4), average="macro", num_classes=3),
+         ValueError, r"input should have shape"),
+        # ---- binary
+        ("binary shape mismatch", lambda: F.binary_accuracy(scores_2d(4, 2), rows_1d(3)),
+         ValueError, r"same dimensions"),
+        ("binary target 2-D", lambda: F.binary_accuracy(scores_2d(4, 2), scores_2d(4, 2)),
+         ValueError, r"one-dimensional tensor"),
+        # ---- multilabel
+        ("multilabel bad criteria", lambda: F.multilabel_accuracy(scores_2d(4, 3), scores_2d(4, 3), criteria="sometimes"),
+         ValueError, r"`criteria` was not in the allowed value"),
+        ("multilabel shape mismatch", lambda: F.multilabel_accuracy(scores_2d(4, 3), scores_2d(3, 3)),
+         ValueError, r"same dimensions"),
+        # ---- top-k multilabel (k=2 bug fixed: k honoured, k<=1 rejected)
+        ("topk k=1 rejected", lambda: F.topk_multilabel_accuracy(scores_2d(4, 3), scores_2d(4, 3), k=1),
+         ValueError, r"greater than 1"),
+        ("topk k not int", lambda: F.topk_multilabel_accuracy(scores_2d(4, 3), scores_2d(4, 3), k="2"),
+         TypeError, r"Expected `k` to be an integer"),
+        ("topk bad criteria", lambda: F.topk_multilabel_accuracy(scores_2d(4, 3), scores_2d(4, 3), criteria="x", k=2),
+         ValueError, r"`criteria` was not in the allowed value"),
+        ("topk 1-D input", lambda: F.topk_multilabel_accuracy(rows_1d(4), rows_1d(4), k=2),
+         ValueError, re.escape("input should have shape (num_sample, num_classes)")),
+        # ---- class-metric constructors and updates reject identically
+        ("class bad average", lambda: M.MulticlassAccuracy(average="bogus"),
+         ValueError, r"`average` was not in the allowed value"),
+        ("class update mismatch", lambda: M.MulticlassAccuracy().update(rows_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        ("class binary update mismatch", lambda: M.BinaryAccuracy().update(rows_1d(4), rows_1d(3)),
+         ValueError, r"same dimensions"),
+        ("class topk k=1", lambda: M.TopKMultilabelAccuracy(k=1),
+         ValueError, r"greater than 1"),
+    )
+
+
+class TestF1PrecisionRecallErrors(_MatrixTester):
+    CASES = (
+        ("f1 bad average", lambda: F.multiclass_f1_score(rows_1d(4), labels_1d(4), average="median"),
+         ValueError, r"`average` was not in the allowed"),
+        ("f1 macro needs classes", lambda: F.multiclass_f1_score(rows_1d(4), labels_1d(4), average="macro"),
+         ValueError, r"num_classes should be a positive number"),
+        ("f1 shape mismatch", lambda: F.multiclass_f1_score(rows_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        ("f1 target 2-D", lambda: F.multiclass_f1_score(scores_2d(4, 3), scores_2d(4, 3), num_classes=3),
+         ValueError, r"one-dimensional tensor"),
+        ("f1 class-width mismatch", lambda: F.multiclass_f1_score(scores_2d(4, 5), labels_1d(4), average="macro", num_classes=3),
+         ValueError, r"input should have shape"),
+        ("binary f1 shape", lambda: F.binary_f1_score(rows_1d(4), rows_1d(3)),
+         ValueError, r"same dimensions"),
+        ("precision bad average", lambda: F.multiclass_precision(rows_1d(4), labels_1d(4), average="harmonic"),
+         ValueError, r"`average` was not in the allowed"),
+        ("precision macro needs classes", lambda: F.multiclass_precision(rows_1d(4), labels_1d(4), average=None),
+         ValueError, r"num_classes"),
+        ("precision shape mismatch", lambda: F.multiclass_precision(rows_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        ("recall bad average", lambda: F.multiclass_recall(rows_1d(4), labels_1d(4), average="harmonic"),
+         ValueError, r"`average` was not in the allowed"),
+        ("recall macro needs classes", lambda: F.multiclass_recall(rows_1d(4), labels_1d(4), average="macro"),
+         ValueError, r"`num_classes` should be a positive number"),
+        ("recall shape mismatch", lambda: F.multiclass_recall(rows_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        ("binary recall shape", lambda: F.binary_recall(rows_1d(4), rows_1d(3)),
+         ValueError, r"same dimensions"),
+        ("binary recall 2-D", lambda: F.binary_recall(scores_2d(4, 2), scores_2d(4, 2)),
+         ValueError, r"one-dimensional tensor"),
+        # class metrics
+        ("class f1 bad average", lambda: M.MulticlassF1Score(average="median"),
+         ValueError, r"`average` was not in the allowed"),
+        ("class f1 update mismatch", lambda: M.MulticlassF1Score().update(rows_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        ("class binary precision mismatch", lambda: M.BinaryPrecision().update(rows_1d(4), rows_1d(3)),
+         ValueError, r"same dimensions"),
+        ("class binary recall 2-D", lambda: M.BinaryRecall().update(scores_2d(4, 2), scores_2d(4, 2)),
+         ValueError, r"one-dimensional tensor"),
+    )
+
+
+class TestConfusionCurveErrors(_MatrixTester):
+    CASES = (
+        ("cm num_classes < 2", lambda: F.multiclass_confusion_matrix(labels_1d(4), labels_1d(4), num_classes=1),
+         ValueError, r"num_classes must be at least 2"),
+        ("cm bad normalize", lambda: F.multiclass_confusion_matrix(labels_1d(4), labels_1d(4), num_classes=3, normalize="rows"),
+         ValueError, r"normalize must be one of"),
+        ("cm shape mismatch", lambda: F.multiclass_confusion_matrix(labels_1d(4), labels_1d(3), num_classes=3),
+         ValueError, r"same first dimension"),
+        ("binary cm bad normalize", lambda: F.binary_confusion_matrix(rows_1d(4), labels_1d(4), normalize="rows"),
+         ValueError, r"normalize must be one of"),
+        ("class cm num_classes", lambda: M.MulticlassConfusionMatrix(1),
+         ValueError, r"num_classes must be at least 2"),
+        ("class cm update mismatch", lambda: M.MulticlassConfusionMatrix(3).update(labels_1d(4), labels_1d(3)),
+         ValueError, r"same first dimension"),
+        # auroc / auprc
+        ("auroc shape mismatch", lambda: F.binary_auroc(rows_1d(4), rows_1d(3)),
+         ValueError, r"same shape"),
+        ("class auroc shape mismatch", lambda: M.BinaryAUROC().update(rows_1d(4), rows_1d(3)),
+         ValueError, r"same shape"),
+        ("auroc compaction threshold", lambda: M.BinaryAUROC(compaction_threshold=0),
+         ValueError, r"compaction_threshold must be positive"),
+        # binned PRC threshold specs
+        ("binned unsorted thresholds", lambda: F.binary_binned_precision_recall_curve(rows_1d(4), labels_1d(4), threshold=A(np.asarray([0.5, 0.2], np.float32))),
+         ValueError, r"should be a sorted array"),
+        ("binned out-of-range thresholds", lambda: F.binary_binned_precision_recall_curve(rows_1d(4), labels_1d(4), threshold=A(np.asarray([0.0, 1.5], np.float32))),
+         ValueError, re.escape("should be in the range of [0, 1]")),
+        # normalized entropy
+        ("ne shape mismatch", lambda: F.binary_normalized_entropy(rows_1d(4), rows_1d(3)),
+         ValueError, r"is different from `target` shape"),
+        ("ne weight mismatch", lambda: F.binary_normalized_entropy(rows_1d(4), rows_1d(4), weight=rows_1d(3)),
+         ValueError, r"weight"),
+        ("ne prob out of range", lambda: F.binary_normalized_entropy(A(np.asarray([0.2, 1.5], np.float32)), rows_1d(2)),
+         ValueError, r"should be probability"),
+        ("ne num_tasks mismatch", lambda: F.binary_normalized_entropy(scores_2d(3, 4), scores_2d(3, 4), num_tasks=2),
+         ValueError, r"num_tasks"),
+    )
+
+
+class TestRankingRegressionAggregationErrors(_MatrixTester):
+    CASES = (
+        ("hit_rate target 2-D", lambda: F.hit_rate(scores_2d(3, 4), scores_2d(3, 4)),
+         ValueError, r"one-dimensional"),
+        ("hit_rate input 1-D", lambda: F.hit_rate(rows_1d(3), labels_1d(3)),
+         ValueError, r"two-dimensional"),
+        ("hit_rate size mismatch", lambda: F.hit_rate(scores_2d(3, 4), labels_1d(2)),
+         ValueError, r"same minibatch dimension"),
+        ("hit_rate k <= 0", lambda: F.hit_rate(scores_2d(3, 4), labels_1d(3), k=0),
+         ValueError, r"k should be None or positive"),
+        ("hit_rate target out of range", lambda: F.hit_rate(scores_2d(3, 4), A(np.asarray([0, 1, 9], np.int32))),
+         ValueError, re.escape("target indices must be in [0, 4)")),
+        ("reciprocal_rank input 1-D", lambda: F.reciprocal_rank(rows_1d(3), labels_1d(3)),
+         ValueError, r"two-dimensional"),
+        ("frequency input 2-D", lambda: F.frequency_at_k(scores_2d(3, 4), 1.0),
+         ValueError, r"one-dimensional"),
+        ("frequency negative k", lambda: F.frequency_at_k(rows_1d(3), -1.0),
+         ValueError, r"k should not be negative"),
+        ("collisions 2-D", lambda: F.num_collisions(scores_2d(3, 4).astype(jnp.int32)),
+         ValueError, r"one-dimensional"),
+        ("collisions float dtype", lambda: F.num_collisions(rows_1d(3)),
+         ValueError, r"integer tensor"),
+        # regression
+        ("mse bad multioutput", lambda: F.mean_squared_error(rows_1d(4), rows_1d(4), multioutput="mean"),
+         ValueError, r"multioutput"),
+        ("mse 3-D", lambda: F.mean_squared_error(A(np.zeros((2, 2, 2), np.float32)), A(np.zeros((2, 2, 2), np.float32))),
+         ValueError, r"should be 1D or 2D"),
+        ("mse shape mismatch", lambda: F.mean_squared_error(rows_1d(4), rows_1d(3)),
+         ValueError, r"should have the same size"),
+        ("mse weight 2-D", lambda: F.mean_squared_error(rows_1d(4), rows_1d(4), sample_weight=scores_2d(2, 2)),
+         ValueError, r"`sample_weight` should be a one-dimensional tensor"),
+        ("r2 bad multioutput", lambda: F.r2_score(rows_1d(4), rows_1d(4), multioutput="mean"),
+         ValueError, r"multioutput"),
+        ("r2 bad num_regressors", lambda: F.r2_score(rows_1d(4), rows_1d(4), num_regressors=-1),
+         ValueError, r"num_regressors"),
+        ("r2 too few samples", lambda: F.r2_score(rows_1d(1), rows_1d(1)),
+         ValueError, r"at least two samples"),
+        ("r2 regressors vs samples", lambda: F.r2_score(rows_1d(4), rows_1d(4), num_regressors=3),
+         ValueError, r"`num_regressors` must be smaller than"),
+        # aggregation
+        ("sum weight shape", lambda: F.sum(rows_1d(4), A(np.zeros(3, np.float32))),
+         ValueError, r"weight must be a scalar or an array whose shape matches"),
+        ("throughput negative elapsed", lambda: M.Throughput().update(num_processed=1, elapsed_time_sec=-1.0),
+         ValueError, r"elapsed_time_sec"),
+        ("mean weight shape", lambda: M.Mean().update(rows_1d(4), weight=A(np.zeros(3, np.float32))),
+         ValueError, r"weight must be a scalar or an array whose shape matches"),
+    )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -85,6 +85,7 @@ def main() -> None:
         get_synced_metric,
         get_synced_state_dict,
         sync_and_compute,
+        sync_and_compute_collection,
     )
     from torcheval_tpu.utils.test_utils import DummySumDictStateMetric
 
@@ -142,6 +143,15 @@ def main() -> None:
     results["dict_keys_r0"] = (
         None if synced_d is None else sorted(synced_d.x)
     )
+
+    # --- whole-collection sync: one typed two-round exchange for acc+sum+
+    # auroc (uneven CAT incl. the empty rank) plus one object gather for the
+    # dict metric — exercises the batched wire end to end
+    col = {"acc": acc, "sum": s, "auroc": auroc, "dict": d, "tp": t}
+    r = sync_and_compute_collection(col, recipient_rank="all")
+    results["collection_all"] = {k: _jsonable(v) for k, v in r.items()}
+    r1 = sync_and_compute_collection(col, recipient_rank=1)
+    results["collection_r1"] = None if r1 is None else sorted(r1)
 
     os.makedirs(outdir, exist_ok=True)
     with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:

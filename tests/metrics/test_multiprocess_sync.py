@@ -142,6 +142,32 @@ class TestMultiprocessSync(unittest.TestCase):
                 self.assertIsNone(res["synced_metric_r1"])
                 self.assertEqual(res["synced_sd_r1_keys"], [])
 
+    def test_collection_single_gather_pass(self):
+        # values must equal the per-metric syncs computed in the same world
+        all_s, all_t = [], []
+        for r in range(WORLD):
+            s, t = make_auroc_shard(r)
+            all_s.append(s)
+            all_t.append(t)
+        want_auroc = roc_auc_score(np.concatenate(all_t), np.concatenate(all_s))
+        want_dict = sum(v for r in range(WORLD) for _, v in make_dict_updates(r))
+        for r, res in enumerate(self.results):
+            col = res["collection_all"]
+            self.assertEqual(
+                sorted(col), ["acc", "auroc", "dict", "sum", "tp"]
+            )
+            self.assertAlmostEqual(col["acc"], res["acc_all"], places=6)
+            self.assertAlmostEqual(col["sum"], 30.0, places=5)
+            self.assertAlmostEqual(col["auroc"], want_auroc, places=5)
+            self.assertAlmostEqual(col["dict"], want_dict, places=5)
+            self.assertAlmostEqual(col["tp"], 250.0, places=5)
+            if r == 1:
+                self.assertEqual(
+                    res["collection_r1"], ["acc", "auroc", "dict", "sum", "tp"]
+                )
+            else:
+                self.assertIsNone(res["collection_r1"])
+
     def test_dict_state_object_gather(self):
         want = sum(v for r in range(WORLD) for _, v in make_dict_updates(r))
         keys = sorted(

@@ -137,6 +137,8 @@ _CAT_DTYPES = (
     jnp.int8,
     jnp.uint8,
     jnp.uint32,
+    jnp.float64,
+    jnp.int64,
 )
 _MAX_CAT_RANK = 5
 
@@ -292,9 +294,7 @@ def _gather_state_dicts(metric: Metric) -> List[Dict[str, TState]]:
                 "sync is not supported for it."
             )
         if red is Reduction.CAT:
-            cache = list(value) if isinstance(value, (list, deque)) else [value]
-            nonempty = [v for v in cache if v.ndim and v.shape[0]]
-            local = jnp.concatenate(nonempty, axis=0) if nonempty else None
+            local = _cat_cache_concat(value)
             # descriptor exchange first: a rank whose cache is empty does not
             # know the trailing dims/dtype, but the collective requires
             # identical shape+dtype on every rank — adopt them from a
@@ -424,13 +424,194 @@ def sync_and_compute(
     return synced.compute()
 
 
+# ------------------------------------------------ batched collection sync
+# One descriptor exchange + one byte-payload gather for a WHOLE collection,
+# instead of one gather round per state per metric (round-2 verdict Weak #7:
+# on a DCN-attached pod every round is a cross-host latency hit). Wire:
+#   round 1: (n_entries, 7) int32 descriptor matrix
+#            [d0, ndim, dtype_code, d1, d2, d3, d4]  (ndim == -1: empty CAT)
+#   round 2: uint8 payload — every entry's raw C-order bytes concatenated,
+#            padded to the max total length across ranks
+# Entry order is (metric key, registered state order) — identical on every
+# rank by SPMD lockstep, same assumption the per-metric path already makes.
+
+
+def _cat_cache_concat(value) -> Optional[jax.Array]:
+    """Concatenate a CAT state's non-empty cache entries into one array
+    (``None`` for an empty cache). Shared by the per-metric and collection
+    gather paths so both compact caches — and promote mixed dtypes — the
+    same way."""
+    cache = list(value) if isinstance(value, (list, deque)) else [value]
+    nonempty = [v for v in cache if v.ndim and v.shape[0]]
+    return jnp.concatenate(nonempty, axis=0) if nonempty else None
+
+
+def _collection_entries(metrics: Dict[str, Metric]):
+    entries = []
+    for mkey, metric in metrics.items():
+        sd = metric.state_dict()
+        for name, red in metric._state_name_to_reduction.items():
+            value = sd[name]
+            if red is Reduction.CAT:
+                cat = _cat_cache_concat(value)
+                local = None if cat is None else np.asarray(cat)
+            else:
+                local = np.asarray(value)
+            entries.append((mkey, name, red, local))
+    return entries
+
+
+def _encode_entry_descriptor(local: Optional[np.ndarray]) -> list:
+    if local is None:
+        return [0, -1, 0, 0, 0, 0, 0]  # empty CAT cache
+    if local.ndim > _MAX_CAT_RANK:
+        # uniform post-exchange failure, as in _encode_cat_descriptor
+        return [0, local.ndim, 0, 0, 0, 0, 0]
+    codes = [
+        i for i, d in enumerate(_CAT_DTYPES) if np.dtype(jnp.dtype(d)) == local.dtype
+    ]
+    code = codes[0] if codes else -1
+    shape = list(local.shape) + [0] * (_MAX_CAT_RANK - local.ndim)
+    d0 = shape[0] if local.ndim else 1
+    return [d0, local.ndim, code] + shape[1:_MAX_CAT_RANK]
+
+
+def _entry_nbytes(desc: np.ndarray) -> int:
+    ndim = int(desc[1])
+    if ndim < 0:
+        return 0
+    dtype = np.dtype(jnp.dtype(_CAT_DTYPES[int(desc[2])]))
+    shape = _entry_shape(desc)
+    n = 1
+    for d in shape:
+        n *= d
+    return n * dtype.itemsize
+
+
+def _entry_shape(desc: np.ndarray) -> tuple:
+    ndim = int(desc[1])
+    if ndim <= 0:
+        return ()
+    return (int(desc[0]),) + tuple(int(d) for d in desc[3 : 3 + ndim - 1])
+
+
+def _gather_collection_states(
+    metrics: Dict[str, Metric],
+) -> List[Dict[str, Dict[str, TState]]]:
+    """All-gather every rank's states for a whole collection in exactly two
+    collective rounds; returns per-rank ``{metric_key: state_dict}``."""
+    from jax.experimental import multihost_utils
+
+    world = _world_size()
+    entries = _collection_entries(metrics)
+    desc = np.asarray(
+        [_encode_entry_descriptor(local) for _, _, _, local in entries],
+        dtype=np.int32,
+    ).reshape(len(entries), 7)
+    all_desc = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(desc))
+    ).reshape(world, len(entries), 7)
+    # uniform validation AFTER the exchange (a one-sided raise would hang the
+    # payload collective on the other ranks); column layout matches the CAT
+    # wire descriptor ([d0, ndim, dtype_code, ...]) so the same checker serves
+    for e, (mkey, name, red, _) in enumerate(entries):
+        _check_cat_descriptors(f"{name} of metric {mkey}", all_desc[:, e, :])
+    totals = [
+        sum(_entry_nbytes(all_desc[r, e]) for e in range(len(entries)))
+        for r in range(world)
+    ]
+    max_total = max(max(totals), 1)
+    payload = np.zeros(max_total, dtype=np.uint8)
+    offset = 0
+    for _, _, _, local in entries:
+        if local is None:
+            continue
+        raw = np.ascontiguousarray(local).view(np.uint8).reshape(-1)
+        payload[offset : offset + raw.size] = raw
+        offset += raw.size
+    all_bytes = np.asarray(
+        multihost_utils.process_allgather(jnp.asarray(payload))
+    ).reshape(world, max_total)
+    gathered: List[Dict[str, Dict[str, TState]]] = [
+        {mkey: {} for mkey in metrics} for _ in range(world)
+    ]
+    for r in range(world):
+        offset = 0
+        for e, (mkey, name, red, _) in enumerate(entries):
+            d = all_desc[r, e]
+            nbytes = _entry_nbytes(d)
+            if int(d[1]) < 0:  # empty CAT
+                gathered[r][mkey][name] = []
+                continue
+            dtype = np.dtype(jnp.dtype(_CAT_DTYPES[int(d[2])]))
+            value = np.frombuffer(
+                all_bytes[r, offset : offset + nbytes].tobytes(), dtype=dtype
+            ).reshape(_entry_shape(d))
+            offset += nbytes
+            decoded = jnp.asarray(value)
+            if decoded.dtype != value.dtype:
+                # 64-bit state with jax x64 disabled: jnp.asarray would
+                # silently truncate (an int64 count >= 2^31 wraps). Keep the
+                # faithful numpy array — TState accepts numpy leaves and
+                # _fold_states' arithmetic works on them exactly.
+                decoded = value
+            if red is Reduction.CAT:
+                gathered[r][mkey][name] = [decoded]
+            else:
+                gathered[r][mkey][name] = decoded
+    return gathered
+
+
 def sync_and_compute_collection(
     metrics: Dict[str, Metric], recipient_rank: _RecipientRank = 0
 ) -> Optional[Dict[str, Any]]:
-    """Sync and compute a named collection of metrics in one pass."""
+    """Sync and compute a named collection of metrics in ONE gather pass.
+
+    All metrics' array/CAT states ride a single two-round typed exchange
+    (descriptors, then one concatenated byte payload); metrics needing the
+    object lane (dict-keyed / CUSTOM states) share a single pickled gather.
+    Results follow :func:`sync_and_compute` semantics per metric: ``None`` on
+    non-recipient ranks."""
+    if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
+        raise ValueError(
+            "recipient_rank should be an integer or 'all', "
+            f"got {recipient_rank} instead."
+        )
+    world = _world_size()
+    if world == 1:
+        _logger.warning(
+            "World size is 1, and metric(s) not synced. "
+            "returning the input metric(s)."
+        )
+        return {name: m.compute() for name, m in metrics.items()} or None
+    for m in metrics.values():
+        m._prepare_for_merge_state()
+    obj_lane = {k: m for k, m in metrics.items() if _needs_object_sync(m)}
+    arr_lane = {k: m for k, m in metrics.items() if k not in obj_lane}
+    gathered = _gather_collection_states(arr_lane) if arr_lane else None
+    obj_gathered = (
+        _allgather_object(
+            {k: _tree_to_host(m.state_dict()) for k, m in obj_lane.items()}
+        )
+        if obj_lane
+        else None
+    )
+    if recipient_rank != "all" and _process_index() != recipient_rank:
+        return None
     out: Dict[str, Any] = {}
-    for name, metric in metrics.items():
-        result = sync_and_compute(metric, recipient_rank)
-        if result is not None:
-            out[name] = result
+    for name, metric in arr_lane.items():
+        synced = get_synced_metric(
+            metric,
+            recipient_rank,
+            _gathered=[g[name] for g in gathered],
+        )
+        if synced is not None:
+            out[name] = synced.compute()
+    for name, metric in obj_lane.items():
+        replicas = []
+        for rank_payload in obj_gathered:
+            rep = clone_metric(metric)
+            rep.load_state_dict(_tree_to_device(rank_payload[name]))
+            replicas.append(rep)
+        out[name] = replicas[0].merge_state(replicas[1:]).compute()
     return out or None
