@@ -1,0 +1,122 @@
+"""Generate per-module API reference pages from docstrings.
+
+The reference ships Sphinx ``automodule`` pages for every module
+(``/root/reference/docs/source/index.rst:1-27``, ``torcheval.metrics.rst``).
+This is the equivalent without a Sphinx dependency (not in this image): walk
+the public surface with ``inspect`` and emit one markdown page per module
+under ``docs/api/``, plus an index.
+
+Usage:
+    python docs/generate_api.py          # (re)write docs/api/*.md
+    python docs/generate_api.py --check  # exit 1 if pages are stale (CI)
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "docs", "api")
+
+MODULES = [
+    "torcheval_tpu.metrics",
+    "torcheval_tpu.metrics.functional",
+    "torcheval_tpu.metrics.toolkit",
+    "torcheval_tpu.metrics.collection",
+    "torcheval_tpu.metrics.deferred",
+    "torcheval_tpu.parallel",
+    "torcheval_tpu.tools",
+    "torcheval_tpu.ops",
+    "torcheval_tpu.utils.test_utils",
+]
+
+
+def _signature(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _doc(obj) -> str:
+    return inspect.getdoc(obj) or "*(no docstring)*"
+
+
+def _member_page(name: str, obj) -> list:
+    lines = []
+    if inspect.isclass(obj):
+        lines.append(f"### class `{name}{_signature(obj)}`\n")
+        lines.append(_doc(obj) + "\n")
+        for mname, meth in sorted(vars(obj).items()):
+            if mname.startswith("_") or not callable(meth):
+                continue
+            fn = inspect.unwrap(getattr(obj, mname, meth))
+            lines.append(f"#### `{name}.{mname}{_signature(fn)}`\n")
+            lines.append(_doc(fn) + "\n")
+    elif callable(obj):
+        lines.append(f"### `{name}{_signature(obj)}`\n")
+        lines.append(_doc(obj) + "\n")
+    return lines
+
+
+def render_module(modname: str) -> str:
+    mod = importlib.import_module(modname)
+    exported = getattr(mod, "__all__", None)
+    if exported is None:
+        exported = [
+            n
+            for n, o in sorted(vars(mod).items())
+            if not n.startswith("_")
+            and (inspect.isclass(o) or inspect.isfunction(o))
+            and getattr(o, "__module__", "").startswith("torcheval_tpu")
+        ]
+    lines = [f"# `{modname}`\n", _doc(mod) + "\n", "---\n"]
+    for name in exported:
+        obj = getattr(mod, name, None)
+        if obj is None:
+            continue
+        lines.extend(_member_page(name, obj))
+    return "\n".join(lines) + "\n"
+
+
+def render_index() -> str:
+    lines = [
+        "# API reference\n",
+        "Generated from docstrings by `docs/generate_api.py` "
+        "(the Sphinx-automodule equivalent for this tree; regenerate after "
+        "changing public surface).\n",
+    ]
+    for modname in MODULES:
+        fname = modname.replace(".", "_") + ".md"
+        lines.append(f"- [`{modname}`]({fname})")
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    check = "--check" in sys.argv
+    os.makedirs(OUT, exist_ok=True)
+    pages = {"index.md": render_index()}
+    for modname in MODULES:
+        pages[modname.replace(".", "_") + ".md"] = render_module(modname)
+    stale = []
+    for fname, content in pages.items():
+        path = os.path.join(OUT, fname)
+        old = open(path).read() if os.path.exists(path) else None
+        if old != content:
+            stale.append(fname)
+            if not check:
+                with open(path, "w") as f:
+                    f.write(content)
+    if check and stale:
+        print(f"stale API pages: {stale}; run python docs/generate_api.py")
+        return 1
+    print(f"{'checked' if check else 'wrote'} {len(pages)} pages under docs/api/")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

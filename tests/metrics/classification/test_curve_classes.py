@@ -2,6 +2,7 @@
 
 import unittest
 
+import jax.numpy as jnp
 import numpy as np
 from sklearn.metrics import (
     average_precision_score,
@@ -38,7 +39,7 @@ class TestBinaryAUROCClass(MetricClassTester):
         x, t = _binary_data()
         self.run_class_implementation_tests(
             metric=BinaryAUROC(),
-            state_names={"inputs", "targets", "summary_scores", "summary_tp", "summary_fp"},
+            state_names={"inputs", "targets", "summary_scores", "summary_tp", "summary_fp", "summary_nan_dropped"},
             update_kwargs={"input": x, "target": t},
             compute_result=roc_auc_score(t.reshape(-1), x.reshape(-1)),
         )
@@ -52,7 +53,7 @@ class TestBinaryAUPRCClass(MetricClassTester):
         x, t = _binary_data()
         self.run_class_implementation_tests(
             metric=BinaryAUPRC(),
-            state_names={"inputs", "targets", "summary_scores", "summary_tp", "summary_fp"},
+            state_names={"inputs", "targets", "summary_scores", "summary_tp", "summary_fp", "summary_nan_dropped"},
             update_kwargs={"input": x, "target": t},
             compute_result=average_precision_score(t.reshape(-1), x.reshape(-1)),
         )
@@ -322,3 +323,46 @@ class TestCurveClassErrorPaths(unittest.TestCase):
         m2 = BinaryNormalizedEntropy(num_tasks=2)
         with self.assertRaisesRegex(ValueError, "num_tasks"):
             m2.update(np.zeros(4), np.zeros(4))
+
+
+class TestCompactionNanFlag(unittest.TestCase):
+    def test_nan_scores_raise_at_compute(self):
+        # NaN samples reaching a compaction are recorded device-side and
+        # raised at compute() (round 3: the per-compaction host check became
+        # a deferred flag)
+        m = BinaryAUROC(compaction_threshold=10)
+        x = np.linspace(0, 1, 20).astype(np.float32)
+        x[3] = np.nan
+        m.update(jnp.asarray(x), jnp.asarray((x > 0.5).astype(np.float32)))
+        with self.assertRaisesRegex(ValueError, "NaN scores reached"):
+            m.compute()
+
+    def test_nan_flag_survives_state_dict_roundtrip(self):
+        m = BinaryAUROC(compaction_threshold=10)
+        x = np.linspace(0, 1, 20).astype(np.float32)
+        x[3] = np.nan
+        m.update(jnp.asarray(x), jnp.asarray((x > 0.5).astype(np.float32)))
+        fresh = BinaryAUROC(compaction_threshold=10)
+        fresh.load_state_dict(m.state_dict())
+        with self.assertRaisesRegex(ValueError, "NaN scores reached"):
+            fresh.compute()
+
+    def test_clean_stream_never_syncs_at_compute(self):
+        m = BinaryAUROC(compaction_threshold=10)
+        x = np.linspace(0, 1, 25).astype(np.float32)
+        m.update(jnp.asarray(x), jnp.asarray((x > 0.5).astype(np.float32)))
+        v1 = float(m.compute())
+        self.assertTrue(m._nan_checked)  # second compute skips the host read
+        v2 = float(m.compute())
+        self.assertEqual(v1, v2)
+
+    def test_nan_flag_raises_on_every_compute(self):
+        # a swallowed first error must not yield silent NaN-dropped results
+        m = BinaryAUROC(compaction_threshold=4)
+        m.update(
+            jnp.asarray(np.array([0.1, np.nan, 0.3, 0.4], np.float32)),
+            jnp.asarray(np.array([0, 1, 0, 1], np.float32)),
+        )
+        for _ in range(2):
+            with self.assertRaisesRegex(ValueError, "NaN scores reached"):
+                m.compute()

@@ -332,9 +332,13 @@ class TestCompactNanHandling(unittest.TestCase):
     def test_compacting_metric_raises_on_nan_scores(self):
         from torcheval_tpu.metrics import BinaryAUROC
 
+        # round 3: the NaN check is a device-side flag raised at compute()
+        # (the per-compaction host read serialized the pipeline); update()
+        # itself stays non-blocking
         m = BinaryAUROC(compaction_threshold=4)
+        m.update(
+            np.array([0.1, np.nan, 0.3, 0.4], np.float32),
+            np.array([0, 1, 0, 1], np.float32),
+        )
         with self.assertRaisesRegex(ValueError, "NaN"):
-            m.update(
-                np.array([0.1, np.nan, 0.3, 0.4], np.float32),
-                np.array([0, 1, 0, 1], np.float32),
-            )
+            m.compute()

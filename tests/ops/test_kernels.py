@@ -80,8 +80,10 @@ class TestClassCounts(unittest.TestCase):
         # huge virtual one-hot: unweighted goes to sort, weighted to scatter
         self.assertEqual(_pick_method(1_000_000, 10_000, "auto", False), "sort")
         self.assertEqual(_pick_method(1_000_000, 10_000, "auto", True), "scatter")
-        # n >= 2**24 would overflow exact f32 accumulation in one batch
-        self.assertEqual(_pick_method(1 << 24, 2, "auto", False), "sort")
+        # counts up to 2**24 INCLUSIVE are f32-exact (ADVICE r02 off-by-one):
+        # the boundary batch keeps the fast lowering, one past it does not
+        self.assertEqual(_pick_method(1 << 24, 2, "auto", False), "matmul")
+        self.assertEqual(_pick_method((1 << 24) + 1, 2, "auto", False), "sort")
 
     def test_unknown_method_rejected(self):
         with self.assertRaisesRegex(ValueError, "method must be one of"):
@@ -101,25 +103,20 @@ class TestClassCounts(unittest.TestCase):
         with mock.patch.object(
             confusion.jax, "default_backend", return_value="tpu"
         ):
-            # pallas_call has no GSPMD partitioning rule: this 8-device
-            # world must NOT route auto to pallas even on a "tpu" backend
-            self.assertNotEqual(
+            # since round 3 the kernel carries a custom_partitioning GSPMD
+            # rule (per-shard VMEM histograms + psum), so the auto-pick fires
+            # on ANY world size of a tpu backend
+            self.assertEqual(
                 confusion._pick_method(big_n, 1000, "auto", False), "pallas"
             )
-            with mock.patch.object(
-                confusion.jax, "devices", return_value=[object()]
-            ):
-                self.assertEqual(
-                    confusion._pick_method(big_n, 1000, "auto", False), "pallas"
-                )
-                # small workloads and weighted counts keep the XLA lowerings
-                self.assertEqual(
-                    confusion._pick_method(1_000_000, 1000, "auto", False),
-                    "matmul",
-                )
-                self.assertEqual(
-                    confusion._pick_method(big_n, 1000, "auto", True), "scatter"
-                )
+            # small workloads and weighted counts keep the XLA lowerings
+            self.assertEqual(
+                confusion._pick_method(1_000_000, 1000, "auto", False),
+                "matmul",
+            )
+            self.assertEqual(
+                confusion._pick_method(big_n, 1000, "auto", True), "scatter"
+            )
 
     def test_weighted(self):
         labels = RNG.integers(0, 5, 100)
@@ -274,3 +271,70 @@ class TestParallelHelpers(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestShardedPallasHistogram(unittest.TestCase):
+    """The custom_partitioning GSPMD rule: per-shard VMEM histograms + one
+    psum, with the sharded operand never re-gathered (round-2 verdict #5)."""
+
+    def _mesh(self):
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(jax.devices()), ("data",))
+
+    def test_sharded_counts_match_bincount(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from torcheval_tpu.ops.pallas_hist import sharded_pallas_class_counts
+
+        mesh = self._mesh()
+        n, c = 8 * 1000, 37
+        labels = np.random.default_rng(0).integers(0, c, n).astype(np.int32)
+        sharded = jax.device_put(
+            jnp.asarray(labels), NamedSharding(mesh, P("data"))
+        )
+        fn = jax.jit(
+            lambda ls: sharded_pallas_class_counts(ls, c, True),
+            in_shardings=NamedSharding(mesh, P("data")),
+        )
+        out = fn(sharded)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.bincount(labels, minlength=c)
+        )
+
+    def test_sharded_operand_not_gathered(self):
+        # the compiled program must reduce per-shard results (all-reduce),
+        # never all-gather the sample operand onto one device
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from torcheval_tpu.ops.pallas_hist import sharded_pallas_class_counts
+
+        mesh = self._mesh()
+        n, c = 8 * 1024, 16
+        fn = jax.jit(
+            lambda ls: sharded_pallas_class_counts(ls, c, True),
+            in_shardings=NamedSharding(mesh, P("data")),
+        )
+        hlo = fn.lower(
+            jax.ShapeDtypeStruct((n,), jnp.int32)
+        ).compile().as_text()
+        self.assertNotIn("all-gather", hlo)
+        self.assertIn("all-reduce", hlo)
+
+    def test_replicated_operand_single_count(self):
+        # replicated input: no psum (counts would multiply by world size)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from torcheval_tpu.ops.pallas_hist import sharded_pallas_class_counts
+
+        mesh = self._mesh()
+        n, c = 2048, 9
+        labels = np.random.default_rng(1).integers(0, c, n).astype(np.int32)
+        repl = jax.device_put(jnp.asarray(labels), NamedSharding(mesh, P()))
+        fn = jax.jit(
+            lambda ls: sharded_pallas_class_counts(ls, c, True),
+            in_shardings=NamedSharding(mesh, P()),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fn(repl)), np.bincount(labels, minlength=c)
+        )

@@ -23,6 +23,7 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _auroc_update_input_check,
 )
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.ops.curves import (
     binary_auprc_counts_kernel,
     binary_auprc_kernel,
@@ -35,6 +36,19 @@ from torcheval_tpu.utils.devices import DeviceLike
 
 def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
+
+
+# compaction buffers pad to a multiple of 4M rows once past 4M (power of two
+# below): bounds the compiled-shape count like pow2 rounding, but with <= 3.6%
+# padding waste at the 1B bench's working size instead of pow2's worst-case
+# ~2x (sorting pad rows is pure thrown-away bandwidth)
+_PAD_GRANULE = 1 << 22
+
+
+def _pad_cap(n: int) -> int:
+    if n <= _PAD_GRANULE:
+        return _next_pow2(n)
+    return ((n + _PAD_GRANULE - 1) // _PAD_GRANULE) * _PAD_GRANULE
 
 
 @jax.jit
@@ -87,17 +101,24 @@ def _auprc_from_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp):
     )
 
 
-@partial(jax.jit, static_argnums=5)
-def _compact_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp, cap: int):
-    """Fold + pad-to-cap + compact in one traced program (cold path, but a
-    single dispatch keeps sharded caches on the mesh end to end)."""
+@partial(jax.jit, static_argnums=6)
+def _compact_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp, nan_acc, cap: int):
+    """Fold + pad-to-cap + compact in ONE traced program (cold path, but a
+    single dispatch keeps sharded caches on the mesh end to end).
+
+    Returns ``(s, tp, fp, n_unique, nan_acc')``. The NaN-sample count folds
+    into a device-side accumulator instead of being host-checked here: round
+    2's ``int(nan_dropped)`` read per compaction cost a tunnel RTT and a
+    pipeline drain each time; the flag is now raised once, at ``compute()``.
+    """
     s, tp, fp = _combined_counts(raw_s, raw_t, sum_s, sum_tp, sum_fp)
     n = s.shape[0]
     if cap > n:
         s = jnp.concatenate([s, jnp.full((cap - n,), PAD_SCORE, s.dtype)])
         tp = jnp.concatenate([tp, jnp.zeros((cap - n,), jnp.int32)])
         fp = jnp.concatenate([fp, jnp.zeros((cap - n,), jnp.int32)])
-    return compact_counts(s, tp, fp)
+    s, tp, fp, n_unique, nan_dropped = compact_counts(s, tp, fp)
+    return s, tp, fp, n_unique, nan_acc + nan_dropped
 
 
 class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
@@ -127,11 +148,19 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
             )
         self._compaction_threshold = compaction_threshold
         self._cached_samples = 0
+        self._nan_checked = True  # no compactions yet -> nothing to check
         self._add_cache_state("inputs")
         self._add_cache_state("targets")
         self._add_cache_state("summary_scores")
         self._add_cache_state("summary_tp")
         self._add_cache_state("summary_fp")
+        # device-side count of NaN-scored samples that reached a compaction;
+        # checked (and raised on) at compute() instead of per compaction
+        self._add_state(
+            "summary_nan_dropped",
+            jnp.zeros((), dtype=jnp.int32),
+            reduction=Reduction.SUM,
+        )
 
     def update(self, input, target) -> "_BinaryCurveMetric":
         input, target = self._input(input), self._input(target)
@@ -150,41 +179,65 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
     def _compact(self) -> None:
         """Fold raw cache + summary into one padded unique-threshold summary.
 
-        One jitted program (fold + pad + compact); the buffer is padded to the
-        next power of two so XLA compiles O(log) distinct shapes over a
-        metric's lifetime, not one per chunk size.
+        One jitted program (fold + pad + compact); the buffer is padded to a
+        4M-row granule (pow2 below that) so XLA compiles a bounded set of
+        shapes over a metric's lifetime, not one per chunk size.
+
+        The one remaining host read — ``int(n_unique)`` for the adaptive trim
+        that keeps low-cardinality streams on small buffers — is prefetched
+        with ``copy_to_host_async`` immediately after dispatch, so it costs
+        the compaction kernel's own latency (which any consumer of the
+        summary pays regardless), not an extra tunnel round trip on top. The
+        NaN-sample check that used to be a second host read per compaction is
+        a device-side accumulator raised at :meth:`compute`.
         """
         n = sum(int(a.shape[0]) for a in self.inputs) + sum(
             int(a.shape[0]) for a in self.summary_scores
         )
         if n == 0:
             return
-        s, tp, fp, n_unique, nan_dropped = _compact_parts(
+        s, tp, fp, n_unique, nan_acc = _compact_parts(
             self.inputs,
             self.targets,
             self.summary_scores,
             self.summary_tp,
             self.summary_fp,
-            _next_pow2(n),
+            self.summary_nan_dropped,
+            _pad_cap(n),
         )
-        if int(nan_dropped):
-            raise ValueError(
-                f"{int(nan_dropped)} sample(s) with NaN scores reached "
-                "compaction; NaN is the summary padding sentinel and such "
-                "samples cannot be represented (the uncompacted metric would "
-                "count them). Filter NaNs before update() or use "
-                "compaction_threshold=None."
-            )
-        # trim to the tightest power of two that holds the unique rows, so a
-        # low-cardinality stream keeps a small buffer (host sync once per
-        # compaction — the cold path)
-        keep = min(s.shape[0], _next_pow2(max(int(n_unique), 1)))
+        try:
+            n_unique.copy_to_host_async()
+        except AttributeError:
+            pass
+        self.summary_nan_dropped = nan_acc
+        self._nan_checked = False
+        keep = min(s.shape[0], _pad_cap(max(int(n_unique), 1)))
         self.inputs = []
         self.targets = []
         self.summary_scores = [s[:keep]]
         self.summary_tp = [tp[:keep]]
         self.summary_fp = [fp[:keep]]
         self._cached_samples = 0
+
+    def _check_nan_flag(self) -> None:
+        """Raise (uniformly, at compute time) if NaN-scored samples ever
+        reached a compaction. One host read of an int32 scalar, skipped when
+        no compaction has happened since the last check."""
+        if self._nan_checked:
+            return
+        dropped = int(self.summary_nan_dropped)
+        # only a CLEAN check is cached: poisoned state must keep raising on
+        # every compute, not just the first (an eval loop that swallows one
+        # error must not silently get NaN-dropped results afterwards)
+        self._nan_checked = dropped == 0
+        if dropped:
+            raise ValueError(
+                f"{dropped} sample(s) with NaN scores reached compaction; "
+                "NaN is the summary padding sentinel and such samples cannot "
+                "be represented (the uncompacted metric would count them). "
+                "Filter NaNs before update() or use "
+                "compaction_threshold=None."
+            )
 
     def _prepare_for_merge_state(self) -> None:
         # compacting metrics ship their bounded summary (one buffer per
@@ -215,17 +268,27 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
             self._compact()
 
     def merge_state(self, metrics):
+        metrics = list(metrics)
         super().merge_state(metrics)
+        for metric in metrics:
+            # the cache base merges only list states; the scalar NaN flag is
+            # additive across replicas
+            self.summary_nan_dropped = self.summary_nan_dropped + jax.device_put(
+                metric.summary_nan_dropped, self.device
+            )
+        self._nan_checked = False
         self._recount_cache()
         return self
 
     def reset(self):
         super().reset()
         self._cached_samples = 0
+        self._nan_checked = True  # flag state re-zeroed by reset
         return self
 
     def load_state_dict(self, state_dict, strict: bool = True) -> None:
         super().load_state_dict(state_dict, strict)
+        self._nan_checked = False  # loaded state may carry a nonzero flag
         self._recount_cache()
 
 
@@ -244,13 +307,17 @@ class BinaryAUROC(_BinaryCurveMetric):
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.5)
-        return _auroc_from_parts(
+        result = _auroc_from_parts(
             self.inputs,
             self.targets,
             self.summary_scores,
             self.summary_tp,
             self.summary_fp,
         )
+        # after dispatching the curve kernel, so the flag read (one host
+        # scalar) overlaps with it instead of stalling in front of it
+        self._check_nan_flag()
+        return result
 
 
 class BinaryAUPRC(_BinaryCurveMetric):
@@ -262,10 +329,12 @@ class BinaryAUPRC(_BinaryCurveMetric):
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.0)
-        return _auprc_from_parts(
+        result = _auprc_from_parts(
             self.inputs,
             self.targets,
             self.summary_scores,
             self.summary_tp,
             self.summary_fp,
         )
+        self._check_nan_flag()
+        return result

@@ -110,7 +110,19 @@ def _put_leaf(value, device, *, strict_layout: bool = False):
                 "(jax.make_array_from_process_local_data / a jitted "
                 "computation with the right out_shardings) instead."
             )
-        host = np.asarray(value)
+        try:
+            host = np.asarray(value)
+        except RuntimeError as e:
+            # a non-addressable global array from a DIFFERENT mesh cannot be
+            # read on this host; np.asarray's RuntimeError names none of that
+            raise ValueError(
+                f"cannot place a global array (sharding "
+                f"{getattr(value, 'sharding', None)}) onto {device}: its "
+                "shards are not addressable from this process and cross-host "
+                "transfers are not available. Build the value on the target "
+                "mesh (jax.make_array_from_process_local_data / a jitted "
+                "computation with the right out_shardings) instead."
+            ) from e
         return jax.make_array_from_callback(
             host.shape, device, lambda idx: host[idx]
         )

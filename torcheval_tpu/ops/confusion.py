@@ -8,7 +8,7 @@ so the TPU-first design offers four lowerings and picks by size:
 * ``matmul`` — weights-vector × one-hot matrix product. The one-hot is
   ``labels[:, None] == iota`` fused by XLA into the dot; the contraction rides
   the MXU. Exact for integer-valued weights below 2**24 per batch (float32
-  accumulation).
+  accumulation; every integer count <= 2**24 is f32-exact).
 * ``sort`` — sort labels, then per-class run lengths via binary search of the
   class edges into the sorted array. O(N log N) but bandwidth-friendly;
   unweighted only. Wins when the virtual one-hot gets huge.
@@ -64,24 +64,24 @@ def _pick_method(n: int, num_classes: int, method: str, weighted: bool) -> str:
         raise ValueError(f"method must be one of {_METHODS}, got {method!r}.")
     if method != "auto":
         return method
-    # n < 2**24 keeps unweighted per-class counts (≤ n) exact in the float32
-    # accumulator; weighted exactness is the caller's documented contract, so
-    # the same bound is applied as a proxy for "sum of weights stays small"
+    # n <= 2**24 keeps unweighted per-class counts (each <= n, and 2**24
+    # itself is f32-exact) exact in the float32 accumulator; weighted
+    # exactness is the caller's documented contract, so the same bound is
+    # applied as a proxy for "sum of weights stays small"
     if (
         not weighted
-        and n < (1 << 24)
+        and n <= (1 << 24)
         and n * num_classes >= _PALLAS_ELEMENT_MIN
         and jax.default_backend() == "tpu"
-        and len(jax.devices()) == 1
     ):
-        # single-device worlds only: pallas_call has no GSPMD partitioning
-        # rule, so on a mesh it would force replicating a sharded operand —
-        # multi-chip sticks with the partitionable XLA lowerings (the
-        # ShardedEvaluator psum design). The lowering itself is further
-        # platform-dispatched in class_counts so a CPU-committed array on a
-        # TPU host takes the sort path instead of a Mosaic kernel.
+        # any world size: the kernel carries a custom_partitioning GSPMD rule
+        # (ops/pallas_hist.py — per-shard VMEM histograms + one psum over the
+        # sample-axis mesh axes), so a sharded operand is never re-gathered.
+        # The lowering is further platform-dispatched in class_counts so a
+        # CPU-committed array on a TPU host takes the sort path instead of a
+        # Mosaic kernel.
         return "pallas"
-    if n * num_classes <= _MATMUL_ELEMENT_BUDGET and n < (1 << 24):
+    if n * num_classes <= _MATMUL_ELEMENT_BUDGET and n <= (1 << 24):
         return "matmul"
     # sort path is unweighted-only; weighted over-budget falls to scatter
     return "scatter" if weighted else "sort"
@@ -128,7 +128,7 @@ def class_counts(
     if resolved == "pallas":
         if weights is not None:
             raise ValueError("method='pallas' supports only unweighted counts.")
-        from torcheval_tpu.ops.pallas_hist import pallas_class_counts
+        from torcheval_tpu.ops.pallas_hist import sharded_pallas_class_counts
 
         if method == "auto":
             # dispatch per LOWERING platform, not per process default: a
@@ -136,14 +136,14 @@ def class_counts(
             # not a Mosaic kernel it cannot compile
             return jax.lax.platform_dependent(
                 labels,
-                tpu=lambda ls: pallas_class_counts(
-                    ls, num_classes, interpret=False
+                tpu=lambda ls: sharded_pallas_class_counts(
+                    ls, num_classes, False
                 ).astype(w.dtype),
                 default=_sort_counts,
             )
         interpret = jax.default_backend() != "tpu"
-        return pallas_class_counts(
-            labels, num_classes, interpret=interpret
+        return sharded_pallas_class_counts(
+            labels, num_classes, interpret
         ).astype(w.dtype)
     if resolved == "sort":
         if weights is not None:
@@ -177,7 +177,7 @@ def confusion_matrix_counts(
     * ``T^T @ P`` where T/P are (N, C) one-hot matrices in bfloat16 (0/1 are
       exact in bf16) accumulated in float32 — the contraction over samples
       rides the MXU. Measured 20× faster than scatter at C=100 and still
-      ahead at (N=100k, C=1000); exact while every cell count < 2**24.
+      ahead at (N=100k, C=1000); exact while every cell count <= 2**24.
     * a single O(N) flat scatter on the joint index ``t * C + p`` for larger
       volumes, where the MAC count outgrows the MXU win.
 
@@ -190,12 +190,13 @@ def confusion_matrix_counts(
     p = pred.astype(jnp.int32)
     t = target.astype(jnp.int32)
     n = p.shape[0]
-    # n < 2**24 keeps every cell count (≤ n) exactly representable in the
-    # float32 accumulator; bigger batches take the integer scatter
+    # n <= 2**24 keeps every cell count (each <= n, and 2**24 itself is
+    # f32-exact) exactly representable in the float32 accumulator; bigger
+    # batches take the integer scatter
     if (
         n * num_classes * num_classes <= _CONFUSION_MATMUL_BUDGET
         and n * num_classes <= _CONFUSION_MATMUL_ONEHOT_ELEMS
-        and n < (1 << 24)
+        and n <= (1 << 24)
     ):
         classes = jnp.arange(num_classes, dtype=jnp.int32)[None, :]
         t_onehot = (t[:, None] == classes).astype(jnp.bfloat16)

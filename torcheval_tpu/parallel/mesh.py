@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _logger = logging.getLogger(__name__)
 _warned_uneven_batch = False
+_warned_replicated_global = False
 
 
 def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -59,13 +60,35 @@ def shard_batch(mesh: Mesh, *arrays: jax.Array):
         return target_cache[ndim]
 
     def _already_placed(a) -> bool:
+        global _warned_replicated_global
         if not isinstance(a, jax.Array):
             return False
         if multiprocess:
             # multi-process: any global array on this mesh is accepted as-is
             # (re-placing would need a cross-host transfer); layout is the
             # caller's choice via make_array_from_process_local_data
-            return getattr(a.sharding, "device_set", None) == mesh_devices
+            on_mesh = getattr(a.sharding, "device_set", None) == mesh_devices
+            if (
+                on_mesh
+                and not _warned_replicated_global
+                and a.ndim
+                and a.sharding.is_equivalent_to(
+                    NamedSharding(mesh, P(*([None] * a.ndim))), a.ndim
+                )
+            ):
+                # the single-controller path re-places replicated batches to
+                # P('data') for exactly this reason; here that would need a
+                # cross-host transfer, so warn instead of silently letting
+                # every device process the full batch
+                _warned_replicated_global = True
+                _logger.warning(
+                    "shard_batch: received a fully-replicated global batch in "
+                    "a multi-process world; every device will process the "
+                    "whole batch. Build data-sharded input with "
+                    "jax.make_array_from_process_local_data(NamedSharding("
+                    "mesh, P('data', ...)), local_shard). (warned once)"
+                )
+            return on_mesh
         # single-controller: bypass ONLY when the array already has the
         # target data sharding — a replicated array must still be re-placed
         # to P("data") or every device would process the full batch
