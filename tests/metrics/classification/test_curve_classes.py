@@ -366,3 +366,32 @@ class TestCompactionNanFlag(unittest.TestCase):
         for _ in range(2):
             with self.assertRaisesRegex(ValueError, "NaN scores reached"):
                 m.compute()
+
+    def test_nan_counter_does_not_recount_on_recompaction(self):
+        # the dropped NaN row's counts must not persist in the stored
+        # summary: repeated compactions keep the counter at exactly 1 and
+        # the clean samples' totals uncorrupted (round-3 review finding)
+        m = BinaryAUROC(compaction_threshold=4)
+        x = np.array([0.1, np.nan, 0.3, 0.4], np.float32)
+        t = np.array([0, 1, 0, 1], np.float32)
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        for _ in range(3):  # force re-compactions over the stored summary
+            m._compact()
+        self.assertEqual(int(m.summary_nan_dropped), 1)
+        self.assertEqual(int(sum(np.asarray(a).sum() for a in m.summary_tp)), 1)
+
+    def test_synced_clone_with_installed_flag_raises(self):
+        # a clone that never compacted locally must still raise when a
+        # nonzero flag is INSTALLED into it (the toolkit sync path)
+        import copy
+
+        src = BinaryAUROC(compaction_threshold=4)
+        x = np.array([0.1, np.nan, 0.3, 0.4], np.float32)
+        src.update(jnp.asarray(x), jnp.asarray((x > 0.2).astype(np.float32)))
+        clean = BinaryAUROC(compaction_threshold=4)
+        clean.update(jnp.asarray(x[:1]), jnp.asarray(np.ones(1, np.float32)))
+        self.assertTrue(clean._nan_checked)  # never compacted: clean cache
+        synced = copy.deepcopy(clean)
+        synced._set_states({"summary_nan_dropped": src.summary_nan_dropped})
+        with self.assertRaisesRegex(ValueError, "NaN scores reached"):
+            synced.compute()

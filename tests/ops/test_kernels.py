@@ -338,3 +338,59 @@ class TestShardedPallasHistogram(unittest.TestCase):
         np.testing.assert_array_equal(
             np.asarray(fn(repl)), np.bincount(labels, minlength=c)
         )
+
+
+class TestMatchTripleCounts(unittest.TestCase):
+    """Both lanes of the F1/precision/recall sufficient-statistic kernel."""
+
+    def _oracle(self, pred, target, c):
+        tp = np.bincount(target[pred == target], minlength=c)
+        label = np.bincount(target, minlength=c)
+        prd = np.bincount(pred, minlength=c)
+        return tp, label, prd
+
+    def test_matmul_lane(self):
+        from torcheval_tpu.ops.confusion import match_triple_counts
+
+        c = 11
+        pred = RNG.integers(0, c, 500).astype(np.int32)
+        target = RNG.integers(0, c, 500).astype(np.int32)
+        got = match_triple_counts(jnp.asarray(pred), jnp.asarray(target), c)
+        for g, w in zip(got, self._oracle(pred, target, c)):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+    def test_joint_sort_lane(self):
+        # force the over-budget branch by shrinking the matmul budget
+        from unittest import mock
+
+        from torcheval_tpu.ops import confusion
+
+        c = 11
+        pred = RNG.integers(0, c, 500).astype(np.int32)
+        target = RNG.integers(0, c, 500).astype(np.int32)
+        with mock.patch.object(confusion, "_MATMUL_ELEMENT_BUDGET", 1):
+            got = confusion.match_triple_counts.__wrapped__(
+                jnp.asarray(pred), jnp.asarray(target), c
+            )
+        for g, w in zip(got, self._oracle(pred, target, c)):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+    def test_joint_sort_lane_drops_out_of_range(self):
+        from unittest import mock
+
+        from torcheval_tpu.ops import confusion
+
+        c = 5
+        pred = np.asarray([0, 1, 2, 9, -1], np.int32)
+        target = np.asarray([0, 1, 3, -2, 7], np.int32)
+        with mock.patch.object(confusion, "_MATMUL_ELEMENT_BUDGET", 1):
+            got = confusion.match_triple_counts.__wrapped__(
+                jnp.asarray(pred), jnp.asarray(target), c
+            )
+        valid_t = (target >= 0) & (target < c)
+        valid_p = (pred >= 0) & (pred < c)
+        tp = np.bincount(target[(pred == target) & valid_t], minlength=c)
+        label = np.bincount(target[valid_t], minlength=c)
+        prd = np.bincount(pred[valid_p], minlength=c)
+        for g, w in zip(got, (tp, label, prd)):
+            np.testing.assert_array_equal(np.asarray(g), w)

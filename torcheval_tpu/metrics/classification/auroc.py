@@ -219,6 +219,14 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         self.summary_fp = [fp[:keep]]
         self._cached_samples = 0
 
+    def _set_states(self, values) -> None:
+        # ANY state installation (merge, load, toolkit sync via
+        # clone+_set_states) may bring in a nonzero NaN flag from another
+        # replica — a cached clean check must not survive it
+        super()._set_states(values)
+        if "summary_nan_dropped" in values:
+            self._nan_checked = False
+
     def _check_nan_flag(self) -> None:
         """Raise (uniformly, at compute time) if NaN-scored samples ever
         reached a compaction. One host read of an int32 scalar, skipped when

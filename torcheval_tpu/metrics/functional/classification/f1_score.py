@@ -17,7 +17,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.ops.confusion import class_counts
+from torcheval_tpu.ops.confusion import match_triple_counts
 from torcheval_tpu.utils.convert import as_jax
 from torcheval_tpu.utils.tracing import async_value_warn
 
@@ -74,10 +74,11 @@ def _f1_score_update(
         num_tp = (input == target).sum(dtype=jnp.int32)
         n = jnp.asarray(target.shape[0], dtype=jnp.int32)
         return num_tp, n, n
-    correct = (input == target).astype(jnp.int32)
-    num_label = class_counts(target, num_classes)
-    num_prediction = class_counts(input, num_classes)
-    num_tp = class_counts(target, num_classes, correct)
+    # shared triple kernel: one joint-key sort covers tp+label at large N
+    # (ops/confusion.py::match_triple_counts)
+    num_tp, num_label, num_prediction = match_triple_counts(
+        input, target, num_classes
+    )
     return num_tp, num_label, num_prediction
 
 

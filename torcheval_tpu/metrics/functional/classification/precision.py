@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torcheval_tpu.ops.confusion import class_counts
+from torcheval_tpu.ops.confusion import match_triple_counts
 from torcheval_tpu.utils.convert import as_jax
 from torcheval_tpu.utils.tracing import async_value_warn
 
@@ -73,11 +73,12 @@ def _precision_update(
         num_tp = (input == target).sum(dtype=jnp.int32)
         num_fp = (input != target).sum(dtype=jnp.int32)
         return num_tp, num_fp, jnp.zeros((), dtype=jnp.int32)
-    correct = (input == target).astype(jnp.int32)
-    num_label = class_counts(target, num_classes)
-    num_tp = class_counts(target, num_classes, correct)
-    num_fp = class_counts(input, num_classes, 1 - correct)
-    return num_tp, num_fp, num_label
+    # shared triple kernel (ops/confusion.py::match_triple_counts);
+    # fp = predictions of a class minus its true positives
+    num_tp, num_label, num_pred = match_triple_counts(
+        input, target, num_classes
+    )
+    return num_tp, num_pred - num_tp, num_label
 
 
 @partial(jax.jit, static_argnames=("average",))

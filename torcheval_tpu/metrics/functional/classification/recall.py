@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torcheval_tpu.ops.confusion import class_counts
+from torcheval_tpu.ops.confusion import match_triple_counts
 from torcheval_tpu.utils.convert import as_jax
 from torcheval_tpu.utils.tracing import async_value_warn
 
@@ -74,10 +74,10 @@ def _recall_update(
         num_tp = (input == target).sum(dtype=jnp.int32)
         n = jnp.asarray(target.size, dtype=jnp.int32)
         return num_tp, n, n
-    correct = (input == target).astype(jnp.int32)
-    num_labels = class_counts(target, num_classes)
-    num_predictions = class_counts(input, num_classes)
-    num_tp = class_counts(target, num_classes, correct)
+    # shared triple kernel (ops/confusion.py::match_triple_counts)
+    num_tp, num_labels, num_predictions = match_triple_counts(
+        input, target, num_classes
+    )
     return num_tp, num_labels, num_predictions
 
 

@@ -162,6 +162,43 @@ def class_counts(
     )
 
 
+@partial(jax.jit, static_argnames=("num_classes",))
+def match_triple_counts(
+    pred: jax.Array, target: jax.Array, num_classes: int
+) -> tuple:
+    """``(num_tp, num_label, num_pred)`` per class — the sufficient-statistic
+    triple behind F1/precision/recall (reference scatter pattern:
+    ``f1_score.py:164-191``).
+
+    Small batches take three MXU one-hot contractions (XLA dedupes the
+    shared compares). Past the matmul budget, the naive formulation costs
+    two unweighted sorts plus a WEIGHTED count for tp — which has no sort
+    lowering and falls to the serialised scatter (~12 ms at N=1.3M on v5e).
+    Instead, tp and label fold into ONE unweighted sort over the joint key
+    ``2*target + (pred == target)``: label c's misses land in bin 2c, hits
+    in 2c+1, so ``num_label = bins[0::2] + bins[1::2]`` and
+    ``num_tp = bins[1::2]`` — two sorts total, no scatter (measured ~2x on
+    the config-3 shape).
+    """
+    p = pred.astype(jnp.int32)
+    t = target.astype(jnp.int32)
+    n = p.shape[0]
+    if n * num_classes <= _MATMUL_ELEMENT_BUDGET and n <= (1 << 24):
+        correct = (p == t).astype(jnp.int32)
+        return (
+            class_counts(t, num_classes, correct),
+            class_counts(t, num_classes),
+            class_counts(p, num_classes),
+        )
+    # joint-key lane: out-of-range targets produce out-of-range keys and
+    # drop, matching the class_counts contract
+    key = jnp.where(t >= 0, 2 * t + (p == t).astype(jnp.int32), -1)
+    bins = class_counts(key, 2 * num_classes)
+    num_tp = bins[1::2]
+    num_label = bins[0::2] + num_tp
+    return num_tp, num_label, class_counts(p, num_classes)
+
+
 @partial(jax.jit, static_argnames=("num_classes", "normalize"))
 def confusion_matrix_counts(
     pred: jax.Array,

@@ -93,5 +93,11 @@ def compact_counts(
     )
     keep = real & ~jnp.isnan(s)
     key = jnp.where(keep, s, PAD_SCORE)
+    # zero the counts of every non-kept row BEFORE they ride the second sort:
+    # a NaN-scored sample's deltas would otherwise survive in the padding
+    # block of the stored summary, re-counting into nan_dropped at every
+    # later compaction and leaking into the curve totals (round-3 review)
+    delta_tp = jnp.where(keep, delta_tp, 0)
+    delta_fp = jnp.where(keep, delta_fp, 0)
     neg2, tp_out, fp_out = jax.lax.sort((-key, delta_tp, delta_fp), num_keys=1)
     return -neg2, tp_out, fp_out, jnp.sum(keep.astype(jnp.int32)), nan_dropped
