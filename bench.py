@@ -218,12 +218,13 @@ def headline_scaled(total, label, thresh_mult):
     scores, labels, logits, binary = _headline_data(jax, BIG_CHUNK)
     n_chunks = total // BIG_CHUNK
     # per-leg threshold so the compaction path ACTUALLY FIRES on every leg
-    # this function claims to measure. Swept on-chip (2026-07-30), identical
-    # exact values at every setting: 1B leg (59 chunks) — 2x 33.8M, 6x 53.2M,
-    # 8x 36.7M preds/s -> 6x (compacts ~every 6 chunks; worst-case state ~7
-    # chunk-rows of (score, tp, fp) columns ≈ 1.4 GB). 100M leg (5 chunks) —
-    # 3x 68M with compaction firing; 6x would never compact and silently
-    # measure the raw full-cache path instead.
+    # this function claims to measure. Swept on-chip across rounds (identical
+    # exact values at every setting). Round-3 sweep after the granule-padding
+    # + sync-removal changes, 1B leg (59 chunks): 4x 56.9M, 5x 60.4M,
+    # 6x 64.6M, 8x 47.0M preds/s -> 6x stays the sweet spot (worst-case
+    # state ~6 chunk-rows + summary of (score, tp, fp) columns ≈ 1.3 GB).
+    # 100M leg (5 chunks): 3x so compaction fires; 6x would never compact and
+    # silently measure the raw full-cache path instead.
     assert thresh_mult < n_chunks, "compaction must fire within the leg"
     thresh = thresh_mult * BIG_CHUNK
 
@@ -277,9 +278,27 @@ def config1_simple_accuracy():
 
     _block(tpu())
     ref_s = _ref_time(ref)
-    _emit(
-        "config1_multiclass_accuracy_c5", n_batches * batch, _time_chain(tpu), ref_s
-    )
+    plain_s = _time_chain(tpu)
+    _emit("config1_multiclass_accuracy_c5", n_batches * batch, plain_s, ref_s)
+    # decomposition rows (round-2 verdict #2): split one plain-leg run into
+    # python/host time (dispatch returns, no barrier) and the device+queue
+    # remainder; env_dispatch_floor (last row of the bench) completes the
+    # (floor, python, device) triple
+    t0 = time.perf_counter()
+    out = tpu()
+    host_s = time.perf_counter() - t0
+    _block(out)
+    for name, val in (
+        ("config1_python_host_ms_per_run", host_s * 1e3),
+        ("config1_device_plus_env_ms_per_run", max(plain_s - host_s, 0.0) * 1e3),
+    ):
+        print(
+            json.dumps(
+                {"metric": name, "value": round(val, 2), "unit": "ms",
+                 "vs_baseline": None}
+            ),
+            flush=True,
+        )
 
     # collection path. Since round 3 counter metrics DEFER: update() is an
     # O(1) host append and the counting kernel folds the concatenated

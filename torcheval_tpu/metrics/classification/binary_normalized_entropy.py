@@ -58,12 +58,14 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
     def update(
         self, input, target, *, weight: Optional[jax.Array] = None
     ) -> "BinaryNormalizedEntropy":
+        raw_input = input
         input, target = self._input(input), self._input(target)
         if weight is not None:
             weight = self._input(weight)
         cross_entropy, num_positive, num_examples = (
             _binary_normalized_entropy_update(
-                input, target, self.from_logits, self.num_tasks, weight
+                input, target, self.from_logits, self.num_tasks, weight,
+                value_check_source=raw_input,
             )
         )
         self.total_entropy = self.total_entropy + cross_entropy

@@ -4,6 +4,12 @@
 The bounded-state streaming PR curve: counters of static shape
 ``(n_thresholds,)`` / ``(n_thresholds, num_classes)``, SUM-merged. This is
 the recommended PRC form for the TPU hot path and for distributed sync.
+
+Updates defer (``metrics/deferred.py``): the O(N·T) broadcast-compare kernel
+runs once over the concatenated pending batches instead of per update. The
+threshold grid is construction-time configuration, so it rides the fold's
+static params as a tuple and is rebuilt as an XLA constant inside the
+kernel.
 """
 
 from __future__ import annotations
@@ -12,7 +18,9 @@ from typing import Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     ThresholdSpec,
     _binary_binned_compute,
@@ -33,8 +41,28 @@ from torcheval_tpu.utils.devices import DeviceLike
 _COUNTER_NAMES = ("num_tp", "num_fp", "num_fn")
 
 
+def _threshold_fold_params(threshold) -> tuple:
+    """Hashable static encoding of the threshold grid for the fold's jit
+    cache key (rebuilt as an XLA constant inside the fold)."""
+    return tuple(float(t) for t in np.asarray(threshold))
+
+
+def _binary_binned_fold(input, target, thresholds):
+    tp, fp, fn = _binary_binned_update(
+        input, target, jnp.asarray(thresholds, jnp.float32)
+    )
+    return {"num_tp": tp, "num_fp": fp, "num_fn": fn}
+
+
+def _multiclass_binned_fold(input, target, thresholds, num_classes):
+    tp, fp, fn = _multiclass_binned_update(
+        input, target, jnp.asarray(thresholds, jnp.float32), num_classes
+    )
+    return {"num_tp": tp, "num_fp": fp, "num_fn": fn}
+
+
 class BinaryBinnedPrecisionRecallCurve(
-    Metric[Tuple[jax.Array, jax.Array, jax.Array]]
+    DeferredFoldMixin, Metric[Tuple[jax.Array, jax.Array, jax.Array]]
 ):
     """Streaming binary PR curve over fixed thresholds.
 
@@ -42,6 +70,8 @@ class BinaryBinnedPrecisionRecallCurve(
         threshold: bin count (int → ``linspace(0, 1)``), list, or array of
             sorted thresholds in ``[0, 1]``.
     """
+
+    _fold_fn = staticmethod(_binary_binned_fold)
 
     def __init__(
         self, *, threshold: ThresholdSpec = 100, device: DeviceLike = None
@@ -58,17 +88,17 @@ class BinaryBinnedPrecisionRecallCurve(
             self._add_state(
                 name, jnp.zeros((n,), dtype=jnp.int32), reduction=Reduction.SUM
             )
+        self._init_deferred()
+        self._fold_params = (_threshold_fold_params(threshold),)
 
     def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
         _binary_precision_recall_curve_update_input_check(input, target)
-        tp, fp, fn = _binary_binned_update(input, target, self.threshold)
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
+        self._defer(input, target)
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        self._fold_now()
         precision, recall = _binary_binned_compute(
             self.num_tp, self.num_fp, self.num_fn
         )
@@ -77,6 +107,10 @@ class BinaryBinnedPrecisionRecallCurve(
     def merge_state(
         self, metrics: Iterable["BinaryBinnedPrecisionRecallCurve"]
     ) -> "BinaryBinnedPrecisionRecallCurve":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             for name in _COUNTER_NAMES:
                 setattr(
@@ -89,7 +123,7 @@ class BinaryBinnedPrecisionRecallCurve(
 
 
 class MulticlassBinnedPrecisionRecallCurve(
-    Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
+    DeferredFoldMixin, Metric[Tuple[List[jax.Array], List[jax.Array], jax.Array]]
 ):
     """Streaming one-vs-all PR curves over fixed thresholds.
 
@@ -97,6 +131,8 @@ class MulticlassBinnedPrecisionRecallCurve(
         num_classes: number of classes (static; sizes the counter state).
         threshold: bin count, list, or sorted array in ``[0, 1]``.
     """
+
+    _fold_fn = staticmethod(_multiclass_binned_fold)
 
     def __init__(
         self,
@@ -119,21 +155,19 @@ class MulticlassBinnedPrecisionRecallCurve(
                 jnp.zeros((n, num_classes), dtype=jnp.int32),
                 reduction=Reduction.SUM,
             )
+        self._init_deferred()
+        self._fold_params = (_threshold_fold_params(threshold), num_classes)
 
     def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
         _multiclass_precision_recall_curve_update_input_check(
             input, target, self.num_classes
         )
-        tp, fp, fn = _multiclass_binned_update(
-            input, target, self.threshold, self.num_classes
-        )
-        self.num_tp = self.num_tp + tp
-        self.num_fp = self.num_fp + fp
-        self.num_fn = self.num_fn + fn
+        self._defer(input, target)
         return self
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+        self._fold_now()
         precision, recall = _multiclass_binned_compute(
             self.num_tp, self.num_fp, self.num_fn
         )
@@ -142,6 +176,10 @@ class MulticlassBinnedPrecisionRecallCurve(
     def merge_state(
         self, metrics: Iterable["MulticlassBinnedPrecisionRecallCurve"]
     ) -> "MulticlassBinnedPrecisionRecallCurve":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             for name in _COUNTER_NAMES:
                 setattr(

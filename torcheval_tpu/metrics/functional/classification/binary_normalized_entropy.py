@@ -20,9 +20,30 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.utils.convert import as_jax
-from torcheval_tpu.utils.tracing import is_concrete
+from torcheval_tpu.utils.tracing import host_resident
 
 _EPS = 1.1920929e-07  # float32 eps, mirroring the reference's float64 clamp
+
+
+def _ne_value_check(source, from_logits: bool) -> None:
+    """[0, 1] probability check against a HOST-resident value source (the
+    raw pre-placement numpy/torch input, or a CPU-committed jax array).
+    Device-resident sources skip: reading them back would block the async
+    dispatch stream on every update (documented divergence from the
+    reference's always-eager check, binary_normalized_entropy.py:145-152) —
+    the log-clamp in the fold keeps the math finite either way."""
+    if from_logits or source is None or not host_resident(source):
+        return
+    import numpy as np
+
+    arr = np.asarray(source)
+    if arr.size and (arr.max() > 1.0 or arr.min() < 0.0):
+        raise ValueError(
+            f"`from_logits`={from_logits}, `input` should be probability "
+            f"in range [0., 1.], but got `input` ranging from {arr.min()} "
+            f"to {arr.max()}. Please set `from_logits = True` or convert "
+            "`input` into valid probability value."
+        )
 
 
 def _ne_input_check(
@@ -53,10 +74,7 @@ def _ne_input_check(
             f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
             f"({num_tasks}, num_samples), but got shape ({input.shape})."
         )
-    # value check: probabilities must live in [0, 1]; concrete arrays only
-    # (host read) — inside jit the log-clamp below keeps the math finite
-    if not from_logits and is_concrete(input):
-        import numpy as np
+
 
         arr = np.asarray(input)
         if arr.size and (arr.max() > 1.0 or arr.min() < 0.0):
@@ -103,8 +121,16 @@ def _binary_normalized_entropy_update(
     from_logits: bool,
     num_tasks: int,
     weight: Optional[jax.Array] = None,
+    *,
+    value_check_source=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     _ne_input_check(input, target, from_logits, num_tasks, weight)
+    # check values against the RAW pre-placement input when given: by now
+    # ``input`` is device-placed even if the caller passed numpy
+    _ne_value_check(
+        value_check_source if value_check_source is not None else input,
+        from_logits,
+    )
     return _ne_fold(input, target, from_logits, weight)
 
 
@@ -132,11 +158,13 @@ def binary_normalized_entropy(
         num_tasks: number of parallel tasks (leading axis when > 1).
         from_logits: interpret ``input`` as logits.
     """
+    raw_input = input
     input, target = as_jax(input), as_jax(target)
     if weight is not None:
         weight = as_jax(weight)
     cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
-        input, target, from_logits, num_tasks, weight
+        input, target, from_logits, num_tasks, weight,
+        value_check_source=raw_input,
     )
     return (cross_entropy / num_examples) / _baseline_entropy(
         num_positive, num_examples

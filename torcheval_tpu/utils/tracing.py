@@ -31,6 +31,21 @@ def is_concrete(x) -> bool:
 _logger = __import__("logging").getLogger(__name__)
 
 
+def host_resident(x) -> bool:
+    """True when reading ``x``'s values costs no device sync: a non-jax
+    array-like (numpy, torch-CPU) or a jax array committed to CPU devices.
+    Value-dependent eager checks gate on this so a TPU-resident batch never
+    blocks the dispatch stream for validation."""
+    if isinstance(x, jax.core.Tracer):
+        return False
+    if isinstance(x, jax.Array):
+        try:
+            return all(d.platform == "cpu" for d in x.devices())
+        except Exception:
+            return False
+    return hasattr(x, "__array__")
+
+
 def async_value_warn(check, *arrays) -> None:
     """Run ``check(*host_values)`` — which may log a warning — on a daemon
     thread after reading ``arrays`` back to the host, without blocking the
