@@ -208,3 +208,41 @@ class TestCollectionTorchBridge(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestGroupFoldFallback(unittest.TestCase):
+    def test_directly_updated_member_falls_back_per_member(self):
+        # a member updated OUTSIDE the collection has misaligned pending:
+        # group_fold must fall back to per-member folds, never mix streams
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4),
+                "cm": MulticlassConfusionMatrix(4),
+            }
+        )
+        x = RNG.random((32, 4)).astype(np.float32)
+        t = RNG.integers(0, 4, 32)
+        col.update(x, t)
+        extra_x = RNG.random((16, 4)).astype(np.float32)
+        extra_t = RNG.integers(0, 4, 16)
+        col["acc"].update(extra_x, extra_t)  # direct update, acc only
+        col.update(x, t)
+        out = col.compute()
+        X = np.concatenate([x, extra_x, x])
+        T = np.concatenate([t, extra_t, t])
+        self.assertAlmostEqual(float(out["acc"]), (X.argmax(1) == T).mean(), places=6)
+        # cm never saw the extra batch
+        self.assertEqual(int(np.asarray(out["cm"]).sum()), 64)
+
+    def test_managed_member_hard_valve_still_folds(self):
+        # direct streaming into a managed member must stay memory-bounded
+        # (self-fold at 2x budget)
+        m = MulticlassAccuracy(num_classes=3)
+        MetricCollection(m)  # marks managed
+        m._DEFER_MAX_CHUNKS = 4
+        x = jnp.eye(3)
+        t = jnp.arange(3)
+        for _ in range(20):
+            m.update(x, t)
+        self.assertLessEqual(len(m._pending), 8)  # valve fired
+        self.assertEqual(float(m.compute()), 1.0)
