@@ -16,6 +16,8 @@ from torcheval_tpu.metrics import (
     BinaryBinnedPrecisionRecallCurve,
     BinaryNormalizedEntropy,
     BinaryPrecisionRecallCurve,
+    MulticlassAUPRC,
+    MulticlassAUROC,
     MulticlassBinnedPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
 )
@@ -395,3 +397,39 @@ class TestCompactionNanFlag(unittest.TestCase):
         synced._set_states({"summary_nan_dropped": src.summary_nan_dropped})
         with self.assertRaisesRegex(ValueError, "NaN scores reached"):
             synced.compute()
+
+
+class TestMulticlassAUROCClasses(MetricClassTester):
+    def test_multiclass_auroc_protocol(self):
+        rng = np.random.default_rng(3)
+        scores = rng.random((8, 16, 5)).astype(np.float32)
+        target = rng.integers(0, 5, (8, 16))
+        import sklearn.metrics as sk
+
+        flat_s = scores.reshape(-1, 5)
+        flat_t = target.reshape(-1)
+        onehot = np.eye(5)[flat_t]
+        want = sk.roc_auc_score(onehot, flat_s, average="macro")
+        self.run_class_implementation_tests(
+            MulticlassAUROC(num_classes=5),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": scores, "target": target},
+            compute_result=np.asarray(want),
+        )
+
+    def test_multiclass_auprc_protocol(self):
+        rng = np.random.default_rng(4)
+        scores = rng.random((8, 16, 5)).astype(np.float32)
+        target = rng.integers(0, 5, (8, 16))
+        import sklearn.metrics as sk
+
+        flat_s = scores.reshape(-1, 5)
+        onehot = np.eye(5)[target.reshape(-1)]
+        want = sk.average_precision_score(onehot, flat_s, average="macro")
+        self.run_class_implementation_tests(
+            MulticlassAUPRC(num_classes=5),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": scores, "target": target},
+            compute_result=np.asarray(want),
+            atol=1e-4,
+        )

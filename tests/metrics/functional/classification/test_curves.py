@@ -3,6 +3,7 @@ values (SURVEY §4 tier 1)."""
 
 import unittest
 
+import jax.numpy as jnp
 import numpy as np
 from sklearn.metrics import (
     average_precision_score,
@@ -342,3 +343,64 @@ class TestCompactNanHandling(unittest.TestCase):
         )
         with self.assertRaisesRegex(ValueError, "NaN"):
             m.compute()
+
+
+class TestMulticlassAUROCandAUPRC(unittest.TestCase):
+    """One-vs-all extensions vs the sklearn oracle."""
+
+    def setUp(self):
+        rng = np.random.default_rng(11)
+        self.C, N = 6, 3000
+        self.scores = rng.random((N, self.C)).astype(np.float32)
+        self.target = rng.integers(0, self.C, N)
+        self.onehot = np.eye(self.C)[self.target]
+
+    def test_macro_auroc(self):
+        want = roc_auc_score(self.onehot, self.scores, average="macro")
+        got = float(
+            F.multiclass_auroc(
+                jnp.asarray(self.scores), jnp.asarray(self.target),
+                num_classes=self.C,
+            )
+        )
+        self.assertAlmostEqual(got, want, places=5)
+
+    def test_per_class_auprc(self):
+        got = np.asarray(
+            F.multiclass_auprc(
+                jnp.asarray(self.scores), jnp.asarray(self.target),
+                num_classes=self.C, average=None,
+            )
+        )
+        want = [
+            average_precision_score(self.onehot[:, c], self.scores[:, c])
+            for c in range(self.C)
+        ]
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_absent_class_degenerates(self):
+        # class C-1 never appears: AUROC 0.5, AUPRC 0.0 for it
+        target = np.clip(self.target, 0, self.C - 2)
+        auroc = np.asarray(
+            F.multiclass_auroc(
+                jnp.asarray(self.scores), jnp.asarray(target),
+                num_classes=self.C, average=None,
+            )
+        )
+        auprc = np.asarray(
+            F.multiclass_auprc(
+                jnp.asarray(self.scores), jnp.asarray(target),
+                num_classes=self.C, average=None,
+            )
+        )
+        self.assertAlmostEqual(float(auroc[-1]), 0.5, places=6)
+        self.assertAlmostEqual(float(auprc[-1]), 0.0, places=6)
+
+    def test_param_errors(self):
+        with self.assertRaisesRegex(ValueError, "num_classes must be at least 2"):
+            F.multiclass_auroc(jnp.zeros((4, 3)), jnp.zeros(4, jnp.int32))
+        with self.assertRaisesRegex(ValueError, "`average` was not in the allowed"):
+            F.multiclass_auprc(
+                jnp.zeros((4, 3)), jnp.zeros(4, jnp.int32),
+                num_classes=3, average="weighted",
+            )

@@ -12,6 +12,8 @@ from torcheval_tpu.metrics.classification import (
     BinaryPrecisionRecallCurve,
     BinaryRecall,
     MulticlassAccuracy,
+    MulticlassAUPRC,
+    MulticlassAUROC,
     MulticlassBinnedPrecisionRecallCurve,
     MulticlassConfusionMatrix,
     MulticlassF1Score,
@@ -29,12 +31,8 @@ from torcheval_tpu.metrics.state import Reduction
 
 __all__ = [
     # base interface
-    "Metric",
-    "MetricCollection",
-    "Reduction",
-    # functional metrics
-    "functional",
     # class metrics
+    # functional metrics
     "BinaryAccuracy",
     "BinaryAUPRC",
     "BinaryAUROC",
@@ -46,12 +44,17 @@ __all__ = [
     "BinaryPrecisionRecallCurve",
     "BinaryRecall",
     "Cat",
+    "functional",
     "HitRate",
     "Max",
     "Mean",
     "MeanSquaredError",
+    "Metric",
+    "MetricCollection",
     "Min",
     "MulticlassAccuracy",
+    "MulticlassAUPRC",
+    "MulticlassAUROC",
     "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",
@@ -61,6 +64,7 @@ __all__ = [
     "MultilabelAccuracy",
     "R2Score",
     "ReciprocalRank",
+    "Reduction",
     "Sum",
     "Throughput",
     "TopKMultilabelAccuracy",

@@ -4,7 +4,12 @@ from torcheval_tpu.metrics.classification.accuracy import (
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
-from torcheval_tpu.metrics.classification.auroc import BinaryAUPRC, BinaryAUROC
+from torcheval_tpu.metrics.classification.auroc import (
+    BinaryAUPRC,
+    BinaryAUROC,
+    MulticlassAUPRC,
+    MulticlassAUROC,
+)
 from torcheval_tpu.metrics.classification.binary_normalized_entropy import (
     BinaryNormalizedEntropy,
 )
@@ -42,6 +47,8 @@ __all__ = [
     "BinaryPrecisionRecallCurve",
     "BinaryRecall",
     "MulticlassAccuracy",
+    "MulticlassAUPRC",
+    "MulticlassAUROC",
     "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",

@@ -21,6 +21,11 @@ import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _auroc_update_input_check,
+    _mc_average,
+    _mc_curve_param_check,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _multiclass_precision_recall_curve_update_input_check,
 )
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
 from torcheval_tpu.metrics.state import Reduction
@@ -29,6 +34,8 @@ from torcheval_tpu.ops.curves import (
     binary_auprc_kernel,
     binary_auroc_counts_kernel,
     binary_auroc_kernel,
+    multiclass_auprc_kernel,
+    multiclass_auroc_kernel,
 )
 from torcheval_tpu.ops.summary import PAD_SCORE, compact_counts
 from torcheval_tpu.utils.devices import DeviceLike
@@ -326,6 +333,74 @@ class BinaryAUROC(_BinaryCurveMetric):
         # scalar) overlaps with it instead of stalling in front of it
         self._check_nan_flag()
         return result
+
+
+class _MulticlassCurveMetric(SampleCacheMetric[jax.Array]):
+    """Shared raw-sample cache for the one-vs-all multiclass curve metrics.
+
+    Framework extensions modelled on later torcheval releases: state is the
+    raw ``(N, C)`` score / ``(N,)`` label cache (the binary metrics' default
+    design); compute runs the binary curve kernel ``vmap``-ed over classes.
+    For bounded state at scale use the binned PRC metrics.
+    """
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "macro",
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _mc_curve_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        self._add_cache_state("inputs")
+        self._add_cache_state("targets")
+
+    def update(self, input, target):
+        input, target = self._input(input), self._input(target)
+        _multiclass_precision_recall_curve_update_input_check(
+            input, target, self.num_classes
+        )
+        self.inputs.append(input)
+        self.targets.append(target)
+        return self
+
+
+class MulticlassAUROC(_MulticlassCurveMetric):
+    """Streaming one-vs-all multiclass AUROC (framework extension)."""
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            return (
+                jnp.asarray(0.5)
+                if self.average == "macro"
+                else jnp.full((self.num_classes,), 0.5)
+            )
+        per_class = multiclass_auroc_kernel(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+        )
+        return _mc_average(per_class, self.average)
+
+
+class MulticlassAUPRC(_MulticlassCurveMetric):
+    """Streaming one-vs-all multiclass average precision (framework
+    extension)."""
+
+    def compute(self) -> jax.Array:
+        if not self.inputs:
+            return (
+                jnp.asarray(0.0)
+                if self.average == "macro"
+                else jnp.zeros((self.num_classes,))
+            )
+        per_class = multiclass_auprc_kernel(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+        )
+        return _mc_average(per_class, self.average)
 
 
 class BinaryAUPRC(_BinaryCurveMetric):

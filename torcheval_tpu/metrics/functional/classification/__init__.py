@@ -7,6 +7,8 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
 from torcheval_tpu.metrics.functional.classification.auroc import (
     binary_auprc,
     binary_auroc,
+    multiclass_auprc,
+    multiclass_auroc,
 )
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     binary_normalized_entropy,
@@ -48,6 +50,8 @@ __all__ = [
     "binary_precision_recall_curve",
     "binary_recall",
     "multiclass_accuracy",
+    "multiclass_auprc",
+    "multiclass_auroc",
     "multiclass_binned_precision_recall_curve",
     "multiclass_confusion_matrix",
     "multiclass_f1_score",

@@ -1,19 +1,45 @@
-"""Binary AUROC. Reference:
-``torcheval/metrics/functional/classification/auroc.py:11-89``.
+"""Binary and one-vs-all multiclass AUROC / AUPRC. Reference:
+``torcheval/metrics/functional/classification/auroc.py:11-89`` (binary; the
+multiclass variants are framework extensions modelled on later torcheval
+releases' one-vs-all semantics).
 
-The compute kernel lives in :mod:`torcheval_tpu.ops.curves` — a static-shape
-redesign of the reference's sort + dedup-mask + cumsum + trapz pipeline.
+The compute kernels live in :mod:`torcheval_tpu.ops.curves` — a static-shape
+redesign of the reference's sort + dedup-mask + cumsum + trapz pipeline;
+multiclass one-vs-all is the same kernel ``vmap``-ed over classes (C
+independent sorts batched into one XLA program).
 """
 
 from __future__ import annotations
 
+from functools import partial
+from typing import Optional
+
 import jax
+import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_update_input_check as _auroc_update_input_check,
+    _multiclass_precision_recall_curve_update_input_check,
 )
-from torcheval_tpu.ops.curves import binary_auprc_kernel, binary_auroc_kernel
+from torcheval_tpu.ops.curves import (
+    binary_auprc_kernel,
+    binary_auroc_kernel,
+    multiclass_auprc_kernel,
+    multiclass_auroc_kernel,
+)
 from torcheval_tpu.utils.convert import as_jax
+
+_MC_AVERAGE_OPTIONS = ("macro", "none", None)
+
+
+def _mc_curve_param_check(num_classes: Optional[int], average) -> None:
+    if average not in _MC_AVERAGE_OPTIONS:
+        raise ValueError(
+            f"`average` was not in the allowed value of {_MC_AVERAGE_OPTIONS}, "
+            f"got {average}."
+        )
+    if num_classes is None or num_classes < 2:
+        raise ValueError(f"num_classes must be at least 2, got {num_classes}.")
 
 
 def binary_auroc(input, target) -> jax.Array:
@@ -42,3 +68,55 @@ def binary_auprc(input, target) -> jax.Array:
     input, target = as_jax(input), as_jax(target)
     _auroc_update_input_check(input, target)
     return binary_auprc_kernel(input, target)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _mc_average(per_class: jax.Array, average):
+    return jnp.mean(per_class) if average == "macro" else per_class
+
+
+def multiclass_auroc(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """One-vs-all multiclass AUROC (framework extension; later torcheval
+    releases' semantics).
+
+    Args:
+        input: scores/logits ``(n_sample, num_classes)``.
+        target: integer labels ``(n_sample,)``.
+        average: ``"macro"`` (unweighted class mean) or ``"none"``/``None``
+            (per-class vector).
+
+    Degenerate classes (absent from ``target``, or the only class present)
+    score 0.5, as in the binary degenerate guard.
+    """
+    _mc_curve_param_check(num_classes, average)
+    input, target = as_jax(input), as_jax(target)
+    _multiclass_precision_recall_curve_update_input_check(
+        input, target, num_classes
+    )
+    return _mc_average(multiclass_auroc_kernel(input, target), average)
+
+
+def multiclass_auprc(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """One-vs-all multiclass average precision (framework extension).
+
+    Classes absent from ``target`` score 0.0 (no positives: the recall axis
+    is undefined — binary AUPRC's degenerate guard, applied per class).
+    """
+    _mc_curve_param_check(num_classes, average)
+    input, target = as_jax(input), as_jax(target)
+    _multiclass_precision_recall_curve_update_input_check(
+        input, target, num_classes
+    )
+    return _mc_average(multiclass_auprc_kernel(input, target), average)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torcheval_tpu.ops.curves import multiclass_prc_points_kernel, prc_points_kernel
+from torcheval_tpu.ops.curves import class_onehot_rows, multiclass_prc_points_kernel, prc_points_kernel
 from torcheval_tpu.utils.convert import as_jax
 
 
@@ -119,7 +119,7 @@ def multiclass_precision_recall_curve(
     _multiclass_precision_recall_curve_update_input_check(
         input, target, num_classes
     )
-    onehot = (target[None, :] == jnp.arange(num_classes)[:, None]).astype(
+    onehot = class_onehot_rows(target, num_classes).astype(
         jnp.float32
     )
     s, p, r, last = multiclass_prc_points_kernel(input.T, onehot)

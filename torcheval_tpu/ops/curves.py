@@ -186,3 +186,31 @@ def prc_points_kernel(
 multiclass_prc_points_kernel = jax.jit(
     jax.vmap(prc_points_kernel, in_axes=(0, 0), out_axes=0)
 )
+
+
+def class_onehot_rows(target: jax.Array, num_classes: int) -> jax.Array:
+    """``(C, N)`` float one-vs-all membership rows from ``(N,)`` integer
+    labels (out-of-range labels match no class). The shared expansion behind
+    every one-vs-all multiclass curve."""
+    return (
+        target[None, :].astype(jnp.int32)
+        == jnp.arange(num_classes, dtype=jnp.int32)[:, None]
+    ).astype(jnp.float32)
+
+
+@jax.jit
+def multiclass_auroc_kernel(scores: jax.Array, target: jax.Array) -> jax.Array:
+    """Per-class one-vs-all AUROC vector from ``(N, C)`` scores and ``(N,)``
+    integer labels: the binary kernel ``vmap``-ed over the class axis — C
+    independent descending sorts batched into one XLA program (TPU sorts
+    vectorise across the batch dimension)."""
+    onehot = class_onehot_rows(target, scores.shape[1])
+    return jax.vmap(binary_auroc_kernel, in_axes=(0, 0))(scores.T, onehot)
+
+
+@jax.jit
+def multiclass_auprc_kernel(scores: jax.Array, target: jax.Array) -> jax.Array:
+    """Per-class one-vs-all average precision, same batching as
+    :func:`multiclass_auroc_kernel`."""
+    onehot = class_onehot_rows(target, scores.shape[1])
+    return jax.vmap(binary_auprc_kernel, in_axes=(0, 0))(scores.T, onehot)
