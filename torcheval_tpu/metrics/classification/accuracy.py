@@ -73,6 +73,9 @@ class MulticlassAccuracy(DeferredFoldMixin, Metric[jax.Array]):
     scalar pair (micro) or per-class ``(num_classes,)`` int32 counters.
     """
 
+    _fold_per_chunk = True
+
+
     _fold_fn = staticmethod(_acc_fold)
 
 

@@ -71,6 +71,9 @@ class BinaryBinnedPrecisionRecallCurve(
             sorted thresholds in ``[0, 1]``.
     """
 
+    _fold_per_chunk = True
+
+
     _fold_fn = staticmethod(_binary_binned_fold)
 
     def __init__(
@@ -131,6 +134,9 @@ class MulticlassBinnedPrecisionRecallCurve(
         num_classes: number of classes (static; sizes the counter state).
         threshold: bin count, list, or sorted array in ``[0, 1]``.
     """
+
+    _fold_per_chunk = True
+
 
     _fold_fn = staticmethod(_multiclass_binned_fold)
 
