@@ -1,0 +1,26 @@
+"""Minimal ``torchtnt.utils`` stand-in for the reference benchmark leg.
+
+``torchtnt`` is not installed in this image; the reference's toolkit
+(``/root/reference/torcheval/metrics/toolkit.py:16``) imports exactly one
+name from it — ``PGWrapper`` — and calls exactly three methods, each a
+one-line delegation to ``torch.distributed`` (which is what the real
+torchtnt ``PGWrapper`` does for an initialized process group). This shim
+provides those three so the reference leg of the config-5 sync benchmark
+can run unmodified; it adds no overhead and no behavior of its own.
+"""
+
+import torch.distributed as dist
+
+
+class PGWrapper:
+    def __init__(self, pg=None):
+        self.pg = pg
+
+    def get_rank(self) -> int:
+        return dist.get_rank(group=self.pg)
+
+    def get_world_size(self) -> int:
+        return dist.get_world_size(group=self.pg)
+
+    def broadcast_object_list(self, obj_list, src: int = 0) -> None:
+        dist.broadcast_object_list(obj_list, src=src, group=self.pg)

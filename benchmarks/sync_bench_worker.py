@@ -1,0 +1,144 @@
+"""Worker for the config-5 cross-process sync benchmark (both sides).
+
+One rank of a 4-process ``sync_and_compute`` world — BASELINE config 5's
+workload (stream ``MulticlassAccuracy`` shards, then sync across ranks) run
+apples-to-apples on the only fabric both frameworks share in this
+environment: CPU processes on one host.
+
+* ``mode=tpu`` — this framework: the rank joins a ``jax.distributed`` CPU
+  world through ``init_from_env`` (same bootstrap a torchrun script would
+  drive) and syncs through the explicit typed-collective path
+  (``torcheval_tpu/metrics/toolkit.py``).
+* ``mode=ref`` — the reference: the rank joins a ``torch.distributed`` Gloo
+  world and syncs through its object-pickle gather
+  (``/root/reference/torcheval/metrics/toolkit.py:24-78``).
+
+Each rank times ``k`` runs of (reset → n_batches updates → sync_and_compute
+on every rank) after one warmup run, and writes its per-run times to
+``<outdir>/<mode>_rank<r>.json``. The parent (``bench.py``) scores the run
+by the SLOWEST rank per repeat (the sync is a barrier: the world's
+throughput is the straggler's) and medians across repeats. Process startup
+and world bootstrap are excluded on both sides — the measured quantity is
+steady-state update+sync cost, not interpreter spawn.
+
+Run: python sync_bench_worker.py <mode> <rank> <world> <port> <outdir>
+                                 <n_batches> <batch>
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 5
+REPEATS = 5
+
+
+def _shard(rank: int, batch: int):
+    rng = np.random.default_rng(1000 + rank)
+    scores = rng.random((batch, NUM_CLASSES)).astype(np.float32)
+    labels = rng.integers(0, NUM_CLASSES, batch).astype(np.int64)
+    return scores, labels
+
+
+def _time_runs(run, repeats=REPEATS):
+    run()  # warmup: compiles / allocates outside the timed region
+    times, result = [], None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = run()
+        times.append(time.perf_counter() - t0)
+    return times, result
+
+
+def main() -> None:
+    mode, rank, world, port, outdir, n_batches, batch = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+        sys.argv[5],
+        int(sys.argv[6]),
+        int(sys.argv[7]),
+    )
+    scores, labels = _shard(rank, batch)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    if mode == "tpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        # same persistent compile cache as bench._jax(): without it every
+        # rank recompiles its fold/sync jits on each bench invocation
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(repo, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        os.environ["MASTER_ADDR"] = "localhost"
+        os.environ["MASTER_PORT"] = port
+        os.environ["WORLD_SIZE"] = str(world)
+        os.environ["RANK"] = str(rank)
+        sys.path.insert(0, repo)
+        from torcheval_tpu.parallel import init_from_env
+
+        init_from_env()
+        import jax.numpy as jnp
+
+        from torcheval_tpu.metrics import MulticlassAccuracy
+        from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+        js, jl = jnp.asarray(scores), jnp.asarray(labels)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+
+        def run():
+            m.reset()
+            for _ in range(n_batches):
+                m.update(js, jl)
+            # every rank receives: the result must land wherever the eval
+            # loop runs, same contract the reference leg is given below.
+            # device_get materializes the result INSIDE the timed region —
+            # the ref leg's torch compute is eager, so leaving this value
+            # unmaterialized would exclude the fold+compute tail from this
+            # side only (same barrier policy as bench._time)
+            return jax.device_get(sync_and_compute(m, recipient_rank="all"))
+
+    elif mode == "ref":
+        sys.path.insert(0, "/root/reference")
+        # torchtnt is not installed here; the reference toolkit needs only
+        # PGWrapper's three one-line delegations (see _torchtnt_shim)
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "_torchtnt_shim"),
+        )
+        import torch
+        import torch.distributed as dist
+
+        os.environ["MASTER_ADDR"] = "localhost"
+        os.environ["MASTER_PORT"] = port
+        dist.init_process_group("gloo", rank=rank, world_size=world)
+        from torcheval.metrics import MulticlassAccuracy
+        from torcheval.metrics.toolkit import sync_and_compute
+
+        ts, tl = torch.from_numpy(scores), torch.from_numpy(labels)
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+
+        def run():
+            m.reset()
+            for _ in range(n_batches):
+                m.update(ts, tl)
+            return sync_and_compute(m, recipient_rank="all")
+
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    times, value = _time_runs(run)
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, f"{mode}_rank{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "times": times, "value": float(value)}, f)
+
+
+if __name__ == "__main__":
+    main()
