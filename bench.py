@@ -564,6 +564,21 @@ def config5_explicit_sync_4proc():
             try:
                 for p in procs:
                     p.wait(timeout=300)
+            except subprocess.TimeoutExpired:
+                # a hung rank is the likeliest distributed failure; its log
+                # is about to be rmtree'd — surface every rank's tail NOW or
+                # the diagnosis is lost to the cleanup
+                for log in logs:
+                    log.flush()
+                for r in range(world):
+                    path = os.path.join(tmpdir, f"{mode}_rank{r}.log")
+                    with open(path, "rb") as f:
+                        tail = f.read()[-1000:].decode(errors="replace")
+                    print(
+                        f"# config5 {mode} rank {r} log tail on hang:\n{tail}",
+                        file=sys.stderr,
+                    )
+                raise
             finally:
                 for log in logs:
                     log.close()

@@ -1,0 +1,156 @@
+"""DeferredFoldMixin edge cases (metrics/deferred.py).
+
+The hot-loop machinery behind every counter metric since round 3: update()
+is an O(1) append, the math folds lazily. These tests pin the lifecycle
+edges the collection tests don't reach: merges with pending batches on both
+sides, signature-change flushes, the tracer fallback inside an enclosing
+jit, pickling mid-stream, the byte-budget valve, and load_state_dict's
+drop-pending contract.
+"""
+
+import pickle
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def _batch(n=32, c=4):
+    return (
+        RNG.random((n, c)).astype(np.float32),
+        RNG.integers(0, c, n),
+    )
+
+
+class TestDeferredEdges(unittest.TestCase):
+    def test_merge_with_pending_on_both_sides(self):
+        a, b = MulticlassAccuracy(num_classes=4), MulticlassAccuracy(num_classes=4)
+        xa, ta = _batch()
+        xb, tb = _batch()
+        a.update(jnp.asarray(xa), jnp.asarray(ta))  # pending, unfolded
+        b.update(jnp.asarray(xb), jnp.asarray(tb))  # pending, unfolded
+        self.assertTrue(a._pending and b._pending)  # the scenario premise
+        a.merge_state([b])
+        X, T = np.concatenate([xa, xb]), np.concatenate([ta, tb])
+        self.assertAlmostEqual(
+            float(a.compute()), float((X.argmax(1) == T).mean()), places=6
+        )
+        # merge must not have mutated the source
+        self.assertAlmostEqual(
+            float(b.compute()), float((xb.argmax(1) == tb).mean()), places=6
+        )
+
+    def test_signature_change_flushes_pending(self):
+        # (N,) 1-D input batches then an (N, C) 2-D batch: ranks differ, so
+        # the mixin must flush the old signature before queueing the new one
+        # (one concatenation never mixes ranks) and still count everything
+        m = MulticlassAccuracy(num_classes=4)
+        t1 = RNG.integers(0, 4, 16)
+        m.update(jnp.asarray(t1.astype(np.float32)), jnp.asarray(t1))  # 1-D
+        x2, t2 = _batch(24)
+        m.update(jnp.asarray(x2), jnp.asarray(t2))  # 2-D: flush + append
+        correct = 16 + int((x2.argmax(1) == t2).sum())
+        self.assertAlmostEqual(float(m.compute()), correct / 40.0, places=6)
+
+    def test_dtype_change_flushes_pending(self):
+        m = BinaryAccuracy()
+        x1 = RNG.random(16).astype(np.float32)
+        t1 = RNG.integers(0, 2, 16).astype(np.float32)
+        m.update(jnp.asarray(x1), jnp.asarray(t1))
+        x2 = RNG.random(16).astype(np.float32)
+        t2 = RNG.integers(0, 2, 16).astype(np.int32)  # target dtype changes
+        m.update(jnp.asarray(x2), jnp.asarray(t2))
+        X = np.concatenate([x1, x2])
+        T = np.concatenate([t1, t2.astype(np.float32)])
+        self.assertAlmostEqual(
+            float(m.compute()), float(((X >= 0.5) == T).mean()), places=6
+        )
+
+    def test_update_inside_enclosing_jit(self):
+        # a user jitting their whole eval step around the metric: tracer
+        # args take the eager fold path so no tracer outlives its trace
+        x, t = _batch(64)
+
+        def step(xs, ts):
+            m = MulticlassAccuracy(num_classes=4)
+            m.update(xs, ts)
+            self.assertEqual(m._pending, [])  # folded eagerly, not queued
+            return m.compute()
+
+        got = jax.jit(step)(jnp.asarray(x), jnp.asarray(t))
+        self.assertAlmostEqual(
+            float(got), float((x.argmax(1) == t).mean()), places=6
+        )
+
+    def test_pickle_mid_stream(self):
+        m = MulticlassF1Score(num_classes=4, average="macro")
+        x, t = _batch(48)
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        self.assertTrue(m._pending)
+        clone = pickle.loads(pickle.dumps(m))
+        self.assertEqual(clone._pending, [])
+        np.testing.assert_allclose(
+            np.asarray(clone.compute()), np.asarray(m.compute()), rtol=1e-6
+        )
+        # the restored metric keeps streaming correctly
+        x2, t2 = _batch(16)
+        clone.update(jnp.asarray(x2), jnp.asarray(t2))
+        ref = MulticlassF1Score(num_classes=4, average="macro")
+        X, T = np.concatenate([x, x2]), np.concatenate([t, t2])
+        ref.update(jnp.asarray(X), jnp.asarray(T))
+        np.testing.assert_allclose(
+            np.asarray(clone.compute()), np.asarray(ref.compute()), rtol=1e-6
+        )
+
+    def test_byte_budget_valve(self):
+        m = MulticlassAccuracy(num_classes=4)
+        x, t = _batch(256)
+        per_update = x.nbytes + np.asarray(t).nbytes
+        m._DEFER_BUDGET_BYTES = 3 * per_update  # force periodic folds
+        for _ in range(10):
+            m.update(jnp.asarray(x), jnp.asarray(t))
+        self.assertLess(len(m._pending), 4)  # valve fired along the way
+        self.assertAlmostEqual(
+            float(m.compute()), float((x.argmax(1) == t).mean()), places=6
+        )
+        self.assertEqual(float(m.num_total), 2560.0)
+
+    def test_load_state_dict_drops_pending(self):
+        donor = MulticlassAccuracy(num_classes=4)
+        x, t = _batch()
+        donor.update(jnp.asarray(x), jnp.asarray(t))
+        sd = donor.state_dict()
+        m = MulticlassAccuracy(num_classes=4)
+        m.update(jnp.asarray(x[:8]), jnp.asarray(t[:8]))  # pending to drop
+        m.load_state_dict(sd)
+        # loading replaces the logical state wholesale: the pre-load pending
+        # batches belong to the replaced stream and must not leak in
+        self.assertEqual(float(m.num_total), float(x.shape[0]))
+        self.assertAlmostEqual(
+            float(m.compute()), float((x.argmax(1) == t).mean()), places=6
+        )
+
+    def test_reset_discards_pending(self):
+        m = MulticlassAccuracy(num_classes=4)
+        x, t = _batch()
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        m.reset()
+        self.assertEqual(m._pending, [])
+        x2, t2 = _batch(16)
+        m.update(jnp.asarray(x2), jnp.asarray(t2))
+        # read through state_dict: direct attribute reads see only the
+        # folded-so-far value (documented deferral semantics)
+        self.assertEqual(float(m.state_dict()["num_total"]), 16.0)
+
+
+if __name__ == "__main__":
+    unittest.main()
