@@ -3,10 +3,10 @@
 Mirrors the reference's strategy (``torcheval/utils/test_utils/
 metric_class_tester.py:272-311``, ``tests/metrics/test_toolkit.py:160-174``):
 multi-node is simulated as multi-process single-node. Here each process is a
-separate ``jax.distributed`` participant on the CPU backend (Gloo), so
-``_gather_state_dicts`` — descriptor exchange, CAT padding, empty-rank
-adoption, the uint8 object-gather lane — executes for real, not via
-hand-built rank dicts.
+separate ``jax.distributed`` participant on the CPU backend (Gloo), so the
+batched typed wire (``_gather_collection_states`` — descriptor exchange,
+empty-rank CAT entries, the uint8 object-gather lane) executes for real, not
+via hand-built rank dicts.
 """
 
 import json

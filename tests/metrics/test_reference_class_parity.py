@@ -1,0 +1,226 @@
+"""Streaming + merge parity against the reference's class-level state machines.
+
+`tests/metrics/functional/test_reference_parity.py` pins single-shot value
+parity per functional kernel; this module pins the CLASS protocol against
+the reference itself: chunked `update` streams accumulate to the same
+result, and `merge_state` over differently-fed replicas agrees — i.e. a
+user porting a streaming eval loop (README "Porting from torcheval") gets
+bit-compatible numbers, not just compatible APIs.
+"""
+
+import sys
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/reference")
+import torch  # noqa: E402
+import torcheval.metrics as RM  # noqa: E402
+
+import torcheval_tpu.metrics as M  # noqa: E402
+
+SEEDS = (0, 3)
+CHUNKS = 3
+
+
+def _close(ours, ref, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(ref), rtol=rtol, atol=atol, equal_nan=True
+    )
+
+
+def _stream_and_merge(make_ours, make_ref, batches, rtol=1e-5):
+    """Stream all batches into one pair; also split across two replicas and
+    merge. Assert ours == reference for both protocols."""
+    ours, ref = make_ours(), make_ref()
+    for args in batches:
+        ours.update(*(jnp.asarray(a) for a in args))
+        ref.update(*(torch.from_numpy(np.asarray(a)) for a in args))
+    _close(ours.compute(), ref.compute(), rtol=rtol)
+
+    ours_a, ours_b = make_ours(), make_ours()
+    ref_a, ref_b = make_ref(), make_ref()
+    for i, args in enumerate(batches):
+        (ours_a if i % 2 == 0 else ours_b).update(
+            *(jnp.asarray(a) for a in args)
+        )
+        (ref_a if i % 2 == 0 else ref_b).update(
+            *(torch.from_numpy(np.asarray(a)) for a in args)
+        )
+    ours_a.merge_state([ours_b])
+    ref_a.merge_state([ref_b])
+    _close(ours_a.compute(), ref_a.compute(), rtol=rtol)
+
+
+def _cls_chunks(rng, n, c):
+    out = []
+    for _ in range(CHUNKS):
+        scores = rng.random((n, c)).astype(np.float32)
+        labels = rng.integers(0, c, n)
+        labels[:c] = np.arange(c)
+        scores[np.arange(c), np.arange(c)] += 2.0
+        out.append((scores, labels))
+    return out
+
+
+class TestClassificationClassParity(unittest.TestCase):
+    def test_multiclass_accuracy(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = _cls_chunks(rng, 100, 5)
+            for average in ("micro", "macro"):
+                _stream_and_merge(
+                    lambda: M.MulticlassAccuracy(average=average, num_classes=5),
+                    lambda: RM.MulticlassAccuracy(average=average, num_classes=5),
+                    batches,
+                )
+
+    def test_multiclass_f1_precision_recall(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = _cls_chunks(rng, 120, 4)
+            for ours_cls, ref_cls in (
+                (M.MulticlassF1Score, RM.MulticlassF1Score),
+                (M.MulticlassPrecision, RM.MulticlassPrecision),
+                (M.MulticlassRecall, RM.MulticlassRecall),
+            ):
+                for average in ("micro", "macro", "weighted"):
+                    _stream_and_merge(
+                        lambda: ours_cls(average=average, num_classes=4),
+                        lambda: ref_cls(average=average, num_classes=4),
+                        batches,
+                    )
+
+    def test_binary_threshold_classes(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = [
+                (
+                    rng.random(80).astype(np.float32),
+                    (rng.random(80) < 0.4).astype(np.int64),
+                )
+                for _ in range(CHUNKS)
+            ]
+            for ours_cls, ref_cls in (
+                (M.BinaryAccuracy, RM.BinaryAccuracy),
+                (M.BinaryF1Score, RM.BinaryF1Score),
+                (M.BinaryPrecision, RM.BinaryPrecision),
+                (M.BinaryRecall, RM.BinaryRecall),
+            ):
+                _stream_and_merge(ours_cls, ref_cls, batches)
+
+    def test_binary_auroc_and_curves(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = [
+                (
+                    rng.random(150).astype(np.float32),
+                    (rng.random(150) < 0.5).astype(np.float32),
+                )
+                for _ in range(CHUNKS)
+            ]
+            _stream_and_merge(M.BinaryAUROC, RM.BinaryAUROC, batches, rtol=1e-4)
+            # curve tuple: compare leaf-wise through compute()
+            ours, ref = M.BinaryPrecisionRecallCurve(), RM.BinaryPrecisionRecallCurve()
+            for x, t in batches:
+                ours.update(jnp.asarray(x), jnp.asarray(t))
+                ref.update(torch.from_numpy(x), torch.from_numpy(t))
+            for o, r in zip(ours.compute(), ref.compute()):
+                _close(o, r, rtol=1e-4)
+
+    def test_binned_prc_class(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            ours = M.BinaryBinnedPrecisionRecallCurve(threshold=20)
+            ref = RM.BinaryBinnedPrecisionRecallCurve(threshold=20)
+            for _ in range(CHUNKS):
+                x = rng.random(120).astype(np.float32)
+                t = (rng.random(120) < 0.4).astype(np.int64)
+                ours.update(jnp.asarray(x), jnp.asarray(t))
+                ref.update(torch.from_numpy(x), torch.from_numpy(t))
+            for o, r in zip(ours.compute(), ref.compute()):
+                _close(o, r, rtol=1e-4)
+
+    def test_normalized_entropy_class(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = [
+                (
+                    rng.uniform(0.05, 0.95, 100).astype(np.float32),
+                    (rng.random(100) < 0.3).astype(np.float32),
+                )
+                for _ in range(CHUNKS)
+            ]
+            _stream_and_merge(
+                M.BinaryNormalizedEntropy, RM.BinaryNormalizedEntropy,
+                batches, rtol=1e-4,
+            )
+
+
+class TestRankingRegressionAggregationClassParity(unittest.TestCase):
+    def test_regression_classes(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = [
+                (
+                    rng.random(90).astype(np.float32),
+                    rng.random(90).astype(np.float32),
+                )
+                for _ in range(CHUNKS)
+            ]
+            _stream_and_merge(M.MeanSquaredError, RM.MeanSquaredError, batches, rtol=1e-4)
+            _stream_and_merge(M.R2Score, RM.R2Score, batches, rtol=1e-4)
+
+    def test_ranking_classes(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = [
+                (
+                    rng.random((50, 6)).astype(np.float32),
+                    rng.integers(0, 6, 50),
+                )
+                for _ in range(CHUNKS)
+            ]
+            _stream_and_merge(M.HitRate, RM.HitRate, batches)
+            _stream_and_merge(M.ReciprocalRank, RM.ReciprocalRank, batches)
+
+    def test_aggregation_classes(self):
+        for seed in SEEDS:
+            rng = np.random.default_rng(seed)
+            batches = [
+                (rng.random(64).astype(np.float32),) for _ in range(CHUNKS)
+            ]
+            for ours_cls, ref_cls in (
+                (M.Sum, RM.Sum),
+                (M.Mean, RM.Mean),
+                (M.Max, RM.Max),
+                (M.Min, RM.Min),
+            ):
+                _stream_and_merge(ours_cls, ref_cls, batches)
+            # Cat: compare concatenated payloads
+            ours, ref = M.Cat(), RM.Cat()
+            for (x,) in batches:
+                ours.update(jnp.asarray(x))
+                ref.update(torch.from_numpy(x))
+            _close(ours.compute(), ref.compute())
+
+    def test_throughput_class(self):
+        ours, ref = M.Throughput(), RM.Throughput()
+        for n, s in ((100, 1.0), (250, 2.5), (75, 0.5)):
+            ours.update(num_processed=n, elapsed_time_sec=s)
+            ref.update(num_processed=n, elapsed_time_sec=s)
+        _close(ours.compute(), ref.compute(), rtol=1e-5)
+        # merge: counts sum, elapsed takes the max
+        oa, ob, ra, rb = M.Throughput(), M.Throughput(), RM.Throughput(), RM.Throughput()
+        oa.update(num_processed=100, elapsed_time_sec=2.0)
+        ra.update(num_processed=100, elapsed_time_sec=2.0)
+        ob.update(num_processed=300, elapsed_time_sec=3.0)
+        rb.update(num_processed=300, elapsed_time_sec=3.0)
+        oa.merge_state([ob])
+        ra.merge_state([rb])
+        _close(oa.compute(), ra.compute(), rtol=1e-5)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -127,17 +127,22 @@ class TestFoldStates(unittest.TestCase):
         # empty-cache ranks inside process_allgather)
         from torcheval_tpu.metrics.toolkit import (
             _check_cat_descriptors,
-            _encode_cat_descriptor,
+            _encode_entry_descriptor,
         )
 
-        desc = _encode_cat_descriptor(jnp.zeros((2,) * 6))
+        desc = np.asarray(
+            _encode_entry_descriptor(np.zeros((2,) * 6)), np.int32
+        )
         self.assertEqual(int(desc[1]), 6)
-        all_desc = np.stack([np.zeros(7, np.int32), np.asarray(desc)])
+        all_desc = np.stack([np.zeros(7, np.int32), desc])
         with self.assertRaisesRegex(NotImplementedError, "rank 6"):
             _check_cat_descriptors("inputs", all_desc)
         # in-range descriptors pass
         _check_cat_descriptors(
-            "inputs", np.asarray(_encode_cat_descriptor(jnp.zeros((3, 2))))[None]
+            "inputs",
+            np.asarray(_encode_entry_descriptor(np.zeros((3, 2))), np.int32)[
+                None
+            ],
         )
 
     def test_cat_descriptor_dtype_guard_is_post_exchange(self):
@@ -145,13 +150,17 @@ class TestFoldStates(unittest.TestCase):
         # would hang empty-cache peers) and fail uniformly after the exchange
         from torcheval_tpu.metrics.toolkit import (
             _check_cat_descriptors,
-            _encode_cat_descriptor,
+            _encode_entry_descriptor,
         )
 
-        desc = _encode_cat_descriptor(jnp.zeros((4,), dtype=jnp.int16))
+        # complex64: outside the wire allowlist (int16 joined it in round 3)
+        desc = np.asarray(
+            _encode_entry_descriptor(np.zeros((4,), dtype=np.complex64)),
+            np.int32,
+        )
         self.assertEqual(int(desc[2]), -1)
         with self.assertRaisesRegex(NotImplementedError, "dtype"):
-            _check_cat_descriptors("inputs", np.asarray(desc)[None])
+            _check_cat_descriptors("inputs", desc[None])
 
     def test_tree_host_roundtrip_preserves_container_metadata(self):
         from collections import defaultdict, deque

@@ -15,10 +15,12 @@ one rank. The TPU-native design never moves Python objects:
 * **Explicit cross-process sync (this module).** For the multi-host pattern
   where each process streams *local* (host-resident or single-chip) batches
   into its own metric replica — the reference's model — every state variable
-  declares a :class:`~torcheval_tpu.metrics.state.Reduction`, and sync runs
-  one typed collective per state: sum/max/min fold or axis-0 concat. States
-  cross the network as arrays (via ``multihost_utils.process_allgather``, i.e.
-  a compiled XLA all-gather over ICI/DCN), never as pickles.
+  declares a :class:`~torcheval_tpu.metrics.state.Reduction`, and sync rides
+  a batched typed wire: ONE descriptor round plus ONE concatenated
+  byte-payload round for all of a metric's (or a whole collection's) states,
+  folded per declared reduction after the exchange. States cross the network
+  as arrays (via ``multihost_utils.process_allgather``, i.e. a compiled XLA
+  all-gather over ICI/DCN), never as pickles.
 
 Semantics preserved from the reference (``toolkit.py:24-311``): works with
 ``recipient_rank`` int or ``"all"``; no-op with a warning at world size 1;
@@ -139,32 +141,14 @@ _CAT_DTYPES = (
     jnp.uint32,
     jnp.float64,
     jnp.int64,
+    # appended (codes are wire format — extend only at the END): round 3
+    # routed ALL typed states through this allowlist, not just CAT caches,
+    # so the exotic-but-legal state dtypes must stay syncable
+    jnp.int16,
+    jnp.uint16,
+    jnp.uint64,
 )
 _MAX_CAT_RANK = 5
-
-
-def _encode_cat_descriptor(local) -> "jnp.ndarray":
-    if local is None:
-        return jnp.zeros((3 + _MAX_CAT_RANK - 1,), dtype=jnp.int32)
-    if local.ndim > _MAX_CAT_RANK:
-        # the wire descriptor has a fixed 7-element layout and cannot carry
-        # this cache's dims. Do NOT raise here: a one-sided pre-collective
-        # raise would leave empty-cache ranks blocked inside process_allgather.
-        # Emit a descriptor recording the oversized ndim; every rank raises
-        # uniformly after the exchange (_check_cat_descriptors).
-        return jnp.asarray(
-            [local.shape[0], local.ndim, 0] + [0] * (_MAX_CAT_RANK - 1),
-            dtype=jnp.int32,
-        )
-    codes = [i for i, d in enumerate(_CAT_DTYPES) if jnp.dtype(d) == local.dtype]
-    # an unsupported dtype must not raise here either (same one-sided-hang
-    # class as the oversized ndim): encode the sentinel -1 and fail uniformly
-    # post-exchange in _check_cat_descriptors
-    dtype_code = codes[0] if codes else -1
-    dims = list(local.shape[1:]) + [0] * (_MAX_CAT_RANK - 1 - (local.ndim - 1))
-    return jnp.asarray(
-        [local.shape[0], local.ndim, dtype_code] + dims, dtype=jnp.int32
-    )
 
 
 def _check_cat_descriptors(name: str, all_desc: np.ndarray) -> None:
@@ -174,24 +158,16 @@ def _check_cat_descriptors(name: str, all_desc: np.ndarray) -> None:
     max_rank = int(all_desc[:, 1].max()) if all_desc.size else 0
     if max_rank > _MAX_CAT_RANK:
         raise NotImplementedError(
-            f"CAT-state {name!r} has a cache of rank {max_rank} on some "
-            f"process, above the sync wire-format limit {_MAX_CAT_RANK}; "
-            "reshape the cache or extend _MAX_CAT_RANK."
+            f"State {name!r} has rank {max_rank} on some process, above the "
+            f"sync wire-format limit {_MAX_CAT_RANK}; reshape the state or "
+            "extend the descriptor layout past _MAX_CAT_RANK."
         )
     if all_desc.size and int(all_desc[:, 2].min()) < 0:
         raise NotImplementedError(
-            f"CAT-state {name!r} has a cache dtype outside the sync "
-            f"wire-format allowlist {[jnp.dtype(d).name for d in _CAT_DTYPES]} "
-            "on some process; cast the cache or extend _CAT_DTYPES."
+            f"State {name!r} has a dtype outside the sync wire-format "
+            f"allowlist {[jnp.dtype(d).name for d in _CAT_DTYPES]} "
+            "on some process; cast the state or extend _CAT_DTYPES."
         )
-
-
-def _decode_cat_descriptor(desc: np.ndarray):
-    ndim = int(desc[1])
-    dtype = jnp.dtype(_CAT_DTYPES[int(desc[2])])
-    trailing = tuple(int(d) for d in desc[3 : 3 + ndim - 1])
-    return trailing, dtype
-
 
 
 def _world_size() -> int:
@@ -274,60 +250,6 @@ def _allgather_object(obj: Any) -> List[Any]:
     ]
 
 
-def _gather_state_dicts(metric: Metric) -> List[Dict[str, TState]]:
-    """All-gather every rank's state dict as arrays (no pickling).
-
-    CAT caches may have different lengths per rank, so each is padded to the
-    global max sample count (gathered first) and trimmed after the collective.
-    """
-    from jax.experimental import multihost_utils
-
-    world = _world_size()
-    sd = metric.state_dict()
-    reductions = metric._state_name_to_reduction
-    gathered: List[Dict[str, TState]] = [dict() for _ in range(world)]
-    for name, red in reductions.items():
-        value = sd[name]
-        if red is Reduction.CUSTOM:
-            raise NotImplementedError(
-                f"State {name!r} declares Reduction.CUSTOM; cross-process "
-                "sync is not supported for it."
-            )
-        if red is Reduction.CAT:
-            local = _cat_cache_concat(value)
-            # descriptor exchange first: a rank whose cache is empty does not
-            # know the trailing dims/dtype, but the collective requires
-            # identical shape+dtype on every rank — adopt them from a
-            # data-bearing rank before padding
-            desc = _encode_cat_descriptor(local)
-            all_desc = np.asarray(multihost_utils.process_allgather(desc))
-            _check_cat_descriptors(name, all_desc)
-            lengths = all_desc[:, 0]
-            max_len = int(lengths.max())
-            if max_len == 0:
-                for rank in range(world):
-                    gathered[rank][name] = []
-                continue
-            ref_desc = all_desc[int(np.argmax(lengths > 0))]
-            trailing, dtype = _decode_cat_descriptor(ref_desc)
-            if local is None:
-                local = jnp.zeros((0,) + trailing, dtype=dtype)
-            n_local = local.shape[0]
-            pad = [(0, max_len - n_local)] + [(0, 0)] * (local.ndim - 1)
-            padded = jnp.pad(local, pad) if max_len > n_local else local
-            all_vals = multihost_utils.process_allgather(padded)
-            for rank in range(world):
-                n_rank = int(lengths[rank])
-                gathered[rank][name] = (
-                    [jnp.asarray(all_vals[rank][:n_rank])] if n_rank else []
-                )
-        else:
-            all_vals = multihost_utils.process_allgather(jnp.asarray(value))
-            for rank in range(world):
-                gathered[rank][name] = jnp.asarray(all_vals[rank])
-    return gathered
-
-
 def _needs_object_sync(metric: Metric) -> bool:
     """True when some state cannot travel on the typed lanes: dict-keyed
     state (arbitrary keys) or a CUSTOM reduction (only the metric's own
@@ -367,9 +289,11 @@ def get_synced_metric(
 
     Reference parity: ``toolkit.py:145-232`` — world size 1 returns the input
     metric with a warning; ``recipient_rank="all"`` returns on every rank.
-    Array/list states travel as typed per-state collectives; dict-keyed and
-    CUSTOM-reduction states fall back to a pickled object gather
-    (:func:`_allgather_object`) folded by the metric's own ``merge_state``.
+    Array/list states travel on the batched typed wire (one descriptor round
+    + one byte-payload round, shared with :func:`sync_and_compute_collection`);
+    dict-keyed and CUSTOM-reduction states fall back to a pickled object
+    gather (:func:`_allgather_object`) folded by the metric's own
+    ``merge_state``.
     """
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
@@ -386,7 +310,19 @@ def get_synced_metric(
     metric._prepare_for_merge_state()
     if _gathered is None and _needs_object_sync(metric):
         return _object_synced_metric(metric, recipient_rank)
-    gathered = _gathered if _gathered is not None else _gather_state_dicts(metric)
+    if _gathered is not None:
+        gathered = _gathered
+    else:
+        # ride the batched collection wire: exactly two collective rounds
+        # (descriptor matrix + one concatenated byte payload) regardless of
+        # how many states the metric has — the per-state path pays one round
+        # per SUM/MAX state and two per CAT state, which on a DCN-attached
+        # pod is a per-round latency hit (and on the bench's timeshared
+        # host, a scheduling-noise amplifier)
+        gathered = [
+            per_rank["m"]
+            for per_rank in _gather_collection_states({"m": metric})
+        ]
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None
     folded = _fold_states(gathered, metric._state_name_to_reduction)
@@ -465,7 +401,9 @@ def _encode_entry_descriptor(local: Optional[np.ndarray]) -> list:
     if local is None:
         return [0, -1, 0, 0, 0, 0, 0]  # empty CAT cache
     if local.ndim > _MAX_CAT_RANK:
-        # uniform post-exchange failure, as in _encode_cat_descriptor
+        # oversized rank: encode it rather than raising here — a one-sided
+        # pre-collective raise would hang the peers; _check_cat_descriptors
+        # fails uniformly on every rank after the exchange
         return [0, local.ndim, 0, 0, 0, 0, 0]
     codes = [
         i for i, d in enumerate(_CAT_DTYPES) if np.dtype(jnp.dtype(d)) == local.dtype
