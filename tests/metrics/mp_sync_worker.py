@@ -153,6 +153,37 @@ def main() -> None:
     r1 = sync_and_compute_collection(col, recipient_rank=1)
     results["collection_r1"] = None if r1 is None else sorted(r1)
 
+    # --- wire-cost contract: count the actual collective rounds. A sync is
+    # exactly TWO process_allgather calls (descriptor matrix + byte payload)
+    # no matter how many states the metric (or whole array-lane collection)
+    # has; the dict metric's object lane costs two more (its own length +
+    # payload exchange). Every process must patch and sync in lockstep — the
+    # patched wrapper still calls the real collective underneath.
+    from jax.experimental import multihost_utils as _mhu
+
+    real_allgather = _mhu.process_allgather
+    counts = {}
+
+    def _counting(*a, **k):
+        counts["n"] = counts.get("n", 0) + 1
+        return real_allgather(*a, **k)
+
+    _mhu.process_allgather = _counting
+    try:
+        counts["n"] = 0
+        sync_and_compute(acc, recipient_rank="all")  # 2 SUM states
+        results["rounds_acc"] = counts["n"]
+        counts["n"] = 0
+        sync_and_compute(auroc, recipient_rank="all")  # 2 CAT caches
+        results["rounds_auroc"] = counts["n"]
+        counts["n"] = 0
+        sync_and_compute_collection(
+            {"acc": acc, "auroc": auroc, "tp": t}, recipient_rank="all"
+        )  # whole array-lane collection: still one two-round exchange
+        results["rounds_collection"] = counts["n"]
+    finally:
+        _mhu.process_allgather = real_allgather
+
     os.makedirs(outdir, exist_ok=True)
     with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
         json.dump(results, f)

@@ -168,6 +168,16 @@ class TestMultiprocessSync(unittest.TestCase):
             else:
                 self.assertIsNone(res["collection_r1"])
 
+    def test_sync_is_two_collective_rounds(self):
+        # the wire-cost contract (counted inside the real 4-process world):
+        # descriptor matrix + byte payload, independent of state count —
+        # for a 2-SUM-state metric, a 2-CAT-cache metric, and a whole
+        # 3-metric array-lane collection alike
+        for res in self.results:
+            self.assertEqual(res["rounds_acc"], 2)
+            self.assertEqual(res["rounds_auroc"], 2)
+            self.assertEqual(res["rounds_collection"], 2)
+
     def test_dict_state_object_gather(self):
         want = sum(v for r in range(WORLD) for _, v in make_dict_updates(r))
         keys = sorted(

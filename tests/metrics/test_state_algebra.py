@@ -1,0 +1,146 @@
+"""Algebraic invariants of the streaming-state protocol.
+
+The reference's harness checks one algebraic fact (N-way merge == single
+stream); these tests pin the rest of the algebra every distributed eval
+loop implicitly relies on — if any fails, some ordering of workers, shards,
+or merge trees silently changes results:
+
+* update-order invariance: counters don't care which batch came first, and
+  curve metrics don't care which rank's cache lands first;
+* merge associativity: ``(a+b)+c == a+(b+c)`` — a pod folding replicas in a
+  tree must agree with a ring;
+* merge identity: merging a fresh (never-updated) replica is a no-op;
+* reset returns to the true initial state (compute-after-reset behaves like
+  a fresh instance, including for deferred and cache metrics).
+"""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    BinaryPrecisionRecallCurve,
+    Max,
+    Mean,
+    MeanSquaredError,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    Sum,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _cls_batches(k, n=64, c=4):
+    out = []
+    for _ in range(k):
+        s = RNG.random((n, c)).astype(np.float32)
+        t = RNG.integers(0, c, n)
+        out.append((jnp.asarray(s), jnp.asarray(t)))
+    return out
+
+
+def _bin_batches(k, n=64):
+    out = []
+    for _ in range(k):
+        x = RNG.random(n).astype(np.float32)
+        t = (RNG.random(n) < 0.5).astype(np.float32)
+        out.append((jnp.asarray(x), jnp.asarray(t)))
+    return out
+
+
+def _reg_batches(k, n=64):
+    return [
+        (
+            jnp.asarray(RNG.random(n).astype(np.float32)),
+            jnp.asarray(RNG.random(n).astype(np.float32)),
+        )
+        for _ in range(k)
+    ]
+
+
+MAKERS = (
+    ("acc", lambda: MulticlassAccuracy(num_classes=4), _cls_batches),
+    ("f1", lambda: MulticlassF1Score(num_classes=4, average="macro"), _cls_batches),
+    ("auroc", BinaryAUROC, _bin_batches),
+    ("mse", MeanSquaredError, _reg_batches),
+    ("sum", Sum, lambda k: [(b[0],) for b in _reg_batches(k)]),
+    ("mean", Mean, lambda k: [(b[0],) for b in _reg_batches(k)]),
+    ("max", Max, lambda k: [(b[0],) for b in _reg_batches(k)]),
+)
+
+
+def _fed(make, batches):
+    m = make()
+    for b in batches:
+        m.update(*b)
+    return m
+
+
+def _assert_same(a, b, msg):
+    np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7, err_msg=msg
+    )
+
+
+class TestStateAlgebra(unittest.TestCase):
+    def test_update_order_invariance(self):
+        for name, make, gen in MAKERS:
+            batches = gen(4)
+            fwd = _fed(make, batches).compute()
+            rev = _fed(make, list(reversed(batches))).compute()
+            _assert_same(fwd, rev, f"{name}: update order changed the result")
+
+    def test_merge_associativity(self):
+        for name, make, gen in MAKERS:
+            batches = gen(3)
+            # (a + b) + c
+            a, b, c = (_fed(make, [bt]) for bt in batches)
+            left = a.merge_state([b]).merge_state([c]).compute()
+            # a + (b + c)
+            a2, b2, c2 = (_fed(make, [bt]) for bt in batches)
+            right = a2.merge_state([b2.merge_state([c2])]).compute()
+            _assert_same(left, right, f"{name}: merge is not associative")
+            # and both equal the single stream
+            single = _fed(make, batches).compute()
+            _assert_same(left, single, f"{name}: merge tree != single stream")
+
+    def test_merge_identity(self):
+        for name, make, gen in MAKERS:
+            batches = gen(2)
+            fed = _fed(make, batches)
+            want = np.asarray(fed.compute())
+            fed2 = _fed(make, batches)
+            fed2.merge_state([make()])  # fresh replica: identity element
+            _assert_same(
+                fed2.compute(), want, f"{name}: merging a fresh replica changed state"
+            )
+
+    def test_reset_equals_fresh(self):
+        for name, make, gen in MAKERS:
+            batches = gen(2)
+            m = _fed(make, batches)
+            m.reset()
+            probe = gen(2)
+            m2 = make()
+            for b in probe:
+                m.update(*b)
+                m2.update(*b)
+            _assert_same(
+                m.compute(), m2.compute(), f"{name}: reset metric != fresh metric"
+            )
+
+    def test_curve_metric_rank_order_invariance(self):
+        # CAT caches from different "ranks" in any order: the sort inside
+        # compute makes cache order irrelevant
+        batches = _bin_batches(3)
+        a = _fed(BinaryPrecisionRecallCurve, batches)
+        b = _fed(BinaryPrecisionRecallCurve, list(reversed(batches)))
+        for o, r in zip(a.compute(), b.compute()):
+            _assert_same(o, r, "PRC: cache order changed the curve")
+
+
+if __name__ == "__main__":
+    unittest.main()
