@@ -1,0 +1,197 @@
+"""ClickThroughRate / WeightedCalibration (+ windowed variants).
+
+Extensions beyond the reference snapshot; value oracles are hand
+computations. The windowed variants are the shipped deque-state metrics, so
+their tests double as the deque lane's real-metric coverage: window
+eviction, state-dict round trips preserving ``maxlen``, bounded merges.
+"""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    ClickThroughRate,
+    WeightedCalibration,
+    WindowedClickThroughRate,
+    WindowedWeightedCalibration,
+)
+from torcheval_tpu.metrics.functional import (
+    click_through_rate,
+    weighted_calibration,
+)
+
+RNG = np.random.default_rng(5)
+
+
+class TestFunctional(unittest.TestCase):
+    def test_ctr_unweighted(self):
+        clicks = np.asarray([1, 0, 1, 1, 0], np.float32)
+        got = float(click_through_rate(jnp.asarray(clicks)))
+        self.assertAlmostEqual(got, 0.6, places=6)
+
+    def test_ctr_weighted(self):
+        clicks = np.asarray([1, 0, 1], np.float32)
+        w = np.asarray([2.0, 1.0, 1.0], np.float32)
+        got = float(click_through_rate(jnp.asarray(clicks), jnp.asarray(w)))
+        self.assertAlmostEqual(got, 3.0 / 4.0, places=6)
+
+    def test_ctr_multitask(self):
+        clicks = RNG.integers(0, 2, (3, 40)).astype(np.float32)
+        got = np.asarray(click_through_rate(jnp.asarray(clicks), num_tasks=3))
+        np.testing.assert_allclose(got, clicks.mean(axis=1), rtol=1e-6)
+
+    def test_ctr_empty_weight_is_zero(self):
+        got = float(
+            click_through_rate(jnp.asarray([1.0, 1.0]), jnp.asarray([0.0, 0.0]))
+        )
+        self.assertEqual(got, 0.0)
+
+    def test_calibration_values(self):
+        pred = np.asarray([0.8, 0.2, 0.5, 0.5], np.float32)
+        target = np.asarray([1, 0, 1, 0], np.float32)
+        got = float(
+            weighted_calibration(jnp.asarray(pred), jnp.asarray(target))
+        )
+        self.assertAlmostEqual(got, pred.sum() / 2.0, places=6)
+        w = np.asarray([1.0, 1.0, 2.0, 1.0], np.float32)
+        got = float(
+            weighted_calibration(
+                jnp.asarray(pred), jnp.asarray(target), jnp.asarray(w)
+            )
+        )
+        self.assertAlmostEqual(
+            got, float((pred * w).sum() / (target * w).sum()), places=6
+        )
+
+    def test_calibration_no_positives_is_zero(self):
+        got = float(
+            weighted_calibration(
+                jnp.asarray([0.5, 0.5]), jnp.asarray([0.0, 0.0])
+            )
+        )
+        self.assertEqual(got, 0.0)
+
+    def test_error_paths(self):
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            click_through_rate(jnp.zeros((2, 3)))
+        with self.assertRaisesRegex(ValueError, "num_tasks = 2"):
+            click_through_rate(jnp.zeros(3), num_tasks=2)
+        with self.assertRaisesRegex(ValueError, "`weights` shape"):
+            click_through_rate(jnp.zeros(3), jnp.zeros(4))
+        with self.assertRaisesRegex(ValueError, "`target` shape"):
+            weighted_calibration(jnp.zeros(3), jnp.zeros(4))
+        with self.assertRaisesRegex(ValueError, "`weight` shape"):
+            weighted_calibration(jnp.zeros(3), jnp.zeros(3), jnp.zeros(4))
+
+
+class TestClassMetrics(unittest.TestCase):
+    def test_ctr_streaming_and_merge(self):
+        m = ClickThroughRate()
+        a = RNG.integers(0, 2, 50).astype(np.float32)
+        b = RNG.integers(0, 2, 30).astype(np.float32)
+        m.update(jnp.asarray(a)).update(jnp.asarray(b))
+        want = np.concatenate([a, b]).mean()
+        self.assertAlmostEqual(float(m.compute()[0]), want, places=6)
+        # merge
+        x, y = ClickThroughRate(), ClickThroughRate()
+        x.update(jnp.asarray(a))
+        y.update(jnp.asarray(b))
+        x.merge_state([y])
+        self.assertAlmostEqual(float(x.compute()[0]), want, places=6)
+
+    def test_calibration_streaming(self):
+        m = WeightedCalibration()
+        pred = RNG.random(60).astype(np.float32)
+        target = RNG.integers(0, 2, 60).astype(np.float32)
+        m.update(jnp.asarray(pred[:30]), jnp.asarray(target[:30]))
+        m.update(jnp.asarray(pred[30:]), jnp.asarray(target[30:]))
+        self.assertAlmostEqual(
+            float(m.compute()[0]), float(pred.sum() / target.sum()), places=5
+        )
+
+    def test_constructor_errors(self):
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            ClickThroughRate(num_tasks=0)
+        with self.assertRaisesRegex(ValueError, "window_size"):
+            WindowedClickThroughRate(window_size=0)
+
+
+class TestWindowed(unittest.TestCase):
+    def test_window_evicts_old_updates(self):
+        m = WindowedClickThroughRate(window_size=2)
+        m.update(jnp.asarray([1.0, 1.0]))  # falls out of the window
+        m.update(jnp.asarray([0.0, 0.0]))
+        m.update(jnp.asarray([0.0, 1.0]))
+        lifetime, windowed = m.compute()
+        self.assertAlmostEqual(float(lifetime[0]), 3.0 / 6.0, places=6)
+        self.assertAlmostEqual(float(windowed[0]), 1.0 / 4.0, places=6)
+
+    def test_windowed_without_lifetime(self):
+        m = WindowedClickThroughRate(window_size=8, enable_lifetime=False)
+        m.update(jnp.asarray([1.0, 0.0]))
+        out = m.compute()  # single value, not a tuple
+        self.assertAlmostEqual(float(out[0]), 0.5, places=6)
+
+    def test_windowed_calibration(self):
+        m = WindowedWeightedCalibration(window_size=1)
+        m.update(jnp.asarray([0.9, 0.1]), jnp.asarray([1.0, 0.0]))
+        m.update(jnp.asarray([0.4, 0.6]), jnp.asarray([1.0, 1.0]))
+        lifetime, windowed = m.compute()
+        self.assertAlmostEqual(float(windowed[0]), 1.0 / 2.0, places=6)
+        self.assertAlmostEqual(float(lifetime[0]), 2.0 / 3.0, places=6)
+
+    def test_state_dict_roundtrip_preserves_window(self):
+        m = WindowedClickThroughRate(window_size=3)
+        for i in range(5):
+            m.update(jnp.asarray([float(i % 2)] * 4))
+        sd = m.state_dict()
+        m2 = WindowedClickThroughRate(window_size=3)
+        m2.load_state_dict(sd)
+        self.assertEqual(len(m2.window), 3)
+        self.assertEqual(m2.window.maxlen, 3)
+        for a, b in zip(m.compute(), m2.compute()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_merge_bounded_by_window(self):
+        a = WindowedClickThroughRate(window_size=2)
+        b = WindowedClickThroughRate(window_size=2)
+        a.update(jnp.asarray([1.0]))
+        b.update(jnp.asarray([0.0]))
+        b.update(jnp.asarray([0.0]))
+        a.merge_state([b])
+        # window keeps the most recent 2 entries: b's two zero updates
+        _, windowed = a.compute()
+        self.assertEqual(float(windowed[0]), 0.0)
+        # lifetime still counts everything
+        lifetime, _ = a.compute()
+        self.assertAlmostEqual(float(lifetime[0]), 1.0 / 3.0, places=6)
+
+    def test_merge_config_mismatch_rejected(self):
+        # merging replicas that disagree on the window configuration would
+        # silently drop lifetime counters or miscount the bound
+        a = WindowedClickThroughRate(window_size=4)
+        for bad in (
+            WindowedClickThroughRate(window_size=2),
+            WindowedClickThroughRate(window_size=4, enable_lifetime=False),
+            WindowedClickThroughRate(window_size=4, num_tasks=2),
+        ):
+            with self.assertRaisesRegex(ValueError, "Cannot merge"):
+                a.merge_state([bad])
+
+    def test_multitask_windowed(self):
+        m = WindowedClickThroughRate(num_tasks=2, window_size=4)
+        data = RNG.integers(0, 2, (2, 20)).astype(np.float32)
+        m.update(jnp.asarray(data))
+        lifetime, windowed = m.compute()
+        np.testing.assert_allclose(
+            np.asarray(windowed), data.mean(axis=1), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(lifetime), data.mean(axis=1), rtol=1e-6
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

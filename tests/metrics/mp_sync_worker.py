@@ -153,6 +153,20 @@ def main() -> None:
     r1 = sync_and_compute_collection(col, recipient_rank=1)
     results["collection_r1"] = None if r1 is None else sorted(r1)
 
+    # --- windowed deque-state metric through the object lane: per-update
+    # window-entry boundaries must survive the sync (each rank contributes
+    # its own update rows, bounded by the shared window size)
+    from torcheval_tpu.metrics import WindowedClickThroughRate
+
+    wctr = WindowedClickThroughRate(window_size=6)
+    for _ in range(2):  # 8 updates worldwide > window 6
+        wctr.update(jnp.asarray([1.0 if rank >= 2 else 0.0] * 4))
+    # rank-ordered merge: window keeps the LAST 6 of
+    # [r0,r0, r1,r1, r2,r2, r3,r3] = [0,0, 4,4, 4,4] clicks / 24 weight
+    wr = sync_and_compute(wctr, recipient_rank="all")
+    results["windowed_ctr_lifetime"] = float(np.asarray(wr[0])[0])
+    results["windowed_ctr_windowed"] = float(np.asarray(wr[1])[0])
+
     # --- wire-cost contract: count the actual collective rounds. A sync is
     # exactly TWO process_allgather calls (descriptor matrix + byte payload)
     # no matter how many states the metric (or whole array-lane collection)

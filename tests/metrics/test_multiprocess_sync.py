@@ -168,6 +168,16 @@ class TestMultiprocessSync(unittest.TestCase):
             else:
                 self.assertIsNone(res["collection_r1"])
 
+    def test_windowed_deque_sync_preserves_entry_boundaries(self):
+        # 8 updates worldwide into a window of 6: the object-lane sync must
+        # keep per-update rows (a CAT concat would collapse each rank's
+        # window into one row and the bound would miscount)
+        for res in self.results:
+            self.assertAlmostEqual(res["windowed_ctr_lifetime"], 0.5, places=6)
+            self.assertAlmostEqual(
+                res["windowed_ctr_windowed"], 16.0 / 24.0, places=6
+            )
+
     def test_sync_is_two_collective_rounds(self):
         # the wire-cost contract (counted inside the real 4-process world):
         # descriptor matrix + byte payload, independent of state count —

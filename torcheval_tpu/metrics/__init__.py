@@ -11,6 +11,7 @@ from torcheval_tpu.metrics.classification import (
     BinaryPrecision,
     BinaryPrecisionRecallCurve,
     BinaryRecall,
+    ClickThroughRate,
     MulticlassAccuracy,
     MulticlassAUPRC,
     MulticlassAUROC,
@@ -22,6 +23,9 @@ from torcheval_tpu.metrics.classification import (
     MulticlassRecall,
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
+    WeightedCalibration,
+    WindowedClickThroughRate,
+    WindowedWeightedCalibration,
 )
 from torcheval_tpu.metrics.collection import MetricCollection
 from torcheval_tpu.metrics.metric import Metric
@@ -43,6 +47,7 @@ __all__ = [
     "BinaryPrecision",
     "BinaryPrecisionRecallCurve",
     "BinaryRecall",
+    "ClickThroughRate",
     "Cat",
     "functional",
     "HitRate",
@@ -68,4 +73,7 @@ __all__ = [
     "Sum",
     "Throughput",
     "TopKMultilabelAccuracy",
+    "WeightedCalibration",
+    "WindowedClickThroughRate",
+    "WindowedWeightedCalibration",
 ]

@@ -33,7 +33,15 @@ from torcheval_tpu.metrics.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
 )
+from torcheval_tpu.metrics.classification.click_through_rate import (
+    ClickThroughRate,
+    WindowedClickThroughRate,
+)
 from torcheval_tpu.metrics.classification.recall import BinaryRecall, MulticlassRecall
+from torcheval_tpu.metrics.classification.weighted_calibration import (
+    WeightedCalibration,
+    WindowedWeightedCalibration,
+)
 
 __all__ = [
     "BinaryAccuracy",
@@ -46,6 +54,7 @@ __all__ = [
     "BinaryPrecision",
     "BinaryPrecisionRecallCurve",
     "BinaryRecall",
+    "ClickThroughRate",
     "MulticlassAccuracy",
     "MulticlassAUPRC",
     "MulticlassAUROC",
@@ -57,4 +66,7 @@ __all__ = [
     "MulticlassRecall",
     "MultilabelAccuracy",
     "TopKMultilabelAccuracy",
+    "WeightedCalibration",
+    "WindowedClickThroughRate",
+    "WindowedWeightedCalibration",
 ]

@@ -1,0 +1,82 @@
+"""Shared machinery for windowed metrics (bounded per-update deque state).
+
+One place for the window invariants so CTR and calibration (and future
+windowed metrics) cannot drift: registration, the empty-window
+representation, the stack/sum split, merge ordering, and the
+config-compatibility contract for merges.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.state import Reduction
+
+
+class WindowedStateMixin:
+    """Bounded per-update window over ``(2, num_tasks)`` stat rows.
+
+    Host class contract: set ``num_tasks``, ``window_size`` and
+    ``enable_lifetime`` attributes (validated here via ``_init_window``),
+    list its lifetime state names in ``_LIFETIME_STATES``, and call
+    ``_push_window(row_a, row_b)`` from ``update``.
+    """
+
+    _LIFETIME_STATES: Tuple[str, ...] = ()
+
+    def _init_window(self, window_size: int) -> None:
+        if window_size < 1:
+            raise ValueError(
+                "`window_size` value should be greater than and equal to 1, "
+                f"but received {window_size}."
+            )
+        self.window_size = window_size
+        # CUSTOM, not CAT: cross-process sync must preserve per-update
+        # window-entry boundaries (the typed CAT lane concatenates a rank's
+        # whole cache into ONE array, which would merge every remote update
+        # into a single window slot). CUSTOM routes sync through the object
+        # lane, which folds with merge_state — the same bounded-window
+        # semantics as a local merge.
+        self._add_state(
+            "window", deque(maxlen=window_size), reduction=Reduction.CUSTOM
+        )
+
+    def _push_window(self, a: jax.Array, b: jax.Array) -> None:
+        self.window.append(jnp.stack([a, b]))
+
+    def _window_totals(self) -> Tuple[jax.Array, jax.Array]:
+        if not self.window:
+            zeros = jnp.zeros((self.num_tasks,), jnp.float32)
+            return zeros, zeros
+        stacked = jnp.sum(jnp.stack(list(self.window)), axis=0)
+        return stacked[0], stacked[1]
+
+    def _merge_windowed(self, metrics: Iterable) -> None:
+        """Fold other replicas: lifetime states by sum, windows by extending
+        this one's deque (others' entries appended in iteration order — the
+        bounded window keeps the most recent ``window_size``). Replicas must
+        agree on the window configuration; a mismatch would silently drop
+        lifetime counters or miscount the bound."""
+        for metric in metrics:
+            for attr in ("num_tasks", "window_size", "enable_lifetime"):
+                if getattr(self, attr) != getattr(metric, attr):
+                    raise ValueError(
+                        f"Cannot merge {type(self).__name__} replicas with "
+                        f"different `{attr}` ({getattr(self, attr)} vs "
+                        f"{getattr(metric, attr)})."
+                    )
+            if self.enable_lifetime:
+                for name in self._LIFETIME_STATES:
+                    setattr(
+                        self,
+                        name,
+                        getattr(self, name)
+                        + jax.device_put(getattr(metric, name), self.device),
+                    )
+            self.window.extend(
+                jax.device_put(row, self.device) for row in metric.window
+            )

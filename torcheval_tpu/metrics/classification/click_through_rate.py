@@ -1,0 +1,164 @@
+"""ClickThroughRate and its windowed variant.
+
+Extensions beyond the reference snapshot (see the functional module's note).
+``WindowedClickThroughRate`` is a shipped deque-state metric: the window is
+a ``deque(maxlen=window_size)`` of per-update ``(clicks, weight)`` rows, so
+the base class's deque machinery (state-dict round trips preserving
+``maxlen``, object-lane sync, merge bounded by the window) carries a real
+metric, not just the test dummies. Window mechanics live in
+:mod:`._windowed` (shared with the calibration variant).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.classification._windowed import WindowedStateMixin
+from torcheval_tpu.metrics.functional.classification.click_through_rate import (
+    _click_through_rate_update,
+    _ctr_compute,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+def _check_num_tasks(num_tasks: int) -> None:
+    if num_tasks < 1:
+        raise ValueError(
+            "`num_tasks` value should be greater than and equal to 1, "
+            f"but received {num_tasks}."
+        )
+
+
+class ClickThroughRate(Metric[jax.Array]):
+    """Streaming weighted click-through rate.
+
+    ``compute()`` returns ``sum(w * clicks) / sum(w)`` with shape
+    ``(num_tasks,)`` (``0.0`` per task before any weighted update).
+    """
+
+    def __init__(
+        self, *, num_tasks: int = 1, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        _check_num_tasks(num_tasks)
+        self.num_tasks = num_tasks
+        for name in ("click_total", "weight_total"):
+            self._add_state(
+                name,
+                jnp.zeros((num_tasks,), dtype=jnp.float32),
+                reduction=Reduction.SUM,
+            )
+
+    def update(
+        self,
+        input,
+        weights: Union[float, int, jax.Array, None] = None,
+    ) -> "ClickThroughRate":
+        input = self._input(input)
+        if weights is not None and hasattr(weights, "shape"):
+            weights = self._input(weights)
+        clicks, total = _click_through_rate_update(
+            input, self.num_tasks, weights
+        )
+        # the fold reduces to scalars at num_tasks=1; states and window
+        # rows always carry the (num_tasks,) axis
+        clicks = jnp.reshape(clicks, (self.num_tasks,))
+        total = jnp.reshape(total, (self.num_tasks,))
+        self.click_total = self.click_total + clicks
+        self.weight_total = self.weight_total + total
+        return self
+
+    def compute(self) -> jax.Array:
+        return _ctr_compute(self.click_total, self.weight_total)
+
+    def merge_state(
+        self, metrics: Iterable["ClickThroughRate"]
+    ) -> "ClickThroughRate":
+        for metric in metrics:
+            self.click_total = self.click_total + jax.device_put(
+                metric.click_total, self.device
+            )
+            self.weight_total = self.weight_total + jax.device_put(
+                metric.weight_total, self.device
+            )
+        return self
+
+
+class WindowedClickThroughRate(
+    WindowedStateMixin, Metric[Tuple[jax.Array, jax.Array]]
+):
+    """CTR over the last ``window_size`` updates, optionally with lifetime.
+
+    The window state is a ``deque(maxlen=window_size)`` of per-update
+    ``(2, num_tasks)`` rows ``[clicks, weight]`` — the oldest update falls
+    out automatically. ``merge_state`` appends the other replicas' windows
+    after this one's (most recent entries win the bounded window); the
+    lifetime counters merge by sum. Replicas must share the same window
+    configuration to merge.
+
+    ``compute()`` returns ``(lifetime_ctr, windowed_ctr)`` when
+    ``enable_lifetime`` (default), else just the windowed rate; each has
+    shape ``(num_tasks,)``.
+    """
+
+    _LIFETIME_STATES = ("click_total", "weight_total")
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        window_size: int = 100,
+        enable_lifetime: bool = True,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _check_num_tasks(num_tasks)
+        self.num_tasks = num_tasks
+        self.enable_lifetime = enable_lifetime
+        if enable_lifetime:
+            for name in self._LIFETIME_STATES:
+                self._add_state(
+                    name,
+                    jnp.zeros((num_tasks,), dtype=jnp.float32),
+                    reduction=Reduction.SUM,
+                )
+        self._init_window(window_size)
+
+    def update(
+        self,
+        input,
+        weights: Union[float, int, jax.Array, None] = None,
+    ) -> "WindowedClickThroughRate":
+        input = self._input(input)
+        if weights is not None and hasattr(weights, "shape"):
+            weights = self._input(weights)
+        clicks, total = _click_through_rate_update(
+            input, self.num_tasks, weights
+        )
+        # the fold reduces to scalars at num_tasks=1; states and window
+        # rows always carry the (num_tasks,) axis
+        clicks = jnp.reshape(clicks, (self.num_tasks,))
+        total = jnp.reshape(total, (self.num_tasks,))
+        if self.enable_lifetime:
+            self.click_total = self.click_total + clicks
+            self.weight_total = self.weight_total + total
+        self._push_window(clicks, total)
+        return self
+
+    def compute(self):
+        clicks, total = self._window_totals()
+        windowed = _ctr_compute(clicks, total)
+        if not self.enable_lifetime:
+            return windowed
+        return _ctr_compute(self.click_total, self.weight_total), windowed
+
+    def merge_state(
+        self, metrics: Iterable["WindowedClickThroughRate"]
+    ) -> "WindowedClickThroughRate":
+        self._merge_windowed(metrics)
+        return self

@@ -1,0 +1,156 @@
+"""WeightedCalibration and its windowed variant.
+
+Extensions beyond the reference snapshot (see the functional module's note).
+Same state layout as :mod:`.click_through_rate`: two SUM scalars per task,
+and for the windowed variant a bounded per-update window via the shared
+:mod:`._windowed` mixin.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.classification._windowed import WindowedStateMixin
+from torcheval_tpu.metrics.classification.click_through_rate import (
+    _check_num_tasks,
+)
+from torcheval_tpu.metrics.functional.classification.weighted_calibration import (
+    _calibration_compute,
+    _weighted_calibration_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+class WeightedCalibration(Metric[jax.Array]):
+    """Streaming ``sum(w * input) / sum(w * target)`` per task."""
+
+    def __init__(
+        self, *, num_tasks: int = 1, device: DeviceLike = None
+    ) -> None:
+        super().__init__(device=device)
+        _check_num_tasks(num_tasks)
+        self.num_tasks = num_tasks
+        for name in ("weighted_input_sum", "weighted_label_sum"):
+            self._add_state(
+                name,
+                jnp.zeros((num_tasks,), dtype=jnp.float32),
+                reduction=Reduction.SUM,
+            )
+
+    def update(
+        self,
+        input,
+        target,
+        weight: Union[float, int, jax.Array, None] = None,
+    ) -> "WeightedCalibration":
+        input, target = self._input(input), self._input(target)
+        if weight is not None and hasattr(weight, "shape"):
+            weight = self._input(weight)
+        pred, label = _weighted_calibration_update(
+            input, target, self.num_tasks, weight
+        )
+        # the fold reduces to scalars at num_tasks=1; states and window
+        # rows always carry the (num_tasks,) axis
+        pred = jnp.reshape(pred, (self.num_tasks,))
+        label = jnp.reshape(label, (self.num_tasks,))
+        self.weighted_input_sum = self.weighted_input_sum + pred
+        self.weighted_label_sum = self.weighted_label_sum + label
+        return self
+
+    def compute(self) -> jax.Array:
+        return _calibration_compute(
+            self.weighted_input_sum, self.weighted_label_sum
+        )
+
+    def merge_state(
+        self, metrics: Iterable["WeightedCalibration"]
+    ) -> "WeightedCalibration":
+        for metric in metrics:
+            self.weighted_input_sum = self.weighted_input_sum + jax.device_put(
+                metric.weighted_input_sum, self.device
+            )
+            self.weighted_label_sum = self.weighted_label_sum + jax.device_put(
+                metric.weighted_label_sum, self.device
+            )
+        return self
+
+
+class WindowedWeightedCalibration(
+    WindowedStateMixin, Metric[Tuple[jax.Array, jax.Array]]
+):
+    """Calibration over the last ``window_size`` updates.
+
+    Window/merge/compute semantics mirror
+    :class:`~torcheval_tpu.metrics.WindowedClickThroughRate` (shared mixin):
+    ``compute()`` returns ``(lifetime, windowed)`` when ``enable_lifetime``
+    (default), else the windowed value alone; shapes ``(num_tasks,)``.
+    Replicas must share the same window configuration to merge.
+    """
+
+    _LIFETIME_STATES = ("weighted_input_sum", "weighted_label_sum")
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        window_size: int = 100,
+        enable_lifetime: bool = True,
+        device: DeviceLike = None,
+    ) -> None:
+        super().__init__(device=device)
+        _check_num_tasks(num_tasks)
+        self.num_tasks = num_tasks
+        self.enable_lifetime = enable_lifetime
+        if enable_lifetime:
+            for name in self._LIFETIME_STATES:
+                self._add_state(
+                    name,
+                    jnp.zeros((num_tasks,), dtype=jnp.float32),
+                    reduction=Reduction.SUM,
+                )
+        self._init_window(window_size)
+
+    def update(
+        self,
+        input,
+        target,
+        weight: Union[float, int, jax.Array, None] = None,
+    ) -> "WindowedWeightedCalibration":
+        input, target = self._input(input), self._input(target)
+        if weight is not None and hasattr(weight, "shape"):
+            weight = self._input(weight)
+        pred, label = _weighted_calibration_update(
+            input, target, self.num_tasks, weight
+        )
+        # the fold reduces to scalars at num_tasks=1; states and window
+        # rows always carry the (num_tasks,) axis
+        pred = jnp.reshape(pred, (self.num_tasks,))
+        label = jnp.reshape(label, (self.num_tasks,))
+        if self.enable_lifetime:
+            self.weighted_input_sum = self.weighted_input_sum + pred
+            self.weighted_label_sum = self.weighted_label_sum + label
+        self._push_window(pred, label)
+        return self
+
+    def compute(self):
+        pred, label = self._window_totals()
+        windowed = _calibration_compute(pred, label)
+        if not self.enable_lifetime:
+            return windowed
+        return (
+            _calibration_compute(
+                self.weighted_input_sum, self.weighted_label_sum
+            ),
+            windowed,
+        )
+
+    def merge_state(
+        self, metrics: Iterable["WindowedWeightedCalibration"]
+    ) -> "WindowedWeightedCalibration":
+        self._merge_windowed(metrics)
+        return self

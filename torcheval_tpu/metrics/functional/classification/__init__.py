@@ -33,6 +33,12 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
     binary_precision_recall_curve,
     multiclass_precision_recall_curve,
 )
+from torcheval_tpu.metrics.functional.classification.click_through_rate import (
+    click_through_rate,
+)
+from torcheval_tpu.metrics.functional.classification.weighted_calibration import (
+    weighted_calibration,
+)
 from torcheval_tpu.metrics.functional.classification.recall import (
     binary_recall,
     multiclass_recall,
@@ -49,6 +55,7 @@ __all__ = [
     "binary_precision",
     "binary_precision_recall_curve",
     "binary_recall",
+    "click_through_rate",
     "multiclass_accuracy",
     "multiclass_auprc",
     "multiclass_auroc",
@@ -60,4 +67,5 @@ __all__ = [
     "multiclass_recall",
     "multilabel_accuracy",
     "topk_multilabel_accuracy",
+    "weighted_calibration",
 ]

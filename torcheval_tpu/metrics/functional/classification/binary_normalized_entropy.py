@@ -76,16 +76,6 @@ def _ne_input_check(
         )
 
 
-        arr = np.asarray(input)
-        if arr.size and (arr.max() > 1.0 or arr.min() < 0.0):
-            raise ValueError(
-                f"`from_logits`={from_logits}, `input` should be probability "
-                f"in range [0., 1.], but got `input` ranging from {arr.min()} "
-                f"to {arr.max()}. Please set `from_logits = True` or convert "
-                "`input` into valid probability value."
-            )
-
-
 @partial(jax.jit, static_argnames=("from_logits",))
 def _ne_fold(
     input: jax.Array,

@@ -1,0 +1,93 @@
+"""Click-through rate: weighted click frequency.
+
+Extension beyond the reference snapshot (which ships no CTR metric; its CTR
+*calibration* companion is ``binary_normalized_entropy``, reference
+``torcheval/metrics/functional/classification/binary_normalized_entropy.py``).
+Modeled on the upstream torcheval windowed/CTR family's semantics:
+``ctr = sum(weight * clicks) / sum(weight)`` per task, ``0.0`` when no
+weight has been seen. Sufficient statistics — ``click_total`` and
+``weight_total`` — are both SUM-mergeable, so the class metric syncs on the
+typed wire like every counter.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _ctr_input_check(
+    input: jax.Array, num_tasks: int, weights: Optional[jax.Array]
+) -> None:
+    if weights is not None and getattr(weights, "ndim", 0) and (
+        input.shape != weights.shape
+    ):
+        raise ValueError(
+            f"`weights` shape ({weights.shape}) is different from `input` "
+            f"shape ({input.shape})"
+        )
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )
+
+
+@jax.jit
+def _ctr_fold(
+    input: jax.Array, weights: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    input = input.astype(jnp.float32)
+    w = jnp.broadcast_to(jnp.asarray(weights, jnp.float32), input.shape)
+    return jnp.sum(w * input, axis=-1), jnp.sum(w, axis=-1)
+
+
+def _click_through_rate_update(
+    input: jax.Array,
+    num_tasks: int,
+    weights: Union[float, int, jax.Array, None],
+) -> Tuple[jax.Array, jax.Array]:
+    _ctr_input_check(input, num_tasks, weights if hasattr(weights, "shape") else None)
+    if weights is None:
+        weights = 1.0
+    return _ctr_fold(input, as_jax(weights))
+
+
+@jax.jit
+def _ctr_compute(click_total: jax.Array, weight_total: jax.Array) -> jax.Array:
+    # 0.0 when nothing was weighed in: branch-free, jit-embeddable
+    return jnp.where(
+        weight_total > 0.0, click_total / jnp.maximum(weight_total, 1e-38), 0.0
+    )
+
+
+def click_through_rate(
+    input,
+    weights: Union[float, int, jax.Array, None] = None,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """``sum(weights * input) / sum(weights)`` — the weighted click rate.
+
+    Args:
+        input: click indicators (0/1), shape ``(num_samples,)`` or
+            ``(num_tasks, num_samples)``.
+        weights: optional per-sample weights (scalar or same shape as
+            ``input``); default 1.
+        num_tasks: number of parallel tasks (leading axis when > 1).
+
+    Returns ``0.0`` (per task) when the total weight is zero.
+    """
+    input = as_jax(input)
+    clicks, total = _click_through_rate_update(input, num_tasks, weights)
+    return _ctr_compute(clicks, total)

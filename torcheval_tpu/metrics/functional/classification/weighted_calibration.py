@@ -1,0 +1,109 @@
+"""Weighted calibration: predicted positives over observed positives.
+
+Extension beyond the reference snapshot (no calibration-ratio metric ships
+there; the nearest neighbour is ``binary_normalized_entropy``, reference
+``torcheval/metrics/functional/classification/binary_normalized_entropy.py``).
+``calibration = sum(weight * input) / sum(weight * target)`` per task — the
+standard ads-ranking check that predicted click probability mass matches
+observed clicks (1.0 = perfectly calibrated, > 1 over-predicts). ``0.0``
+when no positive labels have been seen. Both sufficient statistics are
+SUM-mergeable scalars per task.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _calibration_input_check(
+    input: jax.Array,
+    target: jax.Array,
+    num_tasks: int,
+    weight: Optional[jax.Array],
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            f"`input` shape ({input.shape}) is different from `target` shape "
+            f"({target.shape})"
+        )
+    if weight is not None and getattr(weight, "ndim", 0) and (
+        input.shape != weight.shape
+    ):
+        raise ValueError(
+            f"`weight` shape ({weight.shape}) is different from `input` "
+            f"shape ({input.shape})"
+        )
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )
+
+
+@jax.jit
+def _calibration_fold(
+    input: jax.Array, target: jax.Array, weight: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    input = input.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    w = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), input.shape)
+    return jnp.sum(w * input, axis=-1), jnp.sum(w * target, axis=-1)
+
+
+def _weighted_calibration_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_tasks: int,
+    weight: Union[float, int, jax.Array, None],
+) -> Tuple[jax.Array, jax.Array]:
+    _calibration_input_check(
+        input, target, num_tasks, weight if hasattr(weight, "shape") else None
+    )
+    if weight is None:
+        weight = 1.0
+    return _calibration_fold(input, target, as_jax(weight))
+
+
+@jax.jit
+def _calibration_compute(
+    weighted_input_sum: jax.Array, weighted_label_sum: jax.Array
+) -> jax.Array:
+    return jnp.where(
+        weighted_label_sum > 0.0,
+        weighted_input_sum / jnp.maximum(weighted_label_sum, 1e-38),
+        0.0,
+    )
+
+
+def weighted_calibration(
+    input,
+    target,
+    weight: Union[float, int, jax.Array, None] = None,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """``sum(weight * input) / sum(weight * target)`` per task.
+
+    Args:
+        input: predicted probabilities, shape ``(num_samples,)`` or
+            ``(num_tasks, num_samples)``.
+        target: binary labels, same shape.
+        weight: optional per-sample weights (scalar or same shape); default 1.
+        num_tasks: number of parallel tasks (leading axis when > 1).
+
+    Returns ``0.0`` (per task) when no positive label mass has been seen.
+    """
+    input, target = as_jax(input), as_jax(target)
+    pred, label = _weighted_calibration_update(input, target, num_tasks, weight)
+    return _calibration_compute(pred, label)
