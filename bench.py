@@ -91,34 +91,42 @@ def _time(fn, repeats=3):
     return med - rtt
 
 
-def _time_chain(fn, n=5):
+def _time_chain(fn, n=5, chains=2):
     """Amortised timing for dispatch-light legs: queue ``n`` independent runs
     (``fn`` returns device values WITHOUT reading back), then pay ONE
     host-readback barrier and divide. The tunnel's ~0.1 s round trip — whose
     run-to-run variance dwarfs a 10-40 ms signal — is paid once for n runs
     instead of once per run, cutting its noise contribution by n. The final
     ``device_get`` guarantees every queued run actually finished
-    (``block_until_ready`` alone is not trustworthy here; see ``_time``)."""
-    import jax
+    (``block_until_ready`` alone is not trustworthy here; see ``_time``).
 
-    t0 = time.perf_counter()
-    outs = [fn() for _ in range(n)]
-    jax.device_get(outs)  # one round trip; see _block for why no block_until_ready
-    elapsed = time.perf_counter() - t0
-    rtts = []
+    The whole chain runs ``chains`` times and the BEST per-run time wins: a
+    single co-tenant stall mid-chain poisons all ``n`` runs sharing that
+    barrier (observed: the config-3 plain row swinging 0.7-1.2x vs baseline
+    run-to-run), so within-chain medianing cannot help — only an
+    independent chain can."""
+    import jax
     import jax.numpy as jnp
 
-    for i in range(3):
-        fresh = jnp.float32(i) + 2.0
-        jax.block_until_ready(fresh)
+    per_run = []
+    for _ in range(chains):
         t0 = time.perf_counter()
-        jax.device_get(fresh)
-        rtts.append(time.perf_counter() - t0)
-    rtts.sort()
-    corrected = elapsed - rtts[1]
-    if corrected <= 0:
-        corrected = elapsed  # burst caught by the probe: stay conservative
-    return corrected / n
+        outs = [fn() for _ in range(n)]
+        jax.device_get(outs)  # one round trip; see _block
+        elapsed = time.perf_counter() - t0
+        rtts = []
+        for i in range(3):
+            fresh = jnp.float32(i) + 2.0
+            jax.block_until_ready(fresh)
+            t0 = time.perf_counter()
+            jax.device_get(fresh)
+            rtts.append(time.perf_counter() - t0)
+        rtts.sort()
+        corrected = elapsed - rtts[1]
+        if corrected <= 0:
+            corrected = elapsed  # burst caught by the probe: stay conservative
+        per_run.append(corrected / n)
+    return min(per_run)
 
 
 def _block(*values):

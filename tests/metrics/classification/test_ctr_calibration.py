@@ -193,5 +193,68 @@ class TestWindowed(unittest.TestCase):
         )
 
 
+class TestStandardProtocol(unittest.TestCase):
+    """Run the 4 new classes through the standard class-metric harness:
+    init/state registry, pickle + state-dict round trips, idempotent
+    compute, N-way merge == single stream, merge leaves sources unmutated.
+    Windowed metrics use window_size >= total updates so the bounded window
+    holds every update and the merge==stream equivalence applies."""
+
+    def _run(self, metric, state_names, update_kwargs, compute_result):
+        from torcheval_tpu.utils.test_utils import MetricClassTester
+
+        class _T(MetricClassTester):
+            def runTest(self):  # pragma: no cover - invoked via _run
+                pass
+
+        t = _T()
+        t.run_class_implementation_tests(
+            metric=metric,
+            state_names=state_names,
+            update_kwargs=update_kwargs,
+            compute_result=compute_result,
+        )
+
+    def test_ctr_protocol(self):
+        clicks = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+        self._run(
+            ClickThroughRate(),
+            {"click_total", "weight_total"},
+            {"input": jnp.asarray(clicks)},
+            np.asarray([clicks.mean()], np.float32),
+        )
+
+    def test_calibration_protocol(self):
+        pred = RNG.random((8, 16)).astype(np.float32)
+        target = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+        self._run(
+            WeightedCalibration(),
+            {"weighted_input_sum", "weighted_label_sum"},
+            {"input": jnp.asarray(pred), "target": jnp.asarray(target)},
+            np.asarray([pred.sum() / target.sum()], np.float32),
+        )
+
+    def test_windowed_ctr_protocol(self):
+        clicks = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+        want = np.asarray([clicks.mean()], np.float32)
+        self._run(
+            WindowedClickThroughRate(window_size=16),
+            {"click_total", "weight_total", "window"},
+            {"input": jnp.asarray(clicks)},
+            (want, want),  # lifetime == windowed: everything fits the window
+        )
+
+    def test_windowed_calibration_protocol(self):
+        pred = RNG.random((8, 16)).astype(np.float32)
+        target = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+        want = np.asarray([pred.sum() / target.sum()], np.float32)
+        self._run(
+            WindowedWeightedCalibration(window_size=16),
+            {"weighted_input_sum", "weighted_label_sum", "window"},
+            {"input": jnp.asarray(pred), "target": jnp.asarray(target)},
+            (want, want),
+        )
+
+
 if __name__ == "__main__":
     unittest.main()

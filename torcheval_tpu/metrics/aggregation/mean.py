@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
 from torcheval_tpu.metrics.functional.aggregation.sum import _weight_check
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 from torcheval_tpu.utils.numerics import safe_div
 from torcheval_tpu.utils.tracing import async_value_warn
@@ -30,8 +30,8 @@ class Mean(Metric[jax.Array]):
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
-        self._add_state("weighted_sum", jnp.zeros(()), reduction=Reduction.SUM)
-        self._add_state("weights", jnp.zeros(()), reduction=Reduction.SUM)
+        self._add_state("weighted_sum", zeros_state(), reduction=Reduction.SUM)
+        self._add_state("weights", zeros_state(), reduction=Reduction.SUM)
 
     def update(
         self,

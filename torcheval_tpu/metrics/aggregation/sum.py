@@ -9,7 +9,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update, _weight_check
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -21,7 +21,7 @@ class Sum(Metric[jax.Array]):
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
-        self._add_state("weighted_sum", jnp.zeros(()), reduction=Reduction.SUM)
+        self._add_state("weighted_sum", zeros_state(), reduction=Reduction.SUM)
 
     def update(
         self,

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 from torcheval_tpu.utils.numerics import safe_div
 from torcheval_tpu.utils.tracing import async_value_warn
@@ -32,8 +32,8 @@ class Throughput(Metric[jax.Array]):
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
-        self._add_state("num_total", jnp.zeros(()), reduction=Reduction.SUM)
-        self._add_state("elapsed_time_sec", jnp.zeros(()), reduction=Reduction.MAX)
+        self._add_state("num_total", zeros_state(), reduction=Reduction.SUM)
+        self._add_state("elapsed_time_sec", zeros_state(), reduction=Reduction.MAX)
 
     def update(self, num_processed: int, elapsed_time_sec: float) -> "Throughput":
         if num_processed < 0:

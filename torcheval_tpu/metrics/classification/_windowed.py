@@ -60,7 +60,10 @@ class WindowedStateMixin:
         this one's deque (others' entries appended in iteration order — the
         bounded window keeps the most recent ``window_size``). Replicas must
         agree on the window configuration; a mismatch would silently drop
-        lifetime counters or miscount the bound."""
+        lifetime counters or miscount the bound. ALL replicas are validated
+        before ANY folds so a mismatch raises with ``self`` unmutated (a
+        mid-loop raise would leave a half-merged state)."""
+        metrics = list(metrics)
         for metric in metrics:
             for attr in ("num_tasks", "window_size", "enable_lifetime"):
                 if getattr(self, attr) != getattr(metric, attr):
@@ -69,6 +72,7 @@ class WindowedStateMixin:
                         f"different `{attr}` ({getattr(self, attr)} vs "
                         f"{getattr(metric, attr)})."
                     )
+        for metric in metrics:
             if self.enable_lifetime:
                 for name in self._LIFETIME_STATES:
                     setattr(

@@ -32,7 +32,7 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
 )
 from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 TAccuracy = TypeVar("TAccuracy", bound="MulticlassAccuracy")
@@ -94,10 +94,10 @@ class MulticlassAccuracy(DeferredFoldMixin, Metric[jax.Array]):
         self.k = k
         shape = () if average == "micro" else (num_classes,)
         self._add_state(
-            "num_correct", jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+            "num_correct", zeros_state(shape, dtype=jnp.int32), reduction=Reduction.SUM
         )
         self._add_state(
-            "num_total", jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+            "num_total", zeros_state(shape, dtype=jnp.int32), reduction=Reduction.SUM
         )
         self._init_deferred()
         self._fold_params = (self.average, self.num_classes, self.k)

@@ -28,7 +28,7 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
     _multiclass_precision_recall_curve_update_input_check,
 )
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.ops.curves import (
     binary_auprc_counts_kernel,
     binary_auprc_kernel,
@@ -165,7 +165,7 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         # checked (and raised on) at compute() instead of per compaction
         self._add_state(
             "summary_nan_dropped",
-            jnp.zeros((), dtype=jnp.int32),
+            zeros_state((), dtype=jnp.int32),
             reduction=Reduction.SUM,
         )
 

@@ -14,7 +14,7 @@ from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy i
     _binary_normalized_entropy_update,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 _STATE_NAMES = ("total_entropy", "num_examples", "num_positive")
@@ -51,7 +51,7 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
         for name in _STATE_NAMES:
             self._add_state(
                 name,
-                jnp.zeros((num_tasks,), dtype=jnp.float32),
+                zeros_state((num_tasks,), dtype=jnp.float32),
                 reduction=Reduction.SUM,
             )
 

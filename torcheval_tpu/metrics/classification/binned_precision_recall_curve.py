@@ -35,7 +35,7 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
     _multiclass_precision_recall_curve_update_input_check,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 _COUNTER_NAMES = ("num_tp", "num_fp", "num_fn")
@@ -89,7 +89,7 @@ class BinaryBinnedPrecisionRecallCurve(
         n = threshold.shape[0]
         for name in _COUNTER_NAMES:
             self._add_state(
-                name, jnp.zeros((n,), dtype=jnp.int32), reduction=Reduction.SUM
+                name, zeros_state((n,), dtype=jnp.int32), reduction=Reduction.SUM
             )
         self._init_deferred()
         self._fold_params = (_threshold_fold_params(threshold),)
@@ -158,7 +158,7 @@ class MulticlassBinnedPrecisionRecallCurve(
         for name in _COUNTER_NAMES:
             self._add_state(
                 name,
-                jnp.zeros((n, num_classes), dtype=jnp.int32),
+                zeros_state((n, num_classes), dtype=jnp.int32),
                 reduction=Reduction.SUM,
             )
         self._init_deferred()

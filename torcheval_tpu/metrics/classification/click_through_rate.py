@@ -22,7 +22,7 @@ from torcheval_tpu.metrics.functional.classification.click_through_rate import (
     _ctr_compute,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -32,6 +32,20 @@ def _check_num_tasks(num_tasks: int) -> None:
             "`num_tasks` value should be greater than and equal to 1, "
             f"but received {num_tasks}."
         )
+
+
+def _fold_ctr(metric, input, weights):
+    """Place inputs, run the fold, normalize to the ``(num_tasks,)`` axis
+    (the fold reduces to scalars at ``num_tasks=1``) — shared by the plain
+    and windowed classes so the update contract cannot drift."""
+    input = metric._input(input)
+    if weights is not None and hasattr(weights, "shape"):
+        weights = metric._input(weights)
+    clicks, total = _click_through_rate_update(input, metric.num_tasks, weights)
+    return (
+        jnp.reshape(clicks, (metric.num_tasks,)),
+        jnp.reshape(total, (metric.num_tasks,)),
+    )
 
 
 class ClickThroughRate(Metric[jax.Array]):
@@ -50,7 +64,7 @@ class ClickThroughRate(Metric[jax.Array]):
         for name in ("click_total", "weight_total"):
             self._add_state(
                 name,
-                jnp.zeros((num_tasks,), dtype=jnp.float32),
+                zeros_state((num_tasks,), dtype=jnp.float32),
                 reduction=Reduction.SUM,
             )
 
@@ -59,16 +73,7 @@ class ClickThroughRate(Metric[jax.Array]):
         input,
         weights: Union[float, int, jax.Array, None] = None,
     ) -> "ClickThroughRate":
-        input = self._input(input)
-        if weights is not None and hasattr(weights, "shape"):
-            weights = self._input(weights)
-        clicks, total = _click_through_rate_update(
-            input, self.num_tasks, weights
-        )
-        # the fold reduces to scalars at num_tasks=1; states and window
-        # rows always carry the (num_tasks,) axis
-        clicks = jnp.reshape(clicks, (self.num_tasks,))
-        total = jnp.reshape(total, (self.num_tasks,))
+        clicks, total = _fold_ctr(self, input, weights)
         self.click_total = self.click_total + clicks
         self.weight_total = self.weight_total + total
         return self
@@ -124,7 +129,7 @@ class WindowedClickThroughRate(
             for name in self._LIFETIME_STATES:
                 self._add_state(
                     name,
-                    jnp.zeros((num_tasks,), dtype=jnp.float32),
+                    zeros_state((num_tasks,), dtype=jnp.float32),
                     reduction=Reduction.SUM,
                 )
         self._init_window(window_size)
@@ -134,16 +139,7 @@ class WindowedClickThroughRate(
         input,
         weights: Union[float, int, jax.Array, None] = None,
     ) -> "WindowedClickThroughRate":
-        input = self._input(input)
-        if weights is not None and hasattr(weights, "shape"):
-            weights = self._input(weights)
-        clicks, total = _click_through_rate_update(
-            input, self.num_tasks, weights
-        )
-        # the fold reduces to scalars at num_tasks=1; states and window
-        # rows always carry the (num_tasks,) axis
-        clicks = jnp.reshape(clicks, (self.num_tasks,))
-        total = jnp.reshape(total, (self.num_tasks,))
+        clicks, total = _fold_ctr(self, input, weights)
         if self.enable_lifetime:
             self.click_total = self.click_total + clicks
             self.weight_total = self.weight_total + total

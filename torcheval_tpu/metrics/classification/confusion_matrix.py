@@ -21,7 +21,7 @@ from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _confusion_matrix_param_check,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.ops.confusion import confusion_matrix_counts, normalize_confusion_matrix
 from torcheval_tpu.utils.devices import DeviceLike
 
@@ -58,7 +58,7 @@ class MulticlassConfusionMatrix(DeferredFoldMixin, Metric[jax.Array]):
         self.normalize = normalize
         self._add_state(
             "confusion_matrix",
-            jnp.zeros((num_classes, num_classes), dtype=jnp.int32),
+            zeros_state((num_classes, num_classes), dtype=jnp.int32),
             reduction=Reduction.SUM,
         )
         self._init_deferred()

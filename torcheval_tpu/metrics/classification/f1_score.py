@@ -20,7 +20,7 @@ from torcheval_tpu.metrics.functional.classification.f1_score import (
 )
 from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -70,7 +70,7 @@ class MulticlassF1Score(DeferredFoldMixin, Metric[jax.Array]):
         shape = () if average == "micro" else (num_classes,)
         for name in ("num_tp", "num_label", "num_prediction"):
             self._add_state(
-                name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+                name, zeros_state(shape, dtype=jnp.int32), reduction=Reduction.SUM
             )
         self._init_deferred()
         self._fold_params = (self.num_classes, self.average)

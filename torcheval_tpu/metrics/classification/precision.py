@@ -20,7 +20,7 @@ from torcheval_tpu.metrics.functional.classification.precision import (
 )
 from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -62,7 +62,7 @@ class MulticlassPrecision(DeferredFoldMixin, Metric[jax.Array]):
         shape = () if average == "micro" else (num_classes,)
         for name in ("num_tp", "num_fp", "num_label"):
             self._add_state(
-                name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+                name, zeros_state(shape, dtype=jnp.int32), reduction=Reduction.SUM
             )
         self._init_deferred()
         self._fold_params = (self.num_classes, self.average)

@@ -21,7 +21,7 @@ from torcheval_tpu.metrics.functional.classification.recall import (
 )
 from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -65,7 +65,7 @@ class MulticlassRecall(DeferredFoldMixin, Metric[jax.Array]):
         shape = () if average == "micro" else (num_classes,)
         for name in ("num_tp", "num_labels", "num_predictions"):
             self._add_state(
-                name, jnp.zeros(shape, dtype=jnp.int32), reduction=Reduction.SUM
+                name, zeros_state(shape, dtype=jnp.int32), reduction=Reduction.SUM
             )
         self._init_deferred()
         self._fold_params = (self.num_classes, self.average)
@@ -115,9 +115,9 @@ class BinaryRecall(DeferredFoldMixin, Metric[jax.Array]):
     ) -> None:
         super().__init__(device=device)
         self.threshold = threshold
-        self._add_state("num_tp", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM)
+        self._add_state("num_tp", zeros_state((), dtype=jnp.int32), reduction=Reduction.SUM)
         self._add_state(
-            "num_true_labels", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM
+            "num_true_labels", zeros_state((), dtype=jnp.int32), reduction=Reduction.SUM
         )
         self._init_deferred()
         self._fold_params = (threshold,)

@@ -22,8 +22,23 @@ from torcheval_tpu.metrics.functional.classification.weighted_calibration import
     _weighted_calibration_update,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
+
+
+def _fold_calibration(metric, input, target, weight):
+    """Place inputs, run the fold, normalize to the ``(num_tasks,)`` axis —
+    shared by the plain and windowed classes (see ``_fold_ctr``)."""
+    input, target = metric._input(input), metric._input(target)
+    if weight is not None and hasattr(weight, "shape"):
+        weight = metric._input(weight)
+    pred, label = _weighted_calibration_update(
+        input, target, metric.num_tasks, weight
+    )
+    return (
+        jnp.reshape(pred, (metric.num_tasks,)),
+        jnp.reshape(label, (metric.num_tasks,)),
+    )
 
 
 class WeightedCalibration(Metric[jax.Array]):
@@ -38,7 +53,7 @@ class WeightedCalibration(Metric[jax.Array]):
         for name in ("weighted_input_sum", "weighted_label_sum"):
             self._add_state(
                 name,
-                jnp.zeros((num_tasks,), dtype=jnp.float32),
+                zeros_state((num_tasks,), dtype=jnp.float32),
                 reduction=Reduction.SUM,
             )
 
@@ -48,16 +63,7 @@ class WeightedCalibration(Metric[jax.Array]):
         target,
         weight: Union[float, int, jax.Array, None] = None,
     ) -> "WeightedCalibration":
-        input, target = self._input(input), self._input(target)
-        if weight is not None and hasattr(weight, "shape"):
-            weight = self._input(weight)
-        pred, label = _weighted_calibration_update(
-            input, target, self.num_tasks, weight
-        )
-        # the fold reduces to scalars at num_tasks=1; states and window
-        # rows always carry the (num_tasks,) axis
-        pred = jnp.reshape(pred, (self.num_tasks,))
-        label = jnp.reshape(label, (self.num_tasks,))
+        pred, label = _fold_calibration(self, input, target, weight)
         self.weighted_input_sum = self.weighted_input_sum + pred
         self.weighted_label_sum = self.weighted_label_sum + label
         return self
@@ -110,7 +116,7 @@ class WindowedWeightedCalibration(
             for name in self._LIFETIME_STATES:
                 self._add_state(
                     name,
-                    jnp.zeros((num_tasks,), dtype=jnp.float32),
+                    zeros_state((num_tasks,), dtype=jnp.float32),
                     reduction=Reduction.SUM,
                 )
         self._init_window(window_size)
@@ -121,16 +127,7 @@ class WindowedWeightedCalibration(
         target,
         weight: Union[float, int, jax.Array, None] = None,
     ) -> "WindowedWeightedCalibration":
-        input, target = self._input(input), self._input(target)
-        if weight is not None and hasattr(weight, "shape"):
-            weight = self._input(weight)
-        pred, label = _weighted_calibration_update(
-            input, target, self.num_tasks, weight
-        )
-        # the fold reduces to scalars at num_tasks=1; states and window
-        # rows always carry the (num_tasks,) axis
-        pred = jnp.reshape(pred, (self.num_tasks,))
-        label = jnp.reshape(label, (self.num_tasks,))
+        pred, label = _fold_calibration(self, input, target, weight)
         if self.enable_lifetime:
             self.weighted_input_sum = self.weighted_input_sum + pred
             self.weighted_label_sum = self.weighted_label_sum + label

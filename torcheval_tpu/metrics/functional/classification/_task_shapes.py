@@ -1,0 +1,24 @@
+"""Shared shape validation for per-task streaming metrics.
+
+One definition of the "``(num_samples,)`` at ``num_tasks=1``, else
+``(num_tasks, num_samples)``" contract, used by normalized entropy, CTR and
+calibration — the error strings stay byte-identical across the family.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def check_task_shape(input: jax.Array, num_tasks: int) -> None:
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )

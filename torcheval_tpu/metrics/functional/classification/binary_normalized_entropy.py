@@ -19,6 +19,9 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.classification._task_shapes import (
+    check_task_shape,
+)
 from torcheval_tpu.utils.convert import as_jax
 from torcheval_tpu.utils.tracing import host_resident
 
@@ -63,17 +66,7 @@ def _ne_input_check(
             f"`weight` shape ({weight.shape}) is different from `input` shape "
             f"({input.shape})"
         )
-    if num_tasks == 1:
-        if input.ndim > 1:
-            raise ValueError(
-                "`num_tasks = 1`, `input` is expected to be one-dimensional "
-                f"tensor, but got shape ({input.shape})."
-            )
-    elif input.ndim == 1 or input.shape[0] != num_tasks:
-        raise ValueError(
-            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
-            f"({num_tasks}, num_samples), but got shape ({input.shape})."
-        )
+    check_task_shape(input, num_tasks)
 
 
 @partial(jax.jit, static_argnames=("from_logits",))

@@ -17,7 +17,11 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.classification._task_shapes import (
+    check_task_shape,
+)
 from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.numerics import safe_div
 
 
 def _ctr_input_check(
@@ -30,17 +34,7 @@ def _ctr_input_check(
             f"`weights` shape ({weights.shape}) is different from `input` "
             f"shape ({input.shape})"
         )
-    if num_tasks == 1:
-        if input.ndim > 1:
-            raise ValueError(
-                "`num_tasks = 1`, `input` is expected to be one-dimensional "
-                f"tensor, but got shape ({input.shape})."
-            )
-    elif input.ndim == 1 or input.shape[0] != num_tasks:
-        raise ValueError(
-            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
-            f"({num_tasks}, num_samples), but got shape ({input.shape})."
-        )
+    check_task_shape(input, num_tasks)
 
 
 @jax.jit
@@ -57,18 +51,20 @@ def _click_through_rate_update(
     num_tasks: int,
     weights: Union[float, int, jax.Array, None],
 ) -> Tuple[jax.Array, jax.Array]:
-    _ctr_input_check(input, num_tasks, weights if hasattr(weights, "shape") else None)
     if weights is None:
         weights = 1.0
+    elif not isinstance(weights, (int, float)):
+        # convert BEFORE the check: a python list has no .shape and would
+        # bypass the documented shape validation
+        weights = as_jax(weights)
+    _ctr_input_check(input, num_tasks, weights if hasattr(weights, "shape") else None)
     return _ctr_fold(input, as_jax(weights))
 
 
 @jax.jit
 def _ctr_compute(click_total: jax.Array, weight_total: jax.Array) -> jax.Array:
-    # 0.0 when nothing was weighed in: branch-free, jit-embeddable
-    return jnp.where(
-        weight_total > 0.0, click_total / jnp.maximum(weight_total, 1e-38), 0.0
-    )
+    # 0.0 when nothing was weighed in (shared zero-denominator convention)
+    return safe_div(click_total, weight_total)
 
 
 def click_through_rate(

@@ -17,7 +17,11 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.classification._task_shapes import (
+    check_task_shape,
+)
 from torcheval_tpu.utils.convert import as_jax
+from torcheval_tpu.utils.numerics import safe_div
 
 
 def _calibration_input_check(
@@ -38,17 +42,7 @@ def _calibration_input_check(
             f"`weight` shape ({weight.shape}) is different from `input` "
             f"shape ({input.shape})"
         )
-    if num_tasks == 1:
-        if input.ndim > 1:
-            raise ValueError(
-                "`num_tasks = 1`, `input` is expected to be one-dimensional "
-                f"tensor, but got shape ({input.shape})."
-            )
-    elif input.ndim == 1 or input.shape[0] != num_tasks:
-        raise ValueError(
-            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
-            f"({num_tasks}, num_samples), but got shape ({input.shape})."
-        )
+    check_task_shape(input, num_tasks)
 
 
 @jax.jit
@@ -67,11 +61,15 @@ def _weighted_calibration_update(
     num_tasks: int,
     weight: Union[float, int, jax.Array, None],
 ) -> Tuple[jax.Array, jax.Array]:
+    if weight is None:
+        weight = 1.0
+    elif not isinstance(weight, (int, float)):
+        # convert BEFORE the check: a python list has no .shape and would
+        # bypass the documented shape validation
+        weight = as_jax(weight)
     _calibration_input_check(
         input, target, num_tasks, weight if hasattr(weight, "shape") else None
     )
-    if weight is None:
-        weight = 1.0
     return _calibration_fold(input, target, as_jax(weight))
 
 
@@ -79,11 +77,8 @@ def _weighted_calibration_update(
 def _calibration_compute(
     weighted_input_sum: jax.Array, weighted_label_sum: jax.Array
 ) -> jax.Array:
-    return jnp.where(
-        weighted_label_sum > 0.0,
-        weighted_input_sum / jnp.maximum(weighted_label_sum, 1e-38),
-        0.0,
-    )
+    # 0.0 when no positive label mass (shared zero-denominator convention)
+    return safe_div(weighted_input_sum, weighted_label_sum)
 
 
 def weighted_calibration(

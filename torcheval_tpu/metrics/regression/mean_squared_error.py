@@ -20,7 +20,7 @@ from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_update,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -42,11 +42,11 @@ class MeanSquaredError(Metric[jax.Array]):
         super().__init__(device=device)
         _mean_squared_error_param_check(multioutput)
         self.multioutput = multioutput
-        self._add_state("sum_squared_error", jnp.zeros(()), reduction=Reduction.SUM)
+        self._add_state("sum_squared_error", zeros_state(), reduction=Reduction.SUM)
         # int32 while updates are unweighted (exact counting to 2**31);
         # a weighted update promotes the accumulator to float32
         self._add_state(
-            "sum_weight", jnp.zeros((), dtype=jnp.int32), reduction=Reduction.SUM
+            "sum_weight", zeros_state((), dtype=jnp.int32), reduction=Reduction.SUM
         )
 
     def update(

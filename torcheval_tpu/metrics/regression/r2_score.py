@@ -13,7 +13,7 @@ from torcheval_tpu.metrics.functional.regression.r2_score import (
     _r2_score_update,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 _STATE_NAMES = (
@@ -50,9 +50,9 @@ class R2Score(Metric[jax.Array]):
         for name in _STATE_NAMES:
             # num_obs counts in int32 (exact to 2**31 samples)
             default = (
-                jnp.zeros((), dtype=jnp.int32)
+                zeros_state((), dtype=jnp.int32)
                 if name == "num_obs"
-                else jnp.zeros(())
+                else zeros_state()
             )
             self._add_state(name, default, reduction=Reduction.SUM)
 

@@ -18,12 +18,14 @@ Supported state container types mirror the reference's ``TState`` union
   O(1) host ops; compaction to a single array happens at compute / pre-merge.
 * ``dict[Any, jax.Array]`` — host-side keyed accumulators (test fixtures; no
   shipped metric uses them, see SURVEY §7).
-* ``deque[jax.Array]`` — bounded window state (test fixtures).
+* ``deque[jax.Array]`` — bounded window state (the shipped windowed metrics:
+  ``WindowedClickThroughRate`` / ``WindowedWeightedCalibration``).
 """
 
 from __future__ import annotations
 
 import enum
+import functools
 from collections import defaultdict, deque
 from typing import Any, Deque, Dict, List, Union
 
@@ -86,6 +88,21 @@ def _put_leaf(value, device, *, strict_layout: bool = False):
     import numpy as np
 
     value = jnp.asarray(value) if not hasattr(value, "dtype") else value
+    if isinstance(value, jax.Array) and not isinstance(
+        device, jax.sharding.Sharding
+    ):
+        # single-device fast path, mirroring Metric._input: device_put costs
+        # ~75 µs host-side even as a placement no-op (and a full dispatch
+        # floor on tunneled backends) — skip it when the buffer is already
+        # resident on the target device
+        try:
+            if value.devices() == {device}:
+                # exact single-device residency only: membership alone would
+                # pass a mesh-sharded array through un-gathered when the
+                # target is merely one of its shard devices
+                return value
+        except Exception:
+            pass
     if (
         isinstance(device, jax.sharding.Sharding)
         and not device.is_fully_addressable
@@ -146,12 +163,46 @@ def put_state(value: TState, device) -> TState:
     return _put_leaf(jnp.asarray(value), device)
 
 
+@functools.lru_cache(maxsize=256)
+def _zeros_template(shape, dtype):
+    return jnp.zeros(shape, dtype)
+
+
+def zeros_state(shape=(), dtype=jnp.float32) -> jax.Array:
+    """A zeros array for a state default.
+
+    On backends where donation is off (``utils/platform.py`` — every buffer
+    stays immutable forever), the SAME cached template is returned for a
+    given (shape, dtype): metric construction then costs zero device
+    dispatches for its defaults, where a fresh ``jnp.zeros`` per state per
+    instance paid one dispatch each (0.2-8 ms on a tunneled chip). With
+    donation on, a fresh array is returned — a shared template could be
+    invalidated by a donated fold.
+    """
+    from torcheval_tpu.utils.platform import donation_pipelines
+
+    shape = tuple(shape) if hasattr(shape, "__len__") else (shape,)
+    if donation_pipelines():
+        return jnp.zeros(shape, dtype)
+    return _zeros_template(shape, jnp.dtype(dtype))
+
+
 def _copy_leaf(value):
     # real buffer copies, not aliases: donated-state updates
     # (metrics/collection.py) invalidate live buffers, so a default snapshot
     # or state_dict that merely shared the array would die with it. Arrays
-    # are immutable, but buffer LIFETIME is not.
+    # are immutable, but buffer LIFETIME is not — EXCEPT when this process
+    # never donates (tunneled backends gate donation off, utils/platform.py):
+    # then aliasing an immutable array is safe and skips a device dispatch.
+    # That dispatch is the dominant cost of metric construction/reset on a
+    # tunneled chip: ~2 copy dispatches per state × a 0.2-8 ms floor was
+    # measured at 25-47 ms per fresh 3-state metric, vs ~6 ms for the whole
+    # fold it precedes.
     if isinstance(value, jax.Array):
+        from torcheval_tpu.utils.platform import donation_pipelines
+
+        if not donation_pipelines():
+            return value
         return jnp.copy(value)
     if hasattr(value, "copy"):
         return value.copy()  # numpy leaf: also guards against host mutation
