@@ -13,7 +13,7 @@ run" (never fabricated): the 100M/1B rows (CPU-torch would need the full 8+ GB
 cache the compaction path exists to avoid) and config 5's on-mesh SPMD row
 (the reference cannot run on a TPU mesh). Config 5's cross-process lane DOES
 carry a ratio: both frameworks run the same 4-process sync world on this
-host's CPU (``config5_explicit_sync_4proc``).
+host's CPU (row ``config5_explicit_sync_accuracy_4proc``).
 
 A persistent XLA compile cache (.jax_cache/) keeps recompiles out of repeat
 runs; timed sections always run on pre-warmed shapes either way.

@@ -17,7 +17,10 @@ Each rank times ``k`` runs of (reset → n_batches updates → sync_and_compute
 on every rank) after one warmup run, and writes its per-run times to
 ``<outdir>/<mode>_rank<r>.json``. The parent (``bench.py``) scores the run
 by the SLOWEST rank per repeat (the sync is a barrier: the world's
-throughput is the straggler's) and medians across repeats. Process startup
+throughput is the straggler's) and takes the MIN across repeats (see
+bench.py's scoring comment: on this timeshared single-core host a median
+would be poisoned by whichever framework's repeats co-tenant bursts land
+on). Process startup
 and world bootstrap are excluded on both sides — the measured quantity is
 steady-state update+sync cost, not interpreter spawn.
 

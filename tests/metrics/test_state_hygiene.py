@@ -1,0 +1,123 @@
+"""Dispatch-hygiene contracts for the state machinery (metrics/state.py).
+
+On a tunneled chip every device dispatch costs a 0.2-8 ms floor, so metric
+construction/reset/clone must not dispatch at all when the backend never
+donates buffers. These tests pin the aliasing rules on both sides of the
+donation gate — the CPU test backend donates, so the no-donation side is
+exercised under a mock, exactly like the collection tests do.
+"""
+
+import unittest
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import MulticlassAccuracy, MulticlassF1Score
+from torcheval_tpu.metrics.state import (
+    _copy_leaf,
+    _zeros_template,
+    copy_state,
+    put_state,
+    zeros_state,
+)
+
+
+def _no_donation():
+    return mock.patch(
+        "torcheval_tpu.utils.platform.donation_pipelines", return_value=False
+    )
+
+
+class TestZerosState(unittest.TestCase):
+    def test_cached_template_when_not_donating(self):
+        with _no_donation():
+            a = zeros_state((7,), jnp.int32)
+            b = zeros_state((7,), jnp.int32)
+            self.assertIs(a, b)  # shared template: zero dispatches after first
+            self.assertIsNot(a, zeros_state((7,), jnp.float32))  # dtype keyed
+
+    def test_fresh_arrays_when_donating(self):
+        # donation invalidates buffers: a shared template would die with the
+        # first donated fold, so each call must mint a fresh array
+        a = zeros_state((7,), jnp.int32)
+        b = zeros_state((7,), jnp.int32)
+        self.assertIsNot(a, b)
+
+    def test_values_are_zero_either_way(self):
+        with _no_donation():
+            np.testing.assert_array_equal(np.asarray(zeros_state((3,))), 0.0)
+        np.testing.assert_array_equal(np.asarray(zeros_state((3,))), 0.0)
+
+
+class TestCopyLeaf(unittest.TestCase):
+    def test_alias_when_not_donating(self):
+        x = jnp.arange(4.0)
+        with _no_donation():
+            self.assertIs(_copy_leaf(x), x)
+
+    def test_copy_when_donating(self):
+        x = jnp.arange(4.0)
+        y = _copy_leaf(x)
+        self.assertIsNot(y, x)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+class TestPutLeafFastPath(unittest.TestCase):
+    def test_already_resident_is_identity(self):
+        dev = jax.devices()[0]
+        x = jax.device_put(jnp.arange(4.0), dev)
+        self.assertIs(put_state(x, dev), x)
+
+    def test_cross_device_still_moves(self):
+        devs = jax.devices()
+        if len(devs) < 2:
+            self.skipTest("needs 2 devices")
+        x = jax.device_put(jnp.arange(4.0), devs[0])
+        y = put_state(x, devs[1])
+        self.assertEqual(y.devices(), {devs[1]})
+
+
+class TestMetricLifecycleUnderAliasing(unittest.TestCase):
+    """The correctness story the aliasing must not break, exercised with the
+    no-donation gate active end to end."""
+
+    def test_instances_independent_and_reset_true_zero(self):
+        with _no_donation():
+            a = MulticlassF1Score(num_classes=4, average="macro")
+            b = MulticlassF1Score(num_classes=4, average="macro")
+            rng = np.random.default_rng(0)
+            s = rng.random((64, 4)).astype(np.float32)
+            t = rng.integers(0, 4, 64)
+            a.update(jnp.asarray(s), jnp.asarray(t))
+            va = float(a.compute())
+            # b shares zero templates with a but must stay untouched
+            self.assertEqual(float(jnp.sum(b.state_dict()["num_tp"])), 0.0)
+            a.reset()
+            self.assertEqual(float(jnp.sum(a.state_dict()["num_tp"])), 0.0)
+            a.update(jnp.asarray(s), jnp.asarray(t))
+            self.assertAlmostEqual(float(a.compute()), va, places=6)
+
+    def test_snapshot_survives_later_updates(self):
+        with _no_donation():
+            m = MulticlassAccuracy(num_classes=4)
+            m.update(jnp.eye(4), jnp.arange(4))
+            snap = m.state_dict()
+            before = float(snap["num_total"])
+            m.update(jnp.eye(4), jnp.arange(4))
+            self.assertEqual(float(snap["num_total"]), before)
+
+    def test_copy_state_still_copies_containers(self):
+        # container copies are structural even when leaves alias: appending
+        # to the copy must not grow the original
+        with _no_donation():
+            cache = [jnp.arange(3.0)]
+            c = copy_state(cache)
+            self.assertIsNot(c, cache)
+            c.append(jnp.arange(2.0))
+            self.assertEqual(len(cache), 1)
+
+
+if __name__ == "__main__":
+    unittest.main()
