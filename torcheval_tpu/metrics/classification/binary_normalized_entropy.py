@@ -9,6 +9,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu.metrics.functional.classification._task_shapes import (
+    check_num_tasks,
+)
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _baseline_entropy,
     _binary_normalized_entropy_update,
@@ -42,11 +45,7 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
     ) -> None:
         super().__init__(device=device)
         self.from_logits = from_logits
-        if num_tasks < 1:
-            raise ValueError(
-                "`num_tasks` value should be greater than and equal to 1, "
-                f"but received {num_tasks}."
-            )
+        check_num_tasks(num_tasks)
         self.num_tasks = num_tasks
         for name in _STATE_NAMES:
             self._add_state(

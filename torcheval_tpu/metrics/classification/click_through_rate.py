@@ -26,12 +26,9 @@ from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-def _check_num_tasks(num_tasks: int) -> None:
-    if num_tasks < 1:
-        raise ValueError(
-            "`num_tasks` value should be greater than and equal to 1, "
-            f"but received {num_tasks}."
-        )
+from torcheval_tpu.metrics.functional.classification._task_shapes import (
+    check_num_tasks as _check_num_tasks,
+)
 
 
 def _fold_ctr(metric, input, weights):

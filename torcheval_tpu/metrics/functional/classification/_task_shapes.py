@@ -22,3 +22,11 @@ def check_task_shape(input: jax.Array, num_tasks: int) -> None:
             f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
             f"({num_tasks}, num_samples), but got shape ({input.shape})."
         )
+
+
+def check_num_tasks(num_tasks: int) -> None:
+    if num_tasks < 1:
+        raise ValueError(
+            "`num_tasks` value should be greater than and equal to 1, "
+            f"but received {num_tasks}."
+        )
