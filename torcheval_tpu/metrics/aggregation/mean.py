@@ -6,7 +6,6 @@ import logging
 from typing import Iterable, Union
 
 import jax
-import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
 from torcheval_tpu.metrics.functional.aggregation.sum import _weight_check

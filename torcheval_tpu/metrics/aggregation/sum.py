@@ -5,7 +5,6 @@ from __future__ import annotations
 from typing import Iterable, Union
 
 import jax
-import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update, _weight_check
 from torcheval_tpu.metrics.metric import Metric

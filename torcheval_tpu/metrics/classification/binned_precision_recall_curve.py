@@ -14,7 +14,7 @@ kernel.
 
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Tuple
+from typing import Iterable, List, Tuple
 
 import jax
 import jax.numpy as jnp

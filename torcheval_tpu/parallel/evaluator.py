@@ -19,7 +19,6 @@ from __future__ import annotations
 
 from typing import Any, Dict, Union
 
-import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torcheval_tpu.metrics.metric import Metric

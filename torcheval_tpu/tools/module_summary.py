@@ -12,7 +12,6 @@ subtree.
 from __future__ import annotations
 
 import math
-import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
