@@ -179,6 +179,31 @@ class TestConfusionCurveErrors(_MatrixTester):
     )
 
 
+class TestCTRCalibrationErrors(_MatrixTester):
+    CASES = (
+        ("ctr 2-D at num_tasks=1", lambda: F.click_through_rate(scores_2d(3, 4)),
+         ValueError, r"one-dimensional"),
+        ("ctr tasks mismatch", lambda: F.click_through_rate(rows_1d(4), num_tasks=3),
+         ValueError, r"`num_tasks = 3`"),
+        ("ctr weights shape", lambda: F.click_through_rate(rows_1d(4), rows_1d(3)),
+         ValueError, r"`weights` shape"),
+        ("ctr list weights shape", lambda: F.click_through_rate(rows_1d(4), [1.0, 2.0]),
+         ValueError, r"`weights` shape"),
+        ("calibration target shape", lambda: F.weighted_calibration(rows_1d(4), rows_1d(3)),
+         ValueError, r"`target` shape"),
+        ("calibration weight shape", lambda: F.weighted_calibration(rows_1d(4), rows_1d(4), rows_1d(3)),
+         ValueError, r"`weight` shape"),
+        ("class ctr num_tasks", lambda: M.ClickThroughRate(num_tasks=0),
+         ValueError, r"num_tasks"),
+        ("class windowed window_size", lambda: M.WindowedClickThroughRate(window_size=0),
+         ValueError, r"window_size"),
+        ("class windowed calibration tasks", lambda: M.WindowedWeightedCalibration(num_tasks=-1),
+         ValueError, r"num_tasks"),
+        ("class calibration update shape", lambda: M.WeightedCalibration().update(rows_1d(4), rows_1d(3)),
+         ValueError, r"`target` shape"),
+    )
+
+
 class TestRankingRegressionAggregationErrors(_MatrixTester):
     CASES = (
         ("hit_rate target 2-D", lambda: F.hit_rate(scores_2d(3, 4), scores_2d(3, 4)),
