@@ -1,7 +1,8 @@
 """All benchmark configs — thin wrapper over the driver bench.
 
-Run: python benchmarks/run_all.py  (real chip; ~3-6 min, first run adds
-one-time XLA compiles that land in the persistent .jax_cache/)
+Run: python benchmarks/run_all.py  (real chip; ~12-18 min including the 1B
+leg and the 4-process sync worlds; first run adds one-time XLA compiles
+that land in the persistent .jax_cache/)
 
 Every record and its methodology live in ``bench.py`` at the repo root (the
 driver entry point); this file exists so `benchmarks/` stays a discoverable
