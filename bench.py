@@ -668,10 +668,13 @@ def env_dispatch_floor():
     """Record the tunnel's per-dispatch execution cost at bench time.
 
     Configs that stream many small updates (1 and 3) are bound by this
-    environmental floor, which swings 0.7-5 ms with co-tenant load on the
+    environmental floor, which swings 0.2-8 ms with co-tenant load on the
     tunneled chip (a directly-attached TPU dispatches in tens of µs). One
     chained trivial kernel per dispatch; the drain time divided by calls is
-    the floor. Emitted so each round's record is interpretable."""
+    the floor. Three independent 33-dispatch chains, best one wins: a
+    single co-tenant stall inside this probe's one chain once recorded a
+    "floor" of 1100 ms — a burst reading, not the floor the word claims.
+    Emitted so each round's record is interpretable."""
     jax = _jax()
     import jax.numpy as jnp
 
@@ -682,23 +685,26 @@ def env_dispatch_floor():
     s = jnp.int32(0)
     s = step(s)
     jax.block_until_ready(s)
-    t0 = time.perf_counter()
-    for _ in range(100):
-        s = step(s)
-    jax.device_get(s)
-    elapsed = time.perf_counter() - t0
-    # the terminal readback's flat tunnel RTT is not per-dispatch cost;
-    # measure (median of 3 — single probes catch co-tenant bursts) and
-    # subtract it, same policy as _time
-    rtts = []
-    for i in range(3):
-        fresh = jnp.int32(123) + i
-        jax.block_until_ready(fresh)
+    per_chain = []
+    for chain in range(3):
+        s = jnp.int32(chain)
         t0 = time.perf_counter()
-        jax.device_get(fresh)
-        rtts.append(time.perf_counter() - t0)
-    rtts.sort()
-    per_call = max(elapsed - rtts[1], 0.0) / 100
+        for _ in range(33):
+            s = step(s)
+        jax.device_get(s)
+        elapsed = time.perf_counter() - t0
+        # the terminal readback's flat tunnel RTT is not per-dispatch cost;
+        # measure (median of 3) and subtract it, same policy as _time
+        rtts = []
+        for i in range(3):
+            fresh = jnp.int32(123) + i
+            jax.block_until_ready(fresh)
+            t0 = time.perf_counter()
+            jax.device_get(fresh)
+            rtts.append(time.perf_counter() - t0)
+        rtts.sort()
+        per_chain.append(max(elapsed - rtts[1], 0.0) / 33)
+    per_call = min(per_chain)
     print(
         json.dumps(
             {
