@@ -119,6 +119,66 @@ class TestMetricBase(unittest.TestCase):
         # conftest forces 8 CPU devices; the sync layer depends on this
         self.assertGreaterEqual(len(jax.devices()), 8)
 
+    def test_api_usage_telemetry_once_per_class(self):
+        # mirrors reference metric.py:44 (_log_api_usage_once): each metric
+        # class fires the usage hook exactly once per process
+        from torcheval_tpu.metrics.aggregation import Sum
+        from torcheval_tpu.utils import telemetry
+
+        seen = []
+        telemetry.set_api_usage_sink(seen.append)
+        try:
+            telemetry._seen.discard("torcheval_tpu.metrics.Sum")
+            Sum()
+            Sum()  # second construction: no duplicate record
+            self.assertEqual(
+                seen.count("torcheval_tpu.metrics.Sum"), 1
+            )
+        finally:
+            telemetry.set_api_usage_sink(None)
+
+    def test_api_usage_sink_errors_do_not_break_construction(self):
+        from torcheval_tpu.metrics.aggregation import Mean
+        from torcheval_tpu.utils import telemetry
+
+        def bad_sink(key):
+            raise RuntimeError("boom")
+
+        telemetry.set_api_usage_sink(bad_sink)
+        try:
+            telemetry._seen.discard("torcheval_tpu.metrics.Mean")
+            m = Mean()  # must not raise
+            self.assertIsNotNone(m)
+        finally:
+            telemetry.set_api_usage_sink(None)
+
+    def test_deepcopy_preserves_shared_array_identity(self):
+        # advisor r3 (low): two attributes referencing the same array object
+        # must stay shared in the clone, matching copy.deepcopy semantics
+        import copy
+
+        from torcheval_tpu.metrics.aggregation import Sum
+
+        m = Sum()
+        shared = jnp.ones((3,))
+        m.a_ref = shared
+        m.b_ref = shared
+        c = copy.deepcopy(m)
+        self.assertIs(c.a_ref, c.b_ref)
+        # and tuples referenced twice stay one object too
+        t = (shared, 2)
+        m.t1 = t
+        m.t2 = t
+        c2 = copy.deepcopy(m)
+        self.assertIs(c2.t1, c2.t2)
+        # a cycle through a tuple stays a single object, like copy.deepcopy
+        lst = []
+        cyc = (lst,)
+        lst.append(cyc)
+        m.cyc = cyc
+        c3 = copy.deepcopy(m)
+        self.assertIs(c3.cyc, c3.cyc[0][0])
+
 
 if __name__ == "__main__":
     unittest.main()

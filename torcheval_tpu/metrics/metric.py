@@ -42,6 +42,7 @@ from torcheval_tpu.metrics.state import (
     put_state,
 )
 from torcheval_tpu.utils.devices import DeviceLike, canonical_device
+from torcheval_tpu.utils.telemetry import log_api_usage_once
 
 _logger: logging.Logger = logging.getLogger(__name__)
 
@@ -62,10 +63,15 @@ def _deepcopy_value(v: Any, memo: Dict[int, Any]) -> Any:
     metadata; this walks whole attribute trees.)"""
     from torcheval_tpu.metrics.state import _copy_leaf
 
-    if isinstance(v, jax.Array):
-        return _copy_leaf(v)
     if id(v) in memo:
+        # consult the memo FIRST (arrays included) so two attributes that
+        # reference the same object stay shared in the clone — deepcopy
+        # identity semantics, which custom metrics may rely on
         return memo[id(v)]
+    if isinstance(v, jax.Array):
+        out = _copy_leaf(v)
+        memo[id(v)] = out
+        return out
     t = type(v)
     if t is list:
         out = []
@@ -73,7 +79,11 @@ def _deepcopy_value(v: Any, memo: Dict[int, Any]) -> Any:
         out.extend(_deepcopy_value(i, memo) for i in v)
         return out
     if t is tuple:
-        return tuple(_deepcopy_value(i, memo) for i in v)
+        out = tuple(_deepcopy_value(i, memo) for i in v)
+        # setdefault, not assignment: a cycle through the tuple may have
+        # memoized a copy during the recursion above; keep that one so the
+        # cycle stays a single object (copy.deepcopy semantics)
+        return memo.setdefault(id(v), out)
     if t is deque:
         out = deque(maxlen=v.maxlen)
         memo[id(v)] = out
@@ -110,6 +120,10 @@ class Metric(Generic[TComputeReturn], ABC):
     """
 
     def __init__(self, *, device: DeviceLike = None) -> None:
+        # once-per-class usage telemetry, mirroring the reference's
+        # torch._C._log_api_usage_once (metric.py:44) — a set lookup after
+        # the first construction of each class, so the hot path stays flat
+        log_api_usage_once(f"torcheval_tpu.metrics.{self.__class__.__name__}")
         self._device = canonical_device(device)
         self._state_name_to_default: Dict[str, TState] = {}
         self._state_name_to_reduction: Dict[str, Reduction] = {}
@@ -235,7 +249,14 @@ class Metric(Generic[TComputeReturn], ABC):
 
     def state_dict(self) -> Dict[str, TState]:
         """Snapshot state as a plain dict (arrays are immutable — no clone
-        needed, unlike the reference's detach+clone dance)."""
+        needed, unlike the reference's detach+clone dance).
+
+        On non-donating backends the snapshot may *alias* the live state
+        buffers (see docs/design.md "State lifecycle"); that is safe unless
+        user code later donates those arrays via
+        ``jax.jit(..., donate_argnums=...)`` — donation is the one thing
+        that can invalidate an immutable-array alias. Deep-copy the
+        snapshot first if you must donate metric state."""
         self._fold_now()
         out: Dict[str, TState] = {}
         for name in self._state_name_to_default:

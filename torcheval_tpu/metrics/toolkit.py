@@ -433,25 +433,65 @@ def _entry_shape(desc: np.ndarray) -> tuple:
     return (int(desc[0]),) + tuple(int(d) for d in desc[3 : 3 + ndim - 1])
 
 
+def _schema_digest_row(metrics: Dict[str, Metric]) -> list:
+    """Header row for the descriptor exchange: entry count + 24 bytes of a
+    SHA-256 digest over the ordered ``(metric key, metric class, state name,
+    reduction)`` schema. The byte payload in round 2 is decoded positionally,
+    so every rank MUST enumerate the same entries in the same order; this row
+    turns a violated assumption (previously a silent mis-decode whenever
+    shapes and dtypes happened to coincide) into a uniform post-exchange
+    error. The metric class is part of the schema so two *different* metric
+    types with coinciding state names/reductions still mismatch."""
+    import hashlib
+
+    schema = []
+    for mkey, metric in metrics.items():  # same order as _collection_entries
+        for name, red in metric._state_name_to_reduction.items():
+            schema.append((mkey, type(metric).__qualname__, name, red.name))
+    digest = hashlib.sha256(repr(schema).encode()).digest()[:24]
+    return [len(schema)] + np.frombuffer(digest, dtype="<i4").tolist()
+
+
 def _gather_collection_states(
     metrics: Dict[str, Metric],
 ) -> List[Dict[str, Dict[str, TState]]]:
     """All-gather every rank's states for a whole collection in exactly two
-    collective rounds; returns per-rank ``{metric_key: state_dict}``."""
+    collective rounds; returns per-rank ``{metric_key: state_dict}``.
+
+    Row 0 of the descriptor matrix is a schema digest
+    (:func:`_schema_digest_row`) validated post-exchange, so ranks that
+    built their collections in different orders fail loudly on every rank
+    instead of folding bytes into the wrong states. (Ranks with *different
+    entry counts* diverge in collective shape and fail inside XLA already;
+    the digest covers the dangerous same-shape case.)"""
     from jax.experimental import multihost_utils
 
     world = _world_size()
     entries = _collection_entries(metrics)
     desc = np.asarray(
-        [_encode_entry_descriptor(local) for _, _, _, local in entries],
+        [_schema_digest_row(metrics)]
+        + [_encode_entry_descriptor(local) for _, _, _, local in entries],
         dtype=np.int32,
-    ).reshape(len(entries), 7)
+    ).reshape(len(entries) + 1, 7)
     all_desc = np.asarray(
         multihost_utils.process_allgather(jnp.asarray(desc))
-    ).reshape(world, len(entries), 7)
+    ).reshape(world, len(entries) + 1, 7)
     # uniform validation AFTER the exchange (a one-sided raise would hang the
-    # payload collective on the other ranks); column layout matches the CAT
-    # wire descriptor ([d0, ndim, dtype_code, ...]) so the same checker serves
+    # payload collective on the other ranks): first the schema digest, then
+    # the per-entry wire-format checks. Every rank sees identical gathered
+    # rows, so any raise here happens on every rank.
+    header = all_desc[:, 0, :]
+    if not (header == header[0]).all():
+        raise RuntimeError(
+            "Collection sync schema mismatch: ranks enumerated different "
+            "(metric key, state name, reduction) entry orders "
+            f"(digest rows: {header.tolist()}). Every process must build "
+            "the collection with the same metric keys, construction order "
+            "and metric types before calling sync."
+        )
+    all_desc = all_desc[:, 1:, :]
+    # column layout matches the CAT wire descriptor
+    # ([d0, ndim, dtype_code, ...]) so the same checker serves
     for e, (mkey, name, red, _) in enumerate(entries):
         _check_cat_descriptors(f"{name} of metric {mkey}", all_desc[:, e, :])
     totals = [

@@ -1,0 +1,50 @@
+"""Once-per-key API-usage telemetry.
+
+The reference logs every metric construction through
+``torch._C._log_api_usage_once(f"torcheval.metrics.{cls}")``
+(``/root/reference/torcheval/metrics/metric.py:44``) so fleet owners can
+count which metrics are actually used. This is the framework-neutral
+equivalent: a ``logging``-based hook that emits one DEBUG record per unique
+key per process on the ``torcheval_tpu.api_usage`` logger, plus a
+registration point for a custom sink (e.g. a production telemetry client).
+
+The hot-path cost is a set lookup — no handler work unless a sink or a
+DEBUG-level handler is attached, and never more than once per key.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional, Set
+
+_logger = logging.getLogger("torcheval_tpu.api_usage")
+
+_seen: Set[str] = set()
+_seen_lock = threading.Lock()
+_sink: Optional[Callable[[str], None]] = None
+
+
+def set_api_usage_sink(sink: Optional[Callable[[str], None]]) -> None:
+    """Install a callable invoked once per unique API-usage key (or ``None``
+    to remove it). Mirrors how ``torch._C._log_api_usage_once`` feeds
+    deployment-side usage counters."""
+    global _sink
+    _sink = sink
+
+
+def log_api_usage_once(key: str) -> None:
+    """Record one use of ``key`` (e.g. ``"torcheval_tpu.metrics.BinaryAUROC"``);
+    subsequent calls with the same key are no-ops."""
+    if key in _seen:  # lock-free fast path for the already-seen common case
+        return
+    with _seen_lock:
+        if key in _seen:
+            return
+        _seen.add(key)
+    _logger.debug("API usage: %s", key)
+    if _sink is not None:
+        try:
+            _sink(key)
+        except Exception:  # a broken sink must never break metric construction
+            _logger.exception("api-usage sink failed for key %r", key)
