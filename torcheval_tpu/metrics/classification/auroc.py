@@ -31,13 +31,15 @@ from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.ops.curves import (
     binary_auprc_counts_kernel,
+    binary_auprc_counts_presorted_kernel,
     binary_auprc_kernel,
     binary_auroc_counts_kernel,
+    binary_auroc_counts_presorted_kernel,
     binary_auroc_kernel,
     multiclass_auprc_kernel,
     multiclass_auroc_kernel,
 )
-from torcheval_tpu.ops.summary import PAD_SCORE, compact_counts
+from torcheval_tpu.ops.summary import PAD_SCORE, compact_counts, compact_counts_fast
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -108,6 +110,35 @@ def _auprc_from_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp):
     )
 
 
+# Streaming-compaction mode for the fold pipeline:
+#   "auto"      — Pallas stream-compaction kernel on single-device TPU state,
+#                 classic two-sort compact_counts elsewhere (CPU, sharded)
+#   "off"       — always the two-sort path
+#   "interpret" — kernel algorithm in Pallas interpret mode on any backend
+#                 (CPU test suites exercise the integrated fast path with it)
+STREAM_COMPACTION = "auto"
+
+
+@partial(jax.jit, static_argnums=(6, 7))
+def _compact_parts_fast(
+    raw_s, raw_t, sum_s, sum_tp, sum_fp, nan_acc, cap: int, interpret: bool
+):
+    """:func:`_compact_parts` on the streaming-compaction pipeline: one sort
+    + aggregation scans + the Pallas compress pass (``compact_counts_fast``)
+    instead of two full sorts. Same contract; measured 1.5-1.8x at the 1B
+    bench's fold sizes (docs/performance.md)."""
+    s, tp, fp = _combined_counts(raw_s, raw_t, sum_s, sum_tp, sum_fp)
+    n = s.shape[0]
+    if cap > n:
+        s = jnp.concatenate([s, jnp.full((cap - n,), PAD_SCORE, s.dtype)])
+        tp = jnp.concatenate([tp, jnp.zeros((cap - n,), jnp.int32)])
+        fp = jnp.concatenate([fp, jnp.zeros((cap - n,), jnp.int32)])
+    s, tp, fp, n_unique, nan_dropped = compact_counts_fast(
+        s, tp, fp, interpret=interpret
+    )
+    return s, tp, fp, n_unique, nan_acc + nan_dropped
+
+
 @partial(jax.jit, static_argnums=6)
 def _compact_parts(raw_s, raw_t, sum_s, sum_tp, sum_fp, nan_acc, cap: int):
     """Fold + pad-to-cap + compact in ONE traced program (cold path, but a
@@ -156,6 +187,10 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         self._compaction_threshold = compaction_threshold
         self._cached_samples = 0
         self._nan_checked = True  # no compactions yet -> nothing to check
+        # True while the summary is known to be ONE buffer of unique rows in
+        # descending order with NaN padding last (every _compact output is);
+        # merged/loaded state clears it until the next compaction
+        self._summary_sorted = True
         self._add_cache_state("inputs")
         self._add_cache_state("targets")
         self._add_cache_state("summary_scores")
@@ -203,15 +238,28 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         )
         if n == 0:
             return
-        s, tp, fp, n_unique, nan_acc = _compact_parts(
-            self.inputs,
-            self.targets,
-            self.summary_scores,
-            self.summary_tp,
-            self.summary_fp,
-            self.summary_nan_dropped,
-            _pad_cap(n),
-        )
+        mode = self._stream_compaction_mode()
+        if mode is None:
+            s, tp, fp, n_unique, nan_acc = _compact_parts(
+                self.inputs,
+                self.targets,
+                self.summary_scores,
+                self.summary_tp,
+                self.summary_fp,
+                self.summary_nan_dropped,
+                _pad_cap(n),
+            )
+        else:
+            s, tp, fp, n_unique, nan_acc = _compact_parts_fast(
+                self.inputs,
+                self.targets,
+                self.summary_scores,
+                self.summary_tp,
+                self.summary_fp,
+                self.summary_nan_dropped,
+                _pad_cap(n),
+                mode,  # interpret flag
+            )
         try:
             n_unique.copy_to_host_async()
         except AttributeError:
@@ -225,6 +273,46 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         self.summary_tp = [tp[:keep]]
         self.summary_fp = [fp[:keep]]
         self._cached_samples = 0
+        # both compaction paths emit unique rows, descending, padding last
+        self._summary_sorted = True
+
+    def _stream_compaction_mode(self):
+        """None -> classic two-sort path; False -> Pallas kernel (compiled);
+        True -> Pallas kernel in interpret mode. Kernel requires
+        single-device state (no GSPMD rule yet — sharded caches keep the
+        sort path, whose partitioning XLA already handles)."""
+        if STREAM_COMPACTION == "off":
+            return None
+        if STREAM_COMPACTION == "interpret":
+            return True
+        dev = self._device
+        if isinstance(dev, jax.Device) and dev.platform == "tpu":
+            return False
+        return None
+
+    def _presorted_summary(self):
+        """``(s, tp, fp)`` when state is a single summary buffer known to be
+        sorted-unique (folding raw leftovers first), else ``None``. Gated to
+        the same mode as the streaming compaction so CPU/sharded behavior
+        (one fused fold+sort program at compute) is unchanged."""
+        if (
+            self._compaction_threshold is None
+            or self._stream_compaction_mode() is None
+        ):
+            return None
+        if self.inputs:
+            self._compact()
+        if (
+            not self._summary_sorted
+            or self.inputs
+            or len(self.summary_scores) != 1
+        ):
+            return None
+        return (
+            self.summary_scores[0],
+            self.summary_tp[0],
+            self.summary_fp[0],
+        )
 
     def _set_states(self, values) -> None:
         # ANY state installation (merge, load, toolkit sync via
@@ -233,6 +321,8 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         super()._set_states(values)
         if "summary_nan_dropped" in values:
             self._nan_checked = False
+        if any(k.startswith("summary_") for k in values):
+            self._summary_sorted = False  # unknown provenance
 
     def _check_nan_flag(self) -> None:
         """Raise (uniformly, at compute time) if NaN-scored samples ever
@@ -292,6 +382,7 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
                 metric.summary_nan_dropped, self.device
             )
         self._nan_checked = False
+        self._summary_sorted = False  # concatenated segments may overlap
         self._recount_cache()
         return self
 
@@ -299,11 +390,13 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
         super().reset()
         self._cached_samples = 0
         self._nan_checked = True  # flag state re-zeroed by reset
+        self._summary_sorted = True  # empty summary is trivially sorted
         return self
 
     def load_state_dict(self, state_dict, strict: bool = True) -> None:
         super().load_state_dict(state_dict, strict)
         self._nan_checked = False  # loaded state may carry a nonzero flag
+        self._summary_sorted = False  # unknown provenance
         self._recount_cache()
 
 
@@ -322,13 +415,18 @@ class BinaryAUROC(_BinaryCurveMetric):
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.5)
-        result = _auroc_from_parts(
-            self.inputs,
-            self.targets,
-            self.summary_scores,
-            self.summary_tp,
-            self.summary_fp,
-        )
+        presorted = self._presorted_summary()
+        if presorted is not None:
+            # known-sorted unique summary: cumsums + trapezoid, no sort
+            result = binary_auroc_counts_presorted_kernel(*presorted)
+        else:
+            result = _auroc_from_parts(
+                self.inputs,
+                self.targets,
+                self.summary_scores,
+                self.summary_tp,
+                self.summary_fp,
+            )
         # after dispatching the curve kernel, so the flag read (one host
         # scalar) overlaps with it instead of stalling in front of it
         self._check_nan_flag()
@@ -412,12 +510,16 @@ class BinaryAUPRC(_BinaryCurveMetric):
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.0)
-        result = _auprc_from_parts(
-            self.inputs,
-            self.targets,
-            self.summary_scores,
-            self.summary_tp,
-            self.summary_fp,
-        )
+        presorted = self._presorted_summary()
+        if presorted is not None:
+            result = binary_auprc_counts_presorted_kernel(*presorted)
+        else:
+            result = _auprc_from_parts(
+                self.inputs,
+                self.targets,
+                self.summary_scores,
+                self.summary_tp,
+                self.summary_fp,
+            )
         self._check_nan_flag()
         return result

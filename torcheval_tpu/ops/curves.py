@@ -145,6 +145,35 @@ def binary_auprc_counts_kernel(
 
 
 @jax.jit
+def binary_auroc_counts_presorted_kernel(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> jax.Array:
+    """AUROC over rows that are ALREADY descending-sorted, tie-merged and
+    (NaN, 0, 0)-padded — the invariant every ``compact_counts``(+``_fast``)
+    output satisfies. Every row is its own tie group, so the cumulative
+    sums feed the trapezoid directly and the compute-time sort disappears
+    (padding rows add zero-width segments). The compacting metrics'
+    ``compute()`` rides this when the summary provenance is known-sorted."""
+    ctp = jnp.cumsum(tp_w.astype(jnp.int32), dtype=jnp.int32)
+    cfp = jnp.cumsum(fp_w.astype(jnp.int32), dtype=jnp.int32)
+    return _auroc_from_group_ends(ctp, cfp)
+
+
+@jax.jit
+def binary_auprc_counts_presorted_kernel(
+    scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
+) -> jax.Array:
+    """Average precision over presorted tie-merged count rows (see
+    :func:`binary_auroc_counts_presorted_kernel`); padding rows have zero
+    ``ΔTP`` and contribute nothing to the step integral."""
+    if scores.shape[0] == 0:
+        return jnp.asarray(0.0)
+    ctp = jnp.cumsum(tp_w.astype(jnp.int32), dtype=jnp.int32)
+    cfp = jnp.cumsum(fp_w.astype(jnp.int32), dtype=jnp.int32)
+    return _auprc_from_group_ends(ctp, cfp)
+
+
+@jax.jit
 def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     """Exact trapezoidal AUROC on raw samples — the reduced-sort-traffic
     unit-count path (:func:`_group_end_cumsums`)."""

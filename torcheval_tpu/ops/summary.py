@@ -21,6 +21,7 @@ chunk size.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -32,6 +33,51 @@ import jax.numpy as jnp
 # never merge into a real tie group. NaN scores are thereby reserved: a NaN
 # model output would be meaningless to rank anyway.
 PAD_SCORE = jnp.nan
+
+
+@jax.jit
+def group_deltas_sorted(
+    s: jax.Array, tp_c: jax.Array, fp_c: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-tie-group count aggregation over a stream ALREADY sorted
+    descending (XLA total order: NaN-keyed padding last).
+
+    Returns ``(delta_tp, delta_fp, keep, nan_dropped)``: summed counts
+    placed at each group's END row (zeros elsewhere), ``keep`` marking
+    group-end rows that carry a nonzero count and a non-NaN score, and
+    ``nan_dropped`` counting samples whose score was NaN (their counts are
+    zeroed in the deltas). This is the scan stage of :func:`compact_counts`,
+    shared with the streaming-compaction pipeline
+    (``ops/stream_compact.py``) that replaces the second sort."""
+    n = s.shape[0]
+    if n == 0:
+        zero = jnp.zeros((0,), jnp.int32)
+        return zero, zero, jnp.zeros((0,), bool), jnp.asarray(0, jnp.int32)
+    ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
+    cfp = jnp.cumsum(fp_c, dtype=jnp.int32)
+    last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
+    # cumulative count at the end of the PREVIOUS tie group: inclusive cummax
+    # of the group-end-masked cumsum, shifted right one (cumsums are
+    # nondecreasing and >= 0, so 0 is a neutral mask fill)
+    prev_tp = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jax.lax.cummax(jnp.where(last, ctp, 0))[:-1]]
+    )
+    prev_fp = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jax.lax.cummax(jnp.where(last, cfp, 0))[:-1]]
+    )
+    delta_tp = jnp.where(last, ctp - prev_tp, 0)
+    delta_fp = jnp.where(last, cfp - prev_fp, 0)
+    real = last & ((delta_tp > 0) | (delta_fp > 0))
+    # NaN-scored SAMPLES (garbage model output) are indistinguishable from
+    # padding; count their rows so the caller can fail loudly
+    nan_dropped = jnp.sum(
+        jnp.where(real & jnp.isnan(s), delta_tp + delta_fp, 0), dtype=jnp.int32
+    )
+    keep = real & ~jnp.isnan(s)
+    # zero the counts of every non-kept row so they can never leak back in
+    delta_tp = jnp.where(keep, delta_tp, 0)
+    delta_fp = jnp.where(keep, delta_fp, 0)
+    return delta_tp, delta_fp, keep, nan_dropped
 
 
 @jax.jit
@@ -68,36 +114,43 @@ def compact_counts(
         zero = jnp.zeros((0,), jnp.int32)
         zs = jnp.asarray(0, jnp.int32)
         return s, zero, zero, zs, zs
-    ctp = jnp.cumsum(tp_c, dtype=jnp.int32)
-    cfp = jnp.cumsum(fp_c, dtype=jnp.int32)
-    last = jnp.concatenate([s[1:] != s[:-1], jnp.ones((1,), bool)])
-    # cumulative count at the end of the PREVIOUS tie group: inclusive cummax
-    # of the group-end-masked cumsum, shifted right one (cumsums are
-    # nondecreasing and >= 0, so 0 is a neutral mask fill)
-    prev_tp = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jax.lax.cummax(jnp.where(last, ctp, 0))[:-1]]
-    )
-    prev_fp = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jax.lax.cummax(jnp.where(last, cfp, 0))[:-1]]
-    )
-    delta_tp = jnp.where(last, ctp - prev_tp, 0)
-    delta_fp = jnp.where(last, cfp - prev_fp, 0)
-    # a group whose delta is all-zero is padding (or contributes nothing);
-    # key it NaN so it joins the padding block in the second sort
-    real = last & ((delta_tp > 0) | (delta_fp > 0))
-    # a NaN-scored SAMPLE (garbage model output) is indistinguishable from
-    # padding in the second sort and would be silently dropped; count its
-    # rows so the caller can fail loudly instead (one extra fused reduction)
-    nan_dropped = jnp.sum(
-        jnp.where(real & jnp.isnan(s), delta_tp + delta_fp, 0), dtype=jnp.int32
-    )
-    keep = real & ~jnp.isnan(s)
+    delta_tp, delta_fp, keep, nan_dropped = group_deltas_sorted(s, tp_c, fp_c)
+    # key non-kept rows NaN so they join the padding block in the second
+    # sort; their counts are already zeroed (group_deltas_sorted), so a
+    # NaN-scored sample can never leak into the stored summary (round-3
+    # review)
     key = jnp.where(keep, s, PAD_SCORE)
-    # zero the counts of every non-kept row BEFORE they ride the second sort:
-    # a NaN-scored sample's deltas would otherwise survive in the padding
-    # block of the stored summary, re-counting into nan_dropped at every
-    # later compaction and leaking into the curve totals (round-3 review)
-    delta_tp = jnp.where(keep, delta_tp, 0)
-    delta_fp = jnp.where(keep, delta_fp, 0)
     neg2, tp_out, fp_out = jax.lax.sort((-key, delta_tp, delta_fp), num_keys=1)
     return -neg2, tp_out, fp_out, jnp.sum(keep.astype(jnp.int32)), nan_dropped
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def compact_counts_fast(
+    scores: jax.Array,
+    tp_w: jax.Array,
+    fp_w: jax.Array,
+    *,
+    interpret: bool = False,
+):
+    """:func:`compact_counts` with the second full sort replaced by the
+    Pallas stream-compaction kernel (``ops/stream_compact.py``): one sort,
+    the shared aggregation scans, then a single streaming pass that moves
+    live rows to the front. Identical output contract (descending unique
+    rows, NaN padding, ``n_unique``, ``nan_dropped``); measured 1.5-1.8x
+    the two-sort formulation at the 1B bench's fold sizes on v5e. TPU-only
+    in production (``interpret=True`` runs it anywhere for tests)."""
+    from torcheval_tpu.ops.stream_compact import compact_summary_rows
+
+    tp_w = tp_w.astype(jnp.int32)
+    fp_w = fp_w.astype(jnp.int32)
+    neg, tp_c, fp_c = jax.lax.sort((-scores, tp_w, fp_w), num_keys=1)
+    s = -neg
+    if s.shape[0] == 0:
+        zero = jnp.zeros((0,), jnp.int32)
+        zs = jnp.asarray(0, jnp.int32)
+        return s, zero, zero, zs, zs
+    delta_tp, delta_fp, keep, nan_dropped = group_deltas_sorted(s, tp_c, fp_c)
+    s2, tp2, fp2, n_live = compact_summary_rows(
+        s, delta_tp, delta_fp, keep, interpret=interpret
+    )
+    return s2, tp2, fp2, n_live, nan_dropped
