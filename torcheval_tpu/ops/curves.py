@@ -154,6 +154,8 @@ def binary_auroc_counts_presorted_kernel(
     sums feed the trapezoid directly and the compute-time sort disappears
     (padding rows add zero-width segments). The compacting metrics'
     ``compute()`` rides this when the summary provenance is known-sorted."""
+    if scores.shape[0] == 0:  # static shape — resolved at trace time
+        return jnp.asarray(0.5)
     ctp = jnp.cumsum(tp_w.astype(jnp.int32), dtype=jnp.int32)
     cfp = jnp.cumsum(fp_w.astype(jnp.int32), dtype=jnp.int32)
     return _auroc_from_group_ends(ctp, cfp)

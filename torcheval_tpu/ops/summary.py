@@ -138,7 +138,10 @@ def compact_counts_fast(
     live rows to the front. Identical output contract (descending unique
     rows, NaN padding, ``n_unique``, ``nan_dropped``); measured 1.5-1.8x
     the two-sort formulation at the 1B bench's fold sizes on v5e. TPU-only
-    in production (``interpret=True`` runs it anywhere for tests)."""
+    in production; ``interpret=True`` runs it anywhere — the
+    ``tests/ops/test_stream_compact.py`` suite pins bit-equality with
+    :func:`compact_counts` over boundary tiles, NaN padding, ±inf scores,
+    large counts and multi-chunk folds that way."""
     from torcheval_tpu.ops.stream_compact import compact_summary_rows
 
     tp_w = tp_w.astype(jnp.int32)
