@@ -167,6 +167,52 @@ def main() -> None:
     results["windowed_ctr_lifetime"] = float(np.asarray(wr[0])[0])
     results["windowed_ctr_windowed"] = float(np.asarray(wr[1])[0])
 
+    # --- sub-process-group sync (reference process_group semantics,
+    # toolkit.py:24-78): ranks 1 and 3 sync within processes=[1, 3] while
+    # ranks 0 and 2 are genuinely uninvolved — they never enter the
+    # collective (their only interaction is the eager non-member ValueError)
+    SUBGROUP = [1, 3]
+    if rank in SUBGROUP:
+        sub = Sum()
+        sub.update(jnp.asarray([10.0 * (rank + 1)]))  # 20 + 40 -> 60
+        r = sync_and_compute(sub, recipient_rank="all", processes=SUBGROUP)
+        results["subgroup_sum_all"] = _jsonable(r)
+        r3 = sync_and_compute(sub, recipient_rank=3, processes=SUBGROUP)
+        results["subgroup_sum_r3"] = None if r3 is None else _jsonable(r3)
+        # recipient outside the subgroup: eager raise, no collective entered
+        try:
+            sync_and_compute(sub, recipient_rank=0, processes=SUBGROUP)
+            results["subgroup_bad_recipient"] = None
+        except ValueError:
+            results["subgroup_bad_recipient"] = True
+        # whole-collection subgroup sync: typed lanes (SUM + uneven CAT)
+        # plus the object lane (dict state) — all scoped to the subgroup
+        sub_auroc = BinaryAUROC()
+        ss, st = make_auroc_shard(rank)
+        if ss.size:
+            sub_auroc.update(jnp.asarray(ss), jnp.asarray(st))
+        sub_d = DummySumDictStateMetric()
+        for key, val in make_dict_updates(rank):
+            sub_d.update(key, val)
+        rc = sync_and_compute_collection(
+            {"s": sub, "auroc": sub_auroc, "d": sub_d},
+            recipient_rank="all",
+            processes=SUBGROUP,
+        )
+        results["subgroup_collection"] = {k: _jsonable(v) for k, v in rc.items()}
+        sd = get_synced_state_dict(sub, recipient_rank=1, processes=SUBGROUP)
+        results["subgroup_sd_r1"] = (
+            _jsonable(sd["weighted_sum"]) if sd else None
+        )
+    else:
+        # non-members must be rejected eagerly (entering the collective
+        # would hang the members) — reference: invalid process_group use
+        try:
+            sync_and_compute(s, processes=SUBGROUP)
+            results["subgroup_nonmember_error"] = None
+        except ValueError as e:
+            results["subgroup_nonmember_error"] = "not a member" in str(e)
+
     # --- wire-cost contract: count the actual collective rounds. A sync is
     # exactly TWO process_allgather calls (descriptor matrix + byte payload)
     # no matter how many states the metric (or whole array-lane collection)

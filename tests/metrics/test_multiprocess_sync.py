@@ -188,6 +188,33 @@ class TestMultiprocessSync(unittest.TestCase):
             self.assertEqual(res["rounds_auroc"], 2)
             self.assertEqual(res["rounds_collection"], 2)
 
+    def test_subgroup_sync(self):
+        # processes=[1, 3]: members fold only each other's state; ranks 0/2
+        # never enter the collective and get an eager non-member ValueError
+        sub_scores = np.concatenate([make_auroc_shard(1)[0], make_auroc_shard(3)[0]])
+        sub_targets = np.concatenate([make_auroc_shard(1)[1], make_auroc_shard(3)[1]])
+        want_auroc = roc_auc_score(sub_targets, sub_scores)
+        want_dict = sum(
+            v for r in (1, 3) for _, v in make_dict_updates(r)
+        )
+        for r, res in enumerate(self.results):
+            if r in (1, 3):
+                # Sum over member ranks only: 10*(1+1) + 10*(3+1) = 60
+                self.assertEqual(res["subgroup_sum_all"], 60.0)
+                self.assertEqual(
+                    res["subgroup_sum_r3"], 60.0 if r == 3 else None
+                )
+                self.assertTrue(res["subgroup_bad_recipient"])
+                col = res["subgroup_collection"]
+                self.assertEqual(col["s"], 60.0)
+                self.assertAlmostEqual(col["auroc"], want_auroc, places=5)
+                self.assertAlmostEqual(col["d"], want_dict, places=5)
+                self.assertEqual(
+                    res["subgroup_sd_r1"], 60.0 if r == 1 else None
+                )
+            else:
+                self.assertTrue(res["subgroup_nonmember_error"])
+
     def test_dict_state_object_gather(self):
         want = sum(v for r in range(WORLD) for _, v in make_dict_updates(r))
         keys = sorted(

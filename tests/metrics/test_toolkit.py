@@ -84,6 +84,23 @@ class TestLocalToolkit(unittest.TestCase):
         self.assertEqual(float(out["s"]), 1.0)
         self.assertEqual(float(out["m"]), 7.0)
 
+    def test_processes_validation(self):
+        # single-process world: the only member subgroup is [0]; it behaves
+        # like world size 1 (warn + return input). The real member-semantics
+        # live in the 4-process suite (test_multiprocess_sync.py).
+        m = Sum()
+        m.update(jnp.asarray([4.0]))
+        with self.assertLogs(level="WARNING"):
+            self.assertIs(get_synced_metric(m, processes=[0]), m)
+        with self.assertRaisesRegex(ValueError, "out of range"):
+            sync_and_compute(m, processes=[0, 7])
+        with self.assertRaisesRegex(ValueError, "non-empty"):
+            sync_and_compute(m, processes=[])
+        with self.assertRaisesRegex(ValueError, "out of range"):
+            sync_and_compute_collection({"s": m}, processes=[0, 3])
+        # membership and in-group recipient rejection are exercised in the
+        # real 4-process world (test_multiprocess_sync.py::test_subgroup_sync)
+
 
 class TestFoldStates(unittest.TestCase):
     """The typed reduction fold is the core of cross-process sync; exercise it

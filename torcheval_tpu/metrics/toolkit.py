@@ -31,9 +31,10 @@ mutated; ``_prepare_for_merge_state`` compacts sample caches pre-sync.
 from __future__ import annotations
 
 import copy
+import functools
 import logging
 from collections import defaultdict, deque
-from typing import Any, Dict, List, Optional, TypeVar, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, TypeVar, Union
 
 import jax
 import jax.numpy as jnp
@@ -178,6 +179,122 @@ def _process_index() -> int:
     return jax.process_index()
 
 
+# ------------------------------------------------------- process subgroups
+# The reference's every toolkit API takes a ``process_group`` and syncs only
+# within it (``torcheval/metrics/toolkit.py:24-78``, via ``PGWrapper``). The
+# TPU-native analogue is a ``processes`` sequence of global process indices:
+# collectives then run over a Mesh built from ONE device per member process,
+# so non-member processes are genuinely uninvolved — they neither execute the
+# exchange nor block on it (torch.distributed subgroup semantics).
+_ProcessGroup = Optional[Sequence[int]]
+
+
+def _resolve_group(processes: _ProcessGroup) -> Optional[Tuple[int, ...]]:
+    """Validate and normalise a ``processes`` argument. ``None`` = the full
+    world. A member-only contract is enforced eagerly: a non-member entering
+    the collective path would hang the member processes (same rule as a
+    ``torch.distributed`` group you are not part of)."""
+    if processes is None:
+        return None
+    group = tuple(sorted({int(p) for p in processes}))
+    if not group:
+        raise ValueError(
+            "processes must be a non-empty collection of process indices "
+            "or None (the full world)."
+        )
+    world = _world_size()
+    for p in group:
+        if not 0 <= p < world:
+            raise ValueError(
+                f"process index {p} out of range for world size {world}."
+            )
+    me = _process_index()
+    if me not in group:
+        raise ValueError(
+            f"process {me} is not a member of processes={group}; only "
+            "member processes may call sync APIs on a subgroup (a "
+            "non-member entering the collective would hang the members). "
+            "Gate the call on membership, as with a torch.distributed "
+            "subgroup."
+        )
+    return group
+
+
+def _check_group_recipient(
+    group: Optional[Tuple[int, ...]], recipient_rank: _RecipientRank
+) -> None:
+    if (
+        group is not None
+        and recipient_rank != "all"
+        and recipient_rank not in group
+    ):
+        raise ValueError(
+            f"recipient_rank {recipient_rank} is not a member of "
+            f"processes={group}."
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _subgroup_mesh(group: Tuple[int, ...]) -> jax.sharding.Mesh:
+    """One (lowest-id) device per member process — globally consistent, so
+    every member builds the identical mesh."""
+    devs = [
+        sorted(
+            (d for d in jax.devices() if d.process_index == p),
+            key=lambda d: d.id,
+        )[0]
+        for p in group
+    ]
+    return jax.sharding.Mesh(np.array(devs), ("p",))
+
+
+@functools.lru_cache(maxsize=None)
+def _subgroup_replicate(group: Tuple[int, ...]):
+    """Cached jitted replicating identity for a subgroup mesh — the
+    all-gather collective. jit's cache keys on callable identity, so a fresh
+    lambda per call would recompile every sync round."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _subgroup_mesh(group)
+    return jax.jit(
+        lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
+    )
+
+
+def _subgroup_allgather(x: np.ndarray, group: Tuple[int, ...]) -> np.ndarray:
+    """All-gather ``x`` (same shape/dtype on every member) across the
+    subgroup only: each member contributes its row of a dim-0-sharded global
+    array over the subgroup mesh, and a jitted identity with replicated
+    out-sharding is the all-gather — XLA inserts the collective over the
+    member devices; non-members never participate."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _subgroup_mesh(group)
+    pos = group.index(_process_index())
+    local = jax.device_put(x[None, ...], mesh.devices.reshape(-1)[pos])
+    garr = jax.make_array_from_single_device_arrays(
+        (len(group),) + np.shape(x),
+        NamedSharding(mesh, PartitionSpec("p")),
+        [local],
+    )
+    return np.asarray(_subgroup_replicate(group)(garr))
+
+
+def _allgather_stacked(
+    x: np.ndarray, group: Optional[Tuple[int, ...]]
+) -> np.ndarray:
+    """Per-rank-stacked all-gather of a HOST numpy buffer: the full-world
+    path rides ``multihost_utils.process_allgather`` (one compiled XLA
+    collective); a subgroup rides :func:`_subgroup_allgather`, which keeps
+    the buffer host-side until its single ``device_put``. Returns shape
+    ``(n_members, *x.shape)`` in group order (ascending process index)."""
+    if group is None:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(jnp.asarray(x)))
+    return _subgroup_allgather(np.ascontiguousarray(x), group)
+
+
 # ------------------------------------------------------- object-gather lane
 def _tree_to_host(value):
     """Recursively convert a TState container's arrays to host numpy so the
@@ -218,32 +335,29 @@ def _tree_to_device(value):
     return value
 
 
-def _allgather_object(obj: Any) -> List[Any]:
-    """All-gather an arbitrary picklable object across JAX processes.
+def _allgather_object(
+    obj: Any, group: Optional[Tuple[int, ...]] = None
+) -> List[Any]:
+    """All-gather an arbitrary picklable object across JAX processes (all of
+    them, or a validated subgroup).
 
     This is the reference's ``dist.all_gather_object`` (``toolkit.py:235-257``)
     rebuilt on typed XLA collectives: pickle → uint8 payload → length exchange
-    → pad to the max → ``process_allgather`` → trim + unpickle per rank. Used
+    → pad to the max → stacked all-gather → trim + unpickle per rank. Used
     only for states the typed lanes cannot carry (dict-keyed state, CUSTOM
     reductions); array/list states always travel as typed arrays.
     """
     import pickle
 
-    from jax.experimental import multihost_utils
-
-    world = _world_size()
+    world = len(group) if group is not None else _world_size()
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    lengths = np.asarray(
-        multihost_utils.process_allgather(
-            jnp.asarray([payload.size], dtype=jnp.int32)
-        )
+    lengths = _allgather_stacked(
+        np.asarray([payload.size], dtype=np.int32), group
     ).reshape(world)
     max_len = int(lengths.max())
     padded = np.zeros(max(max_len, 1), dtype=np.uint8)
     padded[: payload.size] = payload
-    all_bytes = np.asarray(
-        multihost_utils.process_allgather(jnp.asarray(padded))
-    ).reshape(world, -1)
+    all_bytes = _allgather_stacked(padded, group).reshape(world, -1)
     return [
         pickle.loads(all_bytes[rank, : lengths[rank]].tobytes())
         for rank in range(world)
@@ -261,13 +375,15 @@ def _needs_object_sync(metric: Metric) -> bool:
 
 
 def _object_synced_metric(
-    metric: TMetric, recipient_rank: _RecipientRank
+    metric: TMetric,
+    recipient_rank: _RecipientRank,
+    group: Optional[Tuple[int, ...]] = None,
 ) -> Optional[TMetric]:
     """Fallback sync for dict/CUSTOM states: all-gather the whole state_dict
     as a pickled payload (over typed uint8 collectives) and fold with the
     metric's own ``merge_state`` — the reference's object-gather semantics
     (``toolkit.py:217-257``) without ``torch.distributed``."""
-    gathered_sds = _allgather_object(_tree_to_host(metric.state_dict()))
+    gathered_sds = _allgather_object(_tree_to_host(metric.state_dict()), group)
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None
     replicas = []
@@ -282,25 +398,31 @@ def get_synced_metric(
     metric: TMetric,
     recipient_rank: _RecipientRank = 0,
     *,
+    processes: _ProcessGroup = None,
     _gathered: Optional[List[Dict[str, TState]]] = None,
 ) -> Optional[TMetric]:
-    """Sync metric states over all JAX processes and return the merged metric
-    on the recipient rank(s); ``None`` elsewhere.
+    """Sync metric states over all JAX processes — or the ``processes``
+    subgroup — and return the merged metric on the recipient rank(s);
+    ``None`` elsewhere.
 
     Reference parity: ``toolkit.py:145-232`` — world size 1 returns the input
-    metric with a warning; ``recipient_rank="all"`` returns on every rank.
-    Array/list states travel on the batched typed wire (one descriptor round
-    + one byte-payload round, shared with :func:`sync_and_compute_collection`);
-    dict-keyed and CUSTOM-reduction states fall back to a pickled object
-    gather (:func:`_allgather_object`) folded by the metric's own
-    ``merge_state``.
+    metric with a warning; ``recipient_rank="all"`` returns on every rank;
+    ``processes`` is the ``process_group`` analogue (a sequence of global
+    process indices; only members may call, and collectives involve only
+    member processes). Array/list states travel on the batched typed wire
+    (one descriptor round + one byte-payload round, shared with
+    :func:`sync_and_compute_collection`); dict-keyed and CUSTOM-reduction
+    states fall back to a pickled object gather (:func:`_allgather_object`)
+    folded by the metric's own ``merge_state``.
     """
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
             "recipient_rank should be an integer or 'all', "
             f"got {recipient_rank} instead."
         )
-    world = _world_size()
+    group = _resolve_group(processes)
+    _check_group_recipient(group, recipient_rank)
+    world = len(group) if group is not None else _world_size()
     if world == 1:
         _logger.warning(
             "World size is 1, and metric(s) not synced. "
@@ -309,7 +431,7 @@ def get_synced_metric(
         return metric
     metric._prepare_for_merge_state()
     if _gathered is None and _needs_object_sync(metric):
-        return _object_synced_metric(metric, recipient_rank)
+        return _object_synced_metric(metric, recipient_rank, group)
     if _gathered is not None:
         gathered = _gathered
     else:
@@ -321,7 +443,7 @@ def get_synced_metric(
         # host, a scheduling-noise amplifier)
         gathered = [
             per_rank["m"]
-            for per_rank in _gather_collection_states({"m": metric})
+            for per_rank in _gather_collection_states({"m": metric}, group)
         ]
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None
@@ -337,24 +459,32 @@ def get_synced_metric(
 
 
 def get_synced_state_dict(
-    metric: Metric, recipient_rank: _RecipientRank = 0
+    metric: Metric,
+    recipient_rank: _RecipientRank = 0,
+    *,
+    processes: _ProcessGroup = None,
 ) -> Dict[str, TState]:
     """Globally-merged ``state_dict``; ``{}`` on non-recipient ranks
-    (reference ``toolkit.py:81-118``)."""
-    synced = get_synced_metric(metric, recipient_rank)
+    (reference ``toolkit.py:81-118``; ``processes`` = subgroup sync)."""
+    synced = get_synced_metric(metric, recipient_rank, processes=processes)
     return synced.state_dict() if synced is not None else {}
 
 
 def sync_and_compute(
-    metric: Metric, recipient_rank: _RecipientRank = 0
+    metric: Metric,
+    recipient_rank: _RecipientRank = 0,
+    *,
+    processes: _ProcessGroup = None,
 ) -> Optional[Any]:
-    """Sync states across all processes and compute on the recipient rank(s).
+    """Sync states across all processes — or the ``processes`` subgroup —
+    and compute on the recipient rank(s).
 
-    Reference parity: ``toolkit.py:24-78``. Because states travel as typed
-    arrays (not pickled objects), every rank could fold cheaply; we still
-    honor the recipient contract — non-recipients get ``None``.
+    Reference parity: ``toolkit.py:24-78`` (``processes`` plays the
+    ``process_group`` role). Because states travel as typed arrays (not
+    pickled objects), every rank could fold cheaply; we still honor the
+    recipient contract — non-recipients get ``None``.
     """
-    synced = get_synced_metric(metric, recipient_rank)
+    synced = get_synced_metric(metric, recipient_rank, processes=processes)
     if synced is None:
         return None
     return synced.compute()
@@ -454,9 +584,11 @@ def _schema_digest_row(metrics: Dict[str, Metric]) -> list:
 
 def _gather_collection_states(
     metrics: Dict[str, Metric],
+    group: Optional[Tuple[int, ...]] = None,
 ) -> List[Dict[str, Dict[str, TState]]]:
     """All-gather every rank's states for a whole collection in exactly two
-    collective rounds; returns per-rank ``{metric_key: state_dict}``.
+    collective rounds (full world, or the ``group`` subgroup); returns
+    per-rank ``{metric_key: state_dict}`` in group order.
 
     Row 0 of the descriptor matrix is a schema digest
     (:func:`_schema_digest_row`) validated post-exchange, so ranks that
@@ -464,18 +596,16 @@ def _gather_collection_states(
     instead of folding bytes into the wrong states. (Ranks with *different
     entry counts* diverge in collective shape and fail inside XLA already;
     the digest covers the dangerous same-shape case.)"""
-    from jax.experimental import multihost_utils
-
-    world = _world_size()
+    world = len(group) if group is not None else _world_size()
     entries = _collection_entries(metrics)
     desc = np.asarray(
         [_schema_digest_row(metrics)]
         + [_encode_entry_descriptor(local) for _, _, _, local in entries],
         dtype=np.int32,
     ).reshape(len(entries) + 1, 7)
-    all_desc = np.asarray(
-        multihost_utils.process_allgather(jnp.asarray(desc))
-    ).reshape(world, len(entries) + 1, 7)
+    all_desc = _allgather_stacked(desc, group).reshape(
+        world, len(entries) + 1, 7
+    )
     # uniform validation AFTER the exchange (a one-sided raise would hang the
     # payload collective on the other ranks): first the schema digest, then
     # the per-entry wire-format checks. Every rank sees identical gathered
@@ -507,9 +637,7 @@ def _gather_collection_states(
         raw = np.ascontiguousarray(local).view(np.uint8).reshape(-1)
         payload[offset : offset + raw.size] = raw
         offset += raw.size
-    all_bytes = np.asarray(
-        multihost_utils.process_allgather(jnp.asarray(payload))
-    ).reshape(world, max_total)
+    all_bytes = _allgather_stacked(payload, group).reshape(world, max_total)
     gathered: List[Dict[str, Dict[str, TState]]] = [
         {mkey: {} for mkey in metrics} for _ in range(world)
     ]
@@ -541,21 +669,27 @@ def _gather_collection_states(
 
 
 def sync_and_compute_collection(
-    metrics: Dict[str, Metric], recipient_rank: _RecipientRank = 0
+    metrics: Dict[str, Metric],
+    recipient_rank: _RecipientRank = 0,
+    *,
+    processes: _ProcessGroup = None,
 ) -> Optional[Dict[str, Any]]:
     """Sync and compute a named collection of metrics in ONE gather pass.
 
     All metrics' array/CAT states ride a single two-round typed exchange
     (descriptors, then one concatenated byte payload); metrics needing the
     object lane (dict-keyed / CUSTOM states) share a single pickled gather.
-    Results follow :func:`sync_and_compute` semantics per metric: ``None`` on
-    non-recipient ranks."""
+    ``processes`` restricts the sync to a subgroup (reference
+    ``process_group`` semantics). Results follow :func:`sync_and_compute`
+    semantics per metric: ``None`` on non-recipient ranks."""
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
             "recipient_rank should be an integer or 'all', "
             f"got {recipient_rank} instead."
         )
-    world = _world_size()
+    group = _resolve_group(processes)
+    _check_group_recipient(group, recipient_rank)
+    world = len(group) if group is not None else _world_size()
     if world == 1:
         _logger.warning(
             "World size is 1, and metric(s) not synced. "
@@ -566,10 +700,11 @@ def sync_and_compute_collection(
         m._prepare_for_merge_state()
     obj_lane = {k: m for k, m in metrics.items() if _needs_object_sync(m)}
     arr_lane = {k: m for k, m in metrics.items() if k not in obj_lane}
-    gathered = _gather_collection_states(arr_lane) if arr_lane else None
+    gathered = _gather_collection_states(arr_lane, group) if arr_lane else None
     obj_gathered = (
         _allgather_object(
-            {k: _tree_to_host(m.state_dict()) for k, m in obj_lane.items()}
+            {k: _tree_to_host(m.state_dict()) for k, m in obj_lane.items()},
+            group,
         )
         if obj_lane
         else None
@@ -581,6 +716,7 @@ def sync_and_compute_collection(
         synced = get_synced_metric(
             metric,
             recipient_rank,
+            processes=processes,
             _gathered=[g[name] for g in gathered],
         )
         if synced is not None:
