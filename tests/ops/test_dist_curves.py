@@ -1,0 +1,215 @@
+"""Distributed bucket-sort curve reduction on the forced 8-device CPU mesh
+(round-4 verdict ask 4: per-shard sort + all_to_all replaces XLA's
+gather-based sort partitioning for sharded curve caches)."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from torcheval_tpu.metrics import BinaryAUPRC, BinaryAUROC
+from torcheval_tpu.ops.dist_curves import (
+    _program,
+    sharded_binary_auprc,
+    sharded_binary_auroc,
+)
+from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh, shard_batch
+
+RNG = np.random.default_rng(17)
+
+
+def _tied_data(n):
+    s = ((RNG.random(n) * 300).astype(np.int32) / 300.0).astype(np.float32)
+    t = (RNG.random(n) < 0.4).astype(np.float32)
+    return s, t
+
+
+class TestDistCurveKernels(unittest.TestCase):
+    def setUp(self):
+        self.mesh = data_parallel_mesh()
+
+    def _sharded_lists(self, batches):
+        s_list = [shard_batch(self.mesh, jnp.asarray(s)) for s, _ in batches]
+        t_list = [shard_batch(self.mesh, jnp.asarray(t)) for _, t in batches]
+        return s_list, t_list
+
+    def test_auroc_parity_multi_batch_with_ties(self):
+        batches = [_tied_data(8 * (200 + 100 * i)) for i in range(3)]
+        s_list, t_list = self._sharded_lists(batches)
+        all_s = np.concatenate([s for s, _ in batches])
+        all_t = np.concatenate([t for _, t in batches])
+        v, ov = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(int(ov), 0)
+        self.assertAlmostEqual(float(v), roc_auc_score(all_t, all_s), places=6)
+
+    def test_auprc_parity(self):
+        batches = [_tied_data(8 * 250) for _ in range(2)]
+        s_list, t_list = self._sharded_lists(batches)
+        all_s = np.concatenate([s for s, _ in batches])
+        all_t = np.concatenate([t for _, t in batches])
+        v, ov = sharded_binary_auprc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(int(ov), 0)
+        self.assertAlmostEqual(
+            float(v), average_precision_score(all_t, all_s), places=5
+        )
+
+    def test_neg_inf_scores(self):
+        s = np.array([0.9, -np.inf, 0.4, -np.inf, 0.1, 0.7, 0.2, 0.3] * 32,
+                     np.float32)
+        t = (RNG.random(s.size) < 0.5).astype(np.float32)
+        s_list, t_list = self._sharded_lists([(s, t)])
+        v, ov = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(int(ov), 0)
+        fin = np.where(np.isinf(s), -1e30, s)  # rank-equivalent for sklearn
+        self.assertAlmostEqual(float(v), roc_auc_score(t, fin), places=6)
+
+    def test_signed_zeros_share_a_tie_group(self):
+        # -0.0 == +0.0 in float compares: the fused path merges them into
+        # one tie group, so the key transform must too (review finding:
+        # distinct bitcast keys silently changed the result by ~2e-3)
+        n = 3200
+        s, t = _tied_data(n)
+        s[:100], t[:100] = 0.0, 1.0
+        s[100:200], t[100:200] = -0.0, 0.0
+        perm = np.random.default_rng(0).permutation(n)  # spread across shards
+        s, t = s[perm], t[perm]
+        s_list, t_list = self._sharded_lists([(s, t)])
+        v, ov = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(int(ov), 0)
+        self.assertAlmostEqual(float(v), roc_auc_score(t, s), places=6)
+
+    def test_degenerate_targets_guard(self):
+        s, _ = _tied_data(800)
+        s_list, t_list = self._sharded_lists([(s, np.ones(800, np.float32))])
+        v, _ = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(float(v), 0.5)
+        v2, _ = sharded_binary_auprc(s_list, t_list, mesh=self.mesh)
+        self.assertAlmostEqual(float(v2), 1.0, places=6)
+
+    def test_capacity_overflow_detected_exactly(self):
+        # every row the same score: one bucket receives everything — the
+        # kernel must COUNT the clipped rows, never silently drop them
+        n = 8 * 128
+        s = np.full(n, 0.5, np.float32)
+        t = (RNG.random(n) < 0.5).astype(np.float32)
+        s_list, t_list = self._sharded_lists([(s, t)])
+        _, ov = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertGreater(int(ov), 0)
+
+    def test_no_sample_all_gather_in_hlo(self):
+        # the acceptance criterion (round-4 verdict ask 4): the compiled
+        # program for a sharded curve compute contains NO all-gather at all —
+        # the only sample-sized collective is the all-to-all bucket exchange;
+        # per-shard totals ride K-element all-reduces
+        batches = [_tied_data(8 * 256)]
+        s_list, t_list = self._sharded_lists(batches)
+        fn = _program(self.mesh, "data", "auroc")
+        hlo = fn.lower(s_list, t_list).compile().as_text()
+        self.assertNotIn("all-gather", hlo)
+        self.assertIn("all-to-all", hlo)
+
+
+class TestDistCurveMetricIntegration(unittest.TestCase):
+    """BinaryAUROC/AUPRC automatically take the distributed path when their
+    cache is uniformly data-sharded (the ShardedEvaluator regime)."""
+
+    def setUp(self):
+        self.mesh = data_parallel_mesh()
+
+    def test_evaluator_auroc_uses_dist_path(self):
+        import torcheval_tpu.metrics.classification.auroc as auroc_mod
+
+        ev = ShardedEvaluator(
+            {"auroc": BinaryAUROC(), "auprc": BinaryAUPRC()}, mesh=self.mesh
+        )
+        parts = [_tied_data(8 * 200) for _ in range(3)]
+        for s, t in parts:
+            ev.update(jnp.asarray(s), jnp.asarray(t))
+        m = ev.metrics["auroc"]
+        self.assertIsNotNone(m._sharded_raw_mesh())  # dist path is active
+        calls = []
+        orig = auroc_mod._auroc_from_parts
+
+        def _spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        auroc_mod._auroc_from_parts = _spy
+        try:
+            out = ev.compute()
+        finally:
+            auroc_mod._auroc_from_parts = orig
+        self.assertEqual(calls, [])  # the gather-based program never ran
+        all_s = np.concatenate([s for s, _ in parts])
+        all_t = np.concatenate([t for _, t in parts])
+        self.assertAlmostEqual(
+            float(out["auroc"]), roc_auc_score(all_t, all_s), places=6
+        )
+        self.assertAlmostEqual(
+            float(out["auprc"]),
+            average_precision_score(all_t, all_s),
+            places=5,
+        )
+
+    def test_overflow_falls_back_to_gather_path(self):
+        # massively tied scores overload one bucket; the metric must detect
+        # the overflow and fall back to the fused sort program — correct
+        # result, never dropped rows
+        ev = ShardedEvaluator(BinaryAUROC(), mesh=self.mesh)
+        n = 8 * 128
+        s = np.full(n, 0.25, np.float32)
+        s[: n // 2] = 0.75
+        t = (RNG.random(n) < 0.5).astype(np.float32)
+        ev.update(jnp.asarray(s), jnp.asarray(t))
+        self.assertAlmostEqual(
+            float(ev.compute()), roc_auc_score(t, s), places=6
+        )
+
+    def test_multi_axis_mesh_falls_back_to_fused_path(self):
+        # a 2-D mesh (or a tuple spec entry) must NOT enter the bucket-sort
+        # kernel, whose k_devices/capacity assume the spec axis covers the
+        # whole mesh — compute falls back to the fused program instead of
+        # raising (review finding)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.asarray(jax.devices()).reshape(4, 2)
+        mesh2d = Mesh(devs, ("data", "model"))
+        s, t = _tied_data(8 * 100)
+        for spec in (P(("data", "model")), P("data")):
+            m = BinaryAUROC()
+            m.update(
+                jax.device_put(jnp.asarray(s), NamedSharding(mesh2d, spec)),
+                jax.device_put(jnp.asarray(t), NamedSharding(mesh2d, spec)),
+            )
+            self.assertIsNone(m._sharded_raw_mesh())
+            self.assertAlmostEqual(
+                float(m.compute()), roc_auc_score(t, s), places=6
+            )
+
+    def test_unsharded_cache_keeps_plain_path(self):
+        m = BinaryAUROC()
+        s, t = _tied_data(1000)
+        m.update(jnp.asarray(s), jnp.asarray(t))
+        self.assertIsNone(m._sharded_raw_mesh())
+        self.assertAlmostEqual(float(m.compute()), roc_auc_score(t, s), places=6)
+
+    def test_merged_then_computed_after_sync_still_correct(self):
+        # merging pulls state through _set_states — mixed provenance caches
+        # must still compute correctly (dist path simply disables itself
+        # when entries are not uniformly sharded)
+        ev = ShardedEvaluator(BinaryAUROC(), mesh=self.mesh)
+        s1, t1 = _tied_data(8 * 100)
+        ev.update(jnp.asarray(s1), jnp.asarray(t1))
+        other = BinaryAUROC()
+        s2, t2 = _tied_data(999)  # not divisible by 8, unsharded
+        other.update(jnp.asarray(s2), jnp.asarray(t2))
+        merged = ev.metrics["m"] if "m" in ev.metrics else list(ev.metrics.values())[0]
+        merged.merge_state([other])
+        want = roc_auc_score(np.concatenate([t1, t2]), np.concatenate([s1, s2]))
+        self.assertAlmostEqual(float(merged.compute()), want, places=6)
+
+
+if __name__ == "__main__":
+    unittest.main()

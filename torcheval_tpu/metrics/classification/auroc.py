@@ -290,6 +290,57 @@ class _BinaryCurveMetric(SampleCacheMetric[jax.Array]):
             return False
         return None
 
+    def _sharded_raw_mesh(self):
+        """``(mesh, axis)`` when the whole cache is raw entries data-sharded
+        on ONE mesh (the :class:`~torcheval_tpu.parallel.ShardedEvaluator`
+        regime) — the distributed bucket-sort curve path applies
+        (``ops/dist_curves.py``); else ``None`` (single-device, replicated,
+        mixed-summary, or uneven-shard caches keep the fused sort program,
+        whose partitioning XLA handles)."""
+        from jax.sharding import NamedSharding
+
+        if self.summary_scores or not self.inputs:
+            return None
+        mesh = axis = None
+        for a in list(self.inputs) + list(self.targets):
+            sh = getattr(a, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                return None
+            spec = sh.spec
+            # a single string axis name covering the WHOLE mesh: the kernel
+            # sizes its all_to_all/capacity from mesh.devices.size, so a
+            # multi-axis mesh (or a tuple spec entry) must take the fused
+            # path instead — correct there, just not bucket-sorted
+            if (
+                sh.mesh.devices.size <= 1
+                or not spec
+                or not isinstance(spec[0], str)
+                or sh.mesh.shape[spec[0]] != sh.mesh.devices.size
+                or a.shape[0] % sh.mesh.devices.size
+            ):
+                return None
+            if mesh is None:
+                mesh, axis = sh.mesh, spec[0]
+            elif sh.mesh != mesh or spec[0] != axis:
+                return None
+        return mesh, axis
+
+    def _sharded_value(self, kernel):
+        """Run a distributed curve kernel over the sharded cache; ``None``
+        when the cache is not uniformly sharded or the score distribution
+        overloaded a bucket (exact overflow detection — fall back to the
+        gather-based sort program rather than lose rows)."""
+        dist = self._sharded_raw_mesh()
+        if dist is None:
+            return None
+        mesh, axis = dist
+        value, overflow = kernel(
+            self.inputs, self.targets, mesh=mesh, axis=str(axis)
+        )
+        if int(overflow):
+            return None
+        return value
+
     def _presorted_summary(self):
         """``(s, tp, fp)`` when state is a single summary buffer known to be
         sorted-unique (folding raw leftovers first), else ``None``. Gated to
@@ -415,18 +466,24 @@ class BinaryAUROC(_BinaryCurveMetric):
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.5)
-        presorted = self._presorted_summary()
-        if presorted is not None:
-            # known-sorted unique summary: cumsums + trapezoid, no sort
-            result = binary_auroc_counts_presorted_kernel(*presorted)
-        else:
-            result = _auroc_from_parts(
-                self.inputs,
-                self.targets,
-                self.summary_scores,
-                self.summary_tp,
-                self.summary_fp,
-            )
+        from torcheval_tpu.ops.dist_curves import sharded_binary_auroc
+
+        # mesh-sharded raw cache: distributed bucket sort — one all_to_all
+        # of the rows instead of XLA's per-partition operand gather
+        result = self._sharded_value(sharded_binary_auroc)
+        if result is None:
+            presorted = self._presorted_summary()
+            if presorted is not None:
+                # known-sorted unique summary: cumsums + trapezoid, no sort
+                result = binary_auroc_counts_presorted_kernel(*presorted)
+            else:
+                result = _auroc_from_parts(
+                    self.inputs,
+                    self.targets,
+                    self.summary_scores,
+                    self.summary_tp,
+                    self.summary_fp,
+                )
         # after dispatching the curve kernel, so the flag read (one host
         # scalar) overlaps with it instead of stalling in front of it
         self._check_nan_flag()
@@ -510,16 +567,20 @@ class BinaryAUPRC(_BinaryCurveMetric):
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.0)
-        presorted = self._presorted_summary()
-        if presorted is not None:
-            result = binary_auprc_counts_presorted_kernel(*presorted)
-        else:
-            result = _auprc_from_parts(
-                self.inputs,
-                self.targets,
-                self.summary_scores,
-                self.summary_tp,
-                self.summary_fp,
-            )
+        from torcheval_tpu.ops.dist_curves import sharded_binary_auprc
+
+        result = self._sharded_value(sharded_binary_auprc)
+        if result is None:
+            presorted = self._presorted_summary()
+            if presorted is not None:
+                result = binary_auprc_counts_presorted_kernel(*presorted)
+            else:
+                result = _auprc_from_parts(
+                    self.inputs,
+                    self.targets,
+                    self.summary_scores,
+                    self.summary_tp,
+                    self.summary_fp,
+                )
         self._check_nan_flag()
         return result

@@ -1,0 +1,278 @@
+"""Distributed exact curve reduction over a device mesh: bucket partition +
+per-shard sort instead of XLA's gather-based sort partitioning.
+
+The curve kernels (``ops/curves.py``) are one global descending sort plus
+scans. XLA's SPMD partitioner handles a partitioned ``lax.sort`` by
+all-gathering the operand and sorting the full array on every device
+(``docs/distributed.md`` conceded this; SURVEY §7 names the 1B-across-chips
+sort as the hard part). This module is the TPU-native fix — the classic
+distributed sort recipe, expressed in ``shard_map`` so every step is explicit
+and collective traffic is exactly one ``all_to_all`` over the sample rows:
+
+1. **Order keys.** Scores become monotone u32 keys (:func:`_desc_key`):
+   ascending key order == descending score order, equal scores == equal keys,
+   every NaN (sample or padding sentinel) maps to the max key. Only keys and
+   counts travel — curve values never need the f32 score again.
+2. **Histogram splitters.** A 2^16-bin histogram over the keys' top 16 bits,
+   ``psum``-reduced across the mesh (an all-reduce of a fixed 256 KiB —
+   independent of sample count), yields K-quantile splitter bins, so every
+   device receives ≈ 1/K of the rows regardless of the score distribution.
+3. **Bucket exchange.** Each device sorts locally once, slices its rows into
+   K contiguous per-destination buckets, pads each to a static capacity
+   ``C = ceil(F·n_local/K)`` (``DIST_CAPACITY_FACTOR``), and one tiled
+   ``lax.all_to_all`` delivers bucket *k* of every source to device *k* —
+   each sample row crosses the ICI exactly once. Rows beyond a bucket's
+   capacity (pathologically skewed distributions: massive ties on few
+   values) are *counted* and the caller raises — never silently dropped.
+4. **Per-shard merge + offset integration.** Each device now owns a disjoint
+   descending score range: one local sort merges its ≤ K·C rows, tie groups
+   are intra-shard by construction (equal keys share a bucket), and global
+   cumulative TP/FP come from a per-device-totals all-reduce (K elements)
+   turned into exclusive prefixes. Trapezoid (AUROC) and step (AUPRC)
+   integrals decompose over shards exactly, so a final ``psum`` of scalar
+   contributions finishes the job.
+
+Reference behavior matched at mesh scale: the single-sort curve math of
+``torcheval/metrics/functional/classification/auroc.py:50-67`` (and
+``precision_recall_curve.py:207-230``), which the single-device kernels
+already pin against sklearn.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+# splitter histogram bins: top 16 bits of the order key
+_HIST_BINS = 1 << 16
+# per-(source, destination) send capacity is ceil(F * n_local / K); under an
+# exchangeable row-to-shard assignment the expected load is n_local / K, so
+# F=4 absorbs heavy skew while keeping the exchange payload 4x the minimum.
+# Overflow is detected exactly and raised by the caller, never dropped.
+DIST_CAPACITY_FACTOR = 4
+
+_PAD_KEY = jnp.uint32(0xFFFFFFFF)
+
+
+def _desc_key(s: jax.Array) -> jax.Array:
+    """Monotone u32 order key: ascending keys == descending scores; equal
+    scores == equal keys; all NaNs (samples and the padding sentinel alike)
+    map to the max key so they sort last and bucket together."""
+    # canonicalize -0.0 -> +0.0 first: the zeros compare float-equal, so
+    # they must share ONE key — distinct keys would split the tie group (and
+    # possibly the bucket), silently changing the result vs the
+    # float-comparing fused path. where(), not `s + 0.0`: XLA's algebraic
+    # simplifier folds add(x, 0) away under jit, sign bit and all.
+    s = s.astype(jnp.float32)
+    s = jnp.where(s == 0, jnp.float32(0.0), s)
+    b = jax.lax.bitcast_convert_type(s, jnp.uint32)
+    asc = jnp.where(
+        jax.lax.shift_right_logical(b, jnp.uint32(31)) == jnp.uint32(1),
+        ~b,
+        b | jnp.uint32(0x80000000),
+    )
+    return jnp.where(jnp.isnan(s), _PAD_KEY, ~asc)
+
+
+def _splitter_buckets(key: jax.Array, axis: str, k_devices: int):
+    """Per-row destination bucket ids from global histogram splitters.
+
+    The histogram is over the key's top 16 bits; the psum makes it global.
+    Quantile targets are computed in f32 — splitters need only balance the
+    load, not be exact quantiles. Equal keys always get equal buckets (the
+    tie-locality invariant the merge step relies on)."""
+    t = jax.lax.shift_right_logical(key, jnp.uint32(16)).astype(jnp.int32)
+    hist = jax.ops.segment_sum(
+        jnp.ones_like(t, dtype=jnp.int32),
+        t,
+        num_segments=_HIST_BINS,
+        indices_are_sorted=False,
+    )
+    hist = jax.lax.psum(hist, axis)
+    cum = jnp.cumsum(hist).astype(jnp.float32)
+    total = cum[-1]
+    targets = total * (
+        jnp.arange(1, k_devices, dtype=jnp.float32) / float(k_devices)
+    )
+    # boundary bins: first bin whose cumulative count reaches each target
+    boundaries = jnp.searchsorted(cum, targets, side="left").astype(jnp.int32)
+    bucket = jnp.searchsorted(boundaries, t, side="right").astype(jnp.int32)
+    return bucket, t
+
+
+def _exchange(
+    cols: Tuple[jax.Array, ...],
+    key: jax.Array,
+    axis: str,
+    k_devices: int,
+    capacity: int,
+):
+    """Local sort → per-destination bucket slices (padded to ``capacity``)
+    → one tiled all_to_all per column. Returns the received columns (first
+    one is the key) and the exact count of rows lost to capacity overflow."""
+    skey, *scols = jax.lax.sort((key, *cols), num_keys=1)
+    bucket, _ = _splitter_buckets(skey, axis, k_devices)
+    cnt = jax.ops.segment_sum(
+        jnp.ones_like(bucket), bucket, num_segments=k_devices,
+        indices_are_sorted=True,
+    )
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(cnt)[:-1]]
+    )
+    sent = jnp.minimum(cnt, capacity)
+    overflow = jnp.sum(jnp.maximum(cnt - capacity, 0))
+    # pad so a window never clamps back into a neighbouring bucket
+    key_p = jnp.concatenate([skey, jnp.full((capacity,), _PAD_KEY)])
+    cols_p = [
+        jnp.concatenate([c, jnp.zeros((capacity,), c.dtype)]) for c in scols
+    ]
+    lane = jnp.arange(capacity, dtype=jnp.int32)
+
+    def _windows(arr, pad_value):
+        parts = []
+        for k in range(k_devices):  # k_devices is static (mesh size)
+            w = jax.lax.dynamic_slice(arr, (starts[k],), (capacity,))
+            parts.append(jnp.where(lane < sent[k], w, pad_value))
+        return jnp.concatenate(parts)
+
+    send = [_windows(key_p, _PAD_KEY)] + [
+        _windows(c, jnp.zeros((), c.dtype)) for c in cols_p
+    ]
+    recv = [
+        jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+        for x in send
+    ]
+    return recv, overflow
+
+
+def _merged_shard(recv_key, recv_tp, recv_fp, axis: str, k_devices: int):
+    """Sort the received rows (this shard's value range), compute local
+    cumulative counts and global offsets (exclusive prefix of per-shard
+    totals via a K-element all-reduce — no sample gather)."""
+    key, tp, fp = jax.lax.sort((recv_key, recv_tp, recv_fp), num_keys=1)
+    ctp = jnp.cumsum(tp, dtype=jnp.int32)
+    cfp = jnp.cumsum(fp, dtype=jnp.int32)
+    last = jnp.concatenate([key[1:] != key[:-1], jnp.ones((1,), bool)])
+    idx = jax.lax.axis_index(axis)
+    onehot = (jnp.arange(k_devices, dtype=jnp.int32) == idx).astype(jnp.int32)
+    all_tp = jax.lax.psum(onehot * ctp[-1], axis)  # (K,) per-shard totals
+    all_fp = jax.lax.psum(onehot * cfp[-1], axis)
+    prevmask = jnp.arange(k_devices, dtype=jnp.int32) < idx
+    tp_off = jnp.sum(jnp.where(prevmask, all_tp, 0))
+    fp_off = jnp.sum(jnp.where(prevmask, all_fp, 0))
+    total_tp = jnp.sum(all_tp)
+    total_fp = jnp.sum(all_fp)
+    return ctp, cfp, last, tp_off, fp_off, total_tp, total_fp
+
+
+def _concat_unit_counts(s_list, t_list):
+    """Raw sample cache entries → (key, tp, fp) local columns (unit
+    counts), concatenated INSIDE the shard so no resharding collective is
+    ever needed: every entry arrives as its own local block."""
+    s = jnp.concatenate(s_list)
+    t = jnp.concatenate(t_list).astype(jnp.int32)
+    return _desc_key(s), t, 1 - t
+
+
+def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
+    key, tp, fp = _concat_unit_counts(s_list, t_list)
+    recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
+    ctp, cfp, last, tp_off, fp_off, p_tot, n_tot = _merged_shard(
+        *recv, axis, k_devices
+    )
+    big = jnp.iinfo(jnp.int32).max
+    # group-end propagation: intra-group points coincide with the group end,
+    # giving zero-width trapezoid segments (ops/curves.py invariant)
+    tp_end = jax.lax.cummin(jnp.where(last, ctp, big), reverse=True)
+    fp_end = jax.lax.cummin(jnp.where(last, cfp, big), reverse=True)
+    tp_pts = jnp.concatenate(
+        [tp_off[None], tp_off + tp_end]
+    ).astype(jnp.float32)
+    fp_pts = jnp.concatenate(
+        [fp_off[None], fp_off + fp_end]
+    ).astype(jnp.float32)
+    auc = jax.lax.psum(jnp.trapezoid(tp_pts, fp_pts), axis)
+    factor = p_tot.astype(jnp.float32) * n_tot.astype(jnp.float32)
+    value = jnp.where(factor == 0, 0.5, auc / jnp.maximum(factor, 1.0))
+    return value, jax.lax.psum(overflow, axis)
+
+
+def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity):
+    key, tp, fp = _concat_unit_counts(s_list, t_list)
+    recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
+    ctp, cfp, last, tp_off, fp_off, p_tot, _ = _merged_shard(
+        *recv, axis, k_devices
+    )
+    # per-group TP delta: cumulative at this group end minus the previous
+    # group's end (shifted cummax of end-masked cumsum — ops/summary.py)
+    prev_tp = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jax.lax.cummax(jnp.where(last, ctp, 0))[:-1]]
+    )
+    delta_tp = jnp.where(last, ctp - prev_tp, 0).astype(jnp.float32)
+    ctp_g = (tp_off + ctp).astype(jnp.float32)
+    cfp_g = (fp_off + cfp).astype(jnp.float32)
+    prec = ctp_g / jnp.maximum(ctp_g + cfp_g, 1.0)
+    ap = jax.lax.psum(jnp.sum(delta_tp * prec), axis)
+    total = p_tot.astype(jnp.float32)
+    value = jnp.where(total == 0, 0.0, ap / jnp.maximum(total, 1.0))
+    return value, jax.lax.psum(overflow, axis)
+
+
+@functools.lru_cache(maxsize=None)
+def _program(mesh: Mesh, axis: str, which: str):
+    """Jitted shard_map program per (mesh, axis, metric); jit handles
+    shape-based caching beneath. Capacity is static per trace (derived from
+    the local row count)."""
+    k_devices = int(mesh.devices.size)
+    kern = _auroc_kernel if which == "auroc" else _auprc_kernel
+
+    def impl(s_list, t_list):
+        n_local = sum(int(s.shape[0]) for s in s_list) // k_devices
+        capacity = max(
+            1, -(-DIST_CAPACITY_FACTOR * n_local // k_devices)
+        )
+        f = functools.partial(
+            kern, axis=axis, k_devices=k_devices, capacity=int(capacity)
+        )
+        return shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(), P()),
+        )(s_list, t_list)
+
+    return jax.jit(impl)
+
+
+def sharded_binary_auroc(
+    s_list: List[jax.Array],
+    t_list: List[jax.Array],
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact AUROC over a mesh-sharded raw sample cache without gathering
+    the samples. Returns ``(value, overflow_rows)`` — a nonzero overflow
+    means the score distribution overloaded a bucket past the send capacity
+    and the value is untrustworthy; callers must raise."""
+    return _program(mesh, axis, "auroc")(list(s_list), list(t_list))
+
+
+def sharded_binary_auprc(
+    s_list: List[jax.Array],
+    t_list: List[jax.Array],
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact average precision over a mesh-sharded raw cache; see
+    :func:`sharded_binary_auroc` for the overflow contract."""
+    return _program(mesh, axis, "auprc")(list(s_list), list(t_list))
