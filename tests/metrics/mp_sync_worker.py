@@ -167,6 +167,18 @@ def main() -> None:
     results["windowed_ctr_lifetime"] = float(np.asarray(wr[0])[0])
     results["windowed_ctr_windowed"] = float(np.asarray(wr[1])[0])
 
+    # --- window-config drift: replicas disagreeing on window_size must fail
+    # loudly and UNIFORMLY at the typed exchange (the schema digest carries
+    # _sync_schema_extra; the typed fold never reaches merge_state's eager
+    # ValueError)
+    bad = WindowedClickThroughRate(window_size=6 if rank == 2 else 5)
+    bad.update(jnp.asarray([1.0]))
+    try:
+        sync_and_compute(bad, recipient_rank="all")
+        results["wctr_config_drift_error"] = False
+    except RuntimeError as e:
+        results["wctr_config_drift_error"] = "schema mismatch" in str(e)
+
     # --- sub-process-group sync (reference process_group semantics,
     # toolkit.py:24-78): ranks 1 and 3 sync within processes=[1, 3] while
     # ranks 0 and 2 are genuinely uninvolved — they never enter the
@@ -241,6 +253,21 @@ def main() -> None:
             {"acc": acc, "auroc": auroc, "tp": t}, recipient_rank="all"
         )  # whole array-lane collection: still one two-round exchange
         results["rounds_collection"] = counts["n"]
+        counts["n"] = 0
+        # windowed deque state rides the TYPED wire (round-5: stacked rows
+        # with per-update boundaries), not the pickled object lane — so a
+        # windowed CTR sync is the same two rounds as any typed metric
+        results["wctr_typed_value"] = float(
+            np.asarray(sync_and_compute(wctr, recipient_rank="all")[1])[0]
+        )
+        results["rounds_wctr"] = counts["n"]
+        counts["n"] = 0
+        # and a collection mixing windowed + dict metrics pays exactly
+        # 2 typed + 2 object rounds
+        sync_and_compute_collection(
+            {"wctr": wctr, "dict": d}, recipient_rank="all"
+        )
+        results["rounds_wctr_plus_dict"] = counts["n"]
     finally:
         _mhu.process_allgather = real_allgather
 

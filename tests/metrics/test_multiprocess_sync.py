@@ -188,6 +188,26 @@ class TestMultiprocessSync(unittest.TestCase):
             self.assertEqual(res["rounds_auroc"], 2)
             self.assertEqual(res["rounds_collection"], 2)
 
+    def test_window_config_drift_raises_uniformly(self):
+        # window_size drift across ranks: the schema digest (which folds in
+        # _sync_schema_extra) mismatches and EVERY rank raises — the typed
+        # fold never reaches merge_state's local validation
+        for res in self.results:
+            self.assertTrue(res["wctr_config_drift_error"])
+
+    def test_windowed_sync_rides_typed_wire(self):
+        # round-4 verdict ask 5: WINDOW deque states travel on the typed
+        # two-round wire (stacked per-update rows), not the pickled object
+        # lane — same window result, two collective rounds; the object lane
+        # stays reserved for dict-keyed states (2 typed + 2 object rounds
+        # for a mixed collection)
+        for res in self.results:
+            self.assertEqual(res["rounds_wctr"], 2)
+            self.assertEqual(res["rounds_wctr_plus_dict"], 4)
+            self.assertAlmostEqual(
+                res["wctr_typed_value"], 16.0 / 24.0, places=6
+            )
+
     def test_subgroup_sync(self):
         # processes=[1, 3]: members fold only each other's state; ranks 0/2
         # never enter the collective and get an eager non-member ValueError

@@ -137,6 +137,40 @@ class TestFoldStates(unittest.TestCase):
                 [{"x": jnp.zeros(())}], {"x": Reduction.CUSTOM}
             )
 
+    def test_window_fold_preserves_row_boundaries_in_rank_order(self):
+        # WINDOW values arrive as stacked (k, ...) arrays off the wire (or
+        # [] for an empty rank); the fold yields per-update rows in rank
+        # order, never concatenating them into one slot
+        ranks = [
+            {"w": jnp.asarray([[1.0, 1.0], [2.0, 2.0]])},  # 2 updates
+            {"w": []},  # empty rank
+            {"w": jnp.asarray([[3.0, 3.0]])},  # 1 update
+        ]
+        folded = _fold_states(ranks, {"w": Reduction.WINDOW})
+        self.assertEqual(len(folded["w"]), 3)
+        np.testing.assert_allclose(np.asarray(folded["w"][0]), [1.0, 1.0])
+        np.testing.assert_allclose(np.asarray(folded["w"][2]), [3.0, 3.0])
+
+    def test_windowed_metric_single_gather_round_trip(self):
+        # full path through _gather_collection_states' entry encoding on a
+        # 1-process world: stacked rows encode/decode bit-identically and
+        # the maxlen bound is re-imposed at install
+        from collections import deque
+
+        from torcheval_tpu.metrics import WindowedClickThroughRate
+        from torcheval_tpu.metrics.toolkit import _gather_collection_states
+
+        m = WindowedClickThroughRate(window_size=3)
+        for v in (1.0, 0.0, 1.0, 1.0):  # 4 updates into a window of 3
+            m.update(jnp.asarray([v]))
+        gathered = _gather_collection_states({"m": m})
+        rows = gathered[0]["m"]["window"]
+        self.assertEqual(np.asarray(rows).shape, (3, 2, 1))
+        win = deque(list(rows), maxlen=3)
+        np.testing.assert_allclose(
+            np.asarray(jnp.stack(list(win))), np.asarray(jnp.stack(list(m.window)))
+        )
+
     def test_cat_descriptor_rank_guard(self):
         # a rank-6 cache cannot fit the fixed wire layout; its descriptor
         # records the oversized ndim and the post-exchange check raises

@@ -35,15 +35,25 @@ class WindowedStateMixin:
                 f"but received {window_size}."
             )
         self.window_size = window_size
-        # CUSTOM, not CAT: cross-process sync must preserve per-update
-        # window-entry boundaries (the typed CAT lane concatenates a rank's
-        # whole cache into ONE array, which would merge every remote update
-        # into a single window slot). CUSTOM routes sync through the object
-        # lane, which folds with merge_state — the same bounded-window
-        # semantics as a local merge.
+        # WINDOW, not CAT: cross-process sync must preserve per-update
+        # window-entry boundaries (a CAT concat would merge every remote
+        # update into a single window slot). The WINDOW lane ships each
+        # rank's deque as ONE stacked (k, 2, num_tasks) array on the typed
+        # two-round wire and re-imposes the deque bound at install — the
+        # same bounded-window semantics as a local merge, without the
+        # pickled object-gather this state rode until round 5.
         self._add_state(
-            "window", deque(maxlen=window_size), reduction=Reduction.CUSTOM
+            "window", deque(maxlen=window_size), reduction=Reduction.WINDOW
         )
+
+    @property
+    def _sync_schema_extra(self) -> Tuple:
+        """Folded into the sync schema digest (``toolkit._schema_digest_row``)
+        so ranks whose replicas disagree on the window configuration fail
+        loudly and uniformly at the exchange — the eager ValueError
+        ``_merge_windowed`` raises locally, transplanted to the typed wire
+        (which folds without ever calling ``merge_state``)."""
+        return (self.num_tasks, self.window_size, self.enable_lifetime)
 
     def _push_window(self, a: jax.Array, b: jax.Array) -> None:
         self.window.append(jnp.stack([a, b]))

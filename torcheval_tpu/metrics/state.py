@@ -43,6 +43,11 @@ class Reduction(enum.Enum):
     MIN = "min"  # elementwise min            -> lax.pmin
     CAT = "cat"  # concatenate along axis 0   -> all_gather(..., tiled=True)
     NONE = "none"  # replicated / identical on all ranks (e.g. threshold grids)
+    # bounded deque of SAME-SHAPE per-update rows: ranks' entries extend in
+    # rank order, the deque maxlen keeps the newest. Rides the typed wire as
+    # ONE stacked array per rank (the leading axis preserves per-update
+    # boundaries a CAT concat would destroy)
+    WINDOW = "window"
     CUSTOM = "custom"  # only mergeable via the metric's merge_state()
 
 

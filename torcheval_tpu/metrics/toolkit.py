@@ -120,6 +120,17 @@ def _fold_states(
             out[name] = acc
         elif red is Reduction.NONE:
             out[name] = values[0]
+        elif red is Reduction.WINDOW:
+            # per-rank entries extend in rank order; each rank's value is a
+            # stacked (k, ...) array off the wire (or a deque/list of rows
+            # from the simulated-rank test path, or [] when empty) — either
+            # way iteration yields the per-update rows. The deque bound is
+            # re-imposed at install (get_synced_metric), where the state's
+            # declared maxlen is known.
+            rows: List[jax.Array] = []
+            for v in values:
+                rows.extend(list(v))
+            out[name] = rows
         else:  # Reduction.CUSTOM
             raise NotImplementedError(
                 f"State {name!r} declares Reduction.CUSTOM and cannot be "
@@ -367,7 +378,8 @@ def _allgather_object(
 def _needs_object_sync(metric: Metric) -> bool:
     """True when some state cannot travel on the typed lanes: dict-keyed
     state (arbitrary keys) or a CUSTOM reduction (only the metric's own
-    ``merge_state`` knows how to fold it)."""
+    ``merge_state`` knows how to fold it). WINDOW deques ride the typed
+    wire (stacked per-update rows), so they do NOT force the object lane."""
     for name, red in metric._state_name_to_reduction.items():
         if red is Reduction.CUSTOM or isinstance(getattr(metric, name), dict):
             return True
@@ -454,6 +466,11 @@ def get_synced_metric(
         default = metric._state_name_to_default[name]
         if red is Reduction.CAT and not isinstance(default, (list, deque)):
             value = value[0] if value else jnp.empty((0,))
+        if red is Reduction.WINDOW:
+            # re-impose the bounded-deque invariant: rank rows arrived in
+            # rank order, the declared maxlen keeps the newest — identical
+            # semantics to a local merge_state fold
+            value = deque(value, maxlen=getattr(default, "maxlen", None))
         synced._set_states({name: value})
     return synced
 
@@ -521,6 +538,15 @@ def _collection_entries(metrics: Dict[str, Metric]):
             if red is Reduction.CAT:
                 cat = _cat_cache_concat(value)
                 local = None if cat is None else np.asarray(cat)
+            elif red is Reduction.WINDOW:
+                # deque of same-shape per-update rows -> ONE stacked array;
+                # the leading axis is the boundary structure a CAT concat
+                # would destroy (empty window = the empty-entry descriptor).
+                # Stack on device, read back ONCE — a per-row np.asarray
+                # loop would pay one host transfer per window entry
+                local = (
+                    np.asarray(jnp.stack(list(value))) if len(value) else None
+                )
             else:
                 local = np.asarray(value)
             entries.append((mkey, name, red, local))
@@ -566,18 +592,25 @@ def _entry_shape(desc: np.ndarray) -> tuple:
 def _schema_digest_row(metrics: Dict[str, Metric]) -> list:
     """Header row for the descriptor exchange: entry count + 24 bytes of a
     SHA-256 digest over the ordered ``(metric key, metric class, state name,
-    reduction)`` schema. The byte payload in round 2 is decoded positionally,
-    so every rank MUST enumerate the same entries in the same order; this row
-    turns a violated assumption (previously a silent mis-decode whenever
-    shapes and dtypes happened to coincide) into a uniform post-exchange
-    error. The metric class is part of the schema so two *different* metric
-    types with coinciding state names/reductions still mismatch."""
+    reduction, config-extra)`` schema. The byte payload in round 2 is decoded
+    positionally, so every rank MUST enumerate the same entries in the same
+    order; this row turns a violated assumption (previously a silent
+    mis-decode whenever shapes and dtypes happened to coincide) into a
+    uniform post-exchange error. The metric class is part of the schema so
+    two *different* metric types with coinciding state names/reductions
+    still mismatch; metrics with fold-relevant configuration (e.g. windowed
+    metrics' ``window_size``) expose it via ``_sync_schema_extra`` so
+    config-drifted replicas mismatch too — the typed fold never calls
+    ``merge_state``, which is where the local eager validation lives."""
     import hashlib
 
     schema = []
     for mkey, metric in metrics.items():  # same order as _collection_entries
+        extra = tuple(getattr(metric, "_sync_schema_extra", ()))
         for name, red in metric._state_name_to_reduction.items():
-            schema.append((mkey, type(metric).__qualname__, name, red.name))
+            schema.append(
+                (mkey, type(metric).__qualname__, name, red.name) + extra
+            )
     digest = hashlib.sha256(repr(schema).encode()).digest()[:24]
     return [len(schema)] + np.frombuffer(digest, dtype="<i4").tolist()
 
@@ -614,10 +647,11 @@ def _gather_collection_states(
     if not (header == header[0]).all():
         raise RuntimeError(
             "Collection sync schema mismatch: ranks enumerated different "
-            "(metric key, state name, reduction) entry orders "
+            "(metric key, state name, reduction, config) entries "
             f"(digest rows: {header.tolist()}). Every process must build "
-            "the collection with the same metric keys, construction order "
-            "and metric types before calling sync."
+            "the collection with the same metric keys, construction order, "
+            "metric types and fold-relevant configuration (e.g. windowed "
+            "metrics' window_size/num_tasks) before calling sync."
         )
     all_desc = all_desc[:, 1:, :]
     # column layout matches the CAT wire descriptor
