@@ -399,6 +399,145 @@ class TestCompactionNanFlag(unittest.TestCase):
             synced.compute()
 
 
+class TestMulticlassCurveCompaction(unittest.TestCase):
+    """Bounded-state multiclass curves (round-4 verdict weak #6): per-class
+    exact summaries via the binary machinery vmapped over classes."""
+
+    def _data(self, n=4000, c=5):
+        rng = np.random.default_rng(21)
+        x = ((rng.random((n, c)) * 150).astype(np.int32) / 150.0).astype(
+            np.float32
+        )  # forced ties per class
+        t = rng.integers(0, c, n)
+        return x, t
+
+    def test_auroc_compaction_parity_vs_raw_and_sklearn(self):
+        import sklearn.metrics as sk
+
+        from torcheval_tpu.metrics import MulticlassAUROC
+
+        x, t = self._data()
+        raw = MulticlassAUROC(num_classes=5, average=None)
+        comp = MulticlassAUROC(
+            num_classes=5, average=None, compaction_threshold=600
+        )
+        for i in range(0, 4000, 400):
+            raw.update(x[i : i + 400], t[i : i + 400])
+            comp.update(x[i : i + 400], t[i : i + 400])
+        self.assertTrue(comp.summary_scores)  # compaction actually fired
+        np.testing.assert_allclose(
+            np.asarray(comp.compute()), np.asarray(raw.compute()), atol=1e-6
+        )
+        onehot = np.eye(5)[t]
+        want = sk.roc_auc_score(onehot, x, average=None)
+        np.testing.assert_allclose(np.asarray(comp.compute()), want, atol=1e-6)
+
+    def test_auprc_compaction_parity(self):
+        import sklearn.metrics as sk
+
+        from torcheval_tpu.metrics import MulticlassAUPRC
+
+        x, t = self._data()
+        comp = MulticlassAUPRC(num_classes=5, compaction_threshold=500)
+        for i in range(0, 4000, 250):
+            comp.update(x[i : i + 250], t[i : i + 250])
+        onehot = np.eye(5)[t]
+        want = sk.average_precision_score(onehot, x, average="macro")
+        self.assertAlmostEqual(float(comp.compute()), float(want), places=5)
+
+    def test_state_is_bounded(self):
+        # the memory bound: after compaction, summary rows <= padded unique
+        # count, NOT the sample count — feeding the same tied grid forever
+        # must not grow state
+        from torcheval_tpu.metrics import MulticlassAUROC
+
+        m = MulticlassAUROC(num_classes=3, compaction_threshold=256)
+        rng = np.random.default_rng(3)
+        sizes = []
+        for _ in range(6):
+            x = ((rng.random((512, 3)) * 60).astype(np.int32) / 60.0).astype(
+                np.float32
+            )
+            t = rng.integers(0, 3, 512)
+            m.update(x, t)
+            sizes.append(sum(int(a.shape[0]) for a in m.summary_scores))
+        self.assertEqual(len(m.inputs), 0)
+        # with ~61 distinct scores per class the padded cap stays at 64
+        self.assertLessEqual(max(sizes), 128)
+        self.assertEqual(sizes[-1], sizes[1])  # no growth after settling
+
+    def test_merge_mixed_and_nan_flag(self):
+        import sklearn.metrics as sk
+
+        from torcheval_tpu.metrics import MulticlassAUROC
+
+        x, t = self._data(2000)
+        a = MulticlassAUROC(num_classes=5, compaction_threshold=300)
+        a.update(x[:1000], t[:1000])
+        b = MulticlassAUROC(num_classes=5)
+        b.update(x[1000:], t[1000:])
+        a.merge_state([b])
+        onehot = np.eye(5)[t]
+        self.assertAlmostEqual(
+            float(a.compute()),
+            float(sk.roc_auc_score(onehot, x, average="macro")),
+            places=6,
+        )
+        # NaN-scored samples reaching a compaction raise at compute
+        bad = MulticlassAUROC(num_classes=5, compaction_threshold=4)
+        xb = x[:8].copy()
+        xb[1, 2] = np.nan
+        bad.update(xb, t[:8])
+        with self.assertRaisesRegex(ValueError, "NaN scores reached"):
+            bad.compute()
+
+    def test_state_dict_roundtrip_recounts(self):
+        from torcheval_tpu.metrics import MulticlassAUPRC
+
+        x, t = self._data(600)
+        src = MulticlassAUPRC(num_classes=5, compaction_threshold=250)
+        src.update(x, t)
+        fresh = MulticlassAUPRC(num_classes=5, compaction_threshold=250)
+        fresh.load_state_dict(src.state_dict())
+        np.testing.assert_allclose(
+            np.asarray(fresh.compute()), np.asarray(src.compute()), atol=1e-7
+        )
+
+    def test_invalid_threshold(self):
+        from torcheval_tpu.metrics import MulticlassAUROC
+
+        with self.assertRaisesRegex(ValueError, "compaction_threshold"):
+            MulticlassAUROC(num_classes=3, compaction_threshold=0)
+
+    def test_presorted_compute_path_taken(self):
+        # steady-state compacted compute must ride the sort-free vmapped
+        # presorted kernels, not re-sort the known-sorted summary
+        import torcheval_tpu.metrics.classification.auroc as auroc_mod
+        from torcheval_tpu.metrics import MulticlassAUROC
+
+        x, t = self._data(1200)
+        m = MulticlassAUROC(num_classes=5, compaction_threshold=400)
+        m.update(x, t)
+        self.assertTrue(m._summary_sorted)
+        calls = []
+        orig = auroc_mod._mc_auroc_from_parts
+
+        def _spy(*a, **k):
+            calls.append(1)
+            return orig(*a, **k)
+
+        auroc_mod._mc_auroc_from_parts = _spy
+        try:
+            v = float(m.compute())
+        finally:
+            auroc_mod._mc_auroc_from_parts = orig
+        self.assertEqual(calls, [])  # sorting program never ran
+        import sklearn.metrics as sk
+
+        want = sk.roc_auc_score(np.eye(5)[t], x, average="macro")
+        self.assertAlmostEqual(v, float(want), places=6)
+
+
 class TestMulticlassAUROCClasses(MetricClassTester):
     def test_multiclass_auroc_protocol(self):
         rng = np.random.default_rng(3)
@@ -412,7 +551,7 @@ class TestMulticlassAUROCClasses(MetricClassTester):
         want = sk.roc_auc_score(onehot, flat_s, average="macro")
         self.run_class_implementation_tests(
             MulticlassAUROC(num_classes=5),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "summary_scores", "summary_tp", "summary_fp", "summary_nan_dropped"},
             update_kwargs={"input": scores, "target": target},
             compute_result=np.asarray(want),
         )
@@ -428,7 +567,7 @@ class TestMulticlassAUROCClasses(MetricClassTester):
         want = sk.average_precision_score(onehot, flat_s, average="macro")
         self.run_class_implementation_tests(
             MulticlassAUPRC(num_classes=5),
-            state_names={"inputs", "targets"},
+            state_names={"inputs", "targets", "summary_scores", "summary_tp", "summary_fp", "summary_nan_dropped"},
             update_kwargs={"input": scores, "target": target},
             compute_result=np.asarray(want),
             atol=1e-4,

@@ -4,10 +4,11 @@ The reference's AUROC/PRC metrics cache every sample and sort once at compute
 (``torcheval/metrics/classification/auroc.py:55-71``) — at 1B predictions the
 cache alone is ~8 GB and the sort workspace more, beyond a single chip's HBM.
 But the *sufficient statistic* for every threshold-curve metric is far
-smaller: per unique score, the aggregated (tp_count, fp_count). float32
-scores have at most 2^24 distinct values in any unit range, so a summary of
-(score, tp, fp) rows is bounded at ~200 MB regardless of sample count — and
-it is **exact**, not a binned approximation: feeding summary rows to the
+smaller: per unique score, the aggregated (tp_count, fp_count). The summary
+of (score, tp, fp) rows is bounded by the stream's score CARDINALITY, not
+its sample count — model heads emit far fewer distinct values than samples
+(a bf16 pipeline at most 2^16; float32 worst case over [0, 1) is ~2^30) —
+and it is **exact**, not a binned approximation: feeding summary rows to the
 weighted curve kernels (``ops/curves.py``) reproduces the raw-sample result
 bit-for-bit because tied scores collapse into one cumsum step either way.
 
