@@ -53,6 +53,7 @@ m.num_total``) dies at the next fold — read state through ``state_dict()``
 
 from __future__ import annotations
 
+import weakref
 from functools import partial
 from typing import Any, Dict, List, Tuple
 
@@ -62,6 +63,37 @@ import jax.numpy as jnp
 
 def _is_tracer(x: Any) -> bool:
     return isinstance(x, jax.core.Tracer)
+
+
+# Live unmanaged deferred metrics (round-4 verdict ask 8): when one folds, it
+# scans here for peers whose pending chunks are the IDENTICAL placed arrays —
+# the signature of standalone metrics fed the same batches (`cm.update(x, y);
+# f1.update(x, y)` outside any collection) — and folds the whole group in one
+# program, so XLA dedupes the shared math exactly as the MetricCollection
+# lane does. WeakSet: registration must not keep metrics alive.
+_live_deferred: "weakref.WeakSet" = weakref.WeakSet()
+_defer_seq_counter = 0
+
+
+def _chunks_identical(a, b) -> bool:
+    """True when two pending lists hold the same chunk ARRAY OBJECTS in the
+    same order — identity, not value: it is free to check and exactly
+    captures "fed the same placed batches"."""
+    return len(a) == len(b) and all(
+        len(c) == len(h) and all(x is y for x, y in zip(c, h))
+        for c, h in zip(a, b)
+    )
+
+
+def _is_prefix(short, long) -> bool:
+    """``short`` is a (non-strict) identity-prefix of ``long``. Standalone
+    metrics fed the same stream are usually one chunk apart mid-loop (A got
+    batch N before B did), so exact equality would miss every
+    valve-triggered fold; prefix grouping folds the common part and leaves
+    the stragglers pending."""
+    return len(short) <= len(long) and _chunks_identical(
+        short, long[: len(short)]
+    )
 
 
 def _fold_deltas(chunks, fold_fn, fold_params, per_chunk):
@@ -145,12 +177,7 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
         return
     head = pending[0]._pending
     aligned = len(pending) == len(members) and all(
-        len(m._pending) == len(head)
-        and all(
-            len(c) == len(h) and all(a is b for a, b in zip(c, h))
-            for c, h in zip(m._pending, head)
-        )
-        for m in pending[1:]
+        _chunks_identical(m._pending, head) for m in pending[1:]
     )
     if not aligned:
         for m in pending:
@@ -232,8 +259,15 @@ class DeferredFoldMixin:
     _fold_per_chunk: bool = False
 
     def _init_deferred(self) -> None:
+        global _defer_seq_counter
         self._pending: List[Tuple[jax.Array, ...]] = []
         self._pending_bytes = 0
+        # registration order: the stable tie-break for group-member ordering
+        # (jit caches on the static specs tuple; WeakSet iteration order and
+        # id() are both unstable)
+        _defer_seq_counter += 1
+        self._defer_seq = _defer_seq_counter
+        _live_deferred.add(self)
 
     def _fold_kernel(self, *cat_args: jax.Array) -> Dict[str, jax.Array]:
         """Per-batch deltas; used directly on the tracer fallback path."""
@@ -269,15 +303,88 @@ class DeferredFoldMixin:
             self._pending_bytes >= scale * self._DEFER_BUDGET_BYTES
             or len(self._pending) >= scale * self._DEFER_MAX_CHUNKS
         ):
-            self._fold_now()
+            # group first: same-stream peers are typically one chunk behind
+            # right now, so the shared prefix frees (almost) everything in
+            # one dispatch; fold solo only if that left us over budget
+            self._group_fold_attempt()
+            if (
+                self._pending_bytes >= scale * self._DEFER_BUDGET_BYTES
+                or len(self._pending) >= scale * self._DEFER_MAX_CHUNKS
+            ):
+                self._fold_now()
 
     def _apply_deltas(self, deltas: Dict[str, jax.Array]) -> None:
         for name, delta in deltas.items():
             setattr(self, name, getattr(self, name) + delta)
 
-    def _fold_now(self) -> None:
-        """Fold all pending batches into the counter state: one dispatch."""
+    def _group_fold_attempt(self) -> None:
+        """Fold the longest common pending-chunk prefix shared with live
+        standalone peers in ONE program (see :data:`_live_deferred`);
+        no-op without peers. Chunks past the common prefix (a peer one
+        batch behind mid-stream) stay pending on their owners."""
         pending = getattr(self, "_pending", None)
+        if not pending or getattr(self, "_defer_managed", False):
+            return
+        peers = [
+            m
+            for m in _live_deferred
+            if m is not self
+            and not getattr(m, "_defer_managed", False)
+            and m.device == self.device
+            and getattr(m, "_pending", None)
+            and (
+                _is_prefix(m._pending, pending)
+                or _is_prefix(pending, m._pending)
+            )
+        ]
+        if not peers:
+            return
+        # stable member order: jit caches on the static specs tuple, so the
+        # same group must enumerate identically whichever member triggers
+        group = sorted(
+            [self, *peers],
+            key=lambda m: (type(m).__qualname__, m._defer_seq),
+        )
+        common = min(len(m._pending) for m in group)
+        chunks = self._pending[:common]
+        # transitivity guard: every member must agree on the common prefix
+        # (pairwise prefix vs self guarantees it, but stay explicit)
+        if not all(_is_prefix(chunks, m._pending) for m in group):
+            return
+        specs = tuple(
+            (str(i), type(m)._fold_fn, m._fold_params, type(m)._fold_per_chunk)
+            for i, m in enumerate(group)
+        )
+        states = {
+            str(i): {n: getattr(m, n) for n in m._state_name_to_default}
+            for i, m in enumerate(group)
+        }
+        from torcheval_tpu.utils.platform import donation_pipelines
+
+        dispatch = (
+            _group_fold_dispatch_donated
+            if donation_pipelines()
+            else _group_fold_dispatch
+        )
+        new_states = dispatch(states, chunks, specs=specs)
+        for i, m in enumerate(group):
+            m._pending = m._pending[common:]
+            m._pending_bytes = sum(
+                int(a.nbytes) for c in m._pending for a in c
+            )
+            for n, v in new_states[str(i)].items():
+                setattr(m, n, v)
+
+    def _fold_now(self) -> None:
+        """Fold all pending batches into the counter state: one dispatch —
+        shared with every standalone peer metric whose pending chunks are
+        an identity-prefix match (see :meth:`_group_fold_attempt`); any
+        remainder folds solo so the full-fold contract holds."""
+        pending = getattr(self, "_pending", None)
+        if not pending:
+            return
+        self._group_fold_attempt()
+        pending = self._pending
         if not pending:
             return
         from torcheval_tpu.utils.platform import donation_pipelines
@@ -325,8 +432,16 @@ class DeferredFoldMixin:
         state.pop("_defer_managed", None)
         return state
 
+    def __setstate__(self, state) -> None:
+        super().__setstate__(state)
+        # restored metrics must be visible to peers' group folds again
+        self._pending = []
+        self._pending_bytes = 0
+        _live_deferred.add(self)
+
     def __deepcopy__(self, memo):
         self._fold_now()
         new = super().__deepcopy__(memo)
         new.__dict__.pop("_defer_managed", None)
+        _live_deferred.add(new)  # clones group with future same-batch peers
         return new
