@@ -112,6 +112,7 @@ def _time_chain(fn, n=5, chains=2):
     for _ in range(chains):
         t0 = time.perf_counter()
         outs = [fn() for _ in range(n)]
+        t_enqueue = time.perf_counter() - t0  # host side of the chain
         jax.device_get(outs)  # one round trip; see _block
         elapsed = time.perf_counter() - t0
         rtts = []
@@ -125,6 +126,13 @@ def _time_chain(fn, n=5, chains=2):
         corrected = elapsed - rtts[1]
         if corrected <= 0:
             corrected = elapsed  # burst caught by the probe: stay conservative
+        # the serial host enqueue loop is a HARD lower bound on the chain's
+        # true cost: when the probe RTT exceeds the chain's own terminal
+        # round trip (RTT variance), the subtraction can leave a sliver far
+        # below anything physically possible — round 5 observed config1
+        # "11.6B preds/s" (0.14 ms/run against 7.8 ms of measured host work
+        # per run) from exactly this. Never report below the host loop.
+        corrected = max(corrected, t_enqueue)
         per_run.append(corrected / n)
     return min(per_run)
 
@@ -301,13 +309,28 @@ def config1_simple_accuracy():
     out = tpu()
     host_s = time.perf_counter() - t0
     _block(out)
-    for name, val in (
-        ("config1_python_host_ms_per_run", host_s * 1e3),
-        ("config1_device_plus_env_ms_per_run", max(plain_s - host_s, 0.0) * 1e3),
+    # floor-normalized reconciliation (round-4 verdict ask 2): this leg's
+    # device+env time is a handful of dispatches riding the environmental
+    # floor, so express it AS a dispatch count against a floor measured in
+    # the SAME window. The count is a property of the code (stable across
+    # rounds); the raw preds/s row swings with whatever the floor does —
+    # r3's 841M vs r4's 282M at 0.556 vs 0.909 ms floors is the same ~3-6
+    # dispatches either way.
+    floor_s = _measure_dispatch_floor()
+    dev_env_s = max(plain_s - host_s, 0.0)
+    for name, val, unit in (
+        ("config1_python_host_ms_per_run", host_s * 1e3, "ms"),
+        ("config1_device_plus_env_ms_per_run", dev_env_s * 1e3, "ms"),
+        ("config1_adjacent_dispatch_floor", floor_s * 1e3, "ms/dispatch"),
+        (
+            "config1_floor_normalized_dispatches",
+            dev_env_s / max(floor_s, 1e-9),
+            "dispatch-equivalents",
+        ),
     ):
         print(
             json.dumps(
-                {"metric": name, "value": round(val, 2), "unit": "ms",
+                {"metric": name, "value": round(val, 3), "unit": unit,
                  "vs_baseline": None}
             ),
             flush=True,
@@ -444,10 +467,15 @@ def config3_confusion_f1_imagenet():
     # consistent phantom 2x that interleaving (parity measured in-process)
     # eliminates. Best-of-2 per leg, alternating, same policy as
     # _time_chain's own chains.
+    # 3 alternations of short chains, not 2 of long ones: the environment
+    # toggles between fast/slow states on a ~10 s cadence, and with only 2
+    # samples per leg a full-bench run still produced a phantom 2x (one leg's
+    # both chains landing in the slow state). More interleaving samples,
+    # same total run count.
     plain_times, fused_times = [], []
-    for _ in range(2):
-        plain_times.append(_time_chain(tpu, chains=1))
-        fused_times.append(_time_chain(tpu_fused, chains=1))
+    for _ in range(3):
+        plain_times.append(_time_chain(tpu, n=3, chains=1))
+        fused_times.append(_time_chain(tpu_fused, n=3, chains=1))
     _emit(
         "config3_confusion_f1_c1000", n_batches * batch, min(plain_times), ref_s
     )
@@ -674,17 +702,11 @@ def config5_explicit_sync_4proc():
     )
 
 
-def env_dispatch_floor():
-    """Record the tunnel's per-dispatch execution cost at bench time.
-
-    Configs that stream many small updates (1 and 3) are bound by this
-    environmental floor, which swings 0.2-8 ms with co-tenant load on the
-    tunneled chip (a directly-attached TPU dispatches in tens of µs). One
-    chained trivial kernel per dispatch; the drain time divided by calls is
-    the floor. Three independent 33-dispatch chains, best one wins: a
-    single co-tenant stall inside this probe's one chain once recorded a
-    "floor" of 1100 ms — a burst reading, not the floor the word claims.
-    Emitted so each round's record is interpretable."""
+def _measure_dispatch_floor():
+    """The tunnel's per-dispatch execution cost, in seconds (see
+    :func:`env_dispatch_floor` for why and how). Shared by the end-of-bench
+    floor row and config 1's floor-normalized reconciliation row (measured
+    ADJACENT to the leg it normalizes — the floor drifts by the minute)."""
     jax = _jax()
     import jax.numpy as jnp
 
@@ -722,7 +744,21 @@ def env_dispatch_floor():
             # figure instead — same never-fabricate policy as _time.
             corrected = elapsed
         per_chain.append(corrected / 33)
-    per_call = min(per_chain)
+    return min(per_chain)
+
+
+def env_dispatch_floor():
+    """Record the tunnel's per-dispatch execution cost at bench time.
+
+    Configs that stream many small updates (1 and 3) are bound by this
+    environmental floor, which swings 0.2-8 ms with co-tenant load on the
+    tunneled chip (a directly-attached TPU dispatches in tens of µs). One
+    chained trivial kernel per dispatch; the drain time divided by calls is
+    the floor. Three independent 33-dispatch chains, best one wins: a
+    single co-tenant stall inside this probe's one chain once recorded a
+    "floor" of 1100 ms — a burst reading, not the floor the word claims.
+    Emitted so each round's record is interpretable."""
+    per_call = _measure_dispatch_floor()
     print(
         json.dumps(
             {

@@ -262,6 +262,16 @@ class TestIntegratedFastPath(_InterpretModeMixin, unittest.TestCase):
         m = BinaryAUPRC(compaction_threshold=700)
         for i in range(0, len(x), 350):
             m.update(x[i : i + 350], t[i : i + 350])
+        # raw leftovers (4000 % 700 != 0) keep the fused-sort path — a
+        # compute-time forced compaction measured SLOWER than the sort
+        self.assertTrue(m.inputs)
+        self.assertIsNone(m._presorted_summary())
+        self.assertAlmostEqual(
+            float(m.compute()), average_precision_score(t, x), places=5
+        )
+        # once the state IS a lone compacted summary, compute rides the
+        # sort-free kernel
+        m._prepare_for_merge_state()
         self.assertIsNotNone(m._presorted_summary())
         self.assertAlmostEqual(
             float(m.compute()), average_precision_score(t, x), places=5

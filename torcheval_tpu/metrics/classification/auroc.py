@@ -547,17 +547,23 @@ class _BinaryCurveMetric(_CompactingCacheLifecycle, SampleCacheMetric[jax.Array]
         return value
 
     def _presorted_summary(self):
-        """``(s, tp, fp)`` when state is a single summary buffer known to be
-        sorted-unique (folding raw leftovers first), else ``None``. Gated to
-        the same mode as the streaming compaction so CPU/sharded behavior
-        (one fused fold+sort program at compute) is unchanged."""
+        """``(s, tp, fp)`` when state is ALREADY a single summary buffer
+        known to be sorted-unique, else ``None``. Gated to the same mode as
+        the streaming compaction so CPU/sharded behavior (one fused
+        fold+sort program at compute) is unchanged.
+
+        Raw leftovers make this return ``None`` rather than force a
+        compaction: a compute-time compaction is the fused sort PLUS the
+        compress pass and state install, strictly more work than feeding
+        the leftovers straight to the sorting counts kernel — measured
+        60 vs 74M preds/s on the 100M bench leg (the round-4/5 "100M
+        regression": the forced fold, not the kernel). The sort-free path
+        pays off exactly when the stream ended on a compaction boundary."""
         if (
             self._compaction_threshold is None
             or self._stream_compaction_mode() is None
         ):
             return None
-        if self.inputs:
-            self._compact()
         if (
             not self._summary_sorted
             or self.inputs
@@ -695,14 +701,13 @@ class _MulticlassCurveMetric(
         self._install_compacted(s, tp, fp, n_unique, nan_acc)
 
     def _mc_presorted(self):
-        """``(K, C)`` summary columns when state is a single known-sorted
-        buffer (folding raw leftovers first), else ``None``. Pure XLA —
-        unlike the binary presorted path there is no Pallas gating, so it
-        serves every backend."""
+        """``(K, C)`` summary columns when state is ALREADY a single
+        known-sorted buffer, else ``None``. Pure XLA — unlike the binary
+        presorted path there is no Pallas gating, so it serves every
+        backend. Raw leftovers disable it rather than force a compute-time
+        compaction (see :meth:`_BinaryCurveMetric._presorted_summary`)."""
         if self._compaction_threshold is None:
             return None
-        if self.inputs:
-            self._compact()
         if (
             not self._summary_sorted
             or self.inputs
