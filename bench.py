@@ -92,49 +92,49 @@ def _time(fn, repeats=3):
 
 
 def _time_chain(fn, n=5, chains=2):
-    """Amortised timing for dispatch-light legs: queue ``n`` independent runs
-    (``fn`` returns device values WITHOUT reading back), then pay ONE
-    host-readback barrier and divide. The tunnel's ~0.1 s round trip — whose
-    run-to-run variance dwarfs a 10-40 ms signal — is paid once for n runs
-    instead of once per run, cutting its noise contribution by n. The final
-    ``device_get`` guarantees every queued run actually finished
-    (``block_until_ready`` alone is not trustworthy here; see ``_time``).
+    """Slope timing for dispatch-light legs: queue a SHORT and a LONG chain
+    of independent runs (``fn`` returns device values WITHOUT reading back;
+    each chain ends in ONE ``device_get`` barrier) back to back, and divide
+    the elapsed-time difference by the extra run count.
 
-    The whole chain runs ``chains`` times and the BEST per-run time wins: a
-    single co-tenant stall mid-chain poisons all ``n`` runs sharing that
-    barrier (observed: the config-3 plain row swinging 0.7-1.2x vs baseline
-    run-to-run), so within-chain medianing cannot help — only an
-    independent chain can."""
+    Both chains pay exactly one terminal tunnel round trip, so the ~0.1 s
+    RTT cancels in the difference with no probe at all. This replaced the
+    round-3/4 probe-subtraction design (time one chain, subtract a
+    separately-measured RTT), which breaks whenever one RTT — variance
+    tens of ms — exceeds the whole chain's signal: observed fabrications
+    in both directions ("11.6B preds/s" on config 1; a phantom 2x between
+    interleaved config-3 legs when one chain's correction clamped). Only
+    RTT *drift between adjacent chains* remains, absorbed by the <=0
+    discard, the host-enqueue lower bound, and best-of-``chains`` (a
+    single co-tenant stall poisons a whole pair; only an independent pair
+    can recover). The final ``device_get`` also guarantees every queued
+    run actually finished (``block_until_ready`` alone is not trustworthy
+    here; see ``_time``)."""
     import jax
-    import jax.numpy as jnp
 
+    short = 2
     per_run = []
+    fallbacks = []
     for _ in range(chains):
-        t0 = time.perf_counter()
-        outs = [fn() for _ in range(n)]
-        t_enqueue = time.perf_counter() - t0  # host side of the chain
-        jax.device_get(outs)  # one round trip; see _block
-        elapsed = time.perf_counter() - t0
-        rtts = []
-        for i in range(3):
-            fresh = jnp.float32(i) + 2.0
-            jax.block_until_ready(fresh)
+        elapsed = {}
+        t_host = {}
+        for k in (short, short + n):
             t0 = time.perf_counter()
-            jax.device_get(fresh)
-            rtts.append(time.perf_counter() - t0)
-        rtts.sort()
-        corrected = elapsed - rtts[1]
-        if corrected <= 0:
-            corrected = elapsed  # burst caught by the probe: stay conservative
-        # the serial host enqueue loop is a HARD lower bound on the chain's
-        # true cost: when the probe RTT exceeds the chain's own terminal
-        # round trip (RTT variance), the subtraction can leave a sliver far
-        # below anything physically possible — round 5 observed config1
-        # "11.6B preds/s" (0.14 ms/run against 7.8 ms of measured host work
-        # per run) from exactly this. Never report below the host loop.
-        corrected = max(corrected, t_enqueue)
-        per_run.append(corrected / n)
-    return min(per_run)
+            outs = [fn() for _ in range(k)]
+            t_host[k] = time.perf_counter() - t0
+            jax.device_get(outs)  # one round trip; see _block
+            elapsed[k] = time.perf_counter() - t0
+        slope = (elapsed[short + n] - elapsed[short]) / n
+        host_slope = max((t_host[short + n] - t_host[short]) / n, 0.0)
+        # discard drift-poisoned pairs on the RAW slope first — clamping to
+        # the (always-positive) host bound before the check would turn a
+        # poisoned pair into a fake "measurement" that min() then selects;
+        # the host enqueue loop is a lower bound on honest pairs only
+        if slope > 0:
+            per_run.append(max(slope, host_slope))
+        # conservative uncorrected figure in case every pair is poisoned
+        fallbacks.append(elapsed[short + n] / (short + n))
+    return min(per_run) if per_run else min(fallbacks)
 
 
 def _block(*values):
@@ -467,11 +467,13 @@ def config3_confusion_f1_imagenet():
     # consistent phantom 2x that interleaving (parity measured in-process)
     # eliminates. Best-of-2 per leg, alternating, same policy as
     # _time_chain's own chains.
-    # 3 alternations of short chains, not 2 of long ones: the environment
-    # toggles between fast/slow states on a ~10 s cadence, and with only 2
-    # samples per leg a full-bench run still produced a phantom 2x (one leg's
-    # both chains landing in the slow state). More interleaving samples,
-    # same total run count.
+    # 3 alternations of short slope-pairs, not 2 of long ones: the
+    # environment toggles between fast/slow states on a ~10 s cadence, and
+    # with only 2 samples per leg a full-bench run still produced a phantom
+    # 2x (one leg's both chains landing in the slow state). Each
+    # _time_chain(n=3, chains=1) call times a 2-run + 5-run pair (~0.3 s
+    # per leg including barriers), so a plain+fused alternation completes
+    # well inside one environment state.
     plain_times, fused_times = [], []
     for _ in range(3):
         plain_times.append(_time_chain(tpu, n=3, chains=1))
@@ -706,7 +708,13 @@ def _measure_dispatch_floor():
     """The tunnel's per-dispatch execution cost, in seconds (see
     :func:`env_dispatch_floor` for why and how). Shared by the end-of-bench
     floor row and config 1's floor-normalized reconciliation row (measured
-    ADJACENT to the leg it normalizes — the floor drifts by the minute)."""
+    ADJACENT to the leg it normalizes — the floor drifts by the minute).
+
+    Slope-timed like :func:`_time_chain`: a short and a long dispatch chain
+    back to back, divided difference — both chains pay exactly one terminal
+    readback RTT, so it cancels with no probe at all (the probe-subtraction
+    design fabricated floors near 0 whenever the probe RTT exceeded the
+    chain's own terminal RTT)."""
     jax = _jax()
     import jax.numpy as jnp
 
@@ -718,33 +726,28 @@ def _measure_dispatch_floor():
     s = step(s)
     jax.block_until_ready(s)
     per_chain = []
+    fallbacks = []
     for chain in range(3):
-        s = jnp.int32(chain)
-        jax.block_until_ready(s)  # seed transfer must not land in the window
-        t0 = time.perf_counter()
-        for _ in range(33):
-            s = step(s)
-        jax.device_get(s)
-        elapsed = time.perf_counter() - t0
-        # the terminal readback's flat tunnel RTT is not per-dispatch cost;
-        # measure (median of 3) and subtract it, same policy as _time
-        rtts = []
-        for i in range(3):
-            fresh = jnp.int32(123) + i
-            jax.block_until_ready(fresh)
+        elapsed = {}
+        t_enq = {}
+        for k in (5, 38):
+            s = jnp.int32(chain)
+            jax.block_until_ready(s)  # seed transfer outside the window
             t0 = time.perf_counter()
-            jax.device_get(fresh)
-            rtts.append(time.perf_counter() - t0)
-        rtts.sort()
-        corrected = elapsed - rtts[1]
-        if corrected <= 0:
-            # a burst hit the RTT probes, not the chain: the corrected value
-            # would fabricate a 0 ms floor (which min() below would then
-            # preferentially select). Keep the conservative uncorrected
-            # figure instead — same never-fabricate policy as _time.
-            corrected = elapsed
-        per_chain.append(corrected / 33)
-    return min(per_chain)
+            for _ in range(k):
+                s = step(s)
+            t_enq[k] = time.perf_counter() - t0
+            jax.device_get(s)
+            elapsed[k] = time.perf_counter() - t0
+        slope = (elapsed[38] - elapsed[5]) / 33
+        # same discipline as _time_chain: discard drift-poisoned pairs on
+        # the raw slope, and never report below the serial enqueue loop —
+        # min() below preferentially selects fabricated near-zero floors
+        host_slope = max((t_enq[38] - t_enq[5]) / 33, 0.0)
+        if slope > 0:
+            per_chain.append(max(slope, host_slope))
+        fallbacks.append(elapsed[38] / 38)
+    return min(per_chain) if per_chain else min(fallbacks)
 
 
 def env_dispatch_floor():
