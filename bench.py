@@ -91,6 +91,30 @@ def _time(fn, repeats=3):
     return med - rtt
 
 
+def _slope_pairs(run_chain, short, long, pairs):
+    """The shared slope-pair policy: time a short and a long chain back to
+    back (``run_chain(k) -> (t_host, elapsed)``), divide the difference by
+    the extra run count, DISCARD drift-poisoned pairs on the RAW slope
+    (clamping first would turn a poisoned pair into a fake measurement
+    that min() then selects), never report below the serial host-enqueue
+    slope, and fall back to the conservative uncorrected long-chain figure
+    only when every pair was poisoned."""
+    per = []
+    fallbacks = []
+    extra = long - short
+    for _ in range(pairs):
+        t_host = {}
+        elapsed = {}
+        for k in (short, long):
+            t_host[k], elapsed[k] = run_chain(k)
+        slope = (elapsed[long] - elapsed[short]) / extra
+        host_slope = max((t_host[long] - t_host[short]) / extra, 0.0)
+        if slope > 0:
+            per.append(max(slope, host_slope))
+        fallbacks.append(elapsed[long] / long)
+    return min(per) if per else min(fallbacks)
+
+
 def _time_chain(fn, n=5, chains=2):
     """Slope timing for dispatch-light legs: queue a SHORT and a LONG chain
     of independent runs (``fn`` returns device values WITHOUT reading back;
@@ -112,29 +136,14 @@ def _time_chain(fn, n=5, chains=2):
     here; see ``_time``)."""
     import jax
 
-    short = 2
-    per_run = []
-    fallbacks = []
-    for _ in range(chains):
-        elapsed = {}
-        t_host = {}
-        for k in (short, short + n):
-            t0 = time.perf_counter()
-            outs = [fn() for _ in range(k)]
-            t_host[k] = time.perf_counter() - t0
-            jax.device_get(outs)  # one round trip; see _block
-            elapsed[k] = time.perf_counter() - t0
-        slope = (elapsed[short + n] - elapsed[short]) / n
-        host_slope = max((t_host[short + n] - t_host[short]) / n, 0.0)
-        # discard drift-poisoned pairs on the RAW slope first — clamping to
-        # the (always-positive) host bound before the check would turn a
-        # poisoned pair into a fake "measurement" that min() then selects;
-        # the host enqueue loop is a lower bound on honest pairs only
-        if slope > 0:
-            per_run.append(max(slope, host_slope))
-        # conservative uncorrected figure in case every pair is poisoned
-        fallbacks.append(elapsed[short + n] / (short + n))
-    return min(per_run) if per_run else min(fallbacks)
+    def run_chain(k):
+        t0 = time.perf_counter()
+        outs = [fn() for _ in range(k)]
+        t_host = time.perf_counter() - t0
+        jax.device_get(outs)  # one round trip; see _block
+        return t_host, time.perf_counter() - t0
+
+    return _slope_pairs(run_chain, short=2, long=2 + n, pairs=chains)
 
 
 def _block(*values):
@@ -725,29 +734,17 @@ def _measure_dispatch_floor():
     s = jnp.int32(0)
     s = step(s)
     jax.block_until_ready(s)
-    per_chain = []
-    fallbacks = []
-    for chain in range(3):
-        elapsed = {}
-        t_enq = {}
-        for k in (5, 38):
-            s = jnp.int32(chain)
-            jax.block_until_ready(s)  # seed transfer outside the window
-            t0 = time.perf_counter()
-            for _ in range(k):
-                s = step(s)
-            t_enq[k] = time.perf_counter() - t0
-            jax.device_get(s)
-            elapsed[k] = time.perf_counter() - t0
-        slope = (elapsed[38] - elapsed[5]) / 33
-        # same discipline as _time_chain: discard drift-poisoned pairs on
-        # the raw slope, and never report below the serial enqueue loop —
-        # min() below preferentially selects fabricated near-zero floors
-        host_slope = max((t_enq[38] - t_enq[5]) / 33, 0.0)
-        if slope > 0:
-            per_chain.append(max(slope, host_slope))
-        fallbacks.append(elapsed[38] / 38)
-    return min(per_chain) if per_chain else min(fallbacks)
+    def run_chain(k):
+        v = jnp.int32(k)
+        jax.block_until_ready(v)  # seed transfer outside the window
+        t0 = time.perf_counter()
+        for _ in range(k):
+            v = step(v)
+        t_host = time.perf_counter() - t0
+        jax.device_get(v)
+        return t_host, time.perf_counter() - t0
+
+    return _slope_pairs(run_chain, short=5, long=38, pairs=3)
 
 
 def env_dispatch_floor():
@@ -755,11 +752,13 @@ def env_dispatch_floor():
 
     Configs that stream many small updates (1 and 3) are bound by this
     environmental floor, which swings 0.2-8 ms with co-tenant load on the
-    tunneled chip (a directly-attached TPU dispatches in tens of µs). One
-    chained trivial kernel per dispatch; the drain time divided by calls is
-    the floor. Three independent 33-dispatch chains, best one wins: a
-    single co-tenant stall inside this probe's one chain once recorded a
-    "floor" of 1100 ms — a burst reading, not the floor the word claims.
+    tunneled chip (a directly-attached TPU dispatches in tens of µs).
+    Slope-measured since round 5: a 5-dispatch and a 38-dispatch chain of
+    one trivial chained kernel, timed back to back — the divided elapsed
+    difference is the marginal per-dispatch cost with the terminal
+    readback RTT cancelled exactly (see :func:`_slope_pairs`). Best of 3
+    pairs: a single co-tenant stall poisons a whole pair (once recorded a
+    "floor" of 1100 ms — a burst reading, not the floor the word claims).
     Emitted so each round's record is interpretable."""
     per_call = _measure_dispatch_floor()
     print(
