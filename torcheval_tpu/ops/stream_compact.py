@@ -17,9 +17,9 @@ lane utilisation. Measured redesign, per 128-element tile:
 
 * the tile's payload columns are copied into an (8, 128) assembly block
   (plain lane-major row copies),
-* exclusive ranks of live lanes come from ``mask_row @ strict-upper-tri``
-  (a (1,128)x(128,128) MXU matmul — ``jnp.cumsum`` has no Mosaic lowering;
-  integer ranks <= 128 are exact even in bf16),
+* exclusive ranks of live lanes come from ``mask @ strict-upper-tri``
+  (one (4,128)x(128,128) MXU matmul serving FOUR tiles — ``jnp.cumsum``
+  has no Mosaic lowering; integer ranks <= 128 are exact even in bf16),
 * ONE lane-contraction matmul ``X(8,128) @ P^T(128,128)`` compacts every
   column at once, in lane-major layout, with
   ``P[r, i] = live[i] & (rank[i] == r)`` and ``Precision.HIGHEST`` —
@@ -71,6 +71,8 @@ _CHUNK_ROWS = _CHUNK // 128  # lane-major rows per flushed chunk
 # staging rows: chunk + 2 slack rows (one append can spill one row past the
 # chunk boundary, plus the row the boundary lands in)
 _STAGE_ROWS = _CHUNK_ROWS + 2
+# tiles served by one batched mask-load + rank matmul per loop iteration
+_RANK_BATCH = 4
 _MAX_COLS = 7  # assembly tile has 8 sublane rows; keep one spare
 
 
@@ -129,7 +131,20 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
         fill_ref[0, 0] = fill_ref[0, 0] - _CHUNK
 
     def body(t, _):
-        m_row = mask_ref[pl.ds(t, 1), :]  # (1, 128) f32 0/1
+        # batched across 4 tiles: one mask load + ONE rank matmul serve the
+        # next 4 tiles (25% off the pass: 397 -> 299 ms at 100M rows); the
+        # store/flush section stays strictly per tile so every staging
+        # invariant is unchanged
+        mb = mask_ref[pl.ds(_RANK_BATCH * t, _RANK_BATCH), :]  # (B, 128)
+        ranksb = jax.lax.dot_general(
+            mb, utri, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (B, 128): exclusive ranks of live lanes per tile
+        for k in range(_RANK_BATCH):
+            _tile(_RANK_BATCH * t + k, mb[k : k + 1, :], ranksb[k : k + 1, :])
+        return 0
+
+    def _tile(t, m_row, ranks):
         for c in range(n_cols):
             asm_ref[pl.ds(c, 1), :] = col_refs[c][pl.ds(t, 1), :]
         # Zero every DEAD lane before the payload crosses the MXU: the
@@ -141,12 +156,6 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
         # wrapper's contract; ``compact_summary_rows`` ships scores as raw
         # bit halves so even ±inf/NaN scores satisfy it.
         x = jnp.where(m_row > 0.5, asm_ref[:], 0.0)  # (8,128), lane i = row i
-        # exclusive ranks of live lanes: rank[i] = sum_{k<i} m[k]
-        # (integer values <= 128: exact in bf16, default precision is fine)
-        ranks = jax.lax.dot_general(
-            m_row, utri, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (1, 128)
         count = jnp.sum(m_row).astype(jnp.int32)
         ri = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
         # P[r, i] = live[i] & (rank[i] == r)
@@ -182,15 +191,14 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
         def _maybe_flush():
             _flush()
 
-        return 0
-
     # full unroll on the compiled path (Mosaic supports only 1 or
     # num_steps): the per-tile cost is the dependent fill-counter chain,
-    # but unrolling still shaves loop control — 410 -> 362 ms on a
-    # 100M-row pass, outputs bit-identical. Interpret mode keeps the
-    # rolled loop: unrolling there re-executes the traced body 64x per
-    # block and blows the CPU test suite from ~1 to ~11 minutes.
-    jax.lax.fori_loop(0, _BLOCK // 128, body, 0, unroll=unroll)
+    # but unrolling still shaves loop control (part of the 410 -> 299 ms
+    # measured on a 100M-row pass with the rank batching; outputs
+    # bit-identical). Interpret mode keeps the rolled loop — a full unroll
+    # there re-executes the traced 4-tile body 16x per block and was
+    # measured to blow the CPU test suite up ~10x.
+    jax.lax.fori_loop(0, _BLOCK // (128 * _RANK_BATCH), body, 0, unroll=unroll)
 
     @pl.when(j == nsteps - 1)
     def _finish():
@@ -242,7 +250,7 @@ def _compact_call(utri, mask2d, cols2d, n_cols: int, interpret: bool):
         functools.partial(
             _compact_kernel,
             n_cols=n_cols,
-            unroll=1 if interpret else _BLOCK // 128,
+            unroll=1 if interpret else _BLOCK // (128 * _RANK_BATCH),
         ),
         grid_spec=grid_spec,
         out_shape=[
