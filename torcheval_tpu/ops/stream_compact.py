@@ -72,7 +72,7 @@ _CHUNK_ROWS = _CHUNK // 128  # lane-major rows per flushed chunk
 # chunk boundary, plus the row the boundary lands in)
 _STAGE_ROWS = _CHUNK_ROWS + 2
 # tiles served by one batched mask-load + rank matmul per loop iteration
-_RANK_BATCH = 4
+_RANK_BATCH = 8
 _MAX_COLS = 7  # assembly tile has 8 sublane rows; keep one spare
 
 
