@@ -17,6 +17,11 @@ host's CPU (row ``config5_explicit_sync_accuracy_4proc``).
 
 A persistent XLA compile cache (.jax_cache/) keeps recompiles out of repeat
 runs; timed sections always run on pre-warmed shapes either way.
+
+``--obs`` turns on the in-library observability registry
+(``torcheval_tpu.obs``) and prints its JSON snapshot after the metric lines
+— span timings, jit trace counts, sync-round/byte counters — so a regressed
+round can be attributed from library instrumentation, not ad-hoc prints.
 """
 
 import json
@@ -28,6 +33,18 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _REPO)
 
 import numpy as np
+
+_OBS = "--obs" in sys.argv
+
+
+def _to_torch(arr):
+    """numpy/jax array -> torch tensor via a WRITABLE host copy.
+    ``np.asarray`` of a jax array is a read-only view, and
+    ``torch.from_numpy`` warns (and would alias UB on write) on non-writable
+    buffers — copy first, outside any timed region where it matters."""
+    import torch
+
+    return torch.from_numpy(np.array(arr, copy=True))
 
 
 def _jax():
@@ -300,7 +317,7 @@ def config1_simple_accuracy():
         import torch
         from torcheval.metrics import MulticlassAccuracy as RefAcc
 
-        ts, tl = torch.from_numpy(scores), torch.from_numpy(labels)
+        ts, tl = _to_torch(scores), _to_torch(labels)
         m = RefAcc()
         for _ in range(n_batches):
             m.update(ts, tl)
@@ -391,8 +408,8 @@ def config2_auroc_auprc():
             binary_precision_recall_curve as ref_prc,
         )
 
-        tx = torch.from_numpy(np.asarray(x))
-        tt = torch.from_numpy(np.asarray(t))
+        tx = _to_torch(x)
+        tt = _to_torch(t)
         # the reference snapshot has no binary_auprc metric; build average
         # precision from ITS OWN PRC kernel (precision_recall_curve.py:155-181)
         # + the standard step-sum, so the ratio compares real AP work on both
@@ -434,8 +451,8 @@ def config3_confusion_f1_imagenet():
         import torch
         from torcheval.metrics import MulticlassF1Score as RefF1
 
-        tp = torch.from_numpy(np.asarray(pred))
-        tl = torch.from_numpy(np.asarray(label))
+        tp = _to_torch(pred)
+        tl = _to_torch(label)
         # the reference snapshot has no confusion-matrix metric; stream the
         # same counting work in its own idiom (a per-batch torch scatter-add
         # state update — the reference's hot-kernel pattern,
@@ -521,7 +538,8 @@ def config4_topk_multilabel():
         import torch
         from torcheval.metrics import TopKMultilabelAccuracy as RefTopK
 
-        ts = torch.from_numpy(np.asarray(scores))
+        ts = _to_torch(scores)
+        # astype already yields a fresh writable buffer: no second copy
         tt = torch.from_numpy(np.asarray(target).astype(np.float32))
         m = RefTopK(k=5, criteria="contain")
         for _ in range(n_batches):
@@ -779,6 +797,10 @@ def main() -> None:
     # JSON line as the round's number — keep that contract. Legs after the
     # headline are isolated: one leg failing (e.g. a rendezvous flake in the
     # 4-process world) must not erase every later row from the round record.
+    if _OBS:
+        from torcheval_tpu import obs
+
+        obs.enable()
     headline_10m()
     for leg in (
         lambda: headline_scaled(100_000_000, "100M", thresh_mult=3),
@@ -795,6 +817,21 @@ def main() -> None:
             leg()
         except Exception as exc:
             print(f"# bench leg failed (continuing): {exc!r}", file=sys.stderr)
+    if _OBS:
+        from torcheval_tpu import obs
+
+        # one self-describing JSON line next to the metric rows: registry
+        # snapshot (spans / counters / gauges) + the recompile watchdog's
+        # per-entry trace counts for the whole bench run
+        print(
+            json.dumps(
+                {
+                    "obs_snapshot": obs.snapshot(),
+                    "obs_trace_counts": obs.trace_counts(),
+                }
+            ),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

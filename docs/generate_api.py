@@ -28,6 +28,7 @@ MODULES = [
     "torcheval_tpu.metrics.toolkit",
     "torcheval_tpu.metrics.collection",
     "torcheval_tpu.metrics.deferred",
+    "torcheval_tpu.obs",
     "torcheval_tpu.parallel",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
@@ -36,6 +37,12 @@ MODULES = [
 
 
 def _signature(obj) -> str:
+    import enum
+
+    if inspect.isclass(obj) and issubclass(obj, enum.Enum):
+        # Enum "signatures" are EnumType internals and differ per Python
+        # minor version; normalise so regeneration never churns these lines
+        return "(value)"
     try:
         return str(inspect.signature(obj))
     except (TypeError, ValueError):

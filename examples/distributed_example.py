@@ -30,12 +30,11 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":
-    import jax
-
     # a site plugin may pin jax_platforms programmatically, so the env var
     # alone is not enough — override through jax.config before backend init
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from torcheval_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(8)
 
 import numpy as np
 

@@ -11,10 +11,14 @@ so we override through ``jax.config`` after import, before first backend use.
 """
 
 import os
+import sys
 
 os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
 
-import jax  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from torcheval_tpu.utils.platform import force_cpu_devices  # noqa: E402
+
+# one shared spelling of the jax-version device-count fallback (config option
+# on newer jax, XLA flag on older) — same helper the examples and workers use
+force_cpu_devices(8)

@@ -52,8 +52,9 @@ def main() -> None:
     )
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", LOCAL_DEVICES)
+    from torcheval_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(LOCAL_DEVICES)
     from torcheval_tpu.parallel import init_from_env
 
     os.environ["MASTER_ADDR"] = "localhost"

@@ -271,6 +271,40 @@ def main() -> None:
     finally:
         _mhu.process_allgather = real_allgather
 
+    # --- obs collective accounting (ISSUE 1 acceptance): with obs enabled,
+    # a sync_and_compute in this 4-process world reports exactly 2 accounted
+    # collective rounds, nonzero payload bytes per POPULATED Reduction lane,
+    # and the true participating world size — the wire-cost contract above,
+    # re-read from the in-library registry instead of a monkeypatch
+    from torcheval_tpu import obs
+
+    obs.enable()
+    try:
+        obs.reset()
+        sync_and_compute(acc, recipient_rank="all")
+        snap = obs.snapshot()
+        results["obs_acc_rounds"] = snap["counters"]["toolkit.sync.rounds"]
+        results["obs_acc_sum_lane_bytes"] = snap["counters"][
+            "toolkit.sync.lane_bytes{lane=SUM}"
+        ]
+        results["obs_acc_payload_bytes"] = snap["counters"][
+            "toolkit.sync.payload_bytes"
+        ]
+        results["obs_world_size"] = snap["gauges"]["toolkit.sync.world_size"]
+
+        obs.reset()
+        sync_and_compute(auroc, recipient_rank="all")
+        snap = obs.snapshot()
+        results["obs_auroc_rounds"] = snap["counters"]["toolkit.sync.rounds"]
+        # the CAT lane records LOCAL bytes: nonzero exactly on the ranks
+        # whose cache holds samples (rank 2's shard is deliberately empty)
+        results["obs_auroc_cat_lane_bytes"] = snap["counters"][
+            "toolkit.sync.lane_bytes{lane=CAT}"
+        ]
+    finally:
+        obs.disable()
+        obs.reset()
+
     os.makedirs(outdir, exist_ok=True)
     with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
         json.dump(results, f)

@@ -188,6 +188,23 @@ class TestMultiprocessSync(unittest.TestCase):
             self.assertEqual(res["rounds_auroc"], 2)
             self.assertEqual(res["rounds_collection"], 2)
 
+    def test_obs_collective_accounting(self):
+        # ISSUE 1 acceptance: the same two-round invariant read from the obs
+        # registry on every rank of the real 4-process world, with nonzero
+        # payload bytes per populated Reduction lane and the true world size
+        for res in self.results:
+            self.assertEqual(res["obs_acc_rounds"], 2)
+            self.assertEqual(res["obs_auroc_rounds"], 2)
+            self.assertGreater(res["obs_acc_sum_lane_bytes"], 0)
+            self.assertGreater(res["obs_acc_payload_bytes"], 0)
+            self.assertEqual(res["obs_world_size"], 4)
+            # CAT lane bytes are local: nonzero exactly where the rank's
+            # cache holds samples (rank 2's shard is deliberately empty)
+            if AUROC_SIZES[res["rank"]]:
+                self.assertGreater(res["obs_auroc_cat_lane_bytes"], 0)
+            else:
+                self.assertEqual(res["obs_auroc_cat_lane_bytes"], 0)
+
     def test_window_config_drift_raises_uniformly(self):
         # window_size drift across ranks: the schema digest (which folds in
         # _sync_schema_extra) mismatches and EVERY rank raises — the typed

@@ -14,8 +14,18 @@ import unittest
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+
 sys.path.insert(0, "/root/reference")
-import torch  # noqa: E402
+torch = pytest.importorskip(
+    "torch", reason="reference parity needs torch"
+)
+# skip (not error) where the reference checkout is absent: these tests pin
+# parity against /root/reference and are meaningless without it
+pytest.importorskip(
+    "torcheval.metrics",
+    reason="reference torcheval checkout not present at /root/reference",
+)
 import torcheval.metrics as RM  # noqa: E402
 
 import torcheval_tpu.metrics as M  # noqa: E402

@@ -461,5 +461,88 @@ class TestCollectionWireSchema(unittest.TestCase):
         self.assertEqual(len(calls), 1)
 
 
+class TestObsCollectiveAccounting(unittest.TestCase):
+    """The obs registry's view of the sync wire (ISSUE 1): every collective
+    round funnels through ``_allgather_stacked``, so with obs enabled the
+    two-round invariant and per-lane payload bytes are observables. The
+    4-real-process variant of these assertions lives in
+    ``tests/metrics/test_multiprocess_sync.py``; here the single-process
+    world exercises the same counters through the same code path."""
+
+    def setUp(self):
+        from torcheval_tpu import obs
+
+        obs.enable()
+        obs.reset()
+
+    def tearDown(self):
+        from torcheval_tpu import obs
+
+        obs.disable()
+        obs.reset()
+
+    def test_collection_gather_is_two_accounted_rounds(self):
+        from torcheval_tpu import obs
+        from torcheval_tpu.metrics.toolkit import _gather_collection_states
+
+        acc = MulticlassAccuracy(num_classes=3)
+        acc.update(
+            jnp.asarray(RNG.random((8, 3)).astype(np.float32)),
+            jnp.asarray(RNG.integers(0, 3, 8)),
+        )
+        auroc = BinaryAUROC()
+        auroc.update(
+            jnp.asarray(RNG.random(16).astype(np.float32)),
+            jnp.asarray((RNG.random(16) > 0.5).astype(np.float32)),
+        )
+        _gather_collection_states({"acc": acc, "auroc": auroc})
+        snap = obs.snapshot()
+        # descriptor matrix + concatenated byte payload: exactly 2 rounds
+        # no matter how many states the collection has
+        self.assertEqual(snap["counters"]["toolkit.sync.rounds"], 2)
+        self.assertGreater(snap["counters"]["toolkit.sync.payload_bytes"], 0)
+        # per-Reduction-lane bytes: both populated lanes nonzero
+        self.assertGreater(
+            snap["counters"]["toolkit.sync.lane_bytes{lane=SUM}"], 0
+        )
+        self.assertGreater(
+            snap["counters"]["toolkit.sync.lane_bytes{lane=CAT}"], 0
+        )
+        self.assertEqual(snap["gauges"]["toolkit.sync.world_size"], 1)
+        self.assertEqual(snap["spans"]["toolkit.sync.round"]["count"], 2)
+
+    def test_world_size_one_sync_enters_no_collective(self):
+        from torcheval_tpu import obs
+
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        sync_and_compute(m)  # world 1: warned no-op
+        self.assertNotIn(
+            "toolkit.sync.rounds", obs.snapshot()["counters"]
+        )
+
+    def test_sync_api_span_recorded(self):
+        from torcheval_tpu import obs
+
+        m = Sum()
+        m.update(jnp.asarray([2.0]))
+        sync_and_compute(m)
+        spans = obs.snapshot()["spans"]
+        self.assertIn(
+            "toolkit.sync_and_compute/toolkit.get_synced_metric", spans
+        )
+
+    def test_disabled_snapshot_untouched_by_gather(self):
+        from torcheval_tpu import obs
+        from torcheval_tpu.metrics.toolkit import _gather_collection_states
+
+        obs.disable()
+        obs.reset()
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        _gather_collection_states({"m": m})
+        self.assertEqual(obs.snapshot()["counters"], {})
+
+
 if __name__ == "__main__":
     unittest.main()

@@ -1,0 +1,242 @@
+"""Recompile watchdog tests: trace counting keyed by abstract signature,
+cache hits not counted, the shape-polymorphic storm warning firing exactly
+once, and watched_jit's drop-in jit compatibility (static args, donation)."""
+
+import logging
+import unittest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import recompile
+
+
+def _capture_telemetry():
+    records = []
+    logger = logging.getLogger("torcheval_tpu.api_usage")
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger.addHandler(handler)
+    return logger, handler, records
+
+
+class TestWatchedJit(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs.reset()
+        recompile.reset()
+        self._threshold = recompile.retrace_threshold()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+        recompile.reset()
+        recompile.set_retrace_threshold(self._threshold)
+
+    def test_counts_traces_not_calls(self):
+        f = obs.watched_jit(lambda x: x * 2, name="double")
+        for _ in range(5):
+            f(jnp.ones(4))  # one signature -> one trace
+        f(jnp.ones(8))  # second signature
+        counts = obs.trace_counts()["double"]
+        self.assertEqual(counts["traces"], 2)
+        self.assertEqual(counts["distinct_signatures"], 2)
+
+    def test_dtype_change_is_a_new_signature(self):
+        f = obs.watched_jit(lambda x: x + 1, name="dtypes")
+        f(jnp.ones(4, jnp.float32))
+        f(jnp.ones(4, jnp.int32))
+        self.assertEqual(
+            obs.trace_counts()["dtypes"]["distinct_signatures"], 2
+        )
+
+    def test_static_argnames_pass_through(self):
+        f = obs.watched_jit(
+            lambda x, n: x * n, name="static", static_argnames=("n",)
+        )
+        self.assertEqual(float(f(jnp.ones(()), n=3)), 3.0)
+        self.assertEqual(float(f(jnp.ones(()), n=4)), 4.0)
+        # distinct static values are distinct signatures (jit cache parity)
+        self.assertEqual(
+            obs.trace_counts()["static"]["distinct_signatures"], 2
+        )
+
+    def test_donate_argnums_pass_through(self):
+        f = obs.watched_jit(
+            lambda s, a: {k: v + a for k, v in s.items()},
+            name="donate",
+            donate_argnums=0,
+        )
+        out = f({"x": jnp.ones(2)}, 1.0)
+        self.assertEqual(float(out["x"][0]), 2.0)
+
+    def test_result_parity_with_plain_jit(self):
+        def g(x, y):
+            return jnp.dot(x, y)
+
+        a = jnp.arange(6.0).reshape(2, 3)
+        b = jnp.arange(12.0).reshape(3, 4)
+        watched = obs.watched_jit(g, name="parity")
+        self.assertTrue(
+            bool(jnp.array_equal(watched(a, b), jax.jit(g)(a, b)))
+        )
+
+    def test_storm_warns_exactly_once(self):
+        recompile.set_retrace_threshold(4)
+        f = obs.watched_jit(lambda x: x + 1, name="poly_entry")
+        logger, handler, records = _capture_telemetry()
+        try:
+            # deliberately shape-polymorphic update loop: every call a new
+            # shape, running well past the threshold
+            for i in range(10):
+                f(jnp.ones(i + 1))
+        finally:
+            logger.removeHandler(handler)
+        storms = [
+            r for r in records if "Retrace storm" in r.getMessage()
+        ]
+        self.assertEqual(len(storms), 1)
+        self.assertEqual(storms[0].levelno, logging.WARNING)
+        self.assertIn("poly_entry", storms[0].getMessage())
+
+    def test_steady_loop_never_warns(self):
+        recompile.set_retrace_threshold(4)
+        f = obs.watched_jit(lambda x: x + 1, name="steady_entry")
+        logger, handler, records = _capture_telemetry()
+        try:
+            for _ in range(50):
+                f(jnp.ones(16))
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            [r for r in records if "Retrace storm" in r.getMessage()], []
+        )
+        self.assertEqual(obs.trace_counts()["steady_entry"]["traces"], 1)
+
+    def test_reset_rearms_warning(self):
+        recompile.set_retrace_threshold(3)
+        f = obs.watched_jit(lambda x: x * 1, name="rearm_entry")
+        logger, handler, records = _capture_telemetry()
+        try:
+            for i in range(4):
+                f(jnp.ones(i + 1))
+            recompile.reset()
+            for i in range(4):
+                f(jnp.ones(i + 10))
+        finally:
+            logger.removeHandler(handler)
+        storms = [
+            r for r in records if "Retrace storm" in r.getMessage()
+        ]
+        self.assertEqual(len(storms), 2)
+
+    def test_registry_mirrors_while_enabled(self):
+        obs.enable()
+        f = obs.watched_jit(lambda x: x + 1, name="mirrored")
+        f(jnp.ones(3))
+        f(jnp.ones(3))
+        snap = obs.snapshot()
+        self.assertEqual(snap["counters"]["jit.calls{entry=mirrored}"], 2)
+        self.assertEqual(
+            snap["counters"]["recompile.traces{entry=mirrored}"], 1
+        )
+        self.assertEqual(snap["spans"]["jit/mirrored"]["count"], 2)
+
+    def test_threshold_validation(self):
+        with self.assertRaises(ValueError):
+            recompile.set_retrace_threshold(1)
+
+    def test_label_shared_instances_do_not_pool_into_a_storm(self):
+        # several jit instances may share a label (every MetricCollection's
+        # fused step is "collection.step"); each tracing once with its own
+        # batch shape is program diversity, NOT a retrace storm
+        recompile.set_retrace_threshold(3)
+        logger, handler, records = _capture_telemetry()
+        try:
+            for i in range(8):
+                f = obs.watched_jit(lambda x: x + 1, name="shared_label")
+                f(jnp.ones(i + 1))  # one trace per fresh instance
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            [r for r in records if "Retrace storm" in r.getMessage()], []
+        )
+        # the per-label reporting still sees all of them
+        self.assertEqual(
+            obs.trace_counts()["shared_label"]["traces"], 8
+        )
+
+    def test_distinct_static_configs_do_not_pool_into_a_storm(self):
+        # one watched entry dispatching many static configurations (the
+        # deferred.fold case: one label, a distinct static fold_fn per
+        # metric class) — each tracing once is not a storm
+        recompile.set_retrace_threshold(3)
+        f = obs.watched_jit(
+            lambda x, n: x * n, name="static_diverse", static_argnames=("n",)
+        )
+        logger, handler, records = _capture_telemetry()
+        try:
+            for i in range(8):
+                f(jnp.ones(4), n=i + 1)  # new static => new program
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            [r for r in records if "Retrace storm" in r.getMessage()], []
+        )
+        # but a drifting SHAPE under one static config still trips
+        logger, handler, records = _capture_telemetry()
+        try:
+            for i in range(6):
+                f(jnp.ones(10 + i), n=1)
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            len([r for r in records if "Retrace storm" in r.getMessage()]), 1
+        )
+
+    def test_collection_construction_churn_never_warns(self):
+        # regression: constructing many MetricCollections (fresh fused-step
+        # jit each) and folding many deferred metric classes must not trip
+        # the watchdog during a fully normal run
+        recompile.set_retrace_threshold(4)
+        from torcheval_tpu.metrics import MeanSquaredError, MetricCollection
+
+        logger, handler, records = _capture_telemetry()
+        try:
+            for i in range(6):
+                col = MetricCollection({"mse": MeanSquaredError()})
+                col.update(jnp.ones(8 + i), jnp.ones(8 + i))
+                col.compute()
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            [r for r in records if "Retrace storm" in r.getMessage()], []
+        )
+
+    def test_weak_type_flip_is_a_new_signature(self):
+        # alternating python-scalar (weak) and committed f32 operands
+        # retraces jit's cache per flip; the watchdog must see it too
+        f = obs.watched_jit(lambda x: x + 1, name="weak_flip")
+        f(1.0)  # weak f32 scalar
+        f(jnp.float32(1.0))  # strong f32 scalar
+        self.assertEqual(
+            obs.trace_counts()["weak_flip"]["distinct_signatures"], 2
+        )
+
+    def test_abstract_signature_distinguishes_treedef(self):
+        sig_list = recompile.abstract_signature(([jnp.ones(2)],), {})
+        sig_tuple = recompile.abstract_signature(((jnp.ones(2),),), {})
+        self.assertNotEqual(sig_list, sig_tuple)
+
+    def test_library_entry_points_are_watched(self):
+        # the ops kernels registered through watched_jit surface in
+        # trace_counts under their own entry names after one use
+        from torcheval_tpu.ops.confusion import class_counts
+
+        class_counts(jnp.asarray([0, 1, 1]), 3)
+        self.assertIn("class_counts", obs.trace_counts())
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,225 @@
+"""Registry + export unit tests: counters/gauges/spans, nesting, thread
+safety, enable/disable gating, JSON snapshot and Prometheus exposition."""
+
+import json
+import threading
+import time
+import unittest
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs.export import prometheus_text, to_json
+from torcheval_tpu.obs.registry import Registry
+
+
+class TestRegistry(unittest.TestCase):
+    def setUp(self):
+        self.reg = Registry()
+
+    def test_counter_accumulates(self):
+        self.reg.counter("c")
+        self.reg.counter("c", 2.5)
+        self.assertEqual(self.reg.snapshot()["counters"]["c"], 3.5)
+
+    def test_counter_rejects_negative(self):
+        with self.assertRaises(ValueError):
+            self.reg.counter("c", -1)
+
+    def test_counter_labels_are_distinct_series(self):
+        self.reg.counter("bytes", 10, lane="SUM")
+        self.reg.counter("bytes", 5, lane="CAT")
+        self.reg.counter("bytes", 1, lane="SUM")
+        snap = self.reg.snapshot()["counters"]
+        self.assertEqual(snap["bytes{lane=SUM}"], 11)
+        self.assertEqual(snap["bytes{lane=CAT}"], 5)
+
+    def test_gauge_last_write_wins(self):
+        self.reg.gauge("world", 4)
+        self.reg.gauge("world", 8)
+        self.assertEqual(self.reg.snapshot()["gauges"]["world"], 8.0)
+
+    def test_span_records_count_total_max(self):
+        for _ in range(3):
+            with self.reg.span("s"):
+                time.sleep(0.002)
+        s = self.reg.snapshot()["spans"]["s"]
+        self.assertEqual(s["count"], 3)
+        self.assertGreaterEqual(s["total_seconds"], 0.006 * 0.5)
+        self.assertGreaterEqual(s["total_seconds"], s["max_seconds"])
+
+    def test_nested_spans_record_joined_paths(self):
+        with self.reg.span("outer"):
+            with self.reg.span("inner"):
+                pass
+            with self.reg.span("inner"):
+                pass
+        spans = self.reg.snapshot()["spans"]
+        self.assertEqual(spans["outer"]["count"], 1)
+        self.assertEqual(spans["outer/inner"]["count"], 2)
+        self.assertNotIn("inner", spans)
+        # nesting state fully unwound: a fresh span is top-level again
+        with self.reg.span("later"):
+            pass
+        self.assertIn("later", self.reg.snapshot()["spans"])
+
+    def test_span_exception_safe(self):
+        with self.assertRaises(RuntimeError):
+            with self.reg.span("boom"):
+                raise RuntimeError("x")
+        self.assertEqual(self.reg.snapshot()["spans"]["boom"]["count"], 1)
+        with self.reg.span("after"):
+            pass
+        self.assertIn("after", self.reg.snapshot()["spans"])
+
+    def test_thread_safety_and_thread_local_nesting(self):
+        def work(tid):
+            for _ in range(200):
+                self.reg.counter("n")
+                with self.reg.span(f"t{tid}"):
+                    with self.reg.span("leaf"):
+                        pass
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = self.reg.snapshot()
+        self.assertEqual(snap["counters"]["n"], 800)
+        # each thread's nesting stayed thread-local: leaves always joined
+        # under their own thread's parent, never a sibling's
+        for i in range(4):
+            self.assertEqual(snap["spans"][f"t{i}"]["count"], 200)
+            self.assertEqual(snap["spans"][f"t{i}/leaf"]["count"], 200)
+
+    def test_reset(self):
+        self.reg.counter("c")
+        self.reg.gauge("g", 1)
+        with self.reg.span("s"):
+            pass
+        self.reg.reset()
+        snap = self.reg.snapshot()
+        self.assertEqual(snap, {"counters": {}, "gauges": {}, "spans": {}})
+
+
+class TestModuleLevelGating(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs.reset()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+
+    def test_disabled_records_nothing(self):
+        obs.counter("c")
+        obs.gauge("g", 1)
+        with obs.span("s"):
+            pass
+        snap = obs.snapshot()
+        self.assertEqual(snap, {"counters": {}, "gauges": {}, "spans": {}})
+
+    def test_enabled_records(self):
+        obs.enable()
+        obs.counter("c", 2)
+        obs.gauge("g", 7)
+        with obs.span("s"):
+            pass
+        snap = obs.snapshot()
+        self.assertEqual(snap["counters"]["c"], 2)
+        self.assertEqual(snap["gauges"]["g"], 7)
+        self.assertEqual(snap["spans"]["s"]["count"], 1)
+
+    def test_disable_keeps_recorded_values(self):
+        obs.enable()
+        obs.counter("c")
+        obs.disable()
+        obs.counter("c")  # ignored
+        self.assertEqual(obs.snapshot()["counters"]["c"], 1)
+
+
+class TestExport(unittest.TestCase):
+    def setUp(self):
+        self.reg = Registry()
+        self.reg.counter("sync.rounds", 2)
+        self.reg.counter("lane_bytes", 128, lane="SUM")
+        self.reg.gauge("world_size", 4)
+        with self.reg.span("outer"):
+            with self.reg.span("inner"):
+                pass
+
+    def test_json_round_trips(self):
+        doc = json.loads(to_json(self.reg))
+        self.assertEqual(doc["counters"]["sync.rounds"], 2)
+        self.assertEqual(doc["counters"]["lane_bytes{lane=SUM}"], 128)
+        self.assertEqual(doc["gauges"]["world_size"], 4)
+        self.assertEqual(doc["spans"]["outer/inner"]["count"], 1)
+
+    def test_prometheus_text_shape(self):
+        text = prometheus_text(self.reg)
+        self.assertIn("# TYPE sync_rounds counter", text)
+        self.assertIn("sync_rounds 2", text)
+        self.assertIn('lane_bytes{lane="SUM"} 128', text)
+        self.assertIn("# TYPE world_size gauge", text)
+        self.assertIn("world_size 4", text)
+        # spans flatten to summary-style series with the path as a label
+        self.assertIn(
+            'torcheval_tpu_span_count{path="outer/inner"} 1', text
+        )
+        self.assertIn("torcheval_tpu_span_seconds_total", text)
+        self.assertTrue(text.endswith("\n"))
+        # every sample line's metric name is Prometheus-legal
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            self.assertRegex(name, r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+    def test_items_does_not_hold_lock_for_consumer(self):
+        # _items must materialise under the lock and release it before the
+        # consumer formats — an abandoned/slow consumer must not block
+        # instrumented threads
+        items = self.reg._items()
+        self.assertIsInstance(items, list)
+        # lock is free again: an instrumented call completes immediately
+        self.reg.counter("after_items")
+        self.assertEqual(
+            self.reg.snapshot()["counters"]["after_items"], 1
+        )
+
+    def test_span_families_are_contiguous_with_multiple_paths(self):
+        reg = Registry()
+        with reg.span("a"):
+            pass
+        with reg.span("b"):
+            pass
+        text = prometheus_text(reg)
+        current = None
+        seen = set()
+        for line in text.splitlines():
+            if line.startswith("# TYPE"):
+                current = line.split()[2]
+                self.assertNotIn(current, seen, "family split into groups")
+                seen.add(current)
+            else:
+                name = line.split("{")[0].split(" ")[0]
+                self.assertEqual(name, current)
+
+    def test_label_value_escaping(self):
+        reg = Registry()
+        reg.counter("c", 1, k='a"b\\c\nd')
+        text = prometheus_text(reg)
+        self.assertIn('k="a\\"b\\\\c\\nd"', text)
+
+    def test_empty_registry_exports_empty(self):
+        reg = Registry()
+        self.assertEqual(prometheus_text(reg), "")
+        self.assertEqual(
+            json.loads(to_json(reg)),
+            {"counters": {}, "gauges": {}, "spans": {}},
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

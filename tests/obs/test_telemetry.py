@@ -1,0 +1,147 @@
+"""``utils/telemetry.py`` coverage (ISSUE 1 satellite): sink registration /
+removal, once-per-key semantics, broken-sink isolation, and the ``log_once``
+helper the recompile watchdog warns through."""
+
+import logging
+import unittest
+
+from torcheval_tpu.utils import telemetry
+from torcheval_tpu.utils.telemetry import (
+    log_api_usage_once,
+    log_once,
+    reset_once_keys,
+    set_api_usage_sink,
+)
+
+PREFIX = "tests.obs.telemetry/"
+
+
+class TestTelemetry(unittest.TestCase):
+    def setUp(self):
+        reset_once_keys(PREFIX)
+        set_api_usage_sink(None)
+
+    def tearDown(self):
+        reset_once_keys(PREFIX)
+        set_api_usage_sink(None)
+
+    def test_once_per_key(self):
+        seen = []
+        set_api_usage_sink(seen.append)
+        key = PREFIX + "once"
+        log_api_usage_once(key)
+        log_api_usage_once(key)
+        log_api_usage_once(key)
+        self.assertEqual(seen, [key])
+
+    def test_distinct_keys_each_fire(self):
+        seen = []
+        set_api_usage_sink(seen.append)
+        log_api_usage_once(PREFIX + "a")
+        log_api_usage_once(PREFIX + "b")
+        self.assertEqual(seen, [PREFIX + "a", PREFIX + "b"])
+
+    def test_sink_removal(self):
+        seen = []
+        set_api_usage_sink(seen.append)
+        log_api_usage_once(PREFIX + "before")
+        set_api_usage_sink(None)
+        log_api_usage_once(PREFIX + "after")
+        self.assertEqual(seen, [PREFIX + "before"])
+
+    def test_sink_replacement(self):
+        first, second = [], []
+        set_api_usage_sink(first.append)
+        log_api_usage_once(PREFIX + "one")
+        set_api_usage_sink(second.append)
+        log_api_usage_once(PREFIX + "two")
+        self.assertEqual(first, [PREFIX + "one"])
+        self.assertEqual(second, [PREFIX + "two"])
+
+    def test_broken_sink_never_raises_and_key_stays_consumed(self):
+        def broken(key):
+            raise RuntimeError("sink down")
+
+        set_api_usage_sink(broken)
+        key = PREFIX + "broken"
+        log_api_usage_once(key)  # must not raise
+        # the key was consumed by the first (failed) delivery: a healthy
+        # sink installed afterwards does NOT get a replay
+        seen = []
+        set_api_usage_sink(seen.append)
+        log_api_usage_once(key)
+        self.assertEqual(seen, [])
+
+    def test_debug_record_emitted_once(self):
+        logger = logging.getLogger("torcheval_tpu.api_usage")
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        handler.setLevel(logging.DEBUG)
+        logger.addHandler(handler)
+        old_level = logger.level
+        logger.setLevel(logging.DEBUG)
+        try:
+            key = PREFIX + "debugrec"
+            log_api_usage_once(key)
+            log_api_usage_once(key)
+        finally:
+            logger.removeHandler(handler)
+            logger.setLevel(old_level)
+        usage = [r for r in records if key in r.getMessage()]
+        self.assertEqual(len(usage), 1)
+        self.assertEqual(usage[0].levelno, logging.DEBUG)
+
+    def test_log_once_fires_once_at_level(self):
+        logger = logging.getLogger("torcheval_tpu.api_usage")
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logger.addHandler(handler)
+        try:
+            for _ in range(3):
+                log_once(PREFIX + "warnkey", "storm on %s", "entry")
+        finally:
+            logger.removeHandler(handler)
+        hits = [r for r in records if "storm on entry" in r.getMessage()]
+        self.assertEqual(len(hits), 1)
+        self.assertEqual(hits[0].levelno, logging.WARNING)
+
+    def test_reset_once_keys_prefix_scoped(self):
+        seen = []
+        set_api_usage_sink(seen.append)
+        log_api_usage_once(PREFIX + "x")
+        log_api_usage_once("tests.obs.other/x")
+        reset_once_keys(PREFIX)
+        log_api_usage_once(PREFIX + "x")  # re-armed
+        log_api_usage_once("tests.obs.other/x")  # still consumed
+        self.assertEqual(
+            seen, [PREFIX + "x", "tests.obs.other/x", PREFIX + "x"]
+        )
+        # clean up the unprefixed key for test isolation
+        reset_once_keys("tests.obs.other/")
+
+    def test_threaded_once_per_key(self):
+        import threading
+
+        seen = []
+        set_api_usage_sink(seen.append)
+        key = PREFIX + "race"
+        threads = [
+            threading.Thread(target=log_api_usage_once, args=(key,))
+            for _ in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.assertEqual(seen, [key])
+
+    def test_first_time_helper(self):
+        key = PREFIX + "first"
+        self.assertTrue(telemetry._first_time(key))
+        self.assertFalse(telemetry._first_time(key))
+
+
+if __name__ == "__main__":
+    unittest.main()

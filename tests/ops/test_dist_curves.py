@@ -98,6 +98,25 @@ class TestDistCurveKernels(unittest.TestCase):
         _, ov = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
         self.assertGreater(int(ov), 0)
 
+    def test_nan_scores_trip_error_channel(self):
+        # NaN-scored REAL rows would take the padding's sort position in the
+        # bucket sort (diverging from the fused kernels' NaN-first order) —
+        # they must be counted into the error channel, never silently folded
+        n = 8 * 200
+        s, t = _tied_data(n)
+        s[3] = np.nan
+        s[n // 2] = np.nan
+        s_list, t_list = self._sharded_lists([(s, t)])
+        _, err = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertGreaterEqual(int(err), 2)
+        _, err = sharded_binary_auprc(s_list, t_list, mesh=self.mesh)
+        self.assertGreaterEqual(int(err), 2)
+
+    def test_nan_free_data_keeps_zero_error_channel(self):
+        s_list, t_list = self._sharded_lists([_tied_data(8 * 200)])
+        _, err = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(int(err), 0)
+
     def test_no_sample_all_gather_in_hlo(self):
         # the acceptance criterion (round-4 verdict ask 4): the compiled
         # program for a sharded curve compute contains NO all-gather at all —
@@ -166,6 +185,21 @@ class TestDistCurveMetricIntegration(unittest.TestCase):
         self.assertAlmostEqual(
             float(ev.compute()), roc_auc_score(t, s), places=6
         )
+
+    def test_nan_scores_fall_back_to_fused_path_and_match_unsharded(self):
+        # a NaN-scored sample in a sharded cache must compute the SAME value
+        # the unsharded cache computes (the fused kernels' NaN semantics),
+        # via the error-channel fallback — not a silently different curve
+        ev = ShardedEvaluator(BinaryAUROC(), mesh=self.mesh)
+        n = 8 * 150
+        s, t = _tied_data(n)
+        s[7] = np.nan
+        ev.update(jnp.asarray(s), jnp.asarray(t))
+        self.assertIsNotNone(ev.metrics["metric"]._sharded_raw_mesh())
+        sharded_value = float(ev.compute())
+        plain = BinaryAUROC()
+        plain.update(jnp.asarray(s), jnp.asarray(t))
+        self.assertAlmostEqual(sharded_value, float(plain.compute()), places=6)
 
     def test_multi_axis_mesh_falls_back_to_fused_path(self):
         # a 2-D mesh (or a tuple spec entry) must NOT enter the bucket-sort

@@ -36,6 +36,8 @@ import jax
 
 from torcheval_tpu.metrics.deferred import group_fold
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.obs.annotate import traced as _traced
+from torcheval_tpu.obs.recompile import watched_jit as _watched_jit
 
 _logger = logging.getLogger(__name__)
 
@@ -113,11 +115,14 @@ class MetricCollection:
 
         # donation keeps the accumulators updating in place in HBM; on a
         # tunneled backend it serialises dispatches instead (7x slower
-        # measured) — see utils/platform.py
+        # measured) — see utils/platform.py. watched_jit: the fused step is
+        # the canonical place a drifting batch signature turns into a
+        # retrace storm, and its HLO carries the collection's scope name.
         if donation_pipelines():
-            return jax.jit(step, donate_argnums=0)
-        return jax.jit(step)
+            return _watched_jit(step, name="collection.step", donate_argnums=0)
+        return _watched_jit(step, name="collection.step")
 
+    @_traced("collection.update")
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
         # convert + place each batch argument ONCE for the whole collection:
         # torch/numpy batches must land on the metrics' device before the jit
@@ -155,6 +160,7 @@ class MetricCollection:
                 group_fold(self._deferred)
         return self
 
+    @_traced("collection.compute")
     def compute(self) -> Any:
         if self._deferred:
             group_fold(self._deferred)

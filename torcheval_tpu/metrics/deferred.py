@@ -60,6 +60,8 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.obs.recompile import watched_jit as _watched_jit
+
 
 def _is_tracer(x: Any) -> bool:
     return isinstance(x, jax.core.Tracer)
@@ -133,11 +135,19 @@ def _fold_body(states, chunks, fold_fn, fold_params, per_chunk):
 # a fresh metric instance reuses the compiled fold instead of re-tracing a
 # wide concat program per instance (measured ~200 ms of host tracing for a
 # 200-chunk fold — more than the fold itself).
+# watched_jit: the deferred fold is the canonical retrace-storm site (the
+# trace cache keys on the pending pytree signature — wildly varying batch
+# shapes recompile the wide concat program per fold) and the watchdog's
+# per-signature counts make that visible; the scope name attributes the
+# fold's device time in XLA traces.
 _fold_dispatch = partial(
-    jax.jit, static_argnames=("fold_fn", "fold_params", "per_chunk")
+    _watched_jit,
+    name="deferred.fold",
+    static_argnames=("fold_fn", "fold_params", "per_chunk"),
 )(_fold_body)
 _fold_dispatch_donated = partial(
-    jax.jit,
+    _watched_jit,
+    name="deferred.fold",
     static_argnames=("fold_fn", "fold_params", "per_chunk"),
     donate_argnums=(0,),
 )(_fold_body)
@@ -160,11 +170,14 @@ def _group_fold_body(states_by_member, chunks, specs):
     return out
 
 
-_group_fold_dispatch = partial(jax.jit, static_argnames=("specs",))(
-    _group_fold_body
-)
+_group_fold_dispatch = partial(
+    _watched_jit, name="deferred.group_fold", static_argnames=("specs",)
+)(_group_fold_body)
 _group_fold_dispatch_donated = partial(
-    jax.jit, static_argnames=("specs",), donate_argnums=(0,)
+    _watched_jit,
+    name="deferred.group_fold",
+    static_argnames=("specs",),
+    donate_argnums=(0,),
 )(_group_fold_body)
 
 

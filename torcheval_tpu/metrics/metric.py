@@ -41,6 +41,7 @@ from torcheval_tpu.metrics.state import (
     copy_state,
     put_state,
 )
+from torcheval_tpu.obs.annotate import instrument_protocol
 from torcheval_tpu.utils.devices import DeviceLike, canonical_device
 from torcheval_tpu.utils.telemetry import log_api_usage_once
 
@@ -118,6 +119,15 @@ class Metric(Generic[TComputeReturn], ABC):
     ``compute`` and ``merge_state``. ``compute()`` must be idempotent and must
     not mutate state.
     """
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        # every concrete (and intermediate) metric class gets its protocol
+        # methods annotated for the profiler/registry — per-class span names
+        # like "metric.update/BinaryAUROC". Free while obs is disabled: the
+        # wrapper is one module-global read, and scope annotation of traced
+        # kernels costs only at trace time (obs/annotate.py).
+        super().__init_subclass__(**kwargs)
+        instrument_protocol(cls)
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         # once-per-class usage telemetry, mirroring the reference's

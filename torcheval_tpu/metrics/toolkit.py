@@ -42,6 +42,8 @@ import numpy as np
 
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, TState
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs.annotate import traced as _traced
 from torcheval_tpu.utils.devices import DeviceLike
 
 _logger = logging.getLogger(__name__)
@@ -298,7 +300,30 @@ def _allgather_stacked(
     path rides ``multihost_utils.process_allgather`` (one compiled XLA
     collective); a subgroup rides :func:`_subgroup_allgather`, which keeps
     the buffer host-side until its single ``device_put``. Returns shape
-    ``(n_members, *x.shape)`` in group order (ascending process index)."""
+    ``(n_members, *x.shape)`` in group order (ascending process index).
+
+    Every explicit cross-process collective round funnels through here, so
+    this is where sync-round accounting lives: with obs enabled, each call
+    increments ``toolkit.sync.rounds``, accumulates the local payload bytes
+    sent, and times the round (the gather blocks on the result, so the span
+    is real wall time, not dispatch time). The two-collective-round
+    invariant of :func:`sync_and_compute` is thereby an observable:
+    ``snapshot()["counters"]["toolkit.sync.rounds"]`` reads exactly 2 after
+    one typed sync."""
+    if not _obs.enabled():
+        return _allgather_stacked_impl(x, group)
+    world = len(group) if group is not None else _world_size()
+    with _obs.span("toolkit.sync.round"):
+        out = _allgather_stacked_impl(x, group)
+    _obs.counter("toolkit.sync.rounds")
+    _obs.counter("toolkit.sync.payload_bytes", float(x.nbytes))
+    _obs.gauge("toolkit.sync.world_size", world)
+    return out
+
+
+def _allgather_stacked_impl(
+    x: np.ndarray, group: Optional[Tuple[int, ...]]
+) -> np.ndarray:
     if group is None:
         from jax.experimental import multihost_utils
 
@@ -362,6 +387,7 @@ def _allgather_object(
 
     world = len(group) if group is not None else _world_size()
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    _obs.counter("toolkit.sync.object_lane_bytes", float(payload.size))
     lengths = _allgather_stacked(
         np.asarray([payload.size], dtype=np.int32), group
     ).reshape(world)
@@ -406,6 +432,7 @@ def _object_synced_metric(
     return replicas[0].merge_state(replicas[1:])
 
 
+@_traced("toolkit.get_synced_metric")
 def get_synced_metric(
     metric: TMetric,
     recipient_rank: _RecipientRank = 0,
@@ -487,6 +514,7 @@ def get_synced_state_dict(
     return synced.state_dict() if synced is not None else {}
 
 
+@_traced("toolkit.sync_and_compute")
 def sync_and_compute(
     metric: Metric,
     recipient_rank: _RecipientRank = 0,
@@ -631,6 +659,16 @@ def _gather_collection_states(
     the digest covers the dangerous same-shape case.)"""
     world = len(group) if group is not None else _world_size()
     entries = _collection_entries(metrics)
+    if _obs.enabled():
+        # per-Reduction-lane payload accounting: how many bytes each lane
+        # (SUM/MAX/MIN/CAT/WINDOW/NONE) contributes to the byte-payload
+        # round — the observable behind "which state is dominating my sync"
+        for _, _, red, local in entries:
+            _obs.counter(
+                "toolkit.sync.lane_bytes",
+                float(local.nbytes) if local is not None else 0.0,
+                lane=red.name,
+            )
     desc = np.asarray(
         [_schema_digest_row(metrics)]
         + [_encode_entry_descriptor(local) for _, _, _, local in entries],
@@ -702,6 +740,7 @@ def _gather_collection_states(
     return gathered
 
 
+@_traced("toolkit.sync_and_compute_collection")
 def sync_and_compute_collection(
     metrics: Dict[str, Metric],
     recipient_rank: _RecipientRank = 0,

@@ -1,0 +1,69 @@
+"""``torcheval_tpu.obs``: observability for the whole eval stack.
+
+One subsystem, four pieces (ISSUE 1 tentpole):
+
+* **Registry** (``registry.py``) — thread-safe process-wide counters,
+  gauges and nested span timers; JSON snapshot + Prometheus exposition
+  (``export.py``).
+* **Profiler annotation** (``annotate.py``) — ``Metric.update/compute/
+  merge_state``, ``MetricCollection``, ``ShardedEvaluator`` and every ops
+  kernel entry point carry ``jax.named_scope`` names into XLA traces, plus
+  host spans/``TraceAnnotation`` while enabled. Disabled path is one global
+  read per call — no jit-traced branching anywhere.
+* **Recompile watchdog** (``recompile.py``) — per-entry-point abstract
+  signature → trace counts through :func:`~torcheval_tpu.obs.recompile.
+  watched_jit`; warns once per entry point on retrace storms. Always on
+  (bookkeeping runs only at trace time).
+* **Collective accounting** — ``metrics/toolkit.py`` and
+  ``ops/dist_curves.py`` report sync rounds, payload bytes per
+  ``Reduction`` lane, wall time and world size into the registry, so the
+  two-collective-round invariant is an observable, not only a test
+  assertion.
+
+Usage::
+
+    from torcheval_tpu import obs
+    obs.enable()
+    ... run the eval loop ...
+    print(obs.to_json(indent=2))        # or obs.prometheus_text()
+    obs.snapshot()["counters"]["toolkit.sync.rounds"]
+"""
+
+from torcheval_tpu.obs.export import prometheus_text, to_json
+from torcheval_tpu.obs.recompile import (
+    retrace_threshold,
+    set_retrace_threshold,
+    trace_counts,
+    watched_jit,
+)
+from torcheval_tpu.obs.registry import (
+    Registry,
+    counter,
+    default_registry,
+    disable,
+    enable,
+    enabled,
+    gauge,
+    reset,
+    snapshot,
+    span,
+)
+
+__all__ = [
+    "Registry",
+    "counter",
+    "default_registry",
+    "disable",
+    "enable",
+    "enabled",
+    "gauge",
+    "prometheus_text",
+    "reset",
+    "retrace_threshold",
+    "set_retrace_threshold",
+    "snapshot",
+    "span",
+    "to_json",
+    "trace_counts",
+    "watched_jit",
+]

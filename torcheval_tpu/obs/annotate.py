@@ -1,0 +1,121 @@
+"""Profiler annotation: attribute device and host time per metric / kernel.
+
+Two annotation mechanisms compose, each applied where it is free:
+
+* ``jax.named_scope(name)`` — prefixes the HLO op names of everything traced
+  under it, so the **XLA profiler** attributes device time per metric and
+  per kernel. Scope entry costs nothing at run time: it executes only while
+  *tracing*, and traces are cached. Kernel entry points therefore bake their
+  scope in unconditionally (``obs/recompile.py::watched_jit`` wraps the
+  traced body), and jit-traced code never branches on the obs flag.
+* ``jax.profiler.TraceAnnotation(name)`` + a registry span — host-side, per
+  call. These DO cost per call, so they are gated on the module enable flag
+  (one global read; the disabled path is ``if not _enabled: return fn(...)``
+  and allocates nothing).
+
+Inside someone else's trace (e.g. ``MetricCollection``'s fused step calling
+member ``update``s with tracer state), host timing would measure *trace*
+time once and never again — misleading — and ``TraceAnnotation`` would
+annotate the tracing host thread, not execution. So instrumented methods
+detect an active trace (``jax.core.trace_state_clean``) and fall back to
+``named_scope`` alone, which is exactly the annotation that matters there.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+from torcheval_tpu.obs import registry as _registry
+
+
+def _trace_state_clean() -> bool:
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:  # private-ish API; absent => assume eager
+        return True
+
+
+def annotated_call(name: str, fn: Callable, args, kwargs):
+    """Run ``fn(*args, **kwargs)`` under full annotation (enabled path)."""
+    if not _trace_state_clean():
+        # inside an outer trace: pure scope annotation only (trace-safe,
+        # baked into the outer program's HLO names)
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+    with jax.profiler.TraceAnnotation(name):
+        with jax.named_scope(name):
+            with _registry.default_registry.span(name):
+                return fn(*args, **kwargs)
+
+
+def traced(name: str) -> Callable[[Callable], Callable]:
+    """Decorator: annotate a host-side entry point (method or function).
+
+    Disabled path: one module-global read, then straight through."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _registry._enabled:
+                return fn(*args, **kwargs)
+            return annotated_call(name, fn, args, kwargs)
+
+        wrapper.__obs_wrapped__ = fn
+        return wrapper
+
+    return deco
+
+
+# methods of the Metric protocol that get per-class span/scope annotation
+_PROTOCOL_METHODS = ("update", "compute", "merge_state")
+
+
+def _protocol_wrapper(method: str, fn: Callable) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if not _registry._enabled:
+            return fn(self, *args, **kwargs)
+        # name by the RUNTIME class: intermediate bases (e.g.
+        # _BinaryCurveMetric) define the method, but attribution belongs to
+        # the concrete metric the user constructed
+        name = f"metric.{method}/{type(self).__name__}"
+        return annotated_call(name, fn, (self,) + args, kwargs)
+
+    wrapper.__obs_wrapped__ = fn
+    return wrapper
+
+
+def instrument_protocol(cls) -> None:
+    """Wrap ``update`` / ``compute`` / ``merge_state`` defined BY ``cls``
+    (not inherited — each definition is wrapped exactly once, where it
+    lives) with per-metric annotation named by the runtime class, e.g.
+    ``metric.update/BinaryAUROC``.
+
+    Called from ``Metric.__init_subclass__`` so every concrete metric —
+    including user-defined subclasses — is annotated with zero per-call
+    cost while obs is disabled."""
+    for method in _PROTOCOL_METHODS:
+        fn = cls.__dict__.get(method)
+        if fn is None or getattr(fn, "__obs_wrapped__", None) is not None:
+            continue
+        if isinstance(fn, (staticmethod, classmethod)):
+            continue  # not the protocol shape; leave exotic overrides alone
+        wrapped = _protocol_wrapper(method, fn)
+        if getattr(fn, "__isabstractmethod__", False):
+            wrapped.__isabstractmethod__ = True
+        if wrapped.__doc__ is None:
+            # inspect.getdoc's MRO docstring inheritance keys on the class
+            # attribute being the original function object; a wrapper breaks
+            # that, so materialise the inherited protocol doc explicitly —
+            # on the original too, for tooling that inspect.unwrap()s first
+            for base in cls.__mro__[1:]:
+                base_fn = base.__dict__.get(method)
+                doc = getattr(base_fn, "__doc__", None)
+                if doc:
+                    wrapped.__doc__ = doc
+                    fn.__doc__ = doc
+                    break
+        setattr(cls, method, wrapped)

@@ -1,0 +1,94 @@
+"""Registry export: JSON snapshot and Prometheus text exposition.
+
+Two consumers, two formats:
+
+* **JSON** (:func:`to_json`) — one self-describing document per scrape, for
+  the bench driver (``bench.py --obs``), log pipelines, and tests.
+* **Prometheus text exposition** (:func:`prometheus_text`) — the de-facto
+  fleet format (version 0.0.4): ``# TYPE`` headers, labelled sample lines,
+  spans flattened to ``_count`` / ``_seconds_total`` / ``_seconds_max``
+  (the summary-metric naming convention). Metric and label names are
+  sanitised to the Prometheus charset (``[a-zA-Z_:][a-zA-Z0-9_:]*``) —
+  span paths like ``collection.update/metric.update.BinaryAUROC`` become
+  valid names with the path preserved in a ``path`` label instead.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from torcheval_tpu.obs.registry import Registry, default_registry
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(name: str) -> str:
+    out = _NAME_BAD.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _label_pairs(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_LABEL_BAD.sub("_", k)}="{_escape(v)}"' for k, v in labels
+    )
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def to_json(registry: Optional[Registry] = None, *, indent=None) -> str:
+    """The registry snapshot as a JSON document string."""
+    reg = registry if registry is not None else default_registry
+    return json.dumps(reg.snapshot(), indent=indent, sort_keys=True)
+
+
+def prometheus_text(registry: Optional[Registry] = None) -> str:
+    """Prometheus text-format exposition of the registry.
+
+    Counters get ``# TYPE <name> counter``; gauges ``gauge``; each span path
+    expands into three lines carrying the path as a ``path`` label::
+
+        torcheval_tpu_span_count{path="collection.update"} 12
+        torcheval_tpu_span_seconds_total{path="collection.update"} 0.0031
+        torcheval_tpu_span_seconds_max{path="collection.update"} 0.0009
+    """
+    reg = registry if registry is not None else default_registry
+    # the text format requires every sample of one metric family to form one
+    # contiguous group under its # TYPE header — buffer per family first
+    # (span samples for different paths share the three span family names)
+    families: dict = {}  # name -> (kind, [sample lines])
+
+    def emit(kind: str, name: str, labels, value: float) -> None:
+        fam = families.setdefault(name, (kind, []))
+        fam[1].append(f"{name}{_label_pairs(labels)} {value:g}")
+
+    for kind, name, labels, value in reg._items():
+        if kind == "counter":
+            emit("counter", _metric_name(name), labels, value)
+        elif kind == "gauge":
+            emit("gauge", _metric_name(name), labels, value)
+        else:  # span: (count, total_seconds, max_seconds)
+            count, total, mx = value
+            path_labels = (("path", name),) + tuple(labels)
+            emit("counter", "torcheval_tpu_span_count", path_labels, count)
+            emit(
+                "counter",
+                "torcheval_tpu_span_seconds_total",
+                path_labels,
+                total,
+            )
+            emit("gauge", "torcheval_tpu_span_seconds_max", path_labels, mx)
+    lines = []
+    for name, (kind, samples) in families.items():
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+    return "\n".join(lines) + ("\n" if lines else "")

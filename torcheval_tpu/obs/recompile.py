@@ -1,0 +1,225 @@
+"""Recompile watchdog: per-entry-point abstract-signature → trace counts.
+
+Retrace storms are the silent killer of dispatch-floor wins: a jitted entry
+point fed a drifting shape signature (unpadded final batches, python-scalar
+arguments that vary per step, accidental weak-type flips) recompiles every
+few calls, and the loop silently runs at compile speed instead of dispatch
+speed. Nothing in JAX warns by default.
+
+The watchdog hooks the one place a retrace cannot hide: the *traced python
+function* of a ``jax.jit`` entry point only executes when the jit cache
+misses. :func:`watched_jit` wraps the function with a probe that records the
+call's **abstract signature** in two halves — the static half (pytree
+structure + non-array leaf values: distinct statics are distinct *programs*)
+and the dynamic half (``(shape, dtype, weak_type)`` per array leaf). A storm
+is :func:`retrace_threshold` distinct DYNAMIC signatures for one jit
+instance under ONE static configuration — anything looser would misreport
+legitimate program diversity (several collections sharing a label, several
+metric classes' folds behind one entry) as a storm. On a storm the watchdog
+warns ONCE per entry point through the telemetry logger
+(``utils/telemetry.py::log_once``), naming the entry point and the most
+recent signature so the drifting argument is identifiable.
+
+Cost model: bookkeeping runs only at trace time (already paying an XLA
+compile, milliseconds at minimum), so the watchdog is always on — there is
+no per-dispatch overhead to gate. The jit-cache *hit* path is byte-identical
+to a plain ``jax.jit`` call. While obs is enabled, trace counts are mirrored
+into the registry (``recompile.traces{entry=...}``) so snapshots carry them.
+
+The probe also enters ``jax.named_scope(name)`` around the traced body, so
+every op the kernel lowers carries the entry point's name in XLA profiler
+traces — device-time attribution per kernel for free (scope entry happens at
+trace time only; see ``obs/annotate.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from torcheval_tpu.obs import registry as _registry
+from torcheval_tpu.obs.annotate import annotated_call
+from torcheval_tpu.utils.telemetry import log_once, reset_once_keys
+
+_WARN_KEY_PREFIX = "torcheval_tpu.obs.recompile/"
+
+_lock = threading.Lock()
+# entry-point name -> {abstract signature -> trace count}
+_traces: Dict[str, Dict[Any, int]] = {}
+_threshold = 8
+
+
+def retrace_threshold() -> int:
+    """Distinct abstract signatures per entry point before the watchdog
+    warns (default 8 — a steady eval loop sees 1-3: warmup shapes plus the
+    final partial batch)."""
+    return _threshold
+
+
+def set_retrace_threshold(n: int) -> None:
+    if n < 2:
+        raise ValueError(f"retrace threshold must be >= 2, got {n}.")
+    global _threshold
+    _threshold = n
+
+
+def split_signature(args: tuple, kwargs: dict) -> Tuple[Any, Any]:
+    """``(static_key, dynamic_sig)`` — the two halves of a jit cache key.
+
+    ``static_key`` is the pytree structure plus every non-array leaf's value
+    (how static arguments key the jit cache: distinct statics are distinct
+    *programs*, not retraces of one program). ``dynamic_sig`` is
+    ``(shape, dtype, weak_type)`` per array(-ish) leaf. weak_type matters:
+    a python-scalar-fed leaf (weak f32) and a committed f32 array retrace
+    separately in jax's cache, and that flip is one of the storm patterns
+    this watchdog exists to name."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    dynamic = []
+    static = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            weak = getattr(leaf, "weak_type", None)
+            if weak is None:
+                weak = getattr(
+                    getattr(leaf, "aval", None), "weak_type", False
+                )
+            dynamic.append((tuple(leaf.shape), str(leaf.dtype), bool(weak)))
+        else:
+            try:
+                hash(leaf)
+                static.append(leaf)
+            except TypeError:
+                static.append(type(leaf).__name__)
+    return (str(treedef), tuple(static)), tuple(dynamic)
+
+
+def abstract_signature(args: tuple, kwargs: dict) -> Tuple[Any, ...]:
+    """Full hashable jit-cache-shaped key for a call (static + dynamic
+    halves of :func:`split_signature` together)."""
+    static_key, dynamic = split_signature(args, kwargs)
+    return (static_key, dynamic)
+
+
+def record_trace(
+    name: str,
+    args: tuple,
+    kwargs: dict,
+    groups: Optional[Dict[Any, set]] = None,
+) -> None:
+    """Record one (re)trace of entry point ``name``. Called from trace-time
+    probes only.
+
+    ``groups`` is the calling ``watched_jit`` instance's own per-static-key
+    store, and is what the storm warning fires on: a storm is many distinct
+    DYNAMIC signatures for the SAME program — one jit instance, one static
+    configuration. Counting any looser than that misreports legitimate
+    program diversity as a storm (several collections sharing the
+    \"collection.step\" label, or several metric classes' folds sharing
+    \"deferred.fold\" with distinct static fold_fns, each trace exactly
+    once). The module-wide ``_traces`` table keeps the full per-label view
+    for :func:`trace_counts`/export."""
+    static_key, dynamic = split_signature(args, kwargs)
+    with _lock:
+        per_entry = _traces.setdefault(name, {})
+        full = (static_key, dynamic)
+        per_entry[full] = per_entry.get(full, 0) + 1
+        total = sum(per_entry.values())
+        if groups is None:
+            distinct = 0
+        else:
+            seen = groups.setdefault(static_key, set())
+            seen.add(dynamic)
+            distinct = len(seen)
+    _registry.counter("recompile.traces", entry=name)
+    if distinct >= _threshold:
+        log_once(
+            _WARN_KEY_PREFIX + name,
+            "Retrace storm on jitted entry point %r: %d traces, %d distinct "
+            "abstract signatures for one static configuration (threshold "
+            "%d). A drifting shape/dtype/weak-type argument is recompiling "
+            "this entry point per call — pad batches to a fixed shape or "
+            "hoist the varying argument. Most recent signature: %r",
+            name,
+            total,
+            distinct,
+            _threshold,
+            (static_key, dynamic),
+        )
+
+
+def trace_counts() -> Dict[str, Dict[str, int]]:
+    """``{entry point: {"traces": total, "distinct_signatures": n}}`` —
+    snapshot of the watchdog's bookkeeping (always on, obs flag or not)."""
+    with _lock:
+        return {
+            name: {
+                "traces": sum(d.values()),
+                "distinct_signatures": len(d),
+            }
+            for name, d in _traces.items()
+        }
+
+
+def reset(*, rearm_warnings: bool = True) -> None:
+    """Clear trace bookkeeping (and by default re-arm the once-per-entry
+    warnings) — fresh-run semantics for tests and long-lived processes."""
+    with _lock:
+        _traces.clear()
+    if rearm_warnings:
+        reset_once_keys(_WARN_KEY_PREFIX)
+
+
+def watched_jit(
+    fun: Optional[Callable] = None,
+    *,
+    name: Optional[str] = None,
+    **jit_kwargs,
+) -> Callable:
+    """Drop-in ``jax.jit`` replacement for library entry points.
+
+    Adds, on top of ``jax.jit(fun, **jit_kwargs)``:
+
+    * retrace counting + the watchdog warning (trace-time only);
+    * ``jax.named_scope`` around the traced body — XLA profiler attribution
+      per entry point with zero run-time cost;
+    * while obs is enabled: a ``TraceAnnotation`` + registry span around
+      each dispatch and a ``jit.calls{entry=...}`` counter. Disabled path:
+      one module-global read on top of the plain jitted call.
+
+    Usable as ``@watched_jit``, ``@watched_jit(name=...)``, or
+    ``functools.partial``-style with jit kwargs
+    (``watched_jit(f, static_argnames=("n",))``).
+    """
+    if fun is None:
+        return lambda f: watched_jit(f, name=name, **jit_kwargs)
+    label = name or getattr(fun, "__qualname__", None) or repr(fun)
+    # THIS instance's static-key -> {dynamic signatures} store: the storm
+    # warning counts retraces of one program (one jit instance, one static
+    # configuration), never across instances that share a label
+    groups: Dict[Any, set] = {}
+
+    @functools.wraps(fun)
+    def probe(*args, **kwargs):
+        record_trace(label, args, kwargs, groups)
+        with jax.named_scope(label):
+            return fun(*args, **kwargs)
+
+    jitted = jax.jit(probe, **jit_kwargs)
+
+    @functools.wraps(fun)
+    def call(*args, **kwargs):
+        if not _registry._enabled:
+            return jitted(*args, **kwargs)
+        _registry.default_registry.counter("jit.calls", entry=label)
+        return annotated_call(f"jit/{label}", jitted, args, kwargs)
+
+    # expose the underlying jit object (and its lower/eval_shape, which
+    # HLO-inspecting tests and tooling call directly on jit entry points)
+    call.jitted = jitted
+    call.lower = jitted.lower
+    call.eval_shape = jitted.eval_shape
+    call.__obs_entry__ = label
+    return call

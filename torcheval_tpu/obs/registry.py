@@ -1,0 +1,329 @@
+"""Host-side observability registry: named counters, gauges and span timers.
+
+The eval stack's in-library instrumentation lives here (ISSUE 1 tentpole):
+every layer — metric state machine, collection, evaluator, ops kernels,
+sync toolkit — reports into ONE process-wide :class:`Registry` so a run can
+answer "where did the time / bytes / dispatches go" from library data alone,
+instead of ad-hoc prints in ``bench.py``.
+
+Design constraints, in order:
+
+* **Zero overhead while disabled.** Instrumented call sites gate on
+  :func:`enabled` — a single module-global read — and do nothing else. No
+  objects are allocated, no locks taken, no strings formatted. The flag is
+  host-only and never read inside jit-traced code (annotation of traced code
+  is resolved at trace time, ``obs/annotate.py``).
+* **Thread-safe.** Metrics stream from data-loader threads and the async
+  warn helper (``utils/tracing.py``) runs on daemon threads; one registry
+  lock serialises structural mutation, and span nesting state is
+  thread-local.
+* **Host-side only.** Counters hold Python numbers. Device-time attribution
+  is the profiler's job (``jax.named_scope`` baked into kernel HLO +
+  ``jax.profiler.TraceAnnotation`` around dispatches); the registry tracks
+  host wall time, call counts and byte volumes — the quantities XLA traces
+  cannot see.
+
+Instruments:
+
+* **Counter** — monotone accumulator (``inc``); e.g. sync rounds, payload
+  bytes, kernel calls.
+* **Gauge** — last-written value (``set``); e.g. participating world size.
+* **Span timer** — aggregated wall-time statistics per span *path*. Spans
+  nest: a span opened while another is active on the same thread records
+  under ``"outer/inner"``, so time attributes hierarchically
+  (``collection.update/metric.update/BinaryAUROC``).
+
+All three key on ``(name, labels)`` where labels are an optional small dict
+(e.g. ``lane="SUM"``) — the Prometheus label model, which ``obs/export.py``
+serialises directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+# Module-level enable flag. Read directly (`if not _enabled: return`) by the
+# instrumentation helpers; mutate only through enable()/disable() so future
+# hooks (e.g. starting a profiler server) have one choke point.
+_enabled: bool = False
+
+
+def enabled() -> bool:
+    """True when observability collection is on (one global read)."""
+    return _enabled
+
+
+def enable() -> None:
+    """Turn on registry collection and span/profiler annotation."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    """Turn off collection. Already-recorded values are kept (snapshot them
+    first if needed); instrumented call sites revert to the no-op path."""
+    global _enabled
+    _enabled = False
+
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotone accumulator. ``inc`` must never be fed negative deltas."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, delta: float = 1.0) -> None:
+        if delta < 0:
+            raise ValueError(f"counter increments must be >= 0, got {delta}.")
+        self.value += delta
+
+
+class Gauge:
+    """Last-written value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class SpanStats:
+    """Aggregated wall-time statistics for one span path."""
+
+    __slots__ = ("count", "total_seconds", "max_seconds")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_seconds = 0.0
+        self.max_seconds = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total_seconds += seconds
+        if seconds > self.max_seconds:
+            self.max_seconds = seconds
+
+
+class _Span:
+    """Context manager for one span instance; see :meth:`Registry.span`."""
+
+    __slots__ = ("_registry", "_name", "_labels", "_path", "_t0")
+
+    def __init__(self, registry: "Registry", name: str, labels: _LabelKey):
+        self._registry = registry
+        self._name = name
+        self._labels = labels
+        self._path = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        stack = self._registry._span_stack()
+        self._path = (
+            f"{stack[-1]}/{self._name}" if stack else self._name
+        )
+        stack.append(self._path)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        seconds = time.perf_counter() - self._t0
+        stack = self._registry._span_stack()
+        # pop OUR frame even if an inner span leaked (exception safety)
+        while stack and stack[-1] != self._path:
+            stack.pop()
+        if stack:
+            stack.pop()
+        self._registry._record_span(self._path, self._labels, seconds)
+
+
+class Registry:
+    """Thread-safe collection of counters, gauges and span timers."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, _LabelKey], Counter] = {}
+        self._gauges: Dict[Tuple[str, _LabelKey], Gauge] = {}
+        self._spans: Dict[Tuple[str, _LabelKey], SpanStats] = {}
+        self._local = threading.local()
+
+    # ------------------------------------------------------------ instruments
+    def counter(self, name: str, delta: float = 1.0, **labels: Any) -> None:
+        """Increment counter ``name`` (created on first use) by ``delta``."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter()
+            c.inc(delta)
+
+    def gauge(self, name: str, value: float, **labels: Any) -> None:
+        """Set gauge ``name`` (created on first use) to ``value``."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self._gauges[key] = Gauge()
+            g.set(value)
+
+    def span(self, name: str, **labels: Any) -> _Span:
+        """Context manager timing a host-side span.
+
+        Spans opened while another span is active on the same thread record
+        under the joined path ``"outer/inner"`` — nested attribution with no
+        double counting (the outer span still includes the inner's time, as
+        a profiler trace would)."""
+        return _Span(self, name, _label_key(labels))
+
+    # --------------------------------------------------------------- plumbing
+    def _span_stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _record_span(
+        self, path: str, labels: _LabelKey, seconds: float
+    ) -> None:
+        key = (path, labels)
+        with self._lock:
+            s = self._spans.get(key)
+            if s is None:
+                s = self._spans[key] = SpanStats()
+            s.record(seconds)
+
+    # ----------------------------------------------------------------- export
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time copy as plain JSON-serialisable data:
+        ``{"counters": {...}, "gauges": {...}, "spans": {...}}``.
+
+        Keys are ``name`` or ``name{k=v,...}`` when labelled (the Prometheus
+        spelling, so snapshot keys and exposition lines correlate 1:1)."""
+
+        def fmt(name: str, labels: _LabelKey) -> str:
+            if not labels:
+                return name
+            inner = ",".join(f"{k}={v}" for k, v in labels)
+            return f"{name}{{{inner}}}"
+
+        with self._lock:
+            return {
+                "counters": {
+                    fmt(n, lb): c.value for (n, lb), c in self._counters.items()
+                },
+                "gauges": {
+                    fmt(n, lb): g.value for (n, lb), g in self._gauges.items()
+                },
+                "spans": {
+                    fmt(n, lb): {
+                        "count": s.count,
+                        "total_seconds": s.total_seconds,
+                        "max_seconds": s.max_seconds,
+                    }
+                    for (n, lb), s in self._spans.items()
+                },
+            }
+
+    def _items(self) -> list:
+        """``[(kind, name, labels, value), ...]`` — export helper. The list
+        is MATERIALISED under the lock and returned: a generator yielding
+        under the lock would hold it across the consumer's formatting work
+        (stalling every instrumented thread for a whole export) and leak it
+        outright if the consumer abandoned iteration."""
+        with self._lock:
+            out: list = [
+                ("counter", n, lb, c.value)
+                for (n, lb), c in self._counters.items()
+            ]
+            out.extend(
+                ("gauge", n, lb, g.value)
+                for (n, lb), g in self._gauges.items()
+            )
+            out.extend(
+                ("span", n, lb, (s.count, s.total_seconds, s.max_seconds))
+                for (n, lb), s in self._spans.items()
+            )
+            return out
+
+    def reset(self) -> None:
+        """Drop every instrument (fresh registry semantics). Live span
+        contexts on other threads finish into fresh entries."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._spans.clear()
+
+
+# The process-wide default registry every library call site reports into.
+default_registry = Registry()
+
+
+def counter(
+    name: str,
+    delta: float = 1.0,
+    *,
+    registry: Optional[Registry] = None,
+    **labels: Any,
+) -> None:
+    """Increment a counter on the default registry IF obs is enabled —
+    the guarded spelling library call sites use."""
+    if not _enabled:
+        return
+    (registry or default_registry).counter(name, delta, **labels)
+
+
+def gauge(
+    name: str,
+    value: float,
+    *,
+    registry: Optional[Registry] = None,
+    **labels: Any,
+) -> None:
+    """Set a gauge on the default registry IF obs is enabled."""
+    if not _enabled:
+        return
+    (registry or default_registry).gauge(name, value, **labels)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **labels: Any):
+    """Span on the default registry IF obs is enabled; a shared no-op
+    context manager (no allocation) otherwise."""
+    if not _enabled:
+        return _NULL_SPAN
+    return default_registry.span(name, **labels)
+
+
+def snapshot() -> Dict[str, Any]:
+    """Snapshot the default registry (works whether or not obs is enabled)."""
+    return default_registry.snapshot()
+
+
+def reset() -> None:
+    """Reset the default registry."""
+    default_registry.reset()

@@ -36,6 +36,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.obs.recompile import watched_jit
+
 # Above this many virtual one-hot elements (N * C), stop using the MXU
 # one-hot contraction (measured crossover vs the sort path, v5e).
 _MATMUL_ELEMENT_BUDGET = 1 << 30
@@ -87,7 +89,7 @@ def _pick_method(n: int, num_classes: int, method: str, weighted: bool) -> str:
     return "scatter" if weighted else "sort"
 
 
-@partial(jax.jit, static_argnames=("num_classes", "method", "dtype"))
+@partial(watched_jit, static_argnames=("num_classes", "method", "dtype"))
 def class_counts(
     labels: jax.Array,
     num_classes: int,
@@ -162,7 +164,7 @@ def class_counts(
     )
 
 
-@partial(jax.jit, static_argnames=("num_classes",))
+@partial(watched_jit, static_argnames=("num_classes",))
 def match_triple_counts(
     pred: jax.Array, target: jax.Array, num_classes: int
 ) -> tuple:
@@ -199,7 +201,7 @@ def match_triple_counts(
     return num_tp, num_label, class_counts(p, num_classes)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "normalize"))
+@partial(watched_jit, static_argnames=("num_classes", "normalize"))
 def confusion_matrix_counts(
     pred: jax.Array,
     target: jax.Array,
@@ -265,7 +267,7 @@ def normalize_confusion_matrix(mat: jax.Array, normalize: Optional[str]) -> jax.
     raise ValueError(f"normalize must be None, 'all', 'pred' or 'true', got {normalize!r}.")
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(watched_jit, static_argnames=("k",))
 def topk_onehot(scores: jax.Array, k: int) -> jax.Array:
     """Exactly-k 0/1 membership matrix (N, C): 1 for the k top-scoring classes
     per row (ties broken by index, like ``torch.topk`` scatter — reference

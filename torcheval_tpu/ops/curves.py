@@ -30,6 +30,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.obs.recompile import watched_jit
+
 
 def _propagate_group_ends(
     s: jax.Array, ctp: jax.Array, cfp: jax.Array
@@ -124,7 +126,7 @@ def _auprc_from_group_ends(itp: jax.Array, ifp: jax.Array) -> jax.Array:
     return jnp.where(total == 0, 0.0, ap)
 
 
-@jax.jit
+@watched_jit
 def binary_auroc_counts_kernel(
     scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
 ) -> jax.Array:
@@ -133,7 +135,7 @@ def binary_auroc_counts_kernel(
     return _auroc_from_group_ends(tp, fp)
 
 
-@jax.jit
+@watched_jit
 def binary_auprc_counts_kernel(
     scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
 ) -> jax.Array:
@@ -144,7 +146,7 @@ def binary_auprc_counts_kernel(
     return _auprc_from_group_ends(tp, fp)
 
 
-@jax.jit
+@watched_jit
 def binary_auroc_counts_presorted_kernel(
     scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
 ) -> jax.Array:
@@ -161,7 +163,7 @@ def binary_auroc_counts_presorted_kernel(
     return _auroc_from_group_ends(ctp, cfp)
 
 
-@jax.jit
+@watched_jit
 def binary_auprc_counts_presorted_kernel(
     scores: jax.Array, tp_w: jax.Array, fp_w: jax.Array
 ) -> jax.Array:
@@ -175,7 +177,7 @@ def binary_auprc_counts_presorted_kernel(
     return _auprc_from_group_ends(ctp, cfp)
 
 
-@jax.jit
+@watched_jit
 def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     """Exact trapezoidal AUROC on raw samples — the reduced-sort-traffic
     unit-count path (:func:`_group_end_cumsums`)."""
@@ -183,7 +185,7 @@ def binary_auroc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     return _auroc_from_group_ends(tp, fp)
 
 
-@jax.jit
+@watched_jit
 def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     """Average precision on raw samples (unit-count sort path)."""
     if input.shape[0] == 0:
@@ -192,7 +194,7 @@ def binary_auprc_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
     return _auprc_from_group_ends(tp, fp)
 
 
-@jax.jit
+@watched_jit
 def prc_points_kernel(
     input: jax.Array, target: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -214,8 +216,9 @@ def prc_points_kernel(
 
 
 # (C, N) batched variant for multiclass one-vs-all curves: vmap over classes.
-multiclass_prc_points_kernel = jax.jit(
-    jax.vmap(prc_points_kernel, in_axes=(0, 0), out_axes=0)
+multiclass_prc_points_kernel = watched_jit(
+    jax.vmap(prc_points_kernel, in_axes=(0, 0), out_axes=0),
+    name="multiclass_prc_points_kernel",
 )
 
 
@@ -229,7 +232,7 @@ def class_onehot_rows(target: jax.Array, num_classes: int) -> jax.Array:
     ).astype(jnp.float32)
 
 
-@jax.jit
+@watched_jit
 def multiclass_auroc_kernel(scores: jax.Array, target: jax.Array) -> jax.Array:
     """Per-class one-vs-all AUROC vector from ``(N, C)`` scores and ``(N,)``
     integer labels: the binary kernel ``vmap``-ed over the class axis — C
@@ -239,7 +242,7 @@ def multiclass_auroc_kernel(scores: jax.Array, target: jax.Array) -> jax.Array:
     return jax.vmap(binary_auroc_kernel, in_axes=(0, 0))(scores.T, onehot)
 
 
-@jax.jit
+@watched_jit
 def multiclass_auprc_kernel(scores: jax.Array, target: jax.Array) -> jax.Array:
     """Per-class one-vs-all average precision, same batching as
     :func:`multiclass_auroc_kernel`."""

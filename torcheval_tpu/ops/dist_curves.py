@@ -36,11 +36,23 @@ Reference behavior matched at mesh scale: the single-sort curve math of
 ``torcheval/metrics/functional/classification/auroc.py:50-67`` (and
 ``precision_recall_curve.py:207-230``), which the single-device kernels
 already pin against sklearn.
+
+**NaN scores fail loudly.** ``_desc_key`` maps every NaN to the max key, so
+a NaN-scored *sample* would sort last and merge into one tie group with the
+padding — silently diverging from the fused raw-sample kernels, whose
+descending sort places NaN first with each NaN its own tie group (XLA total
+order, matching ``torch.sort``). Rather than diverge, the kernels count
+NaN-keyed real rows into the returned error channel alongside capacity
+overflow: callers see a nonzero count, discard the value, and fall back to
+the fused-sort program — whose NaN semantics match the unsharded path
+exactly. (Summary-row padding never reaches these kernels; the raw cache
+carries real samples only.)
 """
 
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import List, Tuple
 
 import jax
@@ -51,6 +63,19 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs.recompile import watched_jit
+
+# older shard_map's replication checker false-positives on the kernels' scan
+# carries (jax <= 0.4.x: "Scan carry input and output got mismatched
+# replication types"); disable it where the knob exists — newer jax dropped
+# the parameter along with the checker
+_SHARD_MAP_KWARGS = (
+    {"check_rep": False}
+    if "check_rep" in inspect.signature(shard_map).parameters
+    else {}
+)
+
 # splitter histogram bins: top 16 bits of the order key
 _HIST_BINS = 1 << 16
 # per-(source, destination) send capacity is ceil(F * n_local / K); under an
@@ -60,6 +85,14 @@ _HIST_BINS = 1 << 16
 DIST_CAPACITY_FACTOR = 4
 
 _PAD_KEY = jnp.uint32(0xFFFFFFFF)
+
+
+def _bucket_capacity(n_local: int, k_devices: int) -> int:
+    """Static per-(source, destination) send capacity - ONE definition,
+    shared by the traced kernels (via ``_program``) and the obs
+    accounting (``_accounted_call``) so the reported exchange bytes
+    can never drift from what the kernel actually allocates."""
+    return max(1, -(-DIST_CAPACITY_FACTOR * n_local // k_devices))
 
 
 def _desc_key(s: jax.Array) -> jax.Array:
@@ -176,15 +209,21 @@ def _merged_shard(recv_key, recv_tp, recv_fp, axis: str, k_devices: int):
 def _concat_unit_counts(s_list, t_list):
     """Raw sample cache entries → (key, tp, fp) local columns (unit
     counts), concatenated INSIDE the shard so no resharding collective is
-    ever needed: every entry arrives as its own local block."""
+    ever needed: every entry arrives as its own local block. Also returns
+    the local NaN-keyed row count — real samples whose score is NaN would
+    silently take the *padding* sort position (module docstring), so they
+    are surfaced through the error channel instead."""
     s = jnp.concatenate(s_list)
     t = jnp.concatenate(t_list).astype(jnp.int32)
-    return _desc_key(s), t, 1 - t
+    key = _desc_key(s)
+    nan_rows = jnp.sum((key == _PAD_KEY).astype(jnp.int32))
+    return key, t, 1 - t, nan_rows
 
 
 def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
-    key, tp, fp = _concat_unit_counts(s_list, t_list)
+    key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
     recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
+    overflow = overflow + nan_rows
     ctp, cfp, last, tp_off, fp_off, p_tot, n_tot = _merged_shard(
         *recv, axis, k_devices
     )
@@ -206,8 +245,9 @@ def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
 
 
 def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity):
-    key, tp, fp = _concat_unit_counts(s_list, t_list)
+    key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
     recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
+    overflow = overflow + nan_rows
     ctp, cfp, last, tp_off, fp_off, p_tot, _ = _merged_shard(
         *recv, axis, k_devices
     )
@@ -236,9 +276,7 @@ def _program(mesh: Mesh, axis: str, which: str):
 
     def impl(s_list, t_list):
         n_local = sum(int(s.shape[0]) for s in s_list) // k_devices
-        capacity = max(
-            1, -(-DIST_CAPACITY_FACTOR * n_local // k_devices)
-        )
+        capacity = _bucket_capacity(n_local, k_devices)
         f = functools.partial(
             kern, axis=axis, k_devices=k_devices, capacity=int(capacity)
         )
@@ -247,9 +285,36 @@ def _program(mesh: Mesh, axis: str, which: str):
             mesh=mesh,
             in_specs=(P(axis), P(axis)),
             out_specs=(P(), P()),
+            **_SHARD_MAP_KWARGS,
         )(s_list, t_list)
 
-    return jax.jit(impl)
+    return watched_jit(impl, name=f"dist_curves.{which}")
+
+
+def _accounted_call(which: str, s_list, t_list, mesh: Mesh, axis: str):
+    """Dispatch the distributed program with collective accounting: one
+    all_to_all exchange per call, whose per-device send payload is derived
+    from the same static capacity formula the kernel uses (3 i32/u32
+    columns of ``k_devices * capacity`` rows). Wall time is the host-side
+    dispatch span — the collectives themselves run inside the compiled
+    program and are attributed by the XLA profiler via the entry point's
+    ``named_scope``."""
+    program = _program(mesh, axis, which)
+    s_list, t_list = list(s_list), list(t_list)
+    if not _obs.enabled():
+        return program(s_list, t_list)
+    k = int(mesh.devices.size)
+    n_local = sum(int(s.shape[0]) for s in s_list) // k
+    capacity = _bucket_capacity(n_local, k)
+    with _obs.span(f"ops.dist_curves.{which}"):
+        out = program(s_list, t_list)
+    _obs.counter("dist_curves.exchanges", kernel=which)
+    # bytes entering the all_to_all per device: key + tp + fp columns
+    _obs.counter(
+        "dist_curves.exchange_send_bytes", 3 * 4 * k * capacity, kernel=which
+    )
+    _obs.gauge("dist_curves.world_size", k)
+    return out
 
 
 def sharded_binary_auroc(
@@ -260,10 +325,12 @@ def sharded_binary_auroc(
     axis: str = "data",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact AUROC over a mesh-sharded raw sample cache without gathering
-    the samples. Returns ``(value, overflow_rows)`` — a nonzero overflow
-    means the score distribution overloaded a bucket past the send capacity
-    and the value is untrustworthy; callers must raise."""
-    return _program(mesh, axis, "auroc")(list(s_list), list(t_list))
+    the samples. Returns ``(value, error_rows)`` — a nonzero count means
+    the score distribution overloaded a bucket past the send capacity OR
+    the cache holds NaN-scored rows (whose sort position here would diverge
+    from the fused kernels'; module docstring); either way the value is
+    untrustworthy and callers must raise or fall back."""
+    return _accounted_call("auroc", s_list, t_list, mesh, axis)
 
 
 def sharded_binary_auprc(
@@ -274,5 +341,5 @@ def sharded_binary_auprc(
     axis: str = "data",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact average precision over a mesh-sharded raw cache; see
-    :func:`sharded_binary_auroc` for the overflow contract."""
-    return _program(mesh, axis, "auprc")(list(s_list), list(t_list))
+    :func:`sharded_binary_auroc` for the error-channel contract."""
+    return _accounted_call("auprc", s_list, t_list, mesh, axis)

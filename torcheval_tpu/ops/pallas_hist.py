@@ -21,10 +21,13 @@ suite runs it in interpret mode.
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from torcheval_tpu.obs.recompile import watched_jit
 
 # byte budget for the (block_rows, 128, c_tile) f32 one-hot intermediate —
 # well under VMEM (~16 MB/core); _tile_plan sizes blocks against it
@@ -67,7 +70,7 @@ def _hist_kernel(labels_ref, out_ref, *, c_tile: int):
     out_ref[:] += jnp.sum(onehot, axis=(0, 1))[None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("num_classes", "interpret"))
+@functools.partial(watched_jit, static_argnames=("num_classes", "interpret"))
 def pallas_class_counts(
     labels: jax.Array, num_classes: int, *, interpret: bool = False
 ) -> jax.Array:
@@ -162,10 +165,17 @@ def sharded_pallas_class_counts(labels, num_classes, interpret=False):
     return pallas_class_counts(labels, num_classes, interpret=interpret)
 
 
+# Shardy rule: the sample factor i is contracted; the class-axis factor j
+# appears only in the result (replicated — the partition callback psums).
+# Older jax predates Shardy and its def_partition has no sharding_rule
+# parameter — the GSPMD callbacks alone are the complete rule there.
+_def_partition_kwargs = {}
+if "sharding_rule" in inspect.signature(
+    sharded_pallas_class_counts.def_partition
+).parameters:
+    _def_partition_kwargs["sharding_rule"] = "i -> j"
 sharded_pallas_class_counts.def_partition(
     infer_sharding_from_operands=_counts_infer,
     partition=_counts_partition,
-    # Shardy rule: the sample factor i is contracted; the class-axis factor j
-    # appears only in the result (replicated — the partition callback psums)
-    sharding_rule="i -> j",
+    **_def_partition_kwargs,
 )

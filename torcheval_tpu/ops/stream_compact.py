@@ -18,8 +18,9 @@ lane utilisation. Measured redesign, per 128-element tile:
 * the tile's payload columns are copied into an (8, 128) assembly block
   (plain lane-major row copies),
 * exclusive ranks of live lanes come from ``mask @ strict-upper-tri``
-  (one (4,128)x(128,128) MXU matmul serving FOUR tiles — ``jnp.cumsum``
-  has no Mosaic lowering; integer ranks <= 128 are exact even in bf16),
+  (one (``_RANK_BATCH``, 128)x(128, 128) MXU matmul serving a batch of
+  ``_RANK_BATCH`` tiles — ``jnp.cumsum`` has no Mosaic lowering; integer
+  ranks <= 128 are exact even in bf16),
 * ONE lane-contraction matmul ``X(8,128) @ P^T(128,128)`` compacts every
   column at once, in lane-major layout, with
   ``P[r, i] = live[i] & (rank[i] == r)`` and ``Precision.HIGHEST`` —
@@ -62,6 +63,29 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from torcheval_tpu.obs.recompile import watched_jit
+
+# renamed across jax versions: TPUCompilerParams (<= 0.4.x) -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+if hasattr(pltpu, "store"):
+
+    def _masked_store(ref, c, row, val, mask):
+        """Lane-masked (1, 128) store at (c, row) of a (C, R, 128) ref."""
+        pltpu.store(ref.at[c, pl.ds(row, 1), :], val, mask=mask)
+
+else:  # jax <= 0.4.x spells the masked store pl.store(ref, idx, val, mask=)
+
+    def _masked_store(ref, c, row, val, mask):
+        pl.store(
+            ref,
+            (pl.ds(c, 1), pl.ds(row, 1), slice(None)),
+            val[None],
+            mask=mask[None],
+        )
 
 # elements per grid step (64 lane-rows of 128)
 _BLOCK = 8192
@@ -131,10 +155,11 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
         fill_ref[0, 0] = fill_ref[0, 0] - _CHUNK
 
     def body(t, _):
-        # batched across 4 tiles: one mask load + ONE rank matmul serve the
-        # next 4 tiles (25% off the pass: 397 -> 299 ms at 100M rows); the
-        # store/flush section stays strictly per tile so every staging
-        # invariant is unchanged
+        # batched across _RANK_BATCH tiles: one mask load + ONE rank matmul
+        # serve the next _RANK_BATCH tiles (batching measured 397 -> 299 ms
+        # at 100M rows when it landed at width 4; width 8 took the 1B leg to
+        # 86.9M preds/s); the store/flush section stays strictly per tile so
+        # every staging invariant is unchanged
         mb = mask_ref[pl.ds(_RANK_BATCH * t, _RANK_BATCH), :]  # (B, 128)
         ranksb = jax.lax.dot_general(
             mb, utri, (((1,), (0,)), ((), ())),
@@ -179,12 +204,8 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
         mask_b = li < end - 128
         for c in range(n_cols):
             v = rotated[c : c + 1, :]
-            pltpu.store(
-                stage_ref.at[c, pl.ds(row, 1), :], v, mask=mask_a
-            )
-            pltpu.store(
-                stage_ref.at[c, pl.ds(row + 1, 1), :], v, mask=mask_b
-            )
+            _masked_store(stage_ref, c, row, v, mask_a)
+            _masked_store(stage_ref, c, row + 1, v, mask_b)
         fill_ref[0, 0] = fill + count
 
         @pl.when(fill_ref[0, 0] >= _CHUNK)
@@ -219,7 +240,7 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
             _dma(jax.lax.rem(cidx + 1, 2), cidx - 1).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("n_cols", "interpret"))
+@functools.partial(watched_jit, static_argnames=("n_cols", "interpret"))
 def _compact_call(utri, mask2d, cols2d, n_cols: int, interpret: bool):
     rows = mask2d.shape[0]
     n = rows * 128
@@ -259,7 +280,7 @@ def _compact_call(utri, mask2d, cols2d, n_cols: int, interpret: bool):
             ),
             jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -349,7 +370,7 @@ def combine_f32_bits(hi: jax.Array, lo: jax.Array) -> jax.Array:
 from torcheval_tpu.ops.summary import PAD_SCORE  # noqa: E402
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(watched_jit, static_argnames=("interpret",))
 def compact_summary_rows(
     scores: jax.Array,
     tp: jax.Array,

@@ -115,7 +115,16 @@ def _auto_detectable() -> bool:
 
 def is_initialized() -> bool:
     """True once this process has joined a multi-process JAX world."""
-    return jax.distributed.is_initialized()
+    try:
+        return jax.distributed.is_initialized()
+    except AttributeError:  # jax < 0.5: no public predicate; read the
+        # runtime state object the initialize/shutdown pair maintains
+        try:
+            from jax._src.distributed import global_state
+
+            return global_state.client is not None
+        except Exception:  # pragma: no cover - internals moved; assume fresh
+            return False
 
 
 def init_from_env(
@@ -179,8 +188,36 @@ def init_from_env(
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
+    _enable_cpu_collectives()
     jax.distributed.initialize(**kwargs)
     return jax.process_index(), jax.process_count()
+
+
+def _enable_cpu_collectives() -> None:
+    """Older jax (< 0.5) ships CPU cross-process collectives but defaults
+    the implementation to 'none', so a CPU world fails at the first
+    collective with "Multiprocess computations aren't implemented on the
+    CPU backend". Newer jax defaults to gloo and dropped the knob — select
+    gloo where the knob exists and nothing was chosen explicitly. Must run
+    before the backend initialises, which init_from_env's contract (call
+    before first jax use) already guarantees."""
+    name = "jax_cpu_collectives_implementation"
+    try:
+        values = jax.config.values
+    except Exception:  # config internals moved; don't guess
+        return
+    if name not in values:
+        return  # knob gone: newer jax defaults CPU collectives to gloo
+    if values[name] not in (None, "none"):
+        return  # explicit user choice (e.g. mpi) — leave it
+    try:
+        jax.config.update(name, "gloo")
+    except Exception:  # backend already up: leave the user's world alone
+        _logger.warning(
+            "init_from_env: could not select gloo CPU collectives; "
+            "cross-process CPU sync may be unavailable.",
+            exc_info=True,
+        )
 
 
 def shutdown() -> None:

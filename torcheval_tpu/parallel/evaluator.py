@@ -22,6 +22,7 @@ from typing import Any, Dict, Union
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.obs.annotate import traced as _traced
 from torcheval_tpu.parallel.mesh import data_parallel_mesh, shard_batch
 
 
@@ -65,6 +66,7 @@ class ShardedEvaluator:
         for m in self.metrics.values():
             m.to(replicated)
 
+    @_traced("evaluator.update")
     def update(self, *args: Any, **kwargs: Any) -> "ShardedEvaluator":
         """Shard positional array arguments along the mesh data axis and fold
         them into every metric — one fused dispatch for all array-state
@@ -77,6 +79,7 @@ class ShardedEvaluator:
         self._collection.update(*sharded, **kwargs)
         return self
 
+    @_traced("evaluator.compute")
     def compute(self) -> Any:
         return self._collection.compute()
 

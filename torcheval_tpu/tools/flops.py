@@ -97,6 +97,9 @@ def _cost_flops(fn, *avals) -> int:
     cost = jax.jit(fn).lower(*avals).compile().cost_analysis()
     if not cost:
         return 0
+    if isinstance(cost, (list, tuple)):
+        # jax <= 0.4.x returns one properties dict per executable computation
+        return int(sum(c.get("flops", 0) for c in cost))
     return int(cost.get("flops", 0))
 
 

@@ -27,3 +27,25 @@ def donation_pipelines() -> bool:
         # private API may move between jax versions; default to donating
         return True
     return "axon" not in version
+
+
+def force_cpu_devices(n: int) -> None:
+    """Pin this process to an ``n``-device CPU backend, across jax versions:
+    newer jax has the ``jax_num_cpu_devices`` config option; older jax only
+    honors the XLA flag. Must run before first backend use either way (a
+    later call into an already-initialised backend raises RuntimeError from
+    jax.config.update, which propagates — callers that tolerate an existing
+    backend catch it and verify the device count themselves)."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()

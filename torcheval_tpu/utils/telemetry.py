@@ -33,18 +33,48 @@ def set_api_usage_sink(sink: Optional[Callable[[str], None]]) -> None:
     _sink = sink
 
 
+def _first_time(key: str) -> bool:
+    """True exactly once per unique key per process (thread-safe)."""
+    if key in _seen:  # lock-free fast path for the already-seen common case
+        return False
+    with _seen_lock:
+        if key in _seen:
+            return False
+        _seen.add(key)
+    return True
+
+
 def log_api_usage_once(key: str) -> None:
     """Record one use of ``key`` (e.g. ``"torcheval_tpu.metrics.BinaryAUROC"``);
     subsequent calls with the same key are no-ops."""
-    if key in _seen:  # lock-free fast path for the already-seen common case
+    if not _first_time(key):
         return
-    with _seen_lock:
-        if key in _seen:
-            return
-        _seen.add(key)
     _logger.debug("API usage: %s", key)
     if _sink is not None:
         try:
             _sink(key)
         except Exception:  # a broken sink must never break metric construction
             _logger.exception("api-usage sink failed for key %r", key)
+
+
+def log_once(
+    key: str, message: str, *args, level: int = logging.WARNING
+) -> None:
+    """Emit ``message % args`` through the telemetry logger once per unique
+    ``key`` — the once-per-key machinery behind :func:`log_api_usage_once`,
+    opened up for in-library watchdogs (e.g. the recompile watchdog,
+    ``obs/recompile.py``) whose warnings must not spam a hot loop."""
+    if not _first_time(key):
+        return
+    _logger.log(level, message, *args)
+
+
+def reset_once_keys(prefix: str = "") -> None:
+    """Forget recorded once-per-key keys starting with ``prefix`` (every key
+    when empty). Test/tooling hook: lets a fresh run re-arm its warnings."""
+    with _seen_lock:
+        if not prefix:
+            _seen.clear()
+        else:
+            for k in [k for k in _seen if k.startswith(prefix)]:
+                _seen.discard(k)
