@@ -22,6 +22,13 @@ runs; timed sections always run on pre-warmed shapes either way.
 (``torcheval_tpu.obs``) and prints its JSON snapshot after the metric lines
 — span timings, jit trace counts, sync-round/byte counters — so a regressed
 round can be attributed from library instrumentation, not ad-hoc prints.
+
+``--smoke`` is the CI bit-rot guard (ISSUE 2 satellite): every leg runs at
+tiny sizes on CPU, reference legs skip cleanly (no /root/reference in CI),
+and main() exits non-zero unless EVERY expected metric row was emitted — so
+a bench leg broken by a library change fails the PR's unit-test workflow
+instead of surfacing at the next driver round. Smoke numbers are
+meaningless as measurements; only completeness is asserted.
 """
 
 import json
@@ -35,6 +42,10 @@ sys.path.insert(0, _REPO)
 import numpy as np
 
 _OBS = "--obs" in sys.argv
+_SMOKE = "--smoke" in sys.argv
+
+# every emitted metric name, for the --smoke completeness assertion
+_EMITTED = []
 
 
 def _to_torch(arr):
@@ -50,6 +61,11 @@ def _to_torch(arr):
 def _jax():
     import jax
 
+    if _SMOKE:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialised (must already be CPU in CI)
     jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
@@ -189,6 +205,7 @@ def _ref_time(fn):
 
 
 def _emit(metric, preds, tpu_s, ref_s, unit="preds/s"):
+    _EMITTED.append(metric)
     print(
         json.dumps(
             {
@@ -202,10 +219,27 @@ def _emit(metric, preds, tpu_s, ref_s, unit="preds/s"):
     )
 
 
+def _emit_row(metric, value, unit):
+    """Raw-value row (ms decompositions, dispatch counts) — same record
+    format, same emission bookkeeping as _emit."""
+    _EMITTED.append(metric)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 3),
+                "unit": unit,
+                "vs_baseline": None,
+            }
+        ),
+        flush=True,
+    )
+
+
 # ----------------------------------------------------------------- headline
 NUM_CLASSES = 5
-CHUNK = 1_000_000
-BIG_CHUNK = 16_777_216  # 2^24
+CHUNK = 10_000 if _SMOKE else 1_000_000
+BIG_CHUNK = 4_096 if _SMOKE else 16_777_216  # 2^24
 
 
 def _headline_data(jax, n):
@@ -224,7 +258,8 @@ def headline_10m():
     jax = _jax()
     from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
 
-    total, n_chunks = 10_000_000, 10
+    n_chunks = 3 if _SMOKE else 10
+    total = n_chunks * CHUNK
     scores, labels, logits, binary = _headline_data(jax, CHUNK)
 
     def run():
@@ -297,7 +332,7 @@ def config1_simple_accuracy():
     from torcheval_tpu.metrics import MulticlassAccuracy
 
     rng = np.random.default_rng(0)
-    n_batches, batch = 200, 8192
+    n_batches, batch = (8, 256) if _SMOKE else (200, 8192)
     scores = rng.random((batch, 5)).astype(np.float32)
     labels = rng.integers(0, 5, batch)
     js, jl = jax.device_put(scores), jax.device_put(labels)
@@ -354,20 +389,20 @@ def config1_simple_accuracy():
             "dispatch-equivalents",
         ),
     ):
-        print(
-            json.dumps(
-                {"metric": name, "value": round(val, 3), "unit": unit,
-                 "vs_baseline": None}
-            ),
-            flush=True,
-        )
+        _emit_row(name, val, unit)
 
     # collection path. Since round 3 counter metrics DEFER: update() is an
-    # O(1) host append and the counting kernel folds the concatenated
-    # pending batches in bulk — the row name keeps the r01/r02 "_fused"
-    # label for round-over-round comparability, but the mechanism measured
-    # here is the deferred-fold lane (metrics/deferred.py), which replaced
-    # per-batch fusion for these metrics.
+    # O(1) host append and the counting kernel folds the pending batches in
+    # bulk — the row name keeps the r01/r02 "_fused" label for
+    # round-over-round comparability, but the mechanism measured here is
+    # the deferred-fold lane (metrics/deferred.py). Since ISSUE 2 deferral
+    # IS the collection's only device lane (the per-batch fused
+    # collection.step jit is deleted) and the steady constant-batch loop
+    # takes the stacked/scan fold, so this row should MATCH the plain row
+    # above to within environment noise — r05's inversion (138.8M fused vs
+    # 159.4M plain) was collection bookkeeping that the update() host diet
+    # removed; an inversion here is a regression signal, not a lane
+    # difference.
     from torcheval_tpu.metrics import MetricCollection
 
     col = MetricCollection(MulticlassAccuracy(num_classes=5))
@@ -392,7 +427,7 @@ def config2_auroc_auprc():
     jax = _jax()
     import torcheval_tpu.metrics.functional as F
 
-    n = 10_000_000
+    n = 20_000 if _SMOKE else 10_000_000
     x = jax.random.uniform(jax.random.PRNGKey(0), (n,))
     t = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) > 0.5).astype(np.float32)
     jax.block_until_ready((x, t))
@@ -428,7 +463,8 @@ def config3_confusion_f1_imagenet():
     jax = _jax()
     from torcheval_tpu.metrics import MulticlassConfusionMatrix, MulticlassF1Score
 
-    n_batches, batch, c = 13, 100_000, 1000  # 1.3M preds ~ ImageNet val x26
+    # 1.3M preds ~ ImageNet val x26 (full size)
+    n_batches, batch, c = (3, 2048, 50) if _SMOKE else (13, 100_000, 1000)
     pred = jax.random.randint(jax.random.PRNGKey(0), (batch,), 0, c, np.int32)
     label = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, c, np.int32)
     jax.block_until_ready((pred, label))
@@ -516,11 +552,25 @@ def config3_confusion_f1_imagenet():
 
 
 def config4_topk_multilabel():
-    """TopKMultilabelAccuracy, k=5, num_labels=10k."""
+    """TopKMultilabelAccuracy, k=5, num_labels=10k.
+
+    Lane note (ISSUE 2 satellite): this metric rides the DeferredFoldMixin
+    append path — updates dispatch NOTHING; the ``lax.top_k`` stats core
+    runs in one fused fold per budget window. At THIS leg's sizes a single
+    (8192, 10000) float32 score batch is ~328 MB, over the 256 MB
+    ``_DEFER_BUDGET_BYTES`` valve, so the fold legitimately fires once per
+    batch and the leg is bounded by the top-k kernel + one dispatch floor
+    per 328 MB batch — NOT by eager host dispatch. Recorded before/after:
+    round 1 (pre-deferral, eager per-update kernel) ~0.4M preds/s; BENCH_r05
+    (deferred, valve-folding) 970k preds/s at a 0.741 ms floor, 287.9x the
+    torch-CPU reference on the identical workload. Deferral's headroom here
+    is capped by the batch-size/budget ratio; raising the budget would trade
+    HBM headroom for at most ~1 dispatch floor per run.
+    """
     jax = _jax()
     from torcheval_tpu.metrics import TopKMultilabelAccuracy
 
-    n_batches, batch, labels = 4, 8192, 10_000
+    n_batches, batch, labels = (2, 128, 500) if _SMOKE else (4, 8192, 10_000)
     scores = jax.random.uniform(jax.random.PRNGKey(0), (batch, labels))
     target = (
         jax.random.uniform(jax.random.PRNGKey(1), (batch, labels)) > 0.999
@@ -558,7 +608,7 @@ def config5_sharded_sync():
     from torcheval_tpu.metrics import MulticlassAccuracy
     from torcheval_tpu.parallel import ShardedEvaluator, data_parallel_mesh
 
-    n_batches, batch = 50, 65536
+    n_batches, batch = (4, 1024) if _SMOKE else (50, 65536)
     mesh = data_parallel_mesh()
     rng = np.random.default_rng(0)
     from torcheval_tpu.parallel import shard_batch
@@ -608,7 +658,7 @@ def config5_explicit_sync_4proc():
     import subprocess
     import tempfile
 
-    world, n_batches, batch = 4, 25, 16384
+    world, n_batches, batch = (4, 3, 512) if _SMOKE else (4, 25, 16384)
     worker = os.path.join(_REPO, "benchmarks", "sync_bench_worker.py")
 
     import shutil
@@ -779,17 +829,29 @@ def env_dispatch_floor():
     "floor" of 1100 ms — a burst reading, not the floor the word claims).
     Emitted so each round's record is interpretable."""
     per_call = _measure_dispatch_floor()
-    print(
-        json.dumps(
-            {
-                "metric": "env_dispatch_floor",
-                "value": round(per_call * 1e3, 3),
-                "unit": "ms/dispatch",
-                "vs_baseline": None,
-            }
-        ),
-        flush=True,
-    )
+    _emit_row("env_dispatch_floor", per_call * 1e3, "ms/dispatch")
+
+
+# the rows a complete bench run must emit; --smoke fails unless every one
+# appeared (prefix match: the sharded row's name carries the device count)
+_EXPECTED_ROW_PREFIXES = (
+    "preds_per_sec_per_chip_acc_plus_auroc_10M",
+    "preds_per_sec_per_chip_acc_plus_auroc_100M",
+    "preds_per_sec_per_chip_acc_plus_auroc_1B",
+    "config1_multiclass_accuracy_c5",
+    "config1_python_host_ms_per_run",
+    "config1_device_plus_env_ms_per_run",
+    "config1_adjacent_dispatch_floor",
+    "config1_floor_normalized_dispatches",
+    "config1_multiclass_accuracy_c5_fused",
+    "config2_auroc_auprc_10M",
+    "config3_confusion_f1_c1000",
+    "config3_confusion_f1_c1000_fused",
+    "config4_topk_multilabel_k5_L10k",
+    "config5_sharded_sync_accuracy_",
+    "config5_explicit_sync_accuracy_4proc",
+    "env_dispatch_floor",
+)
 
 
 def main() -> None:
@@ -802,9 +864,16 @@ def main() -> None:
 
         obs.enable()
     headline_10m()
+    # smoke: scaled headline legs shrink to n_chunks=10 of the smoke
+    # BIG_CHUNK so the compaction path still FIRES at both thresholds
+    scaled_totals = (
+        (10 * BIG_CHUNK, 10 * BIG_CHUNK)
+        if _SMOKE
+        else (100_000_000, 1_000_000_000)
+    )
     for leg in (
-        lambda: headline_scaled(100_000_000, "100M", thresh_mult=3),
-        lambda: headline_scaled(1_000_000_000, "1B", thresh_mult=6),
+        lambda: headline_scaled(scaled_totals[0], "100M", thresh_mult=3),
+        lambda: headline_scaled(scaled_totals[1], "1B", thresh_mult=6),
         config1_simple_accuracy,
         config2_auroc_auprc,
         config3_confusion_f1_imagenet,
@@ -832,6 +901,19 @@ def main() -> None:
             ),
             flush=True,
         )
+    if _SMOKE:
+        missing = [
+            p
+            for p in _EXPECTED_ROW_PREFIXES
+            if not any(name.startswith(p) for name in _EMITTED)
+        ]
+        if missing:
+            print(
+                f"# SMOKE FAILURE: missing metric rows: {missing}",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        print(f"# smoke OK: {len(_EMITTED)} rows emitted", flush=True)
 
 
 if __name__ == "__main__":

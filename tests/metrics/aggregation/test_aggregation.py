@@ -46,13 +46,16 @@ class TestComputeTraceSafety(unittest.TestCase):
 
         m = Mean()
         m.update(jnp.asarray([1.0, 3.0]))
+        # read through state_dict: it folds the deferred pending batches
+        # first (direct attribute reads see only the folded-so-far value)
+        sd = m.state_dict()
 
         def f(ws, w):
             mm = Mean()
             mm.weighted_sum, mm.weights = ws, w
             return mm.compute()
 
-        assert_result_close(jax.jit(f)(m.weighted_sum, m.weights), 2.0)
+        assert_result_close(jax.jit(f)(sd["weighted_sum"], sd["weights"]), 2.0)
         assert_result_close(jax.jit(f)(jnp.zeros(()), jnp.zeros(())), 0.0)
 
     def test_throughput_compute_under_jit(self):

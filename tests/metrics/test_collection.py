@@ -1,15 +1,13 @@
 """MetricCollection routing and equivalence.
 
-Three lanes now exist (metrics/collection.py + metrics/deferred.py):
+Two lanes exist since the unification (metrics/collection.py +
+metrics/deferred.py):
 
-* deferred counter metrics — O(1) appends, bulk fold at read time; the
-  collection must NOT re-fuse them (that would drag them back to
-  one-dispatch-per-batch);
-* fusable array-state metrics (regression/aggregation) — traced into one
-  jitted donated-state dispatch;
-* cache metrics (AUROC etc.) — eager appends.
+* deferred array-state metrics (counters AND regression/aggregation) — O(1)
+  appends, one bulk group fold per budget window / read;
+* host-state metrics (AUROC caches, deque windows) — eager appends.
 
-All lanes must agree with the standalone eager metrics bit-for-bit.
+All lanes must agree with the standalone metrics bit-for-bit.
 """
 
 import unittest
@@ -49,8 +47,7 @@ class TestMetricCollection(unittest.TestCase):
             "f1": MulticlassF1Score(num_classes=7, average="macro"),
             "cm": MulticlassConfusionMatrix(7),
         }
-        self.assertEqual(col._fused, [])
-        self.assertEqual(set(col._eager), {"acc", "f1", "cm"})
+        self.assertEqual(set(col._deferred), {"acc", "f1", "cm"})
         for _ in range(4):
             x = RNG.random((64, 7)).astype(np.float32)
             t = RNG.integers(0, 7, 64)
@@ -63,11 +60,12 @@ class TestMetricCollection(unittest.TestCase):
                 np.asarray(out[name]), np.asarray(m.compute()), rtol=1e-6
             )
 
-    def test_fused_array_state_metrics(self):
-        # regression/aggregation metrics still take the fused one-dispatch
-        # lane; results must match the standalone metrics
+    def test_array_state_metrics_defer(self):
+        # aggregation metrics ride the deferred lane since the unification:
+        # appends per update, ONE group fold at read; results must match the
+        # standalone metrics
         col = MetricCollection({"sum": Sum(), "mean": Mean()})
-        self.assertEqual(set(col._fused), {"sum", "mean"})
+        self.assertEqual(set(col._deferred), {"sum", "mean"})
         ref_sum, ref_mean = Sum(), Mean()
         for _ in range(4):
             x = RNG.random(128).astype(np.float32)
@@ -84,8 +82,7 @@ class TestMetricCollection(unittest.TestCase):
         col = MetricCollection(
             {"bacc": BinaryAccuracy(), "auroc": BinaryAUROC()}
         )
-        self.assertEqual(col._fused, [])
-        self.assertEqual(set(col._eager), {"bacc", "auroc"})
+        self.assertEqual(set(col._deferred), {"bacc"})  # auroc: host cache
         xs, ts = [], []
         for _ in range(3):
             x = RNG.random(128).astype(np.float32)
@@ -145,14 +142,16 @@ class TestMetricCollection(unittest.TestCase):
         col.update(jnp.eye(3), jnp.arange(3))
         self.assertEqual(float(col.state_dicts()["metric"]["num_total"]), 3.0)
 
-    def test_fused_state_dict_snapshot_survives_donation(self):
-        # fused lane: the next fused update donates the live buffers the
-        # snapshot was taken from; the snapshot must be a real copy
+    def test_state_dict_snapshot_survives_donation(self):
+        # deferred lane: the next FOLD donates the live buffers the snapshot
+        # was taken from (on donating backends); the snapshot must be a real
+        # copy
         col = MetricCollection(Sum())
         col.update(jnp.arange(3.0))
-        sd = col.state_dicts()["metric"]
-        col.update(jnp.arange(3.0))  # donates previous live state
-        self.assertEqual(float(sd["weighted_sum"]), 3.0)
+        sd = col.state_dicts()["metric"]  # folds, then snapshots
+        col.update(jnp.arange(3.0))
+        self.assertEqual(float(col.compute()), 6.0)  # fold donates prior state
+        self.assertEqual(float(sd["weighted_sum"]), 3.0)  # snapshot intact
         col.reset()
         col.update(jnp.arange(3.0))
         self.assertEqual(float(col.compute()), 3.0)
@@ -166,15 +165,15 @@ class TestCollectionTorchBridge(unittest.TestCase):
         col.update(torch.eye(3), torch.arange(3))
         self.assertEqual(float(col.compute()), 1.0)
 
-    def test_torch_tensors_through_fused_path(self):
+    def test_torch_tensors_through_deferred_array_state_path(self):
         import torch
 
         col = MetricCollection(MeanSquaredError())
         col.update(torch.zeros(4), torch.ones(4))
         self.assertEqual(float(col.compute()), 1.0)
 
-    def test_non_donated_step_on_tunneled_backend(self):
-        # on a tunneled backend the donation gate compiles the fused step
+    def test_non_donated_fold_on_tunneled_backend(self):
+        # on a tunneled backend the donation gate compiles the deferred fold
         # WITHOUT donate_argnums (utils/platform.py); results must be
         # identical and repeated updates must not touch deleted buffers
         from unittest import mock

@@ -37,15 +37,20 @@ class TestStandaloneGroupFold(unittest.TestCase):
     pending-chunk identity."""
 
     def _spy(self):
+        # wraps every fold dispatcher — the scan-vs-concat choice is a
+        # static argument inside these entry points, so the assertions pin
+        # dispatch counts whatever physical fold shape the pending
+        # signatures select
         import torcheval_tpu.metrics.deferred as dmod
 
         calls = {"single": 0, "group": 0}
-        orig = {
-            "_fold_dispatch": dmod._fold_dispatch,
-            "_fold_dispatch_donated": dmod._fold_dispatch_donated,
-            "_group_fold_dispatch": dmod._group_fold_dispatch,
-            "_group_fold_dispatch_donated": dmod._group_fold_dispatch_donated,
+        names = {
+            "_fold_dispatch": "single",
+            "_fold_dispatch_donated": "single",
+            "_group_fold_dispatch": "group",
+            "_group_fold_dispatch_donated": "group",
         }
+        orig = {name: getattr(dmod, name) for name in names}
 
         def wrap(name, kind):
             real = orig[name]
@@ -56,12 +61,8 @@ class TestStandaloneGroupFold(unittest.TestCase):
 
             return f
 
-        dmod._fold_dispatch = wrap("_fold_dispatch", "single")
-        dmod._fold_dispatch_donated = wrap("_fold_dispatch_donated", "single")
-        dmod._group_fold_dispatch = wrap("_group_fold_dispatch", "group")
-        dmod._group_fold_dispatch_donated = wrap(
-            "_group_fold_dispatch_donated", "group"
-        )
+        for name, kind in names.items():
+            setattr(dmod, name, wrap(name, kind))
 
         def restore():
             for k, v in orig.items():
@@ -283,6 +284,104 @@ class TestDeferredEdges(unittest.TestCase):
         # read through state_dict: direct attribute reads see only the
         # folded-so-far value (documented deferral semantics)
         self.assertEqual(float(m.state_dict()["num_total"]), 16.0)
+
+
+class TestDeferValves(unittest.TestCase):
+    """ISSUE 2 satellite: the two deferral valves' exact mechanics — the
+    2x-scale hard valve on a collection-managed member streamed into
+    directly, and the flush-before-append ordering on a signature change."""
+
+    def test_managed_member_direct_stream_valve_fires_at_exactly_2x(self):
+        from torcheval_tpu.metrics import MetricCollection
+
+        m = MulticlassAccuracy(num_classes=4)
+        MetricCollection(m)  # marks managed: collection owns the trigger
+        m._DEFER_MAX_CHUNKS = 3
+        x, t = _batch(8, 4)
+        jx, jt = jnp.asarray(x), jnp.asarray(t)
+        # updates 1..5 stay pending: the managed scale doubles the chunk cap
+        # (2 * 3 = 6), so the 1x cap passing at chunk 3 must NOT fold
+        for i in range(1, 6):
+            m.update(jx, jt)
+            self.assertEqual(len(m._pending), i)
+        # chunk 6 reaches the 2x hard valve: everything folds
+        m.update(jx, jt)
+        self.assertEqual(m._pending, [])
+        self.assertEqual(float(m.num_total), 48.0)  # folded, not dropped
+        self.assertAlmostEqual(
+            float(m.compute()), float((x.argmax(1) == t).mean()), places=6
+        )
+
+    def test_mixed_signature_flush_folds_old_before_append(self):
+        # an (N, C) chunk arriving after (N,) chunks must fold the old
+        # signature FIRST, then append — the pending list never holds two
+        # signatures (one fold never mixes ranks)
+        m = MulticlassAccuracy(num_classes=4)
+        t1 = RNG.integers(0, 4, 16)
+        j1 = jnp.asarray(t1.astype(np.float32))
+        m.update(j1, jnp.asarray(t1))  # 1-D input chunks
+        m.update(j1, jnp.asarray(t1))
+        self.assertEqual(len(m._pending), 2)
+        x2, t2 = _batch(24)
+        m.update(jnp.asarray(x2), jnp.asarray(t2))  # 2-D: flush + append
+        # pending holds ONLY the new-signature chunk...
+        self.assertEqual(len(m._pending), 1)
+        self.assertEqual(m._pending[0][0].ndim, 2)
+        # ...and the old chunks are already in the folded state (direct
+        # attribute read = folded-so-far value)
+        self.assertEqual(float(m.num_total), 32.0)
+        correct = 32 + int((x2.argmax(1) == t2).sum())
+        self.assertAlmostEqual(float(m.compute()), correct / 56.0, places=6)
+
+
+class TestStackedScanFold(unittest.TestCase):
+    """The stacked/scan fold path (uniform pending signatures) must agree
+    with the concat/per-chunk fallback (ragged signatures) bit-for-bit."""
+
+    def test_uniform_vs_ragged_chunks_agree(self):
+        uniform = MulticlassAccuracy(num_classes=4)
+        ragged = MulticlassAccuracy(num_classes=4)
+        x, t = _batch(60, 4)
+        jx, jt = jnp.asarray(x), jnp.asarray(t)
+        for i in range(4):  # four (15, 4) chunks: stacked scan path
+            uniform.update(jx[i * 15 : (i + 1) * 15], jt[i * 15 : (i + 1) * 15])
+        ragged.update(jx[:20], jt[:20])  # (20,) then (40,): concat fallback
+        ragged.update(jx[20:], jt[20:])
+        self.assertAlmostEqual(
+            float(uniform.compute()), float(ragged.compute()), places=7
+        )
+        self.assertAlmostEqual(
+            float(uniform.compute()), float((x.argmax(1) == t).mean()), places=6
+        )
+
+    def test_extrema_state_threads_through_scan(self):
+        from torcheval_tpu.metrics import Max, Min
+
+        rows = RNG.random((5, 32)).astype(np.float32)
+        mx, mn = Max(), Min()
+        for row in rows:  # five same-shape chunks: scan carry threads state
+            mx.update(jnp.asarray(row))
+            mn.update(jnp.asarray(row))
+        self.assertEqual(float(mx.compute()), float(rows.max()))
+        self.assertEqual(float(mn.compute()), float(rows.min()))
+        # keep streaming after the fold: the reduce keeps threading
+        mx.update(jnp.asarray(rows[0] + 10.0))
+        self.assertEqual(float(mx.compute()), float((rows[0] + 10.0).max()))
+
+    def test_int_counter_meets_float_delta_in_scan(self):
+        # MSE's sum_weight starts int32 and promotes to float32 on the first
+        # weighted fold; the scan carry must stay dtype-stable (first chunk
+        # folds outside the scan to settle promotion)
+        from torcheval_tpu.metrics import MeanSquaredError
+
+        m = MeanSquaredError()
+        x = RNG.random(16).astype(np.float32)
+        t = RNG.random(16).astype(np.float32)
+        w = RNG.random(16).astype(np.float32)
+        for _ in range(3):
+            m.update(jnp.asarray(x), jnp.asarray(t), sample_weight=jnp.asarray(w))
+        expected = (np.square(t - x) * w).sum() * 3 / (w.sum() * 3)
+        self.assertAlmostEqual(float(m.compute()), float(expected), places=5)
 
 
 if __name__ == "__main__":

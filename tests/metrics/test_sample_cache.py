@@ -1,0 +1,59 @@
+"""SampleCacheMetric base-class edges (metrics/sample_cache.py).
+
+ISSUE 2 satellite: the empty-cache ``_concat_cache`` fallback used to return
+``jnp.empty(shape)`` — silently float32 whatever the cache's element dtype.
+The dtype now threads from ``_add_cache_state`` (or an explicit
+``empty_dtype``), so an empty ``compute()`` honours the metric's declared
+dtype.
+"""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.ranking import HitRate, ReciprocalRank
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+
+
+class _IntCache(SampleCacheMetric[jax.Array]):
+    """Minimal integer-cache metric: ids concatenated on read."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_cache_state("ids", dtype=jnp.int32)
+
+    def update(self, ids):
+        self.ids.append(self._input(ids))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self._concat_cache("ids")
+
+
+class TestEmptyCacheDtype(unittest.TestCase):
+    def test_declared_int_dtype_survives_empty_compute(self):
+        out = _IntCache().compute()
+        self.assertEqual(out.shape, (0,))
+        self.assertEqual(out.dtype, jnp.int32)
+
+    def test_empty_then_stream_round_trip(self):
+        m = _IntCache()
+        self.assertEqual(m.compute().dtype, jnp.int32)
+        m.update(jnp.asarray([3, 1, 2], dtype=jnp.int32))
+        self.assertEqual(m.compute().dtype, jnp.int32)
+        self.assertEqual(m.compute().shape, (3,))
+
+    def test_default_float_caches_unchanged(self):
+        # shipped score-cache metrics keep their float32 empty compute
+        self.assertEqual(HitRate().compute().dtype, jnp.float32)
+        self.assertEqual(ReciprocalRank().compute().dtype, jnp.float32)
+
+    def test_explicit_empty_dtype_overrides(self):
+        m = _IntCache()
+        out = m._concat_cache("ids", empty_dtype=jnp.float32)
+        self.assertEqual(out.dtype, jnp.float32)
+
+
+if __name__ == "__main__":
+    unittest.main()

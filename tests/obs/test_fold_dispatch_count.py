@@ -1,0 +1,124 @@
+"""Dispatch-count regression test (ISSUE 2 satellite).
+
+The unified eval hot loop's contract is structural: K ``update()`` calls
+under one budget window must cost O(1) fold *programs*, never O(K)
+dispatches. The PR-1 obs registry makes that an observable
+(``deferred.folds{entry=,path=}`` increments once per fold dispatch), so a
+future change that quietly reintroduces per-batch dispatch fails HERE in CI
+instead of at the next bench round.
+
+The companion assertion pins the retrace bound the stacked/scan fold path
+guarantees: a steady constant-batch loop compiles ``deferred.group_fold``
+for at most 2 distinct signatures per batch shape (the valve-cadence chunk
+count plus the final partial flush).
+"""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import (
+    MeanSquaredError,
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    R2Score,
+)
+from torcheval_tpu.obs import recompile
+
+RNG = np.random.default_rng(7)
+
+
+def _fold_dispatches():
+    counters = obs.snapshot()["counters"]
+    return {
+        k: v for k, v in counters.items() if k.startswith("deferred.folds")
+    }
+
+
+class TestFoldDispatchCount(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+
+    def test_mixed_collection_one_window_is_one_program(self):
+        K = 32
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=6),
+                "f1": MulticlassF1Score(num_classes=6, average="macro"),
+                "cm": MulticlassConfusionMatrix(6),
+            }
+        )
+        # deliberately-odd batch size: this test's trace-count assertions
+        # must not be satisfied by jit-cache hits from other tests' shapes
+        x = jnp.asarray(RNG.random((37, 6)).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 6, 37))
+        recompile.reset()
+        for _ in range(K):
+            col.update(x, t)
+        # the hot loop itself dispatched NO fold program (K << budget window)
+        self.assertEqual(_fold_dispatches(), {})
+        col.compute()
+        total = sum(_fold_dispatches().values())
+        self.assertEqual(total, 1)  # one program for all 3 members × K batches
+
+    def test_valve_cadence_stays_o1_programs_and_bounded_signatures(self):
+        # shrink the window so the valve fires mid-stream: 3 windows of 8
+        # chunks + no remainder must be 3 programs (one per window), and —
+        # constant batch shape — at most 2 distinct deferred.group_fold
+        # signatures (the valve-cadence count; no partial flush here)
+        K, window = 24, 8
+        col = MetricCollection(
+            {"mse": MeanSquaredError(), "r2": R2Score()}
+        )
+        for m in col.metrics.values():
+            m._DEFER_MAX_CHUNKS = window
+        x = jnp.asarray(RNG.random(41).astype(np.float32))
+        t = jnp.asarray(RNG.random(41).astype(np.float32))
+        recompile.reset()
+        for _ in range(K):
+            col.update(x, t)
+        col.compute()
+        total = sum(_fold_dispatches().values())
+        self.assertEqual(total, K // window)  # O(windows), never O(K)
+        group_traces = recompile.trace_counts().get(
+            "deferred.group_fold", {"distinct_signatures": 0}
+        )
+        self.assertLessEqual(group_traces["distinct_signatures"], 2)
+        # and the result is still exact
+        expected = float(np.square(np.asarray(t) - np.asarray(x)).mean())
+        out = col.compute()
+        self.assertAlmostEqual(float(out["mse"]), expected, places=6)
+
+    def test_steady_loop_with_remainder_is_two_signatures(self):
+        # K not a multiple of the window: valve folds at the cadence count,
+        # the read folds the remainder — exactly the "≤2 signatures per
+        # batch shape" bound the scan path guarantees
+        K, window = 11, 4
+        m = MulticlassAccuracy(num_classes=5)
+        col = MetricCollection(m)
+        m._DEFER_MAX_CHUNKS = window
+        x = jnp.asarray(RNG.random((29, 5)).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 5, 29))
+        recompile.reset()
+        for _ in range(K):
+            col.update(x, t)
+        col.compute()
+        total = sum(_fold_dispatches().values())
+        self.assertEqual(total, 3)  # 2 valve windows + 1 remainder fold
+        group_traces = recompile.trace_counts().get(
+            "deferred.group_fold", {"distinct_signatures": 0}
+        )
+        self.assertLessEqual(group_traces["distinct_signatures"], 2)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,4 +1,10 @@
-"""Max metric. Reference: ``torcheval/metrics/aggregation/max.py``."""
+"""Max metric. Reference: ``torcheval/metrics/aggregation/max.py``.
+
+Updates are **deferred** (``metrics/deferred.py``). The running maximum is
+not additive, so the fold threads state through ``jnp.maximum``
+(``_fold_reduce``) instead of the default add — same one-dispatch-per-window
+pipeline as every other deferred metric.
+"""
 
 from __future__ import annotations
 
@@ -7,30 +13,46 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class Max(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py)
+def _max_deferred_fold(input):
+    return {"max": jnp.max(input)}
+
+
+class Max(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming maximum over all seen elements.
 
     Reference parity: ``aggregation/max.py:20-63``.
     """
 
+    _fold_fn = staticmethod(_max_deferred_fold)
+    _fold_per_chunk = True
+    _fold_reduce = staticmethod(jnp.maximum)
+
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
         self._add_state("max", jnp.asarray(-jnp.inf), reduction=Reduction.MAX)
+        self._init_deferred()
 
     def update(self, input: jax.Array) -> "Max":
-        input = self._input(input)
-        self.max = jnp.maximum(self.max, jnp.max(input))
+        self._defer(self._input(input))
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return self.max
 
     def merge_state(self, metrics: Iterable["Max"]) -> "Max":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.max = jnp.maximum(self.max, jax.device_put(metric.max, self.device))
         return self

@@ -1,4 +1,8 @@
-"""Mean metric. Reference: ``torcheval/metrics/aggregation/mean.py``."""
+"""Mean metric. Reference: ``torcheval/metrics/aggregation/mean.py``.
+
+Updates are **deferred** (``metrics/deferred.py``); see :mod:`.sum` for the
+default-weight single-column chunk convention this module shares.
+"""
 
 from __future__ import annotations
 
@@ -6,7 +10,9 @@ import logging
 from typing import Iterable, Union
 
 import jax
+import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
 from torcheval_tpu.metrics.functional.aggregation.sum import _weight_check
 from torcheval_tpu.metrics.metric import Metric
@@ -18,7 +24,20 @@ from torcheval_tpu.utils.tracing import async_value_warn
 _logger = logging.getLogger(__name__)
 
 
-class Mean(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py). A non-default weight
+# defers as a second chunk column; arity discriminates.
+def _mean_deferred_fold(input, weight=None):
+    if weight is None:
+        return {
+            "weighted_sum": jnp.sum(input),
+            "weights": jnp.asarray(float(input.size), dtype=jnp.float32),
+        }
+    weighted_sum, total_weight = _mean_update(input, weight)
+    return {"weighted_sum": weighted_sum, "weights": total_weight}
+
+
+class Mean(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming weighted mean: ``sum(weight * input) / sum(weight)``.
 
     Reference parity: ``aggregation/mean.py:20-102``, with one documented fix:
@@ -27,10 +46,14 @@ class Mean(Metric[jax.Array]):
     ``weights == 0`` instead, which is the correct no-update signal.
     """
 
+    _fold_fn = staticmethod(_mean_deferred_fold)
+    _fold_per_chunk = True
+
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
         self._add_state("weighted_sum", zeros_state(), reduction=Reduction.SUM)
         self._add_state("weights", zeros_state(), reduction=Reduction.SUM)
+        self._init_deferred()
 
     def update(
         self,
@@ -39,10 +62,12 @@ class Mean(Metric[jax.Array]):
         weight: Union[float, int, jax.Array] = 1.0,
     ) -> "Mean":
         input = self._input(input)
-        weight = _weight_check(input, weight)
-        weighted_sum, total_weight = _mean_update(input, weight)
-        self.weighted_sum = self.weighted_sum + weighted_sum
-        self.weights = self.weights + total_weight
+        if isinstance(weight, (int, float)) and weight == 1.0:
+            # default weight: nothing to validate; single-column chunk
+            # (see module doc)
+            self._defer(input)
+        else:
+            self._defer(input, _weight_check(input, weight))
         return self
 
     def compute(self) -> jax.Array:
@@ -50,6 +75,8 @@ class Mean(Metric[jax.Array]):
         # daemon thread (utils/tracing.py) so compute never blocks on the
         # device stream; the returned expression itself is branch-free and
         # jit-embeddable (no-update => 0.0 either way)
+        self._fold_now()
+
         def _check(w) -> None:
             if w == 0.0:
                 _logger.warning(
@@ -60,6 +87,10 @@ class Mean(Metric[jax.Array]):
         return safe_div(self.weighted_sum, self.weights)
 
     def merge_state(self, metrics: Iterable["Mean"]) -> "Mean":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.weighted_sum = self.weighted_sum + jax.device_put(
                 metric.weighted_sum, self.device

@@ -1,4 +1,8 @@
-"""Min metric. Reference: ``torcheval/metrics/aggregation/min.py``."""
+"""Min metric. Reference: ``torcheval/metrics/aggregation/min.py``.
+
+Updates are **deferred** (``metrics/deferred.py``); the fold threads state
+through ``jnp.minimum`` (``_fold_reduce``) — see :mod:`.max`.
+"""
 
 from __future__ import annotations
 
@@ -7,30 +11,46 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class Min(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py)
+def _min_deferred_fold(input):
+    return {"min": jnp.min(input)}
+
+
+class Min(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming minimum over all seen elements.
 
     Reference parity: ``aggregation/min.py:20-63``.
     """
 
+    _fold_fn = staticmethod(_min_deferred_fold)
+    _fold_per_chunk = True
+    _fold_reduce = staticmethod(jnp.minimum)
+
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
         self._add_state("min", jnp.asarray(jnp.inf), reduction=Reduction.MIN)
+        self._init_deferred()
 
     def update(self, input: jax.Array) -> "Min":
-        input = self._input(input)
-        self.min = jnp.minimum(self.min, jnp.min(input))
+        self._defer(self._input(input))
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return self.min
 
     def merge_state(self, metrics: Iterable["Min"]) -> "Min":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.min = jnp.minimum(self.min, jax.device_put(metric.min, self.device))
         return self

@@ -1,26 +1,48 @@
-"""Sum metric. Reference: ``torcheval/metrics/aggregation/sum.py``."""
+"""Sum metric. Reference: ``torcheval/metrics/aggregation/sum.py``.
+
+Updates are **deferred** (``metrics/deferred.py``): ``update()`` is an O(1)
+host append and the reduction folds over the pending stream in one fused
+dispatch. The default-weight path defers only the input column, so inside a
+``MetricCollection`` the pending chunks stay identical across members and
+the whole collection folds in one program.
+"""
 
 from __future__ import annotations
 
 from typing import Iterable, Union
 
 import jax
+import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update, _weight_check
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class Sum(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py). A non-default weight
+# defers as a second chunk column; arity discriminates.
+def _sum_deferred_fold(input, weight=None):
+    if weight is None:
+        return {"weighted_sum": jnp.sum(input)}
+    return {"weighted_sum": _sum_update(input, weight)}
+
+
+class Sum(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming (weighted) sum.
 
     Reference parity: ``aggregation/sum.py:20-86``.
     """
 
+    _fold_fn = staticmethod(_sum_deferred_fold)
+    _fold_per_chunk = True
+
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
         self._add_state("weighted_sum", zeros_state(), reduction=Reduction.SUM)
+        self._init_deferred()
 
     def update(
         self,
@@ -29,14 +51,24 @@ class Sum(Metric[jax.Array]):
         weight: Union[float, int, jax.Array] = 1.0,
     ) -> "Sum":
         input = self._input(input)
-        weight = _weight_check(input, weight)
-        self.weighted_sum = self.weighted_sum + _sum_update(input, weight)
+        if isinstance(weight, (int, float)) and weight == 1.0:
+            # default weight: nothing to validate, and the chunk stays a
+            # single column so sibling metrics fed the same placed input
+            # group-fold with it
+            self._defer(input)
+        else:
+            self._defer(input, _weight_check(input, weight))
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return self.weighted_sum
 
     def merge_state(self, metrics: Iterable["Sum"]) -> "Sum":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.weighted_sum = self.weighted_sum + jax.device_put(
                 metric.weighted_sum, self.device

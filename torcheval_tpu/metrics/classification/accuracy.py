@@ -73,11 +73,8 @@ class MulticlassAccuracy(DeferredFoldMixin, Metric[jax.Array]):
     scalar pair (micro) or per-class ``(num_classes,)`` int32 counters.
     """
 
-    _fold_per_chunk = True
-
-
     _fold_fn = staticmethod(_acc_fold)
-
+    _fold_per_chunk = True
 
     def __init__(
         self,
@@ -161,7 +158,6 @@ class MultilabelAccuracy(MulticlassAccuracy):
 
     _fold_fn = staticmethod(_mlacc_fold)
 
-
     def __init__(
         self,
         *,
@@ -187,10 +183,19 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
 
     Reference parity: ``classification/accuracy.py:305-394``, with the
     hardcoded ``topk(k=2)`` bug (``functional/.../accuracy.py:394``) fixed.
+
+    Deferral note (ISSUE 2 satellite): updates ride the DeferredFoldMixin
+    append path like every counter metric, and the ``lax.top_k`` stats core
+    (``_topk_multilabel_stats``) runs inside the shared fold program — one
+    dispatch per budget window, scan-based when the batch shape is steady.
+    At BASELINE config 4's sizes ((8192, 10000) float32 scores ≈ 328 MB per
+    batch) each chunk alone exceeds ``_DEFER_BUDGET_BYTES``, so the memory
+    valve legitimately folds once per update there; the leg is bounded by
+    the top-k kernel plus one dispatch floor per 328 MB batch, not by host
+    eagerness (see bench.py::config4_topk_multilabel).
     """
 
     _fold_fn = staticmethod(_topk_fold)
-
 
     def __init__(
         self,

@@ -1,5 +1,12 @@
 """BinaryNormalizedEntropy metric. Reference:
-``torcheval/metrics/classification/binary_normalized_entropy.py:22-147``."""
+``torcheval/metrics/classification/binary_normalized_entropy.py:22-147``.
+
+Updates are **deferred** (``metrics/deferred.py``): ``update()`` runs the
+host-side shape/value checks (the [0, 1] probability check reads the RAW
+pre-placement input, so it still happens per update, never inside a fold)
+and appends the placed batch; the entropy fold runs over the pending stream
+in one fused dispatch at read time or on a memory budget.
+"""
 
 from __future__ import annotations
 
@@ -9,12 +16,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.classification._task_shapes import (
     check_num_tasks,
 )
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _baseline_entropy,
-    _binary_normalized_entropy_update,
+    _ne_fold,
+    _ne_input_check,
+    _ne_value_check,
 )
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
@@ -23,7 +33,26 @@ from torcheval_tpu.utils.devices import DeviceLike
 _STATE_NAMES = ("total_entropy", "num_examples", "num_positive")
 
 
-class BinaryNormalizedEntropy(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py). The optional weight
+# defers as an extra chunk column, so the trailing statics are parsed by
+# arity: rest == (from_logits,) or (weight, from_logits).
+def _ne_deferred_fold(input, target, *rest):
+    if len(rest) == 2:
+        weight, from_logits = rest
+    else:
+        weight, from_logits = None, rest[0]
+    cross_entropy, num_positive, num_examples = _ne_fold(
+        input, target, from_logits, weight
+    )
+    return {
+        "total_entropy": cross_entropy,
+        "num_examples": num_examples,
+        "num_positive": num_positive,
+    }
+
+
+class BinaryNormalizedEntropy(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming normalized binary cross entropy (CTR calibration metric).
 
     Args:
@@ -35,6 +64,9 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
     (float32 accumulators instead of float64 — TPU has no fast fp64; see the
     functional module's note).
     """
+
+    _fold_fn = staticmethod(_ne_deferred_fold)
+    _fold_per_chunk = True
 
     def __init__(
         self,
@@ -53,6 +85,8 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
                 zeros_state((num_tasks,), dtype=jnp.float32),
                 reduction=Reduction.SUM,
             )
+        self._init_deferred()
+        self._fold_params = (from_logits,)
 
     def update(
         self, input, target, *, weight: Optional[jax.Array] = None
@@ -61,18 +95,18 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
         input, target = self._input(input), self._input(target)
         if weight is not None:
             weight = self._input(weight)
-        cross_entropy, num_positive, num_examples = (
-            _binary_normalized_entropy_update(
-                input, target, self.from_logits, self.num_tasks, weight,
-                value_check_source=raw_input,
-            )
-        )
-        self.total_entropy = self.total_entropy + cross_entropy
-        self.num_examples = self.num_examples + num_examples
-        self.num_positive = self.num_positive + num_positive
+        _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
+        # the [0, 1] check reads the RAW host-resident source (placed device
+        # arrays skip it — documented divergence in the functional module)
+        _ne_value_check(raw_input, self.from_logits)
+        if weight is None:
+            self._defer(input, target)
+        else:
+            self._defer(input, target, weight)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         if np.any(np.asarray(self.num_examples) == 0.0):
             return jnp.empty((0,))
         baseline = _baseline_entropy(self.num_positive, self.num_examples)
@@ -81,6 +115,10 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
     def merge_state(
         self, metrics: Iterable["BinaryNormalizedEntropy"]
     ) -> "BinaryNormalizedEntropy":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             for name in _STATE_NAMES:
                 setattr(

@@ -1,9 +1,12 @@
 """ClickThroughRate and its windowed variant.
 
 Extensions beyond the reference snapshot (see the functional module's note).
-``WindowedClickThroughRate`` is a shipped deque-state metric: the window is
-a ``deque(maxlen=window_size)`` of per-update ``(clicks, weight)`` rows, so
-the base class's deque machinery (state-dict round trips preserving
+``ClickThroughRate`` is **deferred** (``metrics/deferred.py``): updates
+append and the weighted-count fold runs in the shared one-program-per-window
+pipeline. ``WindowedClickThroughRate`` stays eager — its deque window must
+observe every update as its own ``(clicks, weight)`` row, which a bulk fold
+would collapse; the window is a ``deque(maxlen=window_size)`` of per-update
+rows, so the base class's deque machinery (state-dict round trips preserving
 ``maxlen``, object-lane sync, merge bounded by the window) carries a real
 metric, not just the test dummies. Window mechanics live in
 :mod:`._windowed` (shared with the calibration variant).
@@ -17,12 +20,16 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.classification._windowed import WindowedStateMixin
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.classification.click_through_rate import (
     _click_through_rate_update,
+    _ctr_fold,
+    _ctr_input_check,
     _ctr_compute,
 )
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
+from torcheval_tpu.utils.convert import as_jax
 from torcheval_tpu.utils.devices import DeviceLike
 
 
@@ -33,8 +40,8 @@ from torcheval_tpu.metrics.functional.classification._task_shapes import (
 
 def _fold_ctr(metric, input, weights):
     """Place inputs, run the fold, normalize to the ``(num_tasks,)`` axis
-    (the fold reduces to scalars at ``num_tasks=1``) — shared by the plain
-    and windowed classes so the update contract cannot drift."""
+    (the fold reduces to scalars at ``num_tasks=1``) — the eager helper the
+    windowed class still uses per update."""
     input = metric._input(input)
     if weights is not None and hasattr(weights, "shape"):
         weights = metric._input(weights)
@@ -45,12 +52,29 @@ def _fold_ctr(metric, input, weights):
     )
 
 
-class ClickThroughRate(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py). Weighted updates
+# defer the weights as a second chunk column, so the trailing statics are
+# parsed by arity: rest == (num_tasks,) or (weights, num_tasks).
+def _ctr_deferred_fold(input, *rest):
+    num_tasks = rest[-1]
+    weights = rest[0] if len(rest) == 2 else 1.0
+    clicks, total = _ctr_fold(input, as_jax(weights))
+    return {
+        "click_total": jnp.reshape(clicks, (num_tasks,)),
+        "weight_total": jnp.reshape(total, (num_tasks,)),
+    }
+
+
+class ClickThroughRate(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming weighted click-through rate.
 
     ``compute()`` returns ``sum(w * clicks) / sum(w)`` with shape
     ``(num_tasks,)`` (``0.0`` per task before any weighted update).
     """
+
+    _fold_fn = staticmethod(_ctr_deferred_fold)
+    _fold_per_chunk = True
 
     def __init__(
         self, *, num_tasks: int = 1, device: DeviceLike = None
@@ -64,23 +88,42 @@ class ClickThroughRate(Metric[jax.Array]):
                 zeros_state((num_tasks,), dtype=jnp.float32),
                 reduction=Reduction.SUM,
             )
+        self._init_deferred()
+        self._fold_params = (num_tasks,)
 
     def update(
         self,
         input,
         weights: Union[float, int, jax.Array, None] = None,
     ) -> "ClickThroughRate":
-        clicks, total = _fold_ctr(self, input, weights)
-        self.click_total = self.click_total + clicks
-        self.weight_total = self.weight_total + total
+        input = self._input(input)
+        if weights is None:
+            _ctr_input_check(input, self.num_tasks, None)
+            self._defer(input)
+            return self
+        # scalar weights become a 0-d column (broadcast in the fold);
+        # array-likes (incl. python lists) are placed like any batch arg
+        if isinstance(weights, (int, float)):
+            weights = as_jax(weights)
+        else:
+            weights = self._input(weights)
+        _ctr_input_check(
+            input, self.num_tasks, weights if weights.ndim else None
+        )
+        self._defer(input, weights)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return _ctr_compute(self.click_total, self.weight_total)
 
     def merge_state(
         self, metrics: Iterable["ClickThroughRate"]
     ) -> "ClickThroughRate":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.click_total = self.click_total + jax.device_put(
                 metric.click_total, self.device

@@ -1,9 +1,11 @@
 """WeightedCalibration and its windowed variant.
 
 Extensions beyond the reference snapshot (see the functional module's note).
-Same state layout as :mod:`.click_through_rate`: two SUM scalars per task,
-and for the windowed variant a bounded per-update window via the shared
-:mod:`._windowed` mixin.
+Same state layout as :mod:`.click_through_rate`: two SUM scalars per task —
+and the same lane split: the plain class is **deferred**
+(``metrics/deferred.py``), the windowed variant stays eager because its
+bounded per-update window (shared :mod:`._windowed` mixin) must see every
+batch as its own row.
 """
 
 from __future__ import annotations
@@ -17,18 +19,23 @@ from torcheval_tpu.metrics.classification._windowed import WindowedStateMixin
 from torcheval_tpu.metrics.classification.click_through_rate import (
     _check_num_tasks,
 )
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.classification.weighted_calibration import (
     _calibration_compute,
+    _calibration_fold,
+    _calibration_input_check,
     _weighted_calibration_update,
 )
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
+from torcheval_tpu.utils.convert import as_jax
 from torcheval_tpu.utils.devices import DeviceLike
 
 
 def _fold_calibration(metric, input, target, weight):
     """Place inputs, run the fold, normalize to the ``(num_tasks,)`` axis —
-    shared by the plain and windowed classes (see ``_fold_ctr``)."""
+    the eager helper the windowed class still uses per update (see
+    ``_fold_ctr``)."""
     input, target = metric._input(input), metric._input(target)
     if weight is not None and hasattr(weight, "shape"):
         weight = metric._input(weight)
@@ -41,8 +48,25 @@ def _fold_calibration(metric, input, target, weight):
     )
 
 
-class WeightedCalibration(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py). Weighted updates
+# defer the weight as a third chunk column, so the trailing statics are
+# parsed by arity: rest == (num_tasks,) or (weight, num_tasks).
+def _calibration_deferred_fold(input, target, *rest):
+    num_tasks = rest[-1]
+    weight = rest[0] if len(rest) == 2 else 1.0
+    pred, label = _calibration_fold(input, target, as_jax(weight))
+    return {
+        "weighted_input_sum": jnp.reshape(pred, (num_tasks,)),
+        "weighted_label_sum": jnp.reshape(label, (num_tasks,)),
+    }
+
+
+class WeightedCalibration(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming ``sum(w * input) / sum(w * target)`` per task."""
+
+    _fold_fn = staticmethod(_calibration_deferred_fold)
+    _fold_per_chunk = True
 
     def __init__(
         self, *, num_tasks: int = 1, device: DeviceLike = None
@@ -56,6 +80,8 @@ class WeightedCalibration(Metric[jax.Array]):
                 zeros_state((num_tasks,), dtype=jnp.float32),
                 reduction=Reduction.SUM,
             )
+        self._init_deferred()
+        self._fold_params = (num_tasks,)
 
     def update(
         self,
@@ -63,12 +89,23 @@ class WeightedCalibration(Metric[jax.Array]):
         target,
         weight: Union[float, int, jax.Array, None] = None,
     ) -> "WeightedCalibration":
-        pred, label = _fold_calibration(self, input, target, weight)
-        self.weighted_input_sum = self.weighted_input_sum + pred
-        self.weighted_label_sum = self.weighted_label_sum + label
+        input, target = self._input(input), self._input(target)
+        if weight is None:
+            _calibration_input_check(input, target, self.num_tasks, None)
+            self._defer(input, target)
+            return self
+        if isinstance(weight, (int, float)):
+            weight = as_jax(weight)
+        else:
+            weight = self._input(weight)
+        _calibration_input_check(
+            input, target, self.num_tasks, weight if weight.ndim else None
+        )
+        self._defer(input, target, weight)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return _calibration_compute(
             self.weighted_input_sum, self.weighted_label_sum
         )
@@ -76,6 +113,10 @@ class WeightedCalibration(Metric[jax.Array]):
     def merge_state(
         self, metrics: Iterable["WeightedCalibration"]
     ) -> "WeightedCalibration":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.weighted_input_sum = self.weighted_input_sum + jax.device_put(
                 metric.weighted_input_sum, self.device

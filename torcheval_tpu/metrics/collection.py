@@ -1,30 +1,42 @@
 """MetricCollection: drive many metrics from one batch with minimal dispatch.
 
 SURVEY §3.1 names the goal for the hot loop: "a single fused jit'd XLA
-computation (donated state in HBM)". Three lanes exist, picked per member:
+computation (donated state in HBM)". Since the lane unification (ISSUE 2)
+the collection has ONE device pipeline and one host pipeline:
 
-* **Deferred counter metrics** (``metrics/deferred.py``: accuracy family,
-  F1/precision/recall, confusion matrices) already make ``update`` an O(1)
-  host append with a bulk fused fold later — strictly better than
-  one-dispatch-per-batch fusion, so the collection leaves them on that path
-  (re-tracing them here would drag them back to per-batch kernels).
-* **Fusable array-state metrics** (regression, NE, Sum/Mean/Max/Min): traced
-  once into a single jitted step over the joint state pytree, with the state
-  **donated** so accumulators live in HBM and update in place — one dispatch
-  per batch for all of them together.
+* **Deferred array-state metrics** (``metrics/deferred.py``: the counter
+  families, regression/NE sufficient statistics, Sum/Mean/Max/Min, CTR,
+  calibration) make ``update`` an O(1) host append. The collection owns the
+  fold trigger: all deferred members' pending batches fold TOGETHER in one
+  XLA program per budget window (``group_fold``), so XLA CSEs their shared
+  math, and under a steady constant-batch loop the fold runs the scan-based
+  stacked path with an O(1) trace and retrace-signature space. This replaced
+  the old per-batch fused ``collection.step`` jit — one dispatch per batch
+  was still O(batches) dispatches; one fold per budget window is
+  O(total_bytes / budget).
 * **Host-state metrics** (sample caches, dict/deque fixtures, Throughput's
   host scalars): eager path; their updates are O(1) host appends and were
   never dispatch-bound.
 
 Whatever the lane, the collection converts/places each batch argument ONCE
-(via the first metric's ``_input``) and hands every member the same placed
-arrays — k metrics never pay k host→device transfers, and deferring members'
-pending lists share one buffer per batch.
+(via the first metric's ``_input``, resolved at construction) and hands every
+member the same placed arrays — k metrics never pay k host→device transfers,
+and deferring members' pending lists share one buffer per batch. The
+per-argument "is this an array-like that needs placement" dispatch is
+memoised per *type* at first sight, so the steady-loop ``update()`` does no
+``hasattr`` protocol probing.
 
-Donation caveat: after an ``update()`` (fused lane) or a deferred fold,
-previously captured references to a member's state arrays are invalid (their
-buffers were donated). Read state through the metric/collection (``compute``,
-``state_dict``) instead of holding raw array refs across updates.
+A custom third-party metric with array state that does not opt into
+``DeferredFoldMixin`` simply runs its own eager ``update`` per batch — the
+pre-unification fused lane that re-traced such metrics into a per-batch
+program is gone (it measured *slower* than deferral and forced a
+``_states()``/``_set_states()`` save-restore round trip on every update).
+
+Donation caveat (unchanged semantics, new trigger): after a deferred fold,
+previously captured references to a member's state arrays are invalid on
+donating backends (their buffers were donated). Read state through the
+metric/collection (``compute``, ``state_dict``) instead of holding raw array
+refs across updates.
 """
 
 from __future__ import annotations
@@ -32,29 +44,26 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, Union
 
-import jax
-
 from torcheval_tpu.metrics.deferred import group_fold
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.obs.annotate import traced as _traced
-from torcheval_tpu.obs.recompile import watched_jit as _watched_jit
 
 _logger = logging.getLogger(__name__)
 
+# type -> needs-placement decision, memoised at first sight: the array-like
+# protocols (__array__ / __dlpack__) are class-level in every real producer
+# (numpy, torch, jax), so two hasattr probes per ARG TYPE replace two per
+# arg per update call.
+_placeable_types: Dict[type, bool] = {}
 
-def _is_fusable(metric: Metric) -> bool:
-    """Array-state metrics trace; container-state metrics stay eager.
 
-    Deferred-fold metrics (``metrics/deferred.py``) are excluded: their
-    ``update`` is already an O(1) host append folded in bulk later, which
-    beats one-dispatch-per-batch fusion — re-tracing them here would only
-    drag them back to the eager per-batch kernel."""
-    if getattr(metric, "_defers", False):
-        return False
-    return all(
-        isinstance(v, jax.Array)
-        for v in (metric._states() or {"": None}).values()
-    ) and bool(metric._states())
+def _needs_placement(t: type) -> bool:
+    flag = _placeable_types.get(t)
+    if flag is None:
+        flag = _placeable_types[t] = bool(
+            hasattr(t, "__array__") or hasattr(t, "__dlpack__")
+        )
+    return flag
 
 
 class MetricCollection:
@@ -66,7 +75,7 @@ class MetricCollection:
         col = MetricCollection({
             "acc": MulticlassAccuracy(num_classes=1000),   # deferred append
             "f1": MulticlassF1Score(num_classes=1000, average="macro"),
-            "mse": MeanSquaredError(),    # fusable: one jitted dispatch
+            "mse": MeanSquaredError(),    # deferred append (same fold program)
             "auroc": BinaryAUROC(),       # cache metric: eager append
         })
         for scores, labels in loader:
@@ -84,8 +93,6 @@ class MetricCollection:
         )
         if not self.metrics:
             raise ValueError("MetricCollection needs at least one metric.")
-        self._fused = [n for n, m in self.metrics.items() if _is_fusable(m)]
-        self._eager = [n for n in self.metrics if n not in self._fused]
         # deferred members fold TOGETHER (one dispatch, shared subcomputations
         # CSE'd by XLA) with the collection owning the fold trigger
         self._deferred = {
@@ -93,71 +100,40 @@ class MetricCollection:
         }
         for m in self._deferred.values():
             m._defer_managed = True
-        self._step = self._build_step() if self._fused else None
-
-    def _build_step(self):
-        fused, metrics = self._fused, self.metrics
-
-        def step(states: Dict[str, Dict[str, jax.Array]], args, kwargs):
-            out: Dict[str, Dict[str, jax.Array]] = {}
-            for name in fused:
-                m = metrics[name]
-                saved = m._states()
-                try:
-                    m._set_states(states[name])
-                    m.update(*args, **kwargs)
-                    out[name] = m._states()
-                finally:
-                    m._set_states(saved)
-            return out
-
-        from torcheval_tpu.utils.platform import donation_pipelines
-
-        # donation keeps the accumulators updating in place in HBM; on a
-        # tunneled backend it serialises dispatches instead (7x slower
-        # measured) — see utils/platform.py. watched_jit: the fused step is
-        # the canonical place a drifting batch signature turns into a
-        # retrace storm, and its HLO carries the collection's scope name.
-        if donation_pipelines():
-            return _watched_jit(step, name="collection.step", donate_argnums=0)
-        return _watched_jit(step, name="collection.step")
+        # hot-loop precomputation (host-overhead diet): the placement closure,
+        # the members' bound update methods, and the budget probe are all
+        # resolved once here instead of per update() call
+        self._place = next(iter(self.metrics.values()))._input
+        self._member_updates = tuple(m.update for m in self.metrics.values())
+        self._defer_probe = (
+            next(iter(self._deferred.values())) if self._deferred else None
+        )
 
     @_traced("collection.update")
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
         # convert + place each batch argument ONCE for the whole collection:
-        # torch/numpy batches must land on the metrics' device before the jit
-        # boundary anyway (the traced update's _input is a passthrough for
-        # tracers), and eager/deferred members then hit _input's already-
+        # torch/numpy batches must land on the metrics' device before any
+        # fold anyway, and eager/deferred members then hit _input's already-
         # placed fast path instead of re-transferring per metric
-        place = next(iter(self.metrics.values()))._input
+        place = self._place
         args = tuple(
-            place(a)
-            if hasattr(a, "__array__") or hasattr(a, "__dlpack__")
-            else a
-            for a in args
+            place(a) if _needs_placement(type(a)) else a for a in args
         )
-        kwargs = {
-            k: place(v)
-            if hasattr(v, "__array__") or hasattr(v, "__dlpack__")
-            else v
-            for k, v in kwargs.items()
-        }
-        if self._step is not None:
-            states = {n: self.metrics[n]._states() for n in self._fused}
-            new_states = self._step(states, args, kwargs)
-            for name in self._fused:
-                self.metrics[name]._set_states(new_states[name])
-        for name in self._eager:
-            self.metrics[name].update(*args, **kwargs)
-        if self._deferred:
+        if kwargs:
+            kwargs = {
+                k: place(v) if _needs_placement(type(v)) else v
+                for k, v in kwargs.items()
+            }
+        for member_update in self._member_updates:
+            member_update(*args, **kwargs)
+        probe = self._defer_probe
+        if probe is not None and (
             # collection-owned budget trigger: every deferred member carries
             # the same pending arrays, so one member's budget speaks for all
-            probe = next(iter(self._deferred.values()))
-            if (
-                probe._pending_bytes >= probe._DEFER_BUDGET_BYTES
-                or len(probe._pending) >= probe._DEFER_MAX_CHUNKS
-            ):
-                group_fold(self._deferred)
+            probe._pending_bytes >= probe._DEFER_BUDGET_BYTES
+            or len(probe._pending) >= probe._DEFER_MAX_CHUNKS
+        ):
+            group_fold(self._deferred)
         return self
 
     @_traced("collection.compute")
@@ -182,6 +158,6 @@ class MetricCollection:
 
     def __repr__(self) -> str:
         kinds = ", ".join(
-            f"{n}{'*' if n in self._fused else ''}" for n in self.metrics
+            f"{n}{'*' if n in self._deferred else ''}" for n in self.metrics
         )
-        return f"MetricCollection({kinds})  (* = fused)"
+        return f"MetricCollection({kinds})  (* = deferred)"

@@ -8,10 +8,10 @@ this project's tunneled chip, 0.2-5 ms of transport). Worse, per-batch
 kernels are *small*: a (8192, 5) argmax+compare keeps the chip busy for tens
 of microseconds; the round trip dominates by 10-100×.
 
-So counter metrics here do not fold per batch. ``update()`` validates shapes
+So deferring metrics here do not fold per batch. ``update()`` validates shapes
 (host metadata only), places the arrays, and **appends them to a pending
-list**. The actual math runs later as ONE fused XLA program over the
-concatenated pending batches, triggered by:
+list**. The actual math runs later as ONE fused XLA program over the pending
+batches, triggered by:
 
 * a read of the logical state — ``compute`` / ``state_dict`` / ``to`` /
   ``merge_state`` / pickling / deepcopy / ``_prepare_for_merge_state``;
@@ -19,14 +19,51 @@ concatenated pending batches, triggered by:
   chunk-count cap (``_DEFER_MAX_CHUNKS``), so an unbounded stream folds
   periodically and pending device buffers can be freed.
 
-This is strictly better on TPU for two measured reasons (docs/performance.md):
-dispatch count drops from O(batches) to O(total_bytes / budget), and the big
-fused fold lets the auto-picked lowering ride its *large-N* regime — e.g. the
-confusion update at (N=1.3M, C=1000) runs the flat joint scatter at ~110M
-preds/s where 13 separate 100k-batch one-hot matmuls manage ~24M.
+Since the lane unification (ISSUE 2) the mixin carries every array-state
+metric — the counter families (accuracy, F1/precision/recall, confusion),
+the regression/NE sufficient-statistic metrics, and the aggregations
+(Sum/Mean and, via a state-threading reduce, Max/Min) — so a whole
+``MetricCollection`` folds in one XLA program per budget window and XLA
+CSEs the members' shared math.
+
+The fold itself has two physical shapes, picked at trace time per pending
+signature — always ONE dispatch either way:
+
+* **Scan fold (the steady-loop path).** When every pending chunk shares one
+  full ``(shape, dtype)`` signature — the common case in a constant-batch
+  eval loop — the fold program stacks the chunks into ONE
+  ``(num_chunks, batch, ...)`` operand per update argument and runs
+  ``jax.lax.scan`` over the leading axis (Podracer's
+  many-logical-steps-in-one-device-program recipe, arXiv:2104.06272).
+  The metric math (``fold_fn``) is traced ONCE as the scan body instead of
+  being unrolled per chunk, so trace size and compile time are O(1) in the
+  chunk count, and the retrace-signature space is O(1) per batch shape — a
+  steady constant-batch loop compiles ``deferred.fold`` at most twice per
+  batch shape (the valve-cadence chunk count plus the final partial flush),
+  which the ``obs`` recompile watchdog verifies. The stack happens INSIDE
+  the jitted program: stacking on the host would pay one extra dispatch per
+  update argument, and dispatches are the scarce resource on a tunneled
+  chip. Applies to per-sample-reduce folds (``_fold_per_chunk``);
+  state threads through the scan carry, which is how non-additive states
+  (Max/Min extrema via ``_fold_reduce``) ride the same machinery.
+* **Concat fold (everything else).** Concat-regime folds
+  (``_fold_per_chunk = False``) take one ``jnp.concatenate`` over the
+  pending columns — their count kernels want the whole stream as a single
+  large-N operand. Ragged chunk signatures under a per-sample-reduce fold
+  take the per-chunk accumulation loop (correct for any shape mix, trace is
+  O(chunk count) — which is why the scan path exists). Mesh-sharded pending
+  chunks also keep this path: the SPMD partitioner, not a leading stack
+  axis, should own the batch dimension.
+
+Concat-regime folds (``_fold_per_chunk = False``: confusion, F1 triples)
+still see the whole stream as one large-N operand either way, so the
+auto-picked lowering rides its *large-N* regime — e.g. the confusion update
+at (N=1.3M, C=1000) runs the flat joint scatter at ~110M preds/s where 13
+separate 100k-batch one-hot matmuls manage ~24M (docs/performance.md).
 
 Semantics are unchanged: folding is a physical-representation change with the
-same logical state (counts are integer — grouping cannot change them), the
+same logical state (sums and extrema are order-insensitive — grouping cannot
+change them beyond float associativity, and counts are integer-exact), the
 same trick the reference itself plays in ``_prepare_for_merge_state``
 (``metric.py:112-121``). Two visible differences, documented here:
 
@@ -37,29 +74,35 @@ same trick the reference itself plays in ``_prepare_for_merge_state``
   batch size) see one or two signatures; wildly varying batch shapes fall
   back to more compiles, never wrong results. Mixed signatures (e.g. a
   (N, C) score batch after (N,) label batches) flush the pending list first
-  so one concatenation never mixes ranks.
+  so one fold never mixes ranks.
 
 Tracer transparency: when ``update`` is called inside someone else's trace
 (a user jitting their eval step around a metric), deferral would leak
 tracers into the pending list — so tracer args take the eager fold path,
 which is exactly the pre-deferral behavior.
 
-Donation caveat (same as ``MetricCollection``'s fused lane): on backends
-where ``donation_pipelines()`` is true, a fold donates the previous state
-buffers. A raw reference captured from a state attribute (``ref =
-m.num_total``) dies at the next fold — read state through ``state_dict()``
-/ ``compute()`` instead of holding array refs across updates.
+Donation caveat: on backends where ``donation_pipelines()`` is true, a fold
+donates the previous state buffers. A raw reference captured from a state
+attribute (``ref = m.num_total``) dies at the next fold — read state through
+``state_dict()`` / ``compute()`` instead of holding array refs across
+updates.
+
+Observability: every fold dispatch increments ``deferred.folds{entry=,path=}``
+(and ``deferred.folded_chunks{entry=}`` with the chunk count) in the obs
+registry while obs is enabled — the counters a dispatch-count regression
+test asserts O(1) programs per budget window on (tests/obs).
 """
 
 from __future__ import annotations
 
 import weakref
 from functools import partial
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.recompile import watched_jit as _watched_jit
 
 
@@ -98,21 +141,84 @@ def _is_prefix(short, long) -> bool:
     )
 
 
-def _fold_deltas(chunks, fold_fn, fold_params, per_chunk):
+def _add(state, delta):
+    return state + delta
+
+
+def _combine(states, deltas, fold_reduce):
+    """Merge ``deltas`` into ``states`` with the metric's reduce (add for
+    accumulator states, max/min for extrema — the state-threading fold that
+    lets non-additive states ride the same machinery). EVERY state is
+    returned (merged), not just the delta'd ones: under donation all input
+    buffers are invalidated, so an untouched state must still be threaded
+    through to a live output buffer."""
+    red = _add if fold_reduce is None else fold_reduce
+    return {**states, **{n: red(states[n], d) for n, d in deltas.items()}}
+
+
+def _uniform_chunks(chunks) -> bool:
+    """Every chunk shares one full (shape, dtype) signature. Shapes are
+    static inside a trace, so the fold bodies branch on this at TRACE time —
+    the compiled program contains only the selected path."""
+    head = chunks[0]
+    for c in chunks[1:]:
+        if len(c) != len(head):
+            return False
+        for x, h in zip(c, head):
+            if x.shape != h.shape or x.dtype != h.dtype:
+                return False
+    return True
+
+
+def _scan_fold(states_by_key, chunks, specs):
+    """State-threading scan fold of uniform chunks for one or more
+    ``(key, fold_fn, fold_params, fold_reduce)`` specs — the single shared
+    scan recipe for the solo and group dispatch bodies (each member's fold
+    runs inside ONE ``lax.scan`` step, so shared math dedupes per step).
+
+    The chunks past the first stack INSIDE the program (a host-side stack
+    would pay an extra dispatch per column) into one
+    ``(num_chunks - 1, batch, ...)`` operand per column, and ``lax.scan``
+    folds them with the metric math traced ONCE. The first chunk folds
+    OUTSIDE the scan so dtype promotion settles the carry structure (an
+    int32 counter meeting a float delta promotes on the first combine; the
+    scan carry must be shape/dtype-stable)."""
+
+    def step(states, chunk):
+        return {
+            key: _combine(
+                states[key], fold_fn(*chunk, *fold_params), fold_reduce
+            )
+            for key, fold_fn, fold_params, fold_reduce in specs
+        }
+
+    carry = step(states_by_key, chunks[0])
+    if len(chunks) == 1:
+        return carry
+    rest = tuple(jnp.stack(cols, axis=0) for cols in zip(*chunks[1:]))
+    carry, _ = jax.lax.scan(
+        lambda c, chunk: (step(c, chunk), None), carry, rest
+    )
+    return carry
+
+
+def _fold_deltas(chunks, fold_fn, fold_params, per_chunk, fold_reduce):
     """Deltas over the pending batches: one kernel over the concatenated
     stream (count kernels want the large-N regime), or per-chunk kernels with
-    summed deltas when the fold is per-sample independent + reduce
+    reduced deltas when the fold is per-sample independent + reduce
     (``per_chunk``) — a many-operand ``jnp.concatenate`` measured ~1.4× the
     cost of per-chunk accumulation at 200 chunks on v5e, and count kernels
-    gain nothing from it there."""
+    gain nothing from it there. Ragged-signature fallback for per-chunk
+    folds; the steady-loop path is the scan fold (module doc)."""
     if per_chunk and len(chunks) > 1:
+        red = _add if fold_reduce is None else fold_reduce
         acc = None
         for chunk in chunks:
             deltas = fold_fn(*chunk, *fold_params)
             acc = (
                 deltas
                 if acc is None
-                else {n: acc[n] + d for n, d in deltas.items()}
+                else {n: red(acc[n], d) for n, d in deltas.items()}
             )
         return acc
     cat = tuple(
@@ -122,63 +228,122 @@ def _fold_deltas(chunks, fold_fn, fold_params, per_chunk):
     return fold_fn(*cat, *fold_params)
 
 
-def _fold_body(states, chunks, fold_fn, fold_params, per_chunk):
-    deltas = _fold_deltas(chunks, fold_fn, fold_params, per_chunk)
-    # return EVERY state (merged), not just the delta'd ones: under donation
-    # all input buffers are invalidated, so an untouched state must still be
-    # threaded through to a live output buffer
-    return {**states, **{n: states[n] + d for n, d in deltas.items()}}
+def _fold_body(
+    states, chunks, fold_fn, fold_params, per_chunk, fold_reduce, scan_ok
+):
+    if scan_ok and per_chunk and len(chunks) > 1 and _uniform_chunks(chunks):
+        spec = (("s", fold_fn, fold_params, fold_reduce),)
+        return _scan_fold({"s": states}, chunks, spec)["s"]
+    deltas = _fold_deltas(chunks, fold_fn, fold_params, per_chunk, fold_reduce)
+    return _combine(states, deltas, fold_reduce)
 
 
 # Module-level jitted dispatchers shared by ALL metric instances: the trace
 # cache keys on (fold_fn identity, fold_params, pending pytree signature), so
 # a fresh metric instance reuses the compiled fold instead of re-tracing a
 # wide concat program per instance (measured ~200 ms of host tracing for a
-# 200-chunk fold — more than the fold itself).
+# 200-chunk fold — more than the fold itself; the scan path cuts exactly
+# that cost to O(1)).
 # watched_jit: the deferred fold is the canonical retrace-storm site (the
 # trace cache keys on the pending pytree signature — wildly varying batch
-# shapes recompile the wide concat program per fold) and the watchdog's
-# per-signature counts make that visible; the scope name attributes the
-# fold's device time in XLA traces.
+# shapes recompile the fold per signature) and the watchdog's per-signature
+# counts make that visible; the scope name attributes the fold's device
+# time in XLA traces.
+_FOLD_STATICS = ("fold_fn", "fold_params", "per_chunk", "fold_reduce", "scan_ok")
 _fold_dispatch = partial(
-    _watched_jit,
-    name="deferred.fold",
-    static_argnames=("fold_fn", "fold_params", "per_chunk"),
+    _watched_jit, name="deferred.fold", static_argnames=_FOLD_STATICS
 )(_fold_body)
 _fold_dispatch_donated = partial(
     _watched_jit,
     name="deferred.fold",
-    static_argnames=("fold_fn", "fold_params", "per_chunk"),
+    static_argnames=_FOLD_STATICS,
     donate_argnums=(0,),
 )(_fold_body)
 
 
-def _group_fold_body(states_by_member, chunks, specs):
+def _group_fold_body(states_by_member, chunks, specs, scan_ok):
     """Fold SEVERAL metrics' pending batches (identical args) in one program.
 
     ``specs`` is a static tuple of ``(member_key, fold_fn, fold_params,
-    per_chunk)``. Because every member folds the same arrays inside one XLA
-    program, common subcomputations dedupe: a MulticlassConfusionMatrix and a
-    MulticlassF1Score over the same batch share the argmax and (depending on
-    lowerings) the count kernels instead of dispatching them twice.
+    per_chunk, fold_reduce)``. Because every member folds the same arrays
+    inside one XLA program, common subcomputations dedupe: a
+    MulticlassConfusionMatrix and a MulticlassF1Score over the same batch
+    share the argmax and (depending on lowerings) the count kernels instead
+    of dispatching them twice.
+
+    Under a uniform pending signature (and ``scan_ok``), every per-chunk
+    member folds inside ONE shared ``lax.scan`` whose carry holds all their
+    states — the members' shared math dedupes per scan step, not just per
+    program; concat-regime members keep their large-N concatenated operand
+    in the same program.
     """
+    uniform = (
+        scan_ok and len(chunks) > 1 and _uniform_chunks(chunks)
+    )
     out = {}
-    for key, fold_fn, fold_params, per_chunk in specs:
-        states = states_by_member[key]
-        deltas = _fold_deltas(chunks, fold_fn, fold_params, per_chunk)
-        out[key] = {**states, **{n: states[n] + d for n, d in deltas.items()}}
+    scan_specs = []
+    for spec in specs:
+        key, fold_fn, fold_params, per_chunk, fold_reduce = spec
+        if uniform and per_chunk:
+            scan_specs.append(spec)
+            continue
+        deltas = _fold_deltas(
+            chunks, fold_fn, fold_params, per_chunk, fold_reduce
+        )
+        out[key] = _combine(states_by_member[key], deltas, fold_reduce)
+    if scan_specs:
+        out.update(
+            _scan_fold(
+                {s[0]: states_by_member[s[0]] for s in scan_specs},
+                chunks,
+                tuple(
+                    (key, fold_fn, fold_params, fold_reduce)
+                    for key, fold_fn, fold_params, _, fold_reduce in scan_specs
+                ),
+            )
+        )
     return out
 
 
 _group_fold_dispatch = partial(
-    _watched_jit, name="deferred.group_fold", static_argnames=("specs",)
+    _watched_jit,
+    name="deferred.group_fold",
+    static_argnames=("specs", "scan_ok"),
 )(_group_fold_body)
 _group_fold_dispatch_donated = partial(
     _watched_jit,
     name="deferred.group_fold",
-    static_argnames=("specs",),
+    static_argnames=("specs", "scan_ok"),
     donate_argnums=(0,),
 )(_group_fold_body)
+
+
+def _scan_allowed(chunks) -> bool:
+    """Host-side gate for the scan path: single-device pending arrays only.
+    Mesh-sharded chunks keep the concat/per-chunk program — a leading stack
+    axis would fight the SPMD partitioner for the batch dimension. (Shape
+    uniformity is checked inside the trace, where shapes are static.)"""
+    for a in chunks[0]:
+        try:
+            if len(a.sharding.device_set) != 1:
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _member_spec(key, m) -> Tuple[Any, ...]:
+    """Static per-member fold spec for the group dispatchers."""
+    cls = type(m)
+    return (key, cls._fold_fn, m._fold_params, cls._fold_per_chunk, cls._fold_reduce)
+
+
+def _count_fold(entry: str, path: str, n_chunks: int) -> None:
+    """Obs accounting: one increment per fold *dispatch* — the quantity the
+    dispatch-count regression test bounds (O(1) programs per budget window,
+    never O(batches))."""
+    _obs.counter("deferred.folds", entry=entry, path=path)
+    _obs.counter("deferred.folded_chunks", float(n_chunks), entry=entry)
 
 
 def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
@@ -197,10 +362,7 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
             m._fold_now()
         return
     chunks = head
-    specs = tuple(
-        (key, type(m)._fold_fn, m._fold_params, type(m)._fold_per_chunk)
-        for key, m in members.items()
-    )
+    specs = tuple(_member_spec(key, m) for key, m in members.items())
     states = {
         key: {n: getattr(m, n) for n in m._state_name_to_default}
         for key, m in members.items()
@@ -212,7 +374,9 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
         if donation_pipelines()
         else _group_fold_dispatch
     )
-    new_states = dispatch(states, chunks, specs=specs)
+    scan_ok = _scan_allowed(chunks)
+    new_states = dispatch(states, chunks, specs=specs, scan_ok=scan_ok)
+    _count_fold("group_fold", "scan" if scan_ok else "concat", len(chunks))
     # clear pending only after a successful dispatch (see _fold_now)
     for m in pending:
         m._pending = []
@@ -223,13 +387,13 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
 
 
 class DeferredFoldMixin:
-    """Mixin for counter metrics: pending-batch cache + lazy fused fold.
+    """Mixin for array-state metrics: pending-batch cache + lazy fused fold.
 
     Contract for subclasses::
 
         def _my_fold(input, target, threshold):   # MODULE-level pure fn:
-            ...                                    # math on the CONCATENATED
-            return {"num_tp": ..., "num_fp": ...}  # args -> {state: delta}
+            ...                                    # math on one (stream of)
+            return {"num_tp": ..., "num_fp": ...}  # batches -> {state: delta}
 
         class MyMetric(DeferredFoldMixin, Metric[jax.Array]):
             _fold_fn = staticmethod(_my_fold)
@@ -247,11 +411,15 @@ class DeferredFoldMixin:
                 return self
 
     ``_fold_fn`` must be a module-level function (shared identity across
-    instances — it keys the shared jit cache) taking the concatenated update
-    args followed by ``*_fold_params``. ``compute``/``merge_state``
-    implementations must call ``_fold_now()`` (and fold merge sources) before
-    reading state; the :class:`Metric` base class folds in
-    ``state_dict``/``to``/``_prepare_for_merge_state``/pickle.
+    instances — it keys the shared jit cache) taking the update args (a whole
+    concatenated stream when ``_fold_per_chunk`` is False, one chunk at a
+    time otherwise) followed by ``*_fold_params``. Optional update arguments
+    (a per-sample weight) defer as extra positional chunk columns; the fold
+    fn discriminates on arity. Deltas merge into state with ``_fold_reduce``
+    (``None`` = add; ``jnp.maximum``/``jnp.minimum`` thread extrema states).
+    ``compute``/``merge_state`` implementations must call ``_fold_now()``
+    (and fold merge sources) before reading state; the :class:`Metric` base
+    class folds in ``state_dict``/``to``/``_prepare_for_merge_state``/pickle.
     """
 
     # pending-args budget before a fold is forced. 256 MB holds e.g. 32 chunks
@@ -259,22 +427,35 @@ class DeferredFoldMixin:
     # ~0.7 ns/byte of pending data even at the tunnel's worst measured
     # 5 ms/dispatch floor.
     _DEFER_BUDGET_BYTES: int = 1 << 28
-    # cap on pending chunk count: bounds the concat arity (trace size) and the
-    # shape-signature space for small-batch streams.
+    # cap on pending chunk count: bounds the stacked operand's leading axis
+    # (and, on the mixed-shape fallback, the concat arity / trace size) for
+    # small-batch streams. Under a steady constant-batch loop every
+    # valve-triggered fold fires at exactly this count, so the stacked fold
+    # sees ONE pending signature all stream long.
     _DEFER_MAX_CHUNKS: int = 256
-    _defers = True  # MetricCollection: do not re-fuse; deferral already fuses
+    _defers = True  # MetricCollection: deferral is the (only) fused lane
 
     _fold_params: Tuple[Any, ...] = ()
     # True for folds that are per-sample independent + reduce (accuracy
-    # family, binned threshold counts): per-chunk kernels with summed deltas
-    # beat a many-operand concat. Count kernels (confusion, F1 triples) keep
-    # the concat to stay in their measured large-N regime.
+    # family, regression/NE sufficient statistics, aggregations): the scan
+    # path folds chunk-wise with the math traced once, and the ragged
+    # fallback accumulates per chunk — both beat a many-operand concat.
+    # Count kernels (confusion, F1 triples) keep the concat to stay in
+    # their measured large-N regime.
     _fold_per_chunk: bool = False
+    # None = states merge by addition. Non-additive states (Max/Min extrema)
+    # set a module-level combine (e.g. ``staticmethod(jnp.maximum)``) and the
+    # fold threads state through it instead.
+    _fold_reduce: Optional[Any] = None
 
     def _init_deferred(self) -> None:
         global _defer_seq_counter
         self._pending: List[Tuple[jax.Array, ...]] = []
         self._pending_bytes = 0
+        # cached (ndim, dtype, trailing-shape) signature of the chunks in
+        # _pending — _defer compares one tuple instead of re-deriving the
+        # head chunk's signature attribute-by-attribute on every call
+        self._pending_sig: Optional[Tuple[Any, ...]] = None
         # registration order: the stable tie-break for group-member ordering
         # (jit caches on the static specs tuple; WeakSet iteration order and
         # id() are both unstable)
@@ -293,18 +474,14 @@ class DeferredFoldMixin:
             # its trace in the pending list
             self._apply_deltas(self._fold_kernel(*args))
             return
-        if self._pending:
-            head = self._pending[0]
-            if len(head) != len(args) or any(
-                h.ndim != a.ndim
-                or h.shape[1:] != a.shape[1:]
-                or h.dtype != a.dtype
-                for h, a in zip(head, args)
-            ):
-                # rank/width/dtype change: concatenation would be illegal (or
-                # silently promote) — flush the old signature first
-                self._fold_now()
+        sig = tuple((a.ndim, a.dtype, a.shape[1:]) for a in args)
+        if self._pending and sig != self._pending_sig:
+            # arity/rank/width/dtype change: one fold never mixes signatures
+            # (concatenation would be illegal or silently promote) — flush
+            # the old signature FIRST, then append the new chunk
+            self._fold_now()
         self._pending.append(args)
+        self._pending_sig = sig
         self._pending_bytes += sum(int(a.nbytes) for a in args)
         # _defer_managed: a MetricCollection owns the fold trigger so sibling
         # metrics fold in ONE dispatch (XLA CSEs shared math, e.g. confusion
@@ -327,8 +504,9 @@ class DeferredFoldMixin:
                 self._fold_now()
 
     def _apply_deltas(self, deltas: Dict[str, jax.Array]) -> None:
+        red = type(self)._fold_reduce or _add
         for name, delta in deltas.items():
-            setattr(self, name, getattr(self, name) + delta)
+            setattr(self, name, red(getattr(self, name), delta))
 
     def _group_fold_attempt(self) -> None:
         """Fold the longest common pending-chunk prefix shared with live
@@ -365,8 +543,7 @@ class DeferredFoldMixin:
         if not all(_is_prefix(chunks, m._pending) for m in group):
             return
         specs = tuple(
-            (str(i), type(m)._fold_fn, m._fold_params, type(m)._fold_per_chunk)
-            for i, m in enumerate(group)
+            _member_spec(str(i), m) for i, m in enumerate(group)
         )
         states = {
             str(i): {n: getattr(m, n) for n in m._state_name_to_default}
@@ -379,7 +556,11 @@ class DeferredFoldMixin:
             if donation_pipelines()
             else _group_fold_dispatch
         )
-        new_states = dispatch(states, chunks, specs=specs)
+        scan_ok = _scan_allowed(chunks)
+        new_states = dispatch(states, chunks, specs=specs, scan_ok=scan_ok)
+        _count_fold(
+            "group_fold", "scan" if scan_ok else "concat", len(chunks)
+        )
         for i, m in enumerate(group):
             m._pending = m._pending[common:]
             m._pending_bytes = sum(
@@ -389,7 +570,7 @@ class DeferredFoldMixin:
                 setattr(m, n, v)
 
     def _fold_now(self) -> None:
-        """Fold all pending batches into the counter state: one dispatch —
+        """Fold all pending batches into the metric state: one dispatch —
         shared with every standalone peer metric whose pending chunks are
         an identity-prefix match (see :meth:`_group_fold_attempt`); any
         remainder folds solo so the full-fold contract holds."""
@@ -408,13 +589,18 @@ class DeferredFoldMixin:
             _fold_dispatch_donated if donation_pipelines() else _fold_dispatch
         )
         states = {n: getattr(self, n) for n in self._state_name_to_default}
+        cls = type(self)
+        scan_ok = _scan_allowed(pending)
         new_states = dispatch(
             states,
             pending,
-            fold_fn=type(self)._fold_fn,
+            fold_fn=cls._fold_fn,
             fold_params=self._fold_params,
-            per_chunk=type(self)._fold_per_chunk,
+            per_chunk=cls._fold_per_chunk,
+            fold_reduce=cls._fold_reduce,
+            scan_ok=scan_ok,
         )
+        _count_fold("fold", "scan" if scan_ok else "concat", len(pending))
         # clear pending only after a successful dispatch: a fold that raises
         # (bad batch reaching the trace) must not silently discard the valid
         # batches queued alongside it
@@ -427,6 +613,7 @@ class DeferredFoldMixin:
     def reset(self):
         self._pending = []
         self._pending_bytes = 0
+        self._pending_sig = None
         return super().reset()
 
     def load_state_dict(self, state_dict, strict: bool = True) -> None:
@@ -434,6 +621,7 @@ class DeferredFoldMixin:
         # to the stream being replaced and are dropped with it
         self._pending = []
         self._pending_bytes = 0
+        self._pending_sig = None
         super().load_state_dict(state_dict, strict)
 
     def __getstate__(self) -> Dict[str, Any]:
@@ -450,6 +638,7 @@ class DeferredFoldMixin:
         # restored metrics must be visible to peers' group folds again
         self._pending = []
         self._pending_bytes = 0
+        self._pending_sig = None
         _live_deferred.add(self)
 
     def __deepcopy__(self, memo):

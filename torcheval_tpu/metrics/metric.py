@@ -180,8 +180,9 @@ class Metric(Generic[TComputeReturn], ABC):
         from torcheval_tpu.utils.convert import as_jax
 
         if isinstance(x, jax.core.Tracer):
-            # already inside a trace (MetricCollection's fused step): placement
-            # happened before the jit boundary; pass straight through
+            # already inside a trace (a user jitting their eval step around
+            # the metric): placement happened before the jit boundary; pass
+            # straight through
             return x
         arr = as_jax(x)
         if isinstance(arr, jax.Array):
@@ -220,7 +221,7 @@ class Metric(Generic[TComputeReturn], ABC):
         """Fold state into the final result. Idempotent on the logical state.
 
         Deferred metrics (``metrics/deferred.py``) first fold pending batches
-        into their counters — a physical-representation change that rebinds
+        into their state — a physical-representation change that rebinds
         the state attributes (and, on donating backends, deletes the old
         buffers) while preserving the logical value. Repeated ``compute``
         calls return the same result either way."""

@@ -5,6 +5,12 @@ The reference's ``sum_squared_error`` starts scalar and is lazily promoted to
 ``(n_output,)`` on the first 2-D update (``mean_squared_error.py:80-84,
 108-113``); here JAX broadcasting performs the same promotion for free —
 ``zeros(()) + vec`` yields ``vec``.
+
+Updates are **deferred** (``metrics/deferred.py``): each ``update()`` is an
+O(1) host append, and the squared-error fold runs over the pending batch
+stream in one fused dispatch at read time or on a memory budget — inside a
+``MetricCollection`` it shares that one program with every other deferred
+member.
 """
 
 from __future__ import annotations
@@ -14,17 +20,31 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_compute,
     _mean_squared_error_param_check,
-    _mean_squared_error_update,
+    _mean_squared_error_update_input_check,
+    _mse_fold,
+    _mse_fold_weighted,
 )
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class MeanSquaredError(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py). The optional sample
+# weight defers as a third chunk column; arity discriminates.
+def _mse_deferred_fold(input, target, sample_weight=None):
+    if sample_weight is None:
+        sse, sw = _mse_fold(input, target)
+    else:
+        sse, sw = _mse_fold_weighted(input, target, sample_weight)
+    return {"sum_squared_error": sse, "sum_weight": sw}
+
+
+class MeanSquaredError(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming mean squared error with optional per-sample weights.
 
     Args:
@@ -32,6 +52,9 @@ class MeanSquaredError(Metric[jax.Array]):
 
     Reference parity: ``regression/mean_squared_error.py:23-140``.
     """
+
+    _fold_fn = staticmethod(_mse_deferred_fold)
+    _fold_per_chunk = True
 
     def __init__(
         self,
@@ -44,10 +67,11 @@ class MeanSquaredError(Metric[jax.Array]):
         self.multioutput = multioutput
         self._add_state("sum_squared_error", zeros_state(), reduction=Reduction.SUM)
         # int32 while updates are unweighted (exact counting to 2**31);
-        # a weighted update promotes the accumulator to float32
+        # a weighted update promotes the accumulator to float32 at fold time
         self._add_state(
             "sum_weight", zeros_state((), dtype=jnp.int32), reduction=Reduction.SUM
         )
+        self._init_deferred()
 
     def update(
         self,
@@ -60,12 +84,15 @@ class MeanSquaredError(Metric[jax.Array]):
         target = self._input(target)
         if sample_weight is not None:
             sample_weight = self._input(sample_weight)
-        sse, sw = _mean_squared_error_update(input, target, sample_weight)
-        self.sum_squared_error = self.sum_squared_error + sse
-        self.sum_weight = self.sum_weight + sw
+        _mean_squared_error_update_input_check(input, target, sample_weight)
+        if sample_weight is None:
+            self._defer(input, target)
+        else:
+            self._defer(input, target, sample_weight)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return _mean_squared_error_compute(
             self.sum_squared_error, self.multioutput, self.sum_weight
         )
@@ -73,6 +100,10 @@ class MeanSquaredError(Metric[jax.Array]):
     def merge_state(
         self, metrics: Iterable["MeanSquaredError"]
     ) -> "MeanSquaredError":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             self.sum_squared_error = self.sum_squared_error + jax.device_put(
                 metric.sum_squared_error, self.device

@@ -1,4 +1,10 @@
-"""R2Score metric. Reference: ``torcheval/metrics/regression/r2_score.py``."""
+"""R2Score metric. Reference: ``torcheval/metrics/regression/r2_score.py``.
+
+Updates are **deferred** (``metrics/deferred.py``): the four sufficient
+statistics fold over the pending batch stream in one fused dispatch at read
+time or on a memory budget, shared with every other deferred member of a
+``MetricCollection``.
+"""
 
 from __future__ import annotations
 
@@ -7,10 +13,12 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.functional.regression.r2_score import (
+    _r2_fold,
     _r2_score_compute,
     _r2_score_param_check,
-    _r2_score_update,
+    _r2_score_update_input_check,
 )
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
@@ -24,7 +32,13 @@ _STATE_NAMES = (
 )
 
 
-class R2Score(Metric[jax.Array]):
+# module-level fold function: shared identity keys the deferred-fold jit
+# cache across metric instances (metrics/deferred.py)
+def _r2_deferred_fold(input, target):
+    return dict(zip(_STATE_NAMES, _r2_fold(input, target)))
+
+
+class R2Score(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming R-squared score over four sufficient statistics.
 
     Args:
@@ -35,6 +49,9 @@ class R2Score(Metric[jax.Array]):
 
     Reference parity: ``regression/r2_score.py:23-162``.
     """
+
+    _fold_fn = staticmethod(_r2_deferred_fold)
+    _fold_per_chunk = True
 
     def __init__(
         self,
@@ -55,16 +72,17 @@ class R2Score(Metric[jax.Array]):
                 else zeros_state()
             )
             self._add_state(name, default, reduction=Reduction.SUM)
+        self._init_deferred()
 
     def update(self, input, target) -> "R2Score":
         input = self._input(input)
         target = self._input(target)
-        stats = _r2_score_update(input, target)
-        for name, value in zip(_STATE_NAMES, stats):
-            setattr(self, name, getattr(self, name) + value)
+        _r2_score_update_input_check(input, target)
+        self._defer(input, target)
         return self
 
     def compute(self) -> jax.Array:
+        self._fold_now()
         return _r2_score_compute(
             self.sum_squared_obs,
             self.sum_obs,
@@ -75,6 +93,10 @@ class R2Score(Metric[jax.Array]):
         )
 
     def merge_state(self, metrics: Iterable["R2Score"]) -> "R2Score":
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
         for metric in metrics:
             for name in _STATE_NAMES:
                 setattr(

@@ -30,7 +30,14 @@ TSelf = TypeVar("TSelf", bound="SampleCacheMetric")
 class SampleCacheMetric(Metric[TComputeReturn]):
     """Metric whose state variables are lists of arrays concatenated on axis 0."""
 
-    def _add_cache_state(self, name: str) -> None:
+    def _add_cache_state(self, name: str, *, dtype=jnp.float32) -> None:
+        """Register a CAT cache. ``dtype`` declares the cache's element type,
+        which only matters on the empty-cache read path: an empty
+        ``compute()`` must still return an array of the dtype the metric
+        documents, not whatever ``jnp.empty`` defaults to."""
+        if not hasattr(self, "_cache_dtypes"):
+            self._cache_dtypes = {}
+        self._cache_dtypes[name] = jnp.dtype(dtype)
         self._add_state(name, [], reduction=Reduction.CAT)
 
     def _cache_names(self) -> List[str]:
@@ -40,10 +47,16 @@ class SampleCacheMetric(Metric[TComputeReturn]):
             if isinstance(default, list)
         ]
 
-    def _concat_cache(self, name: str, *, empty_shape=(0,)) -> jax.Array:
+    def _concat_cache(self, name: str, *, empty_shape=(0,), empty_dtype=None) -> jax.Array:
+        """Concatenate cache ``name`` (axis 0). An empty cache returns
+        ``jnp.empty(empty_shape, empty_dtype)`` — ``empty_dtype`` defaults to
+        the dtype declared at ``_add_cache_state`` time, so the empty read
+        does not silently degrade to float32 for integer caches."""
         cache = getattr(self, name)
         if not cache:
-            return jnp.empty(empty_shape)
+            if empty_dtype is None:
+                empty_dtype = getattr(self, "_cache_dtypes", {}).get(name)
+            return jnp.empty(empty_shape, dtype=empty_dtype)
         return jnp.concatenate(cache, axis=0)
 
     def merge_state(self: TSelf, metrics: Iterable[TSelf]) -> TSelf:

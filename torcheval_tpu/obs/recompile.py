@@ -116,11 +116,11 @@ def record_trace(
     store, and is what the storm warning fires on: a storm is many distinct
     DYNAMIC signatures for the SAME program — one jit instance, one static
     configuration. Counting any looser than that misreports legitimate
-    program diversity as a storm (several collections sharing the
-    \"collection.step\" label, or several metric classes' folds sharing
-    \"deferred.fold\" with distinct static fold_fns, each trace exactly
-    once). The module-wide ``_traces`` table keeps the full per-label view
-    for :func:`trace_counts`/export."""
+    program diversity as a storm (the concat and stacked fold dispatchers
+    sharing the \"deferred.fold\" label, or several metric classes' folds
+    sharing one dispatcher with distinct static fold_fns, each trace
+    exactly once). The module-wide ``_traces`` table keeps the full
+    per-label view for :func:`trace_counts`/export."""
     static_key, dynamic = split_signature(args, kwargs)
     with _lock:
         per_entry = _traces.setdefault(name, {})

@@ -56,8 +56,8 @@ class ShardedEvaluator:
         from torcheval_tpu.metrics.collection import MetricCollection
 
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
-        # the collection owns single-vs-dict wrapping, fuses every fusable
-        # metric's update into one jitted donated-state dispatch per batch,
+        # the collection owns single-vs-dict wrapping, folds every deferred
+        # member's pending batches in one SPMD program per budget window,
         # and is the delegate for compute/reset; cache metrics stay eager
         # inside it
         self._collection = MetricCollection(metrics)
@@ -68,10 +68,11 @@ class ShardedEvaluator:
 
     @_traced("evaluator.update")
     def update(self, *args: Any, **kwargs: Any) -> "ShardedEvaluator":
-        """Shard positional array arguments along the mesh data axis and fold
-        them into every metric — one fused dispatch for all array-state
-        metrics. Keyword arguments pass through unsharded (weights etc.
-        follow their positional companions' sharding via XLA)."""
+        """Shard positional array arguments along the mesh data axis and
+        queue them for every metric — array-state metrics defer and fold in
+        one SPMD program per budget window. Keyword arguments pass through
+        unsharded (weights etc. follow their positional companions' sharding
+        via XLA)."""
         sharded = tuple(
             shard_batch(self.mesh, a) if _is_batch_arraylike(a) else a
             for a in args
