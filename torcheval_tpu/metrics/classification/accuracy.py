@@ -59,9 +59,9 @@ def _mlacc_fold(input, target, threshold, criteria):
     return {"num_correct": num_correct, "num_total": num_total}
 
 
-def _topk_fold(input, target, criteria, k):
+def _topk_fold(input, target, criteria, k, topk_method):
     num_correct, num_total = _topk_multilabel_accuracy_update(
-        input, target, criteria, k
+        input, target, criteria, k, topk_method
     )
     return {"num_correct": num_correct, "num_total": num_total}
 
@@ -193,6 +193,13 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
     valve legitimately folds once per update there; the leg is bounded by
     the top-k kernel plus one dispatch floor per 328 MB batch, not by host
     eagerness (see bench.py::config4_topk_multilabel).
+
+    The top-k kernel inside the fold is the streaming selection engine
+    (``ops/topk.py``): at L=10k the ``auto`` pick streams label tiles
+    through VMEM (Pallas, TPU) or the threshold-prune two-stage sort (XLA
+    backends) instead of ``lax.top_k``'s full-width sort. ``topk_method``
+    forces one lowering — the bench's interleaved A/B legs pin
+    ``"dense"`` (the pre-engine baseline) against ``"auto"``.
     """
 
     _fold_fn = staticmethod(_topk_fold)
@@ -202,13 +209,24 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
         *,
         criteria: str = "exact_match",
         k: int = 2,
+        topk_method: str = "auto",
         device: DeviceLike = None,
     ) -> None:
         _topk_multilabel_accuracy_param_check(criteria, k)
+        # validate the engine method EAGERLY, like criteria/k above: updates
+        # defer, so a typo here would otherwise only surface at compute() —
+        # after the whole eval stream has been accepted
+        from torcheval_tpu.ops.topk import _METHODS
+
+        if topk_method not in _METHODS:
+            raise ValueError(
+                f"topk_method must be one of {_METHODS}, got {topk_method!r}."
+            )
         super().__init__(device=device)
         self.criteria = criteria
         self.k = k
-        self._fold_params = (criteria, k)
+        self.topk_method = topk_method
+        self._fold_params = (criteria, k, topk_method)
 
     def update(self, input, target) -> "TopKMultilabelAccuracy":
         input, target = self._input(input), self._input(target)

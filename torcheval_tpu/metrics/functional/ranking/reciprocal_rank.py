@@ -33,12 +33,31 @@ def _reciprocal_rank_input_check(input: jax.Array, target: jax.Array) -> None:
 def _reciprocal_rank_kernel(
     input: jax.Array, target: jax.Array, k: Optional[int]
 ) -> jax.Array:
+    from torcheval_tpu.ops.topk import _pick_method, topk_values
+
     target = target.astype(jnp.int32)
     y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
-    rank = jnp.sum(input > y_score, axis=-1)
-    score = 1.0 / (rank.astype(jnp.float32) + 1.0)
-    if k is not None:
-        score = jnp.where(rank >= k, 0.0, score)
+    if (
+        k is not None
+        and k < input.shape[-1]
+        and _pick_method(input.shape[-1], k, input.dtype, "auto") != "dense"
+    ):
+        # k-truncated path on the streaming top-k engine (ops/topk.py): only
+        # ranks < k matter, and against the k largest VALUES the truncated
+        # rank is exact — when the true rank r < k, all r elements above the
+        # target score are among the top-k values, so the count matches; when
+        # r >= k every top-k value beats the target and the count saturates
+        # at k, exactly the cutoff bucket. Strict `>` keeps the reference's
+        # tie semantics (equal scores never count against the target), so
+        # this is bit-identical to the full-width comparison below.
+        kv = topk_values(input.astype(jnp.float32), k)
+        rank = jnp.sum(kv > y_score.astype(jnp.float32), axis=-1)
+        score = jnp.where(rank >= k, 0.0, 1.0 / (rank.astype(jnp.float32) + 1.0))
+    else:
+        rank = jnp.sum(input > y_score, axis=-1)
+        score = 1.0 / (rank.astype(jnp.float32) + 1.0)
+        if k is not None:
+            score = jnp.where(rank >= k, 0.0, score)
     valid = (target >= 0) & (target < input.shape[-1])
     return jnp.where(valid, score, jnp.nan)
 

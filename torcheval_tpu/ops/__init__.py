@@ -9,6 +9,13 @@ from torcheval_tpu.ops.curves import (
     multiclass_prc_points_kernel,
     prc_points_kernel,
 )
+from torcheval_tpu.ops.topk import (
+    pallas_topk,
+    prune_topk,
+    topk,
+    topk_indices,
+    topk_values,
+)
 
 __all__ = [
     "binary_auprc_kernel",
@@ -16,6 +23,11 @@ __all__ = [
     "class_counts",
     "confusion_matrix_counts",
     "multiclass_prc_points_kernel",
+    "pallas_topk",
     "prc_points_kernel",
+    "prune_topk",
+    "topk",
+    "topk_indices",
     "topk_onehot",
+    "topk_values",
 ]

@@ -156,10 +156,10 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
 
     def body(t, _):
         # batched across _RANK_BATCH tiles: one mask load + ONE rank matmul
-        # serve the next _RANK_BATCH tiles (batching measured 397 -> 299 ms
-        # at 100M rows when it landed at width 4; width 8 took the 1B leg to
-        # 86.9M preds/s); the store/flush section stays strictly per tile so
-        # every staging invariant is unchanged
+        # serve the next _RANK_BATCH tiles (at the current _RANK_BATCH = 8
+        # a 100M-row pass measured 299 ms vs 410 ms unbatched, and the 1B
+        # headline leg reached 86.9M preds/s); the store/flush section stays
+        # strictly per tile so every staging invariant is unchanged
         mb = mask_ref[pl.ds(_RANK_BATCH * t, _RANK_BATCH), :]  # (B, 128)
         ranksb = jax.lax.dot_general(
             mb, utri, (((1,), (0,)), ((), ())),
@@ -217,8 +217,9 @@ def _compact_kernel(utri_ref, mask_ref, *refs, n_cols: int, unroll: int):
     # but unrolling still shaves loop control (part of the 410 -> 299 ms
     # measured on a 100M-row pass with the rank batching; outputs
     # bit-identical). Interpret mode keeps the rolled loop — a full unroll
-    # there re-executes the traced 4-tile body 16x per block and was
-    # measured to blow the CPU test suite up ~10x.
+    # there re-executes the traced _RANK_BATCH-tile body all
+    # _BLOCK/(128*_RANK_BATCH) times per block and was measured to blow the
+    # CPU test suite up ~10x.
     jax.lax.fori_loop(0, _BLOCK // (128 * _RANK_BATCH), body, 0, unroll=unroll)
 
     @pl.when(j == nsteps - 1)
