@@ -871,6 +871,62 @@ def config5_explicit_sync_4proc():
     )
 
 
+def checkpoint_overhead():
+    """ISSUE 5 satellite: the robustness tax as a measured number, not a
+    guess — save+restore wall time and on-disk bytes for the config1 metric
+    set checkpointed MID-STREAM (pending deferred chunks at save time, so
+    each timed save pays the fold a periodic checkpoint in a live eval loop
+    would). Restore goes into a fresh metric and is parity-checked against
+    the source before the rows are emitted."""
+    jax = _jax()
+    import shutil
+    import tempfile
+
+    from torcheval_tpu.metrics import MulticlassAccuracy
+    from torcheval_tpu.resilience import restore, save
+
+    rng = np.random.default_rng(0)
+    n_batches, batch = (4, 256) if _SMOKE else (100, 8192)
+    scores = rng.random((batch, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, batch)
+    js, jl = jax.device_put(scores), jax.device_put(labels)
+    jax.block_until_ready((js, jl))
+    m = MulticlassAccuracy(num_classes=5)
+    for _ in range(n_batches):
+        m.update(js, jl)
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        save_times = []
+        for _ in range(3):
+            m.update(js, jl)  # re-arm the mid-stream pending state
+            t0 = time.perf_counter()
+            path = save(m, ckpt_dir, keep_last=2)
+            save_times.append(time.perf_counter() - t0)
+        nbytes = float(
+            sum(
+                os.path.getsize(os.path.join(path, f))
+                for f in os.listdir(path)
+            )
+        )
+        fresh = MulticlassAccuracy(num_classes=5)
+        restore_times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            restore(fresh, path)
+            restore_times.append(time.perf_counter() - t0)
+        want, got = float(np.asarray(m.compute())), float(
+            np.asarray(fresh.compute())
+        )
+        assert got == want, f"checkpoint parity mismatch: {got} != {want}"
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    save_times.sort()
+    restore_times.sort()
+    _emit_row("checkpoint_overhead_save_ms", save_times[1] * 1e3, "ms")
+    _emit_row("checkpoint_overhead_restore_ms", restore_times[1] * 1e3, "ms")
+    _emit_row("checkpoint_overhead_bytes", nbytes, "bytes")
+
+
 def _measure_dispatch_floor():
     """The tunnel's per-dispatch execution cost, in seconds (see
     :func:`env_dispatch_floor` for why and how). Shared by the end-of-bench
@@ -949,6 +1005,9 @@ _EXPECTED_ROW_PREFIXES = (
     "config5_adjacent_dispatch_floor",
     "config5_floor_normalized_dispatches",
     "config5_explicit_sync_accuracy_4proc",
+    "checkpoint_overhead_save_ms",
+    "checkpoint_overhead_restore_ms",
+    "checkpoint_overhead_bytes",
     "env_dispatch_floor",
 )
 
@@ -979,6 +1038,7 @@ def main() -> None:
         config4_topk_multilabel,
         config5_sharded_sync,
         config5_explicit_sync_4proc,
+        checkpoint_overhead,
         env_dispatch_floor,
     ):
         try:

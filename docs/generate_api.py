@@ -30,6 +30,7 @@ MODULES = [
     "torcheval_tpu.metrics.deferred",
     "torcheval_tpu.obs",
     "torcheval_tpu.parallel",
+    "torcheval_tpu.resilience",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
     "torcheval_tpu.utils.test_utils",

@@ -5,7 +5,9 @@ is an O(1) append, the math folds lazily. These tests pin the lifecycle
 edges the collection tests don't reach: merges with pending batches on both
 sides, signature-change flushes, the tracer fallback inside an enclosing
 jit, pickling mid-stream, the byte-budget valve, and load_state_dict's
-drop-pending contract.
+fold-before-overwrite contract (ISSUE 5: a mid-window restore must be
+exact — stale pending chunks never fold into restored state, and partial
+loads keep their contribution in untouched states).
 """
 
 import pickle
@@ -258,32 +260,59 @@ class TestDeferredEdges(unittest.TestCase):
         )
         self.assertEqual(float(m.num_total), 2560.0)
 
-    def test_load_state_dict_drops_pending(self):
+    def test_load_state_dict_mid_window_restore_is_exact(self):
+        # ISSUE 5 satellite: pending chunks queued against the OLD state
+        # fold into it BEFORE the overwrite — they must never fold into the
+        # restored state on the next read (the checkpoint-restore shape:
+        # post-checkpoint batches are discarded with the stream they
+        # belong to)
         donor = MulticlassAccuracy(num_classes=4)
         x, t = _batch()
         donor.update(jnp.asarray(x), jnp.asarray(t))
         sd = donor.state_dict()
         m = MulticlassAccuracy(num_classes=4)
-        m.update(jnp.asarray(x[:8]), jnp.asarray(t[:8]))  # pending to drop
+        m.update(jnp.asarray(x[:8]), jnp.asarray(t[:8]))  # mid-window
+        self.assertTrue(m._pending)
         m.load_state_dict(sd)
-        # loading replaces the logical state wholesale: the pre-load pending
-        # batches belong to the replaced stream and must not leak in
+        self.assertEqual(m._pending, [])
         self.assertEqual(float(m.num_total), float(x.shape[0]))
         self.assertAlmostEqual(
             float(m.compute()), float((x.argmax(1) == t).mean()), places=6
         )
 
+    def test_partial_load_keeps_pending_contribution_in_untouched_states(self):
+        # strict=False naming only num_correct: the pending batch's
+        # contribution to num_total must survive (the old drop-pending
+        # behavior silently lost it)
+        m = MulticlassAccuracy(num_classes=4)
+        x, t = _batch(24)
+        m.update(jnp.asarray(x), jnp.asarray(t))  # pending, unfolded
+        m.load_state_dict({"num_correct": jnp.zeros(())}, strict=False)
+        self.assertEqual(float(m.state_dict()["num_total"]), 24.0)
+        self.assertEqual(float(m.state_dict()["num_correct"]), 0.0)
+
     def test_reset_discards_pending(self):
+        # ISSUE 5 satellite audit: a reset mid-window must drop the whole
+        # pending machinery (_pending / _pending_bytes / _pending_sig) so
+        # no pre-reset chunk can leak into the next fold
         m = MulticlassAccuracy(num_classes=4)
         x, t = _batch()
         m.update(jnp.asarray(x), jnp.asarray(t))
+        self.assertTrue(m._pending)
+        self.assertGreater(m._pending_bytes, 0)
+        self.assertIsNotNone(m._pending_sig)
         m.reset()
         self.assertEqual(m._pending, [])
+        self.assertEqual(m._pending_bytes, 0)
+        self.assertIsNone(m._pending_sig)
         x2, t2 = _batch(16)
         m.update(jnp.asarray(x2), jnp.asarray(t2))
         # read through state_dict: direct attribute reads see only the
         # folded-so-far value (documented deferral semantics)
         self.assertEqual(float(m.state_dict()["num_total"]), 16.0)
+        self.assertAlmostEqual(
+            float(m.compute()), float((x2.argmax(1) == t2).mean()), places=6
+        )
 
 
 class TestDeferValves(unittest.TestCase):

@@ -11,6 +11,8 @@ import sys
 import unittest
 from unittest import mock
 
+import jax
+
 from torcheval_tpu.parallel import init_from_env, is_initialized
 from torcheval_tpu.parallel.bootstrap import _resolve_env
 
@@ -153,6 +155,156 @@ class TestInitFromEnvSingleProcess(unittest.TestCase):
         # misconfiguration — must not raise
         self.assertEqual(init_from_env(), (0, 1))
         self.assertFalse(is_initialized())
+
+
+_RETRY_ENV = {
+    "MASTER_ADDR": "localhost",
+    "MASTER_PORT": "29999",
+    "WORLD_SIZE": "4",
+    "RANK": "1",
+}
+
+
+@mock.patch.dict(os.environ, _RETRY_ENV, clear=True)
+class TestConnectRetry(unittest.TestCase):
+    """ISSUE 5: coordinator connection retries with bounded exponential
+    backoff + jitter. ``jax.distributed.initialize`` is mocked — the real
+    multi-process join is covered by the mp test workers — so these pin the
+    retry policy itself: which errors retry, how many times, how long the
+    sleeps are, and the ``bootstrap.retries`` obs counter.
+
+    ``_enable_cpu_collectives`` is stubbed: with initialize mocked there is
+    no distributed client, and selecting gloo without one poisons CPU
+    backend creation for the rest of the process."""
+
+    def setUp(self):
+        from torcheval_tpu.parallel import bootstrap
+
+        p = mock.patch.object(
+            bootstrap, "_enable_cpu_collectives", lambda: None
+        )
+        p.start()
+        self.addCleanup(p.stop)
+
+    def test_connection_failure_retries_then_succeeds(self):
+        from torcheval_tpu import obs
+        from torcheval_tpu.parallel import bootstrap
+
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky(**kwargs):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("failed to connect to coordinator")
+
+        obs.enable()
+        try:
+            obs.reset()
+            with mock.patch.object(jax.distributed, "initialize", flaky), \
+                    mock.patch.object(bootstrap.time, "sleep", sleeps.append):
+                got = init_from_env(connect_backoff_s=1.0)
+            snap = obs.snapshot()["counters"]
+        finally:
+            obs.disable()
+            obs.reset()
+        self.assertEqual(calls["n"], 3)
+        self.assertEqual(got, (jax.process_index(), jax.process_count()))
+        self.assertEqual(snap["bootstrap.retries"], 2.0)
+        # exponential base with 0.5-1.5x jitter: 1s then 2s nominal
+        self.assertEqual(len(sleeps), 2)
+        self.assertTrue(0.5 <= sleeps[0] <= 1.5, sleeps)
+        self.assertTrue(1.0 <= sleeps[1] <= 3.0, sleeps)
+
+    def test_failed_attempt_resets_partial_init_before_retry(self):
+        # the runtime assigns its client object BEFORE the connection
+        # attempt, so a connect failure leaves is_initialized() true and a
+        # naive retry raises "should only be called once" forever — each
+        # failed attempt must clear that state before the next initialize
+        from jax._src.distributed import global_state
+
+        self.addCleanup(setattr, global_state, "client", None)
+        self.addCleanup(setattr, global_state, "service", None)
+        from torcheval_tpu.parallel import bootstrap
+
+        calls = {"n": 0}
+        sentinel = object()
+
+        def flaky(**kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                global_state.client = sentinel  # half-initialized, then fail
+                raise RuntimeError("failed to connect")
+            # the retry must arrive with the partial state cleared, exactly
+            # as the real initialize requires (it raises "called once"
+            # whenever client is already set)
+            assert global_state.client is None, "partial init not reset"
+
+        with mock.patch.object(jax.distributed, "initialize", flaky), \
+                mock.patch.object(bootstrap.time, "sleep", lambda s: None):
+            init_from_env()
+        self.assertEqual(calls["n"], 2)
+        self.assertFalse(is_initialized())
+
+    def test_gives_up_after_bounded_attempts_with_original_error(self):
+        from torcheval_tpu.parallel import bootstrap
+
+        sleeps = []
+        with mock.patch.object(
+            jax.distributed,
+            "initialize",
+            side_effect=RuntimeError("coordinator unreachable"),
+        ), mock.patch.object(bootstrap.time, "sleep", sleeps.append):
+            with self.assertRaisesRegex(RuntimeError, "coordinator unreachable"):
+                init_from_env(connect_attempts=3)
+        self.assertEqual(len(sleeps), 2)  # attempts - 1 backoffs
+
+    def test_configuration_errors_never_retry(self):
+        from torcheval_tpu.parallel import bootstrap
+
+        sleeps = []
+        with mock.patch.object(
+            jax.distributed,
+            "initialize",
+            side_effect=ValueError("bad coordinator_address"),
+        ), mock.patch.object(bootstrap.time, "sleep", sleeps.append):
+            with self.assertRaises(ValueError):
+                init_from_env()
+        self.assertEqual(sleeps, [])
+
+    def test_attempts_env_override(self):
+        from torcheval_tpu.parallel import bootstrap
+
+        sleeps = []
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_CONNECT_ATTEMPTS": "1"}
+        ), mock.patch.object(
+            jax.distributed,
+            "initialize",
+            side_effect=RuntimeError("nope"),
+        ), mock.patch.object(bootstrap.time, "sleep", sleeps.append):
+            with self.assertRaises(RuntimeError):
+                init_from_env()
+        self.assertEqual(sleeps, [])  # one attempt, no backoff
+
+    def test_backoff_cap(self):
+        from torcheval_tpu.parallel import bootstrap
+
+        sleeps = []
+        with mock.patch.object(
+            jax.distributed,
+            "initialize",
+            side_effect=RuntimeError("down"),
+        ), mock.patch.object(bootstrap.time, "sleep", sleeps.append):
+            with self.assertRaises(RuntimeError):
+                init_from_env(connect_attempts=4, connect_backoff_s=100.0)
+        # every nominal delay (100, 200, 400) is capped at 30s pre-jitter
+        for s in sleeps:
+            self.assertLessEqual(s, 30.0 * 1.5)
+
+    def test_invalid_attempts_rejected(self):
+        with self.assertRaisesRegex(ValueError, "connect_attempts"):
+            init_from_env(connect_attempts=0)
 
 
 if __name__ == "__main__":

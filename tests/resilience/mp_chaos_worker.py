@@ -1,0 +1,130 @@
+"""Worker for the 4-process fault-injection test (ISSUE 5 acceptance).
+
+Each process joins a real ``jax.distributed`` CPU world, streams its shard
+into a local ``MulticlassAccuracy``, completes one HEALTHY sync, checkpoints
+its local replica, streams more, and enters a second sync — at which point
+the chaos hooks (armed by the parent via ``TORCHEVAL_TPU_CHAOS_*``) kill
+rank 2 with a hard ``os._exit`` as it enters the descriptor round. The
+survivors' ``sync_and_compute(..., timeout_s=, on_failure="local")`` must
+come back within the deadline with their LOCAL values and the
+``toolkit.sync.timeouts{policy=local}`` counter bumped.
+
+Run:  python mp_chaos_worker.py <rank> <world> <port> <outdir>
+Writes <outdir>/rank<r>.json and <outdir>/rank<r>.obs.json (the obs registry
+snapshot — uploaded as a CI artifact on failure, so a hung CI run leaves a
+diagnosable trace of which sync round each rank reached). Rank 2 writes
+nothing: it is dead.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 5
+BATCH = 48
+# the survivors' degraded-mode deadline; the parent asserts the wall time
+# of the failed sync stays within a small multiple of this
+TIMEOUT_S = 8.0
+CHAOS_EXIT_CODE = 43
+KILLED_RANK = 2
+
+
+def make_shard(rank: int, phase: int):
+    rng = np.random.default_rng(1000 + 10 * phase + rank)
+    scores = rng.random((BATCH, NUM_CLASSES)).astype(np.float32)
+    labels = rng.integers(0, NUM_CLASSES, BATCH)
+    return scores, labels
+
+
+def main() -> None:
+    rank, world, port, outdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["MASTER_ADDR"] = "localhost"
+    os.environ["MASTER_PORT"] = port
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["RANK"] = str(rank)
+    from torcheval_tpu.parallel import init_from_env
+
+    got_rank, got_world = init_from_env()
+    assert (got_rank, got_world) == (rank, world)
+    import jax.numpy as jnp
+
+    from torcheval_tpu import obs
+    from torcheval_tpu.metrics import MulticlassAccuracy
+    from torcheval_tpu.metrics.toolkit import sync_and_compute
+    from torcheval_tpu.resilience import save
+
+    obs.enable()
+    results = {"rank": rank}
+
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    s0, l0 = make_shard(rank, phase=0)
+    acc.update(jnp.asarray(s0), jnp.asarray(l0))
+
+    # --- sync 1 (rounds 1-2): every rank alive, full global value
+    r = sync_and_compute(
+        acc, recipient_rank="all", timeout_s=60.0, on_failure="local"
+    )
+    results["sync1"] = float(np.asarray(r))
+
+    # --- pre-fault checkpoint of the LOCAL replica (per-rank directory:
+    # state is process-local in the explicit sync model)
+    ckpt_dir = os.path.join(outdir, f"ckpt_rank{rank}")
+    save(acc, ckpt_dir)
+    # repr round-trips the float64 exactly through JSON: the parent asserts
+    # the restored compute() is BIT-identical to this
+    results["local_compute_at_ckpt"] = float(np.asarray(acc.compute()))
+
+    # --- post-checkpoint stream (these batches are NOT in the checkpoint)
+    s1, l1 = make_shard(rank, phase=1)
+    acc.update(jnp.asarray(s1), jnp.asarray(l1))
+    results["local_compute_post"] = float(np.asarray(acc.compute()))
+
+    # --- sync 2 (rounds 3-4): chaos kills rank 2 entering round 3. The
+    # survivors' collective has a dead member and can only hang or error;
+    # degraded mode must return the LOCAL value within the deadline.
+    t0 = time.monotonic()
+    r = sync_and_compute(
+        acc, recipient_rank="all", timeout_s=TIMEOUT_S, on_failure="local"
+    )
+    results["sync2"] = float(np.asarray(r))
+    results["sync2_elapsed_s"] = time.monotonic() - t0
+
+    snap = obs.snapshot()
+    results["timeouts_local"] = snap["counters"].get(
+        "toolkit.sync.timeouts{policy=local}", 0.0
+    )
+    results["sync_rounds"] = snap["counters"].get("toolkit.sync.rounds", 0.0)
+
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, f"rank{rank}.obs.json"), "w") as f:
+        json.dump(snap, f, indent=2)
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # straggler world only: rank 0 hosts the coordination service, and the
+    # coordination client hard-aborts (SIGABRT) any process that outlives
+    # the leader — so the leader holds until the delayed rank has finished
+    # its own (budget-expired) degrade and written its results
+    hold_s = float(os.environ.get("TORCHEVAL_TPU_CHAOS_HOLD_S", "0"))
+    if rank == 0 and hold_s > 0:
+        time.sleep(hold_s)
+    # hard exit: after a degraded sync the dead rank's peers must not risk
+    # wedging in interpreter teardown (atexit distributed shutdown would
+    # wait on a world that no longer exists)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

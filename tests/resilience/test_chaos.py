@@ -1,0 +1,87 @@
+"""Unit tests for the env-gated fault-injection hooks (resilience/chaos.py).
+
+The kill action (``os._exit`` mid-collective) can only run in a disposable
+process — that is ``test_fault_injection.py``'s 4-process world. Here: the
+round/rank targeting, the straggler delay, and the must-not-break-production
+edges (disarmed fast path, malformed env disarms instead of raising).
+"""
+
+import os
+import time
+import unittest
+from unittest import mock
+
+from torcheval_tpu.resilience import chaos
+
+
+class TestChaosHooks(unittest.TestCase):
+    def tearDown(self):
+        chaos.reset_for_tests()
+
+    def _arm(self, **extra):
+        env = {
+            "TORCHEVAL_TPU_CHAOS": "1",
+            "TORCHEVAL_TPU_CHAOS_RANK": "0",  # this process in a 1-proc world
+            "TORCHEVAL_TPU_CHAOS_ROUND": "2",
+            "TORCHEVAL_TPU_CHAOS_ACTION": "delay",
+            "TORCHEVAL_TPU_CHAOS_DELAY_S": "0.3",
+        }
+        env.update(extra)
+        return mock.patch.dict(os.environ, env)
+
+    def test_disarmed_is_a_noop(self):
+        with mock.patch.dict(os.environ):
+            os.environ.pop("TORCHEVAL_TPU_CHAOS", None)
+            chaos.reset_for_tests()
+            t0 = time.monotonic()
+            for _ in range(1000):
+                chaos.on_sync_round()
+            self.assertLess(time.monotonic() - t0, 0.5)
+
+    def test_delay_fires_only_at_configured_round(self):
+        with self._arm():
+            chaos.reset_for_tests()
+            t0 = time.monotonic()
+            chaos.on_sync_round()  # round 1: no action
+            first = time.monotonic() - t0
+            t0 = time.monotonic()
+            chaos.on_sync_round()  # round 2: the straggler delay
+            second = time.monotonic() - t0
+            t0 = time.monotonic()
+            chaos.on_sync_round()  # round 3: past the target, no action
+            third = time.monotonic() - t0
+        self.assertLess(first, 0.2)
+        self.assertGreaterEqual(second, 0.3)
+        self.assertLess(third, 0.2)
+
+    def test_other_rank_never_acts(self):
+        with self._arm(TORCHEVAL_TPU_CHAOS_RANK="7"):
+            chaos.reset_for_tests()
+            t0 = time.monotonic()
+            for _ in range(3):
+                chaos.on_sync_round()
+            self.assertLess(time.monotonic() - t0, 0.2)
+
+    def test_malformed_config_disarms_instead_of_raising(self):
+        # a stale TORCHEVAL_TPU_CHAOS=1 without the targeting vars must
+        # never be able to break a production sync
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_CHAOS": "1"}, clear=False
+        ):
+            for var in (
+                "TORCHEVAL_TPU_CHAOS_RANK",
+                "TORCHEVAL_TPU_CHAOS_ROUND",
+            ):
+                os.environ.pop(var, None)
+            chaos.reset_for_tests()
+            chaos.on_sync_round()  # no raise, no action
+
+    def test_unknown_action_disarms(self):
+        with self._arm(TORCHEVAL_TPU_CHAOS_ACTION="explode"):
+            chaos.reset_for_tests()
+            chaos.on_sync_round()
+            chaos.on_sync_round()  # the configured round: still no action
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,271 @@
+"""Sync deadline + degraded-mode unit tests (simulated world).
+
+The real dead-rank hang is exercised in 4 OS processes by
+``test_fault_injection.py``; here the collective layer is stubbed so every
+policy/timeout edge runs in milliseconds: the watchdog fires and names the
+round and lane, ``on_failure="local"`` degrades to local results with the
+obs counter bumped, transport errors under a deadline wrap as
+:class:`SyncRoundError`, and invalid arguments are rejected eagerly.
+"""
+
+import threading
+import time
+import unittest
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy, Sum
+from torcheval_tpu.metrics import toolkit
+from torcheval_tpu.metrics.toolkit import (
+    SyncError,
+    SyncRoundError,
+    SyncTimeoutError,
+    get_synced_metric,
+    get_synced_state_dict,
+    sync_and_compute,
+    sync_and_compute_collection,
+)
+from torcheval_tpu.utils.telemetry import reset_once_keys
+
+
+def _hang(seconds):
+    def impl(x, group):
+        time.sleep(seconds)
+        raise AssertionError("hung collective unexpectedly completed")
+
+    return impl
+
+
+class _SimulatedWorld(unittest.TestCase):
+    """Patch the world to size 2 and stub the collective impl — the layers
+    above (_allgather_stacked and the public APIs) run for real."""
+
+    def setUp(self):
+        patches = [
+            mock.patch.object(toolkit, "_world_size", lambda: 2),
+            mock.patch.object(toolkit, "_process_index", lambda: 0),
+        ]
+        for p in patches:
+            p.start()
+            self.addCleanup(p.stop)
+        reset_once_keys("toolkit.sync.degraded")
+
+    def _metric(self):
+        m = Sum()
+        m.update(jnp.asarray([4.0, 1.0]))
+        return m
+
+
+class TestTimeoutRaises(_SimulatedWorld):
+    def test_timeout_names_round_and_lane(self):
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", _hang(3)):
+            t0 = time.monotonic()
+            with self.assertRaises(SyncTimeoutError) as ctx:
+                sync_and_compute(self._metric(), recipient_rank="all", timeout_s=0.2)
+            elapsed = time.monotonic() - t0
+        self.assertLess(elapsed, 2.0)  # returned at the deadline, not the hang
+        self.assertEqual(ctx.exception.round, "descriptor")
+        self.assertEqual(ctx.exception.lane, "typed")
+        self.assertEqual(ctx.exception.timeout_s, 0.2)
+        self.assertIn("descriptor", str(ctx.exception))
+
+    def test_object_lane_timeout_names_object_round(self):
+        from torcheval_tpu.utils.test_utils import DummySumDictStateMetric
+
+        d = DummySumDictStateMetric()
+        d.update("k", 1.0)
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", _hang(3)):
+            with self.assertRaises(SyncTimeoutError) as ctx:
+                sync_and_compute(d, recipient_rank="all", timeout_s=0.2)
+        self.assertEqual(ctx.exception.round, "object-length")
+        self.assertEqual(ctx.exception.lane, "object")
+
+    def test_budget_is_shared_across_rounds(self):
+        # first round eats most of the budget; the second must not get a
+        # fresh timeout_s (a per-round budget would wait ~2x the deadline)
+        calls = {"n": 0}
+
+        def slow_first(x, group):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.35)
+                # simulate a completed round so the sync proceeds to round 2
+                return np.stack([x, x])
+            time.sleep(10)
+            raise AssertionError("unreachable")
+
+        m = self._metric()
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", slow_first):
+            t0 = time.monotonic()
+            with self.assertRaises(SyncTimeoutError) as ctx:
+                sync_and_compute(m, recipient_rank="all", timeout_s=0.5)
+            elapsed = time.monotonic() - t0
+        self.assertEqual(ctx.exception.round, "payload")
+        self.assertLess(elapsed, 2.0)
+
+    def test_transport_error_under_deadline_wraps_as_round_error(self):
+        def boom(x, group):
+            raise RuntimeError("connection reset by peer")
+
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", boom):
+            with self.assertRaises(SyncRoundError) as ctx:
+                sync_and_compute(self._metric(), recipient_rank=0, timeout_s=1.0)
+        self.assertEqual(ctx.exception.round, "descriptor")
+        self.assertIsInstance(ctx.exception.__cause__, RuntimeError)
+
+    def test_no_deadline_keeps_original_error_type(self):
+        # without timeout_s the pre-ISSUE-5 contract holds: errors pass
+        # through unwrapped (and hangs hang — not testable here)
+        def boom(x, group):
+            raise RuntimeError("schema-adjacent failure")
+
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", boom):
+            with self.assertRaises(RuntimeError) as ctx:
+                sync_and_compute(self._metric(), recipient_rank=0)
+        self.assertNotIsInstance(ctx.exception, SyncError)
+
+
+class TestDegradedMode(_SimulatedWorld):
+    def test_local_policy_returns_local_compute_and_counts(self):
+        m = self._metric()
+        obs.enable()
+        try:
+            obs.reset()
+            with mock.patch.object(
+                toolkit, "_allgather_stacked_impl", _hang(3)
+            ):
+                out = sync_and_compute(
+                    m, recipient_rank="all", timeout_s=0.2, on_failure="local"
+                )
+            snap = obs.snapshot()["counters"]
+        finally:
+            obs.disable()
+            obs.reset()
+        self.assertEqual(float(out), 5.0)  # the LOCAL (unsynced) value
+        self.assertEqual(snap["toolkit.sync.timeouts{policy=local}"], 1.0)
+
+    def test_local_policy_returns_on_every_rank_even_non_recipient(self):
+        # the recipient contract is unsatisfiable once the exchange failed;
+        # each survivor's local state is the only data it still has
+        m = self._metric()
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", _hang(3)):
+            out = sync_and_compute(
+                m, recipient_rank=1, timeout_s=0.2, on_failure="local"
+            )
+        self.assertEqual(float(out), 5.0)
+
+    def test_get_synced_metric_local_returns_clone(self):
+        m = self._metric()
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", _hang(3)):
+            got = get_synced_metric(
+                m, recipient_rank="all", timeout_s=0.2, on_failure="local"
+            )
+        self.assertIsNot(got, m)  # source never mutated / aliased
+        self.assertEqual(float(got.compute()), 5.0)
+
+    def test_get_synced_state_dict_local(self):
+        m = self._metric()
+        with mock.patch.object(toolkit, "_allgather_stacked_impl", _hang(3)):
+            sd = get_synced_state_dict(
+                m, recipient_rank="all", timeout_s=0.2, on_failure="local"
+            )
+        self.assertEqual(float(sd["weighted_sum"]), 5.0)
+
+    def test_collection_local_degrades_every_member_uniformly(self):
+        from torcheval_tpu.utils.test_utils import DummySumDictStateMetric
+
+        acc = MulticlassAccuracy(num_classes=3)
+        rng = np.random.default_rng(0)
+        x = rng.random((16, 3)).astype(np.float32)
+        t = rng.integers(0, 3, 16)
+        acc.update(jnp.asarray(x), jnp.asarray(t))
+        d = DummySumDictStateMetric()
+        d.update("k", 2.0)
+        s = self._metric()
+        obs.enable()
+        try:
+            obs.reset()
+            with mock.patch.object(
+                toolkit, "_allgather_stacked_impl", _hang(3)
+            ):
+                out = sync_and_compute_collection(
+                    {"acc": acc, "d": d, "s": s},
+                    recipient_rank="all",
+                    timeout_s=0.2,
+                    on_failure="local",
+                )
+            snap = obs.snapshot()["counters"]
+        finally:
+            obs.disable()
+            obs.reset()
+        self.assertEqual(sorted(out), ["acc", "d", "s"])
+        self.assertEqual(float(out["s"]), 5.0)
+        self.assertEqual(float(out["d"]), 2.0)
+        self.assertAlmostEqual(
+            float(out["acc"]), float((x.argmax(1) == t).mean()), places=6
+        )
+        self.assertEqual(snap["toolkit.sync.timeouts{policy=local}"], 1.0)
+
+    def test_raise_policy_still_counts(self):
+        obs.enable()
+        try:
+            obs.reset()
+            with mock.patch.object(
+                toolkit, "_allgather_stacked_impl", _hang(3)
+            ):
+                with self.assertRaises(SyncTimeoutError):
+                    sync_and_compute(
+                        self._metric(), recipient_rank="all", timeout_s=0.2
+                    )
+            snap = obs.snapshot()["counters"]
+        finally:
+            obs.disable()
+            obs.reset()
+        self.assertEqual(snap["toolkit.sync.timeouts{policy=raise}"], 1.0)
+
+
+class TestArgumentValidation(_SimulatedWorld):
+    def test_bad_policy_rejected_eagerly(self):
+        for api in (
+            lambda: sync_and_compute(self._metric(), on_failure="retry"),
+            lambda: get_synced_metric(self._metric(), on_failure="retry"),
+            lambda: sync_and_compute_collection(
+                {"s": self._metric()}, on_failure="retry"
+            ),
+        ):
+            with self.assertRaisesRegex(ValueError, "on_failure"):
+                api()
+
+    def test_nonpositive_timeout_rejected(self):
+        with mock.patch.object(
+            toolkit, "_allgather_stacked_impl", _hang(0.01)
+        ):
+            with self.assertRaisesRegex(ValueError, "timeout_s"):
+                sync_and_compute(self._metric(), timeout_s=0.0)
+
+    def test_watchdog_thread_is_daemonic(self):
+        # a timed-out collective leaves its watchdog thread blocked inside
+        # the native call; it must be daemonic so process exit never hangs
+        seen = {}
+        orig = threading.Thread
+
+        class SpyThread(orig):
+            def start(self):
+                if self.name.startswith("toolkit-sync-"):
+                    seen["daemon"] = self.daemon
+                super().start()
+
+        with mock.patch.object(threading, "Thread", SpyThread):
+            with mock.patch.object(
+                toolkit, "_allgather_stacked_impl", _hang(0.6)
+            ):
+                with self.assertRaises(SyncTimeoutError):
+                    sync_and_compute(self._metric(), timeout_s=0.1)
+        self.assertTrue(seen.get("daemon"))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,399 @@
+"""Checkpoint/restore engine tests (torcheval_tpu/resilience/snapshot.py).
+
+ISSUE 5 acceptance: round trips are bit-identical (including the tricky
+state containers — WINDOW deques with order+maxlen, SampleCacheMetric
+empty-cache dtypes, Throughput's max-elapsed merge), ``restore`` rejects
+corrupted payloads and schema-mismatched manifests with structured errors,
+and writes are atomic (a simulated crash between the temp write and the
+rename publishes nothing).
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import unittest
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MetricCollection,
+    MulticlassAccuracy,
+    Sum,
+    Throughput,
+    WindowedClickThroughRate,
+)
+from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.resilience import (
+    CheckpointError,
+    latest_checkpoint,
+    list_checkpoints,
+    restore,
+    save,
+)
+from torcheval_tpu.resilience import snapshot as snapshot_mod
+from torcheval_tpu.utils.test_utils import DummySumDictStateMetric
+
+RNG = np.random.default_rng(7)
+
+
+def _acc_batch(n=64, c=5, seed=None):
+    rng = np.random.default_rng(seed) if seed is not None else RNG
+    return (
+        rng.random((n, c)).astype(np.float32),
+        rng.integers(0, c, n),
+    )
+
+
+class _IntCache(SampleCacheMetric[jax.Array]):
+    """Integer-cache fixture (mirrors tests/metrics/test_sample_cache.py)."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_cache_state("ids", dtype=jnp.int32)
+
+    def update(self, ids):
+        self.ids.append(self._input(ids))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self._concat_cache("ids")
+
+
+class _TmpDirTest(unittest.TestCase):
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="tpu_ckpt_")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+
+class TestRoundTrip(_TmpDirTest):
+    def test_bare_metric_mid_stream_bit_identical(self):
+        m = MulticlassAccuracy(num_classes=5)
+        x, t = _acc_batch()
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        self.assertTrue(m._pending)  # mid-window: save must fold first
+        path = save(m, self.dir)
+        self.assertEqual(m._pending, [])  # folded, not dropped
+        want = np.asarray(m.compute())
+        fresh = MulticlassAccuracy(num_classes=5)
+        restore(fresh, path)
+        self.assertTrue((np.asarray(fresh.compute()) == want).all())
+        # the restored metric keeps streaming
+        x2, t2 = _acc_batch(16)
+        fresh.update(jnp.asarray(x2), jnp.asarray(t2))
+        ref = MulticlassAccuracy(num_classes=5)
+        ref.update(
+            jnp.asarray(np.concatenate([x, x2])),
+            jnp.asarray(np.concatenate([t, t2])),
+        )
+        self.assertAlmostEqual(
+            float(fresh.compute()), float(ref.compute()), places=6
+        )
+
+    def test_restore_from_parent_dir_takes_latest(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        save(m, self.dir)
+        m.update(jnp.asarray([2.0]))
+        save(m, self.dir)
+        fresh = Sum()
+        restore(fresh, self.dir)  # parent dir -> newest ckpt
+        self.assertEqual(float(fresh.compute()), 3.0)
+
+    def test_mixed_metric_dict_round_trip_including_cache_and_dict(self):
+        acc = MulticlassAccuracy(num_classes=5)
+        auroc = BinaryAUROC()
+        x, t = _acc_batch()
+        scores = RNG.random(33).astype(np.float32)
+        targets = (RNG.random(33) > 0.4).astype(np.float32)
+        acc.update(jnp.asarray(x), jnp.asarray(t))
+        auroc.update(jnp.asarray(scores), jnp.asarray(targets))
+        d = DummySumDictStateMetric()
+        d.update("a", 2.0)
+        d.update("b", 3.0)
+        want_acc = np.asarray(acc.compute())
+        want_auroc = np.asarray(auroc.compute())
+        save({"acc": acc, "auroc": auroc, "d": d}, self.dir)
+        fresh_acc = MulticlassAccuracy(num_classes=5)
+        fresh_auroc = BinaryAUROC()
+        fresh_d = DummySumDictStateMetric()
+        restore({"acc": fresh_acc, "auroc": fresh_auroc, "d": fresh_d}, self.dir)
+        self.assertTrue((np.asarray(fresh_acc.compute()) == want_acc).all())
+        self.assertTrue((np.asarray(fresh_auroc.compute()) == want_auroc).all())
+        self.assertEqual(float(fresh_d.compute()), 5.0)
+        # dict state keeps missing-key-is-zero semantics after restore
+        fresh_d.update("c", 1.0)
+        self.assertEqual(float(fresh_d.compute()), 6.0)
+
+    def test_metric_collection_object_round_trip(self):
+        col = MetricCollection({"acc": MulticlassAccuracy(num_classes=5)})
+        x, t = _acc_batch()
+        col.update(jnp.asarray(x), jnp.asarray(t))
+        want = float(col.compute()["acc"])
+        save(col, self.dir)
+        fresh = MetricCollection({"acc": MulticlassAccuracy(num_classes=5)})
+        restore(fresh, self.dir)
+        self.assertEqual(float(fresh.compute()["acc"]), want)
+
+    def test_sharded_evaluator_round_trip(self):
+        from torcheval_tpu.parallel import ShardedEvaluator
+
+        ev = ShardedEvaluator({"acc": MulticlassAccuracy(num_classes=5)})
+        x, t = _acc_batch(64)
+        ev.update(jnp.asarray(x), jnp.asarray(t))
+        want = float(ev.compute()["acc"])
+        save(ev, self.dir)
+        fresh = ShardedEvaluator({"acc": MulticlassAccuracy(num_classes=5)})
+        restore(fresh, self.dir)
+        self.assertEqual(float(fresh.compute()["acc"]), want)
+        # restored state is back on the mesh: further sharded updates work
+        fresh.update(jnp.asarray(x), jnp.asarray(t))
+        self.assertAlmostEqual(float(fresh.compute()["acc"]), want, places=6)
+
+
+class TestTrickyContainers(_TmpDirTest):
+    def test_window_deque_order_and_maxlen_preserved(self):
+        m = WindowedClickThroughRate(window_size=3)
+        for i in range(5):  # 5 updates > window 3: only the newest 3 survive
+            m.update(jnp.asarray([float(i % 2)] * 4))
+        want_rows = [np.asarray(r) for r in m.window]
+        lifetime, windowed = (np.asarray(v) for v in m.compute())
+        save(m, self.dir)
+        fresh = WindowedClickThroughRate(window_size=3)
+        restore(fresh, self.dir)
+        self.assertEqual(fresh.window.maxlen, 3)
+        self.assertEqual(len(fresh.window), 3)
+        for got, want in zip(fresh.window, want_rows):
+            self.assertTrue((np.asarray(got) == want).all())
+        got_lifetime, got_windowed = (np.asarray(v) for v in fresh.compute())
+        self.assertTrue((got_lifetime == lifetime).all())
+        self.assertTrue((got_windowed == windowed).all())
+        # the bound still enforces after restore: one more update evicts
+        # the oldest restored row, exactly as it would have pre-save
+        fresh.update(jnp.asarray([1.0] * 4))
+        self.assertEqual(len(fresh.window), 3)
+        self.assertTrue(
+            (np.asarray(fresh.window[0]) == want_rows[1]).all()
+        )
+
+    def test_sample_cache_empty_dtype_honored_on_restore(self):
+        m = _IntCache()
+        save(m, self.dir)  # empty cache checkpoint
+        fresh = _IntCache()
+        restore(fresh, self.dir)
+        out = fresh.compute()
+        self.assertEqual(out.shape, (0,))
+        self.assertEqual(out.dtype, jnp.int32)  # not silently float32
+
+    def test_sample_cache_chunks_round_trip(self):
+        m = _IntCache()
+        m.update(jnp.asarray([3, 1, 2], dtype=jnp.int32))
+        m.update(jnp.asarray([9, 8], dtype=jnp.int32))
+        save(m, self.dir)
+        fresh = _IntCache()
+        restore(fresh, self.dir)
+        self.assertTrue(
+            (np.asarray(fresh.compute()) == np.asarray([3, 1, 2, 9, 8])).all()
+        )
+        self.assertEqual(fresh.compute().dtype, jnp.int32)
+
+    def test_throughput_max_elapsed_merge_unaffected_by_restore(self):
+        m = Throughput()
+        m.update(num_processed=100, elapsed_time_sec=4.0)
+        save(m, self.dir)
+        fresh = Throughput()
+        restore(fresh, self.dir)
+        peer = Throughput()
+        peer.update(num_processed=200, elapsed_time_sec=2.0)
+        fresh.merge_state([peer])
+        # counts sum (300), elapsed is the MAX (4.0), not the sum (6.0):
+        # the restore must not have perturbed the merge semantics
+        self.assertEqual(float(fresh.num_total), 300.0)
+        self.assertEqual(float(fresh.elapsed_time_sec), 4.0)
+        self.assertEqual(float(fresh.compute()), 75.0)
+
+
+class TestValidation(_TmpDirTest):
+    def _saved_sum(self):
+        m = Sum()
+        m.update(jnp.asarray([5.0]))
+        return save(m, self.dir)
+
+    def test_missing_checkpoint_not_found(self):
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(Sum(), os.path.join(self.dir, "nope"))
+        self.assertEqual(ctx.exception.reason, "not_found")
+
+    def test_corrupted_payload_rejected(self):
+        path = self._saved_sum()
+        with open(os.path.join(path, "state.npz"), "r+b") as f:
+            f.seek(12)
+            f.write(b"\xde\xad\xbe\xef")
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(Sum(), path)
+        self.assertEqual(ctx.exception.reason, "checksum_mismatch")
+
+    def test_corrupted_manifest_rejected(self):
+        path = self._saved_sum()
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            f.write("{not json")
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(Sum(), path)
+        self.assertEqual(ctx.exception.reason, "corrupt_manifest")
+
+    def test_manifest_missing_field_rejected(self):
+        path = self._saved_sum()
+        mpath = os.path.join(path, "manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        del manifest["payload_sha256"]
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(Sum(), path)
+        self.assertEqual(ctx.exception.reason, "corrupt_manifest")
+
+    def test_schema_mismatch_different_metric_set(self):
+        self._saved_sum()
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(MulticlassAccuracy(num_classes=5), self.dir)
+        self.assertEqual(ctx.exception.reason, "schema_mismatch")
+
+    def test_schema_mismatch_window_config_drift(self):
+        # window_size is fold-relevant configuration (_sync_schema_extra):
+        # the digest must reject a drifted replica, exactly as the sync
+        # wire's schema digest does
+        m = WindowedClickThroughRate(window_size=4)
+        m.update(jnp.asarray([1.0]))
+        save(m, self.dir)
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(WindowedClickThroughRate(window_size=5), self.dir)
+        self.assertEqual(ctx.exception.reason, "schema_mismatch")
+
+    def test_shape_drift_within_same_schema_rejected(self):
+        # macro accuracy's per-class counters: num_classes is not in the
+        # digest (same class/state/reduction schema) but sizes the state —
+        # the per-leaf shape check must catch it before any state install
+        m = MulticlassAccuracy(num_classes=5, average="macro")
+        x, t = _acc_batch()
+        m.update(jnp.asarray(x), jnp.asarray(t))
+        save(m, self.dir)
+        target = MulticlassAccuracy(num_classes=4, average="macro")
+        before = {k: np.asarray(v) for k, v in target.state_dict().items()}
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(target, self.dir)
+        self.assertEqual(ctx.exception.reason, "schema_mismatch")
+        # failed restore left the target untouched
+        after = {k: np.asarray(v) for k, v in target.state_dict().items()}
+        for k in before:
+            self.assertTrue((before[k] == after[k]).all(), k)
+
+    def test_failed_validation_precedes_any_state_write(self):
+        path = self._saved_sum()
+        with open(os.path.join(path, "state.npz"), "r+b") as f:
+            f.seek(12)
+            f.write(b"\xde\xad\xbe\xef")
+        target = Sum()
+        target.update(jnp.asarray([42.0]))
+        with self.assertRaises(CheckpointError):
+            restore(target, path)
+        self.assertEqual(float(target.compute()), 42.0)  # unperturbed
+
+
+class TestAtomicityAndRotation(_TmpDirTest):
+    def test_crash_between_temp_write_and_rename_publishes_nothing(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        real_replace = os.replace
+
+        def crash(src, dst):
+            raise OSError("simulated crash before rename")
+
+        with mock.patch.object(snapshot_mod.os, "replace", crash):
+            with self.assertRaises(OSError):
+                save(m, self.dir)
+        # no partial checkpoint is visible: a reader scanning the directory
+        # finds nothing to restore from
+        self.assertEqual(list_checkpoints(self.dir), [])
+        self.assertIsNone(latest_checkpoint(self.dir))
+        with self.assertRaises(CheckpointError) as ctx:
+            restore(Sum(), self.dir)
+        self.assertEqual(ctx.exception.reason, "not_found")
+        # and a later save on the same directory succeeds cleanly
+        with mock.patch.object(snapshot_mod.os, "replace", real_replace):
+            path = save(m, self.dir)
+        fresh = Sum()
+        restore(fresh, path)
+        self.assertEqual(float(fresh.compute()), 1.0)
+
+    def test_stray_tmp_dirs_are_invisible_to_readers(self):
+        os.makedirs(os.path.join(self.dir, ".tmp-ckpt-00000007-123"))
+        self.assertEqual(list_checkpoints(self.dir), [])
+        m = Sum()
+        m.update(jnp.asarray([2.0]))
+        save(m, self.dir)
+        self.assertEqual(len(list_checkpoints(self.dir)), 1)
+
+    def test_keep_last_rotation(self):
+        m = Sum()
+        for i in range(4):
+            m.update(jnp.asarray([1.0]))
+            save(m, self.dir, keep_last=2)
+        ckpts = list_checkpoints(self.dir)
+        self.assertEqual(len(ckpts), 2)
+        self.assertTrue(ckpts[-1].endswith("ckpt-00000003"))
+        fresh = Sum()
+        restore(fresh, self.dir)
+        self.assertEqual(float(fresh.compute()), 4.0)
+
+    def test_step_numbering_monotonic_after_rotation(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        for _ in range(3):
+            save(m, self.dir, keep_last=1)
+        # rotation removed older steps but numbering keeps advancing
+        self.assertTrue(
+            latest_checkpoint(self.dir).endswith("ckpt-00000002")
+        )
+
+    def test_invalid_keep_last_rejected_before_any_write(self):
+        m = Sum()
+        with self.assertRaisesRegex(ValueError, "keep_last"):
+            save(m, self.dir, keep_last=0)
+        # the argument error must precede the save side effect: no
+        # checkpoint published, no counters bumped
+        self.assertEqual(list_checkpoints(self.dir), [])
+
+    def test_explicit_step_collision_rejected(self):
+        m = Sum()
+        save(m, self.dir, step=3)
+        with self.assertRaises(CheckpointError):
+            save(m, self.dir, step=3)
+
+
+class TestObsCounters(_TmpDirTest):
+    def test_save_restore_counters(self):
+        from torcheval_tpu import obs
+
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        obs.enable()
+        try:
+            obs.reset()
+            path = save(m, self.dir)
+            restore(Sum(), path)
+            snap = obs.snapshot()["counters"]
+        finally:
+            obs.disable()
+            obs.reset()
+        self.assertEqual(snap["resilience.checkpoint.saves"], 1.0)
+        self.assertEqual(snap["resilience.checkpoint.restores"], 1.0)
+        self.assertGreater(snap["resilience.checkpoint.bytes"], 0.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

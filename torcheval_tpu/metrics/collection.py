@@ -153,6 +153,30 @@ class MetricCollection:
             group_fold(self._deferred)
         return {n: m.state_dict() for n, m in self.metrics.items()}
 
+    def load_state_dicts(
+        self, state_dicts: Dict[str, Dict[str, Any]], strict: bool = True
+    ) -> "MetricCollection":
+        """Install per-member state dicts (the inverse of
+        :meth:`state_dicts`; the checkpoint restore path,
+        ``torcheval_tpu.resilience``). ``strict`` mirrors
+        ``Metric.load_state_dict`` at the collection level: the metric-key
+        sets must match exactly. Members fold any pending deferred chunks
+        into their OLD state before installing (``Metric.load_state_dict``),
+        so a mid-stream restore is exact."""
+        if strict:
+            unexpected = set(state_dicts) - set(self.metrics)
+            missing = set(self.metrics) - set(state_dicts)
+            if missing or unexpected:
+                raise RuntimeError(
+                    "Error(s) in loading state_dicts for MetricCollection. "
+                    f"Encountered missing metric keys: {missing} and "
+                    f"unexpected metric keys: {unexpected}."
+                )
+        for name, sd in state_dicts.items():
+            if name in self.metrics:
+                self.metrics[name].load_state_dict(sd, strict)
+        return self
+
     def __getitem__(self, name: str) -> Metric:
         return self.metrics[name]
 

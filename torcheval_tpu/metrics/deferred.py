@@ -616,13 +616,12 @@ class DeferredFoldMixin:
         self._pending_sig = None
         return super().reset()
 
-    def load_state_dict(self, state_dict, strict: bool = True) -> None:
-        # loading REPLACES the logical state wholesale; pending batches belong
-        # to the stream being replaced and are dropped with it
-        self._pending = []
-        self._pending_bytes = 0
-        self._pending_sig = None
-        super().load_state_dict(state_dict, strict)
+    # NOTE no load_state_dict override: the base class folds pending chunks
+    # into the OLD state before overwriting (Metric.load_state_dict), which
+    # both keeps partial (strict=False) loads exact for the states they do
+    # not touch and guarantees stale chunks never fold into restored state —
+    # regression-tested in tests/metrics/test_deferred.py (mid-window
+    # restore) and tests/resilience/test_snapshot.py.
 
     def __getstate__(self) -> Dict[str, Any]:
         self._fold_now()

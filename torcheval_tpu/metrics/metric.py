@@ -277,6 +277,14 @@ class Metric(Generic[TComputeReturn], ABC):
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        # fold BEFORE overwriting (ISSUE 5 satellite): pending deferred
+        # chunks belong to the stream that produced the CURRENT state. Fold
+        # them into it now so (a) they can never fold into the restored
+        # state on the next read — a mid-window restore must be exact — and
+        # (b) a partial load (strict=False naming only some states) keeps
+        # their contribution in the states it does NOT overwrite; the old
+        # drop-pending behavior silently lost those updates.
+        self._fold_now()
         state_dict = dict(state_dict)
         names = set(self._state_name_to_default)
         for name in names:

@@ -26,15 +26,28 @@ Semantics preserved from the reference (``toolkit.py:24-311``): works with
 ``recipient_rank`` int or ``"all"``; no-op with a warning at world size 1;
 ``None`` / ``{}`` returned on non-recipient ranks; source metrics are never
 mutated; ``_prepare_for_merge_state`` compacts sample caches pre-sync.
+
+**Failure semantics (ISSUE 5).** A collective with a dead or straggling
+member does not fail — it hangs, forever, on every healthy rank. Every sync
+API therefore takes ``timeout_s=`` (a watchdog thread around each blocking
+collective round; expiry raises :class:`SyncTimeoutError` naming the round
+and lane) and ``on_failure="raise"|"local"`` — ``"local"`` warns once,
+bumps ``toolkit.sync.timeouts{policy=local}`` and returns the **local**
+(unsynced) result on every calling rank, so one preempted worker degrades
+the report instead of wedging the job. The full per-API table lives in
+``docs/robustness.md``; fault-injection coverage in ``tests/resilience/``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import functools
 import logging
+import threading
+import time
 from collections import defaultdict, deque
-from typing import Any, Dict, List, Optional, Sequence, Tuple, TypeVar, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, TypeVar, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,12 +57,149 @@ from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction, TState
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.annotate import traced as _traced
+from torcheval_tpu.resilience import chaos as _chaos
 from torcheval_tpu.utils.devices import DeviceLike
+from torcheval_tpu.utils.telemetry import log_once as _log_once
 
 _logger = logging.getLogger(__name__)
 
 TMetric = TypeVar("TMetric", bound=Metric)
 _RecipientRank = Union[int, str]
+
+
+# ------------------------------------------------------- failure semantics
+class SyncError(RuntimeError):
+    """Base for explicit-sync failures (timeouts and in-round errors)."""
+
+
+class SyncTimeoutError(SyncError):
+    """A collective round did not complete within the sync deadline.
+
+    Carries the failing ``round`` (``"descriptor"`` / ``"payload"`` /
+    ``"object-length"`` / ``"object-payload"``), the ``lane`` (``"typed"``
+    or ``"object"``) and the overall ``timeout_s`` budget, so a log line is
+    enough to tell *which* exchange a dead rank wedged."""
+
+    def __init__(self, round_label: str, lane: str, timeout_s: float) -> None:
+        super().__init__(
+            f"sync round {round_label!r} ({lane} lane) did not complete "
+            f"within timeout_s={timeout_s}: a participating process is "
+            "likely dead or stalled. Use on_failure='local' to degrade to "
+            "local results instead of raising."
+        )
+        self.round = round_label
+        self.lane = lane
+        self.timeout_s = timeout_s
+
+
+class SyncRoundError(SyncError):
+    """A collective round FAILED (rather than hanging) while a sync
+    deadline was active — e.g. the transport surfaced a peer death as a
+    connection error, or the coordinator aborted the world. Wrapped so
+    ``on_failure="local"`` covers both ways a dead rank can manifest; the
+    original error is ``__cause__``."""
+
+    def __init__(self, round_label: str, lane: str, cause: BaseException) -> None:
+        super().__init__(
+            f"sync round {round_label!r} ({lane} lane) failed: {cause!r}"
+        )
+        self.round = round_label
+        self.lane = lane
+
+
+_FAILURE_POLICIES = ("raise", "local")
+
+
+def _check_failure_policy(on_failure: str) -> None:
+    if on_failure not in _FAILURE_POLICIES:
+        raise ValueError(
+            f"on_failure must be one of {_FAILURE_POLICIES}, got {on_failure!r}."
+        )
+
+
+class _Deadline:
+    __slots__ = ("expires_at", "timeout_s")
+
+    def __init__(self, expires_at: float, timeout_s: float) -> None:
+        self.expires_at = expires_at
+        self.timeout_s = timeout_s
+
+
+_deadline_local = threading.local()
+
+
+@contextlib.contextmanager
+def _sync_deadline(timeout_s: Optional[float]):
+    """Install a sync deadline for the calling thread: every collective
+    round dispatched under it runs on a watchdog (``_run_guarded``) and the
+    budget is shared across rounds — ``timeout_s`` bounds the WHOLE sync,
+    not each round. ``None`` = no deadline (the pre-ISSUE-5 behavior:
+    block forever)."""
+    if timeout_s is None:
+        yield
+        return
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be positive, got {timeout_s}.")
+    prev = getattr(_deadline_local, "deadline", None)
+    _deadline_local.deadline = _Deadline(
+        time.monotonic() + timeout_s, timeout_s
+    )
+    try:
+        yield
+    finally:
+        _deadline_local.deadline = prev
+
+
+def _run_guarded(fn: Callable[[], Any], round_label: str, lane: str) -> Any:
+    """Run one blocking collective round under the active deadline (if any).
+
+    The round executes on a daemon watchdog thread; the caller joins with
+    the remaining budget. On expiry the caller raises
+    :class:`SyncTimeoutError` and moves on — the watchdog thread stays
+    blocked inside the collective (there is no portable way to cancel a
+    native collective) but, being daemonic, never blocks process exit. If
+    the round *raises* instead (a peer death surfaced as a transport
+    error), the error is re-raised as :class:`SyncRoundError` so both
+    failure shapes hit the same ``on_failure`` policy."""
+    deadline = getattr(_deadline_local, "deadline", None)
+    if deadline is None:
+        return fn()
+    remaining = deadline.expires_at - time.monotonic()
+    if remaining <= 0:
+        raise SyncTimeoutError(round_label, lane, deadline.timeout_s)
+    box: Dict[str, Any] = {}
+
+    def _worker() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - relayed to the caller
+            box["error"] = e
+
+    t = threading.Thread(
+        target=_worker, name=f"toolkit-sync-{round_label}", daemon=True
+    )
+    t.start()
+    t.join(remaining)
+    if t.is_alive():
+        raise SyncTimeoutError(round_label, lane, deadline.timeout_s)
+    if "error" in box:
+        raise SyncRoundError(round_label, lane, box["error"]) from box["error"]
+    return box["value"]
+
+
+def _sync_failure(err: SyncError, on_failure: str) -> None:
+    """Account a sync failure and apply the policy: re-raise, or warn ONCE
+    and fall through to the caller's local degraded return."""
+    _obs.counter("toolkit.sync.timeouts", policy=on_failure)
+    if on_failure == "raise":
+        raise err
+    _log_once(
+        "toolkit.sync.degraded",
+        "explicit sync failed (%s); continuing with LOCAL (unsynced) "
+        "results under on_failure='local'. Later syncs may degrade the "
+        "same way; this warning is emitted once per process.",
+        err,
+    )
 
 
 # --------------------------------------------------------------------- local
@@ -294,7 +444,10 @@ def _subgroup_allgather(x: np.ndarray, group: Tuple[int, ...]) -> np.ndarray:
 
 
 def _allgather_stacked(
-    x: np.ndarray, group: Optional[Tuple[int, ...]]
+    x: np.ndarray,
+    group: Optional[Tuple[int, ...]],
+    round_label: str = "collective",
+    lane: str = "typed",
 ) -> np.ndarray:
     """Per-rank-stacked all-gather of a HOST numpy buffer: the full-world
     path rides ``multihost_utils.process_allgather`` (one compiled XLA
@@ -303,18 +456,32 @@ def _allgather_stacked(
     ``(n_members, *x.shape)`` in group order (ascending process index).
 
     Every explicit cross-process collective round funnels through here, so
-    this is where sync-round accounting lives: with obs enabled, each call
-    increments ``toolkit.sync.rounds``, accumulates the local payload bytes
-    sent, and times the round (the gather blocks on the result, so the span
-    is real wall time, not dispatch time). The two-collective-round
-    invariant of :func:`sync_and_compute` is thereby an observable:
-    ``snapshot()["counters"]["toolkit.sync.rounds"]`` reads exactly 2 after
-    one typed sync."""
+    three per-round mechanisms live at this choke point:
+
+    * **accounting** — with obs enabled, each call increments
+      ``toolkit.sync.rounds``, accumulates the local payload bytes sent,
+      and times the round (the gather blocks on the result, so the span is
+      real wall time, not dispatch time). The two-collective-round
+      invariant of :func:`sync_and_compute` is thereby an observable:
+      ``snapshot()["counters"]["toolkit.sync.rounds"]`` reads exactly 2
+      after one typed sync;
+    * **deadlines** — under an active ``timeout_s`` deadline the blocking
+      gather runs on a watchdog thread (:func:`_run_guarded`) and a hang
+      raises :class:`SyncTimeoutError` naming ``round_label``/``lane``;
+    * **fault injection** — the env-gated chaos hook
+      (``resilience/chaos.py``) counts rounds here and can kill or delay
+      this process at a chosen round, which is how the 4-process recovery
+      tests produce a real dead-rank hang."""
+    _chaos.on_sync_round()
     if not _obs.enabled():
-        return _allgather_stacked_impl(x, group)
+        return _run_guarded(
+            lambda: _allgather_stacked_impl(x, group), round_label, lane
+        )
     world = len(group) if group is not None else _world_size()
     with _obs.span("toolkit.sync.round"):
-        out = _allgather_stacked_impl(x, group)
+        out = _run_guarded(
+            lambda: _allgather_stacked_impl(x, group), round_label, lane
+        )
     _obs.counter("toolkit.sync.rounds")
     _obs.counter("toolkit.sync.payload_bytes", float(x.nbytes))
     _obs.gauge("toolkit.sync.world_size", world)
@@ -389,12 +556,17 @@ def _allgather_object(
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     _obs.counter("toolkit.sync.object_lane_bytes", float(payload.size))
     lengths = _allgather_stacked(
-        np.asarray([payload.size], dtype=np.int32), group
+        np.asarray([payload.size], dtype=np.int32),
+        group,
+        "object-length",
+        "object",
     ).reshape(world)
     max_len = int(lengths.max())
     padded = np.zeros(max(max_len, 1), dtype=np.uint8)
     padded[: payload.size] = payload
-    all_bytes = _allgather_stacked(padded, group).reshape(world, -1)
+    all_bytes = _allgather_stacked(
+        padded, group, "object-payload", "object"
+    ).reshape(world, -1)
     return [
         pickle.loads(all_bytes[rank, : lengths[rank]].tobytes())
         for rank in range(world)
@@ -438,6 +610,8 @@ def get_synced_metric(
     recipient_rank: _RecipientRank = 0,
     *,
     processes: _ProcessGroup = None,
+    timeout_s: Optional[float] = None,
+    on_failure: str = "raise",
     _gathered: Optional[List[Dict[str, TState]]] = None,
 ) -> Optional[TMetric]:
     """Sync metric states over all JAX processes — or the ``processes``
@@ -453,12 +627,23 @@ def get_synced_metric(
     :func:`sync_and_compute_collection`); dict-keyed and CUSTOM-reduction
     states fall back to a pickled object gather (:func:`_allgather_object`)
     folded by the metric's own ``merge_state``.
+
+    ``timeout_s`` bounds the WHOLE sync (all collective rounds share the
+    budget); on expiry — or a transport error surfacing a dead peer —
+    ``on_failure="raise"`` raises the :class:`SyncError`, while
+    ``on_failure="local"`` warns once, bumps
+    ``toolkit.sync.timeouts{policy=local}`` and returns a clone of the
+    LOCAL (unsynced) metric on every calling rank — including
+    non-recipients, since the recipient contract is unsatisfiable once the
+    exchange has failed and each survivor's local state is the only data
+    it still has.
     """
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
             "recipient_rank should be an integer or 'all', "
             f"got {recipient_rank} instead."
         )
+    _check_failure_policy(on_failure)
     group = _resolve_group(processes)
     _check_group_recipient(group, recipient_rank)
     world = len(group) if group is not None else _world_size()
@@ -469,21 +654,29 @@ def get_synced_metric(
         )
         return metric
     metric._prepare_for_merge_state()
-    if _gathered is None and _needs_object_sync(metric):
-        return _object_synced_metric(metric, recipient_rank, group)
-    if _gathered is not None:
-        gathered = _gathered
-    else:
-        # ride the batched collection wire: exactly two collective rounds
-        # (descriptor matrix + one concatenated byte payload) regardless of
-        # how many states the metric has — the per-state path pays one round
-        # per SUM/MAX state and two per CAT state, which on a DCN-attached
-        # pod is a per-round latency hit (and on the bench's timeshared
-        # host, a scheduling-noise amplifier)
-        gathered = [
-            per_rank["m"]
-            for per_rank in _gather_collection_states({"m": metric}, group)
-        ]
+    try:
+        with _sync_deadline(timeout_s):
+            if _gathered is None and _needs_object_sync(metric):
+                return _object_synced_metric(metric, recipient_rank, group)
+            if _gathered is not None:
+                gathered = _gathered
+            else:
+                # ride the batched collection wire: exactly two collective
+                # rounds (descriptor matrix + one concatenated byte payload)
+                # regardless of how many states the metric has — the
+                # per-state path pays one round per SUM/MAX state and two
+                # per CAT state, which on a DCN-attached pod is a per-round
+                # latency hit (and on the bench's timeshared host, a
+                # scheduling-noise amplifier)
+                gathered = [
+                    per_rank["m"]
+                    for per_rank in _gather_collection_states(
+                        {"m": metric}, group
+                    )
+                ]
+    except SyncError as err:
+        _sync_failure(err, on_failure)
+        return clone_metric(metric)
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None
     folded = _fold_states(gathered, metric._state_name_to_reduction)
@@ -507,10 +700,20 @@ def get_synced_state_dict(
     recipient_rank: _RecipientRank = 0,
     *,
     processes: _ProcessGroup = None,
+    timeout_s: Optional[float] = None,
+    on_failure: str = "raise",
 ) -> Dict[str, TState]:
     """Globally-merged ``state_dict``; ``{}`` on non-recipient ranks
-    (reference ``toolkit.py:81-118``; ``processes`` = subgroup sync)."""
-    synced = get_synced_metric(metric, recipient_rank, processes=processes)
+    (reference ``toolkit.py:81-118``; ``processes`` = subgroup sync;
+    ``timeout_s``/``on_failure`` as in :func:`get_synced_metric` — a
+    degraded ``"local"`` call returns the LOCAL state dict)."""
+    synced = get_synced_metric(
+        metric,
+        recipient_rank,
+        processes=processes,
+        timeout_s=timeout_s,
+        on_failure=on_failure,
+    )
     return synced.state_dict() if synced is not None else {}
 
 
@@ -520,6 +723,8 @@ def sync_and_compute(
     recipient_rank: _RecipientRank = 0,
     *,
     processes: _ProcessGroup = None,
+    timeout_s: Optional[float] = None,
+    on_failure: str = "raise",
 ) -> Optional[Any]:
     """Sync states across all processes — or the ``processes`` subgroup —
     and compute on the recipient rank(s).
@@ -528,8 +733,19 @@ def sync_and_compute(
     ``process_group`` role). Because states travel as typed arrays (not
     pickled objects), every rank could fold cheaply; we still honor the
     recipient contract — non-recipients get ``None``.
+
+    ``timeout_s`` + ``on_failure="local"`` is the preemption-survival
+    spelling: if a rank died and the collective hangs, every survivor
+    returns its LOCAL compute within the deadline instead of wedging
+    (see :func:`get_synced_metric` for the exact degradation contract).
     """
-    synced = get_synced_metric(metric, recipient_rank, processes=processes)
+    synced = get_synced_metric(
+        metric,
+        recipient_rank,
+        processes=processes,
+        timeout_s=timeout_s,
+        on_failure=on_failure,
+    )
     if synced is None:
         return None
     return synced.compute()
@@ -686,7 +902,7 @@ def _gather_collection_states(
         + [_encode_entry_descriptor(local) for _, _, _, local in entries],
         dtype=np.int32,
     ).reshape(len(entries) + 1, 7)
-    all_desc = _allgather_stacked(desc, group).reshape(
+    all_desc = _allgather_stacked(desc, group, "descriptor", "typed").reshape(
         world, len(entries) + 1, 7
     )
     # uniform validation AFTER the exchange (a one-sided raise would hang the
@@ -772,7 +988,9 @@ def _gather_collection_states(
         raw = np.ascontiguousarray(local).view(np.uint8).reshape(-1)
         payload[offset : offset + raw.size] = raw
         offset += raw.size
-    all_bytes = _allgather_stacked(payload, group).reshape(world, max_total)
+    all_bytes = _allgather_stacked(
+        payload, group, "payload", "typed"
+    ).reshape(world, max_total)
     gathered: List[Dict[str, Dict[str, TState]]] = [
         {mkey: {} for mkey in metrics} for _ in range(world)
     ]
@@ -809,6 +1027,8 @@ def sync_and_compute_collection(
     recipient_rank: _RecipientRank = 0,
     *,
     processes: _ProcessGroup = None,
+    timeout_s: Optional[float] = None,
+    on_failure: str = "raise",
 ) -> Optional[Dict[str, Any]]:
     """Sync and compute a named collection of metrics in ONE gather pass.
 
@@ -817,12 +1037,19 @@ def sync_and_compute_collection(
     object lane (dict-keyed / CUSTOM states) share a single pickled gather.
     ``processes`` restricts the sync to a subgroup (reference
     ``process_group`` semantics). Results follow :func:`sync_and_compute`
-    semantics per metric: ``None`` on non-recipient ranks."""
+    semantics per metric: ``None`` on non-recipient ranks.
+
+    ``timeout_s`` bounds ALL of the collection's collective rounds under
+    one shared budget; on failure with ``on_failure="local"`` every
+    calling rank gets ``{name: local compute}`` for the whole collection
+    (one degraded exchange degrades every member uniformly — a mixed
+    synced/unsynced result dict would be unreadable)."""
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
             "recipient_rank should be an integer or 'all', "
             f"got {recipient_rank} instead."
         )
+    _check_failure_policy(on_failure)
     group = _resolve_group(processes)
     _check_group_recipient(group, recipient_rank)
     world = len(group) if group is not None else _world_size()
@@ -836,15 +1063,25 @@ def sync_and_compute_collection(
         m._prepare_for_merge_state()
     obj_lane = {k: m for k, m in metrics.items() if _needs_object_sync(m)}
     arr_lane = {k: m for k, m in metrics.items() if k not in obj_lane}
-    gathered = _gather_collection_states(arr_lane, group) if arr_lane else None
-    obj_gathered = (
-        _allgather_object(
-            {k: _tree_to_host(m.state_dict()) for k, m in obj_lane.items()},
-            group,
-        )
-        if obj_lane
-        else None
-    )
+    try:
+        with _sync_deadline(timeout_s):
+            gathered = (
+                _gather_collection_states(arr_lane, group) if arr_lane else None
+            )
+            obj_gathered = (
+                _allgather_object(
+                    {
+                        k: _tree_to_host(m.state_dict())
+                        for k, m in obj_lane.items()
+                    },
+                    group,
+                )
+                if obj_lane
+                else None
+            )
+    except SyncError as err:
+        _sync_failure(err, on_failure)
+        return {name: m.compute() for name, m in metrics.items()} or None
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None
     out: Dict[str, Any] = {}

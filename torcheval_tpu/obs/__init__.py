@@ -20,6 +20,11 @@ One subsystem, four pieces (ISSUE 1 tentpole):
   two-collective-round invariant is an observable, not only a test
   assertion.
 
+The resilience layer (ISSUE 5) reports here too:
+``toolkit.sync.timeouts{policy=raise|local}`` (sync deadline expiries and
+degraded-mode falls), ``resilience.checkpoint.{saves,restores,bytes}`` and
+``bootstrap.retries`` — see docs/robustness.md.
+
 Usage::
 
     from torcheval_tpu import obs

@@ -23,13 +23,27 @@ from __future__ import annotations
 
 import logging
 import os
+import random
+import time
 from typing import Optional, Tuple
 
 import jax
 
+from torcheval_tpu.obs import registry as _obs
+
 _logger = logging.getLogger(__name__)
 
 __all__ = ["init_from_env", "is_initialized", "shutdown"]
+
+# coordinator-connection retry policy (ISSUE 5): on a preemptible slice the
+# coordinator process routinely comes up seconds after its workers (or is
+# itself restarted mid-join), so one-shot connection failure is an ordinary
+# launch race, not an error. Bounded exponential backoff with jitter —
+# jitter because a whole pod retrying in lockstep re-creates the thundering
+# herd that made the first attempt fail.
+_DEFAULT_CONNECT_ATTEMPTS = 3
+_CONNECT_ATTEMPTS_ENV = "TORCHEVAL_TPU_CONNECT_ATTEMPTS"
+_BACKOFF_CAP_S = 30.0
 
 
 def _resolve_env(environ) -> Tuple[Optional[str], Optional[int], Optional[int]]:
@@ -127,12 +141,40 @@ def is_initialized() -> bool:
             return False
 
 
+def _reset_partial_init() -> None:
+    """Clear runtime state left behind by a FAILED ``jax.distributed.
+    initialize``: the runtime assigns its client object before the
+    connection attempt, so a connect failure leaves ``is_initialized()``
+    true and every subsequent initialize raising "should only be called
+    once" — which would turn the retry loop below into a no-op that burns
+    its backoff sleeps on an instant, misleading error."""
+    try:
+        jax.distributed.shutdown()
+        return
+    except Exception:
+        pass
+    # a client that never connected can fail its own shutdown; fall back to
+    # clearing the runtime state object directly so the next attempt starts
+    # from scratch (best effort — internals may move)
+    try:
+        from jax._src.distributed import global_state
+
+        global_state.client = None
+        global_state.service = None
+        if hasattr(global_state, "preemption_sync_manager"):
+            global_state.preemption_sync_manager = None
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+
+
 def init_from_env(
     *,
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[list] = None,
+    connect_attempts: Optional[int] = None,
+    connect_backoff_s: float = 1.0,
 ) -> Tuple[int, int]:
     """Join (or confirm membership in) the multi-process JAX world.
 
@@ -141,6 +183,27 @@ def init_from_env(
     Idempotent: if the runtime is already initialized, logs and returns the
     existing coordinates — matching the reference's world-size guards
     (reference ``toolkit.py:199-215``) rather than raising.
+
+    Coordinator connection failures (the runtime's ``RuntimeError`` family —
+    configuration errors raise ``ValueError`` and are never retried) are
+    retried up to ``connect_attempts`` times (default 3, or
+    ``TORCHEVAL_TPU_CONNECT_ATTEMPTS``) with exponential backoff starting at
+    ``connect_backoff_s`` seconds, capped at 30 s, each sleep jittered to
+    0.5-1.5× so a restarted pod does not reconverge on the coordinator in
+    lockstep. A failed attempt leaves the runtime half-initialized (its
+    client object is assigned before the connection is attempted), so each
+    retry first resets that state — without it, every retry would raise
+    "should only be called once" instead of reconnecting. Each retry bumps
+    the ``bootstrap.retries`` obs counter; the final failure re-raises the
+    runtime's own error.
+
+    Caveat (verified against this jaxlib build): some CLIENT-side connect
+    failures — e.g. a dead/unresolvable coordinator timing out RegisterTask
+    — are handled by the C++ distributed client as a fatal abort of the
+    whole process before Python sees any exception. No in-process retry can
+    cover that shape; the recovery story there is the scheduler restarting
+    the worker and ``torcheval_tpu.resilience.restore()`` reloading state.
+    The retry layer covers every failure the runtime *raises*.
 
     Returns ``(process_index, process_count)``. In a single-process run with
     no coordinator configured anywhere, skips initialization entirely and
@@ -188,8 +251,48 @@ def init_from_env(
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
+    if connect_attempts is None:
+        connect_attempts = int(
+            os.environ.get(_CONNECT_ATTEMPTS_ENV, _DEFAULT_CONNECT_ATTEMPTS)
+        )
+    if connect_attempts < 1:
+        raise ValueError(
+            f"connect_attempts must be >= 1, got {connect_attempts}."
+        )
     _enable_cpu_collectives()
-    jax.distributed.initialize(**kwargs)
+    delay_s = connect_backoff_s
+    for attempt in range(1, connect_attempts + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            break
+        except RuntimeError as e:
+            # the runtime reports coordinator-unreachable/handshake-deadline
+            # failures as RuntimeError (XlaRuntimeError subclasses it);
+            # ValueError (bad arguments) propagates immediately above.
+            # Either way the failed attempt may have left the runtime
+            # half-initialized — reset it, or the next initialize (ours or
+            # a caller-level retry) raises "called once" instead of
+            # reconnecting.
+            _reset_partial_init()
+            if attempt == connect_attempts:
+                _logger.error(
+                    "init_from_env: coordinator connection failed after "
+                    "%d attempt(s); giving up.",
+                    connect_attempts,
+                )
+                raise
+            sleep_s = min(delay_s, _BACKOFF_CAP_S) * (0.5 + random.random())
+            _logger.warning(
+                "init_from_env: coordinator connection failed (attempt "
+                "%d/%d: %s); retrying in %.1fs.",
+                attempt,
+                connect_attempts,
+                e,
+                sleep_s,
+            )
+            _obs.counter("bootstrap.retries")
+            time.sleep(sleep_s)
+            delay_s *= 2
     return jax.process_index(), jax.process_count()
 
 

@@ -88,6 +88,20 @@ class ShardedEvaluator:
         self._collection.reset()
         return self
 
+    # ------------------------------------------------------- checkpointing
+    # the resilience snapshot engine (torcheval_tpu.resilience) talks to the
+    # evaluator through the same per-member state-dict protocol as the
+    # collection; restored state lands back in the metrics' replicated mesh
+    # placement via each metric's own load_state_dict -> put_state.
+    def state_dicts(self) -> Dict[str, Dict[str, Any]]:
+        return self._collection.state_dicts()
+
+    def load_state_dicts(
+        self, state_dicts: Dict[str, Dict[str, Any]], strict: bool = True
+    ) -> "ShardedEvaluator":
+        self._collection.load_state_dicts(state_dicts, strict)
+        return self
+
 
 def _is_batch_arraylike(x: Any) -> bool:
     """Array-like with a leading batch axis (0-d scalars pass through)."""

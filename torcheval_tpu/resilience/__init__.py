@@ -1,0 +1,57 @@
+"""``torcheval_tpu.resilience``: failure handling for the eval stack.
+
+Three legs (ISSUE 5 tentpole) — the failure-semantics table lives in
+``docs/robustness.md``:
+
+* **Atomic checkpoint/restore** (``snapshot.py``) — ``save``/``restore``
+  for any state holder (Metric / MetricCollection / ShardedEvaluator /
+  metric dict): temp-then-rename publishes, SHA-256 content checksums, the
+  sync wire's schema digest, ``keep_last`` rotation, and structured
+  :class:`CheckpointError` rejection of corrupt or mismatched payloads.
+* **Sync deadlines + graceful degradation** (``metrics/toolkit.py``) —
+  every explicit sync API takes ``timeout_s=`` (a watchdog around the
+  blocking collective, raising :class:`SyncTimeoutError` naming the round
+  and lane) and ``on_failure="raise"|"local"`` (``"local"`` returns the
+  local, unsynced result so a dead rank degrades the report instead of
+  hanging the job); ``parallel.init_from_env`` retries coordinator
+  connection with bounded exponential backoff.
+* **Fault injection** (``chaos.py``) — env-gated test-only hooks that kill
+  or delay a chosen rank at a chosen sync round, driving the 4-process
+  recovery tests in ``tests/resilience/``.
+
+Obs counters: ``resilience.checkpoint.{saves,restores,bytes}``,
+``toolkit.sync.timeouts{policy=}``, ``bootstrap.retries``.
+"""
+
+from torcheval_tpu.resilience.snapshot import (
+    CheckpointError,
+    latest_checkpoint,
+    list_checkpoints,
+    restore,
+    save,
+)
+
+__all__ = [
+    "CheckpointError",
+    "SyncError",
+    "SyncRoundError",
+    "SyncTimeoutError",
+    "latest_checkpoint",
+    "list_checkpoints",
+    "restore",
+    "save",
+]
+
+_TOOLKIT_REEXPORTS = ("SyncError", "SyncRoundError", "SyncTimeoutError")
+
+
+def __getattr__(name: str):
+    # lazy re-export (PEP 562): the sync failure types are DEFINED in
+    # metrics/toolkit.py (next to the sync APIs that raise them) and only
+    # surfaced here; importing toolkit eagerly would cycle, because toolkit
+    # itself imports resilience.chaos for the fault-injection funnel.
+    if name in _TOOLKIT_REEXPORTS:
+        from torcheval_tpu.metrics import toolkit
+
+        return getattr(toolkit, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,136 @@
+"""Env-gated fault injection for the explicit sync path (test-only).
+
+A preemption on a real TPU slice looks, from the surviving processes' point
+of view, like one rank silently vanishing (or stalling) between two
+collective rounds — the healthy ranks then block forever inside the next
+collective. The multiprocess fault-injection tests
+(``tests/resilience/test_fault_injection.py``) reproduce exactly that by
+arming this module through the environment before launching a world:
+
+``TORCHEVAL_TPU_CHAOS``
+    ``"1"`` arms the hooks; anything else (or unset) leaves them disabled.
+    Disabled cost is one cached-config check per *collective round* — host
+    code on a path that is about to block on the network, so it is free.
+``TORCHEVAL_TPU_CHAOS_RANK``
+    Global process index the fault targets; other ranks never act.
+``TORCHEVAL_TPU_CHAOS_ROUND``
+    1-based index of the explicit collective round (every
+    ``toolkit._allgather_stacked`` call counts one round, process-wide) at
+    which the fault fires. A ``sync_and_compute`` is two rounds, so round 3
+    is "entering the descriptor exchange of the second sync".
+``TORCHEVAL_TPU_CHAOS_ACTION``
+    ``"kill"`` (default) — ``os._exit(TORCHEVAL_TPU_CHAOS_EXIT_CODE)``,
+    modelling a hard preemption: no Python cleanup, no atexit, no goodbye
+    to the coordinator. ``"delay"`` — sleep ``TORCHEVAL_TPU_CHAOS_DELAY_S``
+    seconds before entering the round, modelling a straggler.
+``TORCHEVAL_TPU_CHAOS_DELAY_S``
+    Straggler sleep, seconds (default 30).
+``TORCHEVAL_TPU_CHAOS_EXIT_CODE``
+    Exit code for ``kill`` (default 43), so a launcher can tell an injected
+    death from a genuine crash.
+
+The hook lives at the one funnel every explicit cross-process collective
+round already passes through (``toolkit._allgather_stacked``), so the
+injection point is the real preemption surface, not a mock: the surviving
+ranks execute the genuine Gloo collective and the genuine watchdog path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+_logger = logging.getLogger(__name__)
+
+_ENV_ARM = "TORCHEVAL_TPU_CHAOS"
+_ENV_RANK = "TORCHEVAL_TPU_CHAOS_RANK"
+_ENV_ROUND = "TORCHEVAL_TPU_CHAOS_ROUND"
+_ENV_ACTION = "TORCHEVAL_TPU_CHAOS_ACTION"
+_ENV_DELAY = "TORCHEVAL_TPU_CHAOS_DELAY_S"
+_ENV_EXIT = "TORCHEVAL_TPU_CHAOS_EXIT_CODE"
+
+
+class _ChaosConfig:
+    __slots__ = ("rank", "round", "action", "delay_s", "exit_code")
+
+    def __init__(self, rank: int, rnd: int, action: str, delay_s: float, exit_code: int):
+        self.rank = rank
+        self.round = rnd
+        self.action = action
+        self.delay_s = delay_s
+        self.exit_code = exit_code
+
+
+# resolved lazily on first round; False = disarmed, None = not yet resolved
+_config: Optional[object] = None
+_rounds_seen = 0
+_lock = threading.Lock()
+
+
+def _resolve() -> object:
+    """Parse the environment once. A malformed configuration disarms with a
+    warning rather than raising — chaos must never be able to break a
+    production job that merely inherited a stale variable."""
+    global _config
+    if os.environ.get(_ENV_ARM) != "1":
+        _config = False
+        return _config
+    try:
+        rank = int(os.environ[_ENV_RANK])
+        rnd = int(os.environ[_ENV_ROUND])
+        action = os.environ.get(_ENV_ACTION, "kill")
+        if action not in ("kill", "delay"):
+            raise ValueError(f"unknown chaos action {action!r}")
+        delay_s = float(os.environ.get(_ENV_DELAY, "30"))
+        exit_code = int(os.environ.get(_ENV_EXIT, "43"))
+    except (KeyError, ValueError) as e:
+        _logger.warning("chaos hooks armed but misconfigured (%s); disarming.", e)
+        _config = False
+        return _config
+    _config = _ChaosConfig(rank, rnd, action, delay_s, exit_code)
+    return _config
+
+
+def reset_for_tests() -> None:
+    """Re-read the environment and restart the round counter (test hook)."""
+    global _config, _rounds_seen
+    with _lock:
+        _config = None
+        _rounds_seen = 0
+
+
+def on_sync_round() -> None:
+    """Called by ``toolkit._allgather_stacked`` before every explicit
+    collective round. No-op unless armed for this process at this round."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    if cfg is False:
+        return
+    global _rounds_seen
+    with _lock:
+        _rounds_seen += 1
+        seen = _rounds_seen
+    import jax
+
+    if jax.process_index() != cfg.rank or seen != cfg.round:
+        return
+    if cfg.action == "kill":
+        _logger.warning(
+            "chaos: killing rank %d at sync round %d (exit %d)",
+            cfg.rank,
+            seen,
+            cfg.exit_code,
+        )
+        # a preemption does not run atexit handlers or close collectives
+        os._exit(cfg.exit_code)
+    _logger.warning(
+        "chaos: delaying rank %d at sync round %d by %.1fs",
+        cfg.rank,
+        seen,
+        cfg.delay_s,
+    )
+    time.sleep(cfg.delay_s)

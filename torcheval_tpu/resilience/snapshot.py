@@ -1,0 +1,477 @@
+"""Atomic metric checkpoint/restore: the preemption-survival spine.
+
+A multi-hour streaming eval on a preemptible slice loses every accumulated
+state on the first preemption unless that state periodically reaches durable
+storage. The reference library ships no failure handling at all (SURVEY
+§5.3); this module gives every state holder in the stack — a ``Metric``, a
+``MetricCollection``, a ``ShardedEvaluator``, or a plain ``{name: Metric}``
+dict — one pair of entry points:
+
+``save(obj, directory)``
+    Folds any deferred pending chunks first (``Metric._fold_now`` — the
+    checkpoint must capture the *logical* state, not the physical
+    pending-list representation), snapshots every ``state_dict()`` tree, and
+    writes ONE checkpoint directory ``ckpt-<step>/`` containing
+
+    * ``state.npz`` — every array leaf, in (metric key, registered state
+      order) enumeration order, exact bytes;
+    * ``manifest.json`` — format version, step, the **schema digest**
+      (``toolkit._schema_digest_row``'s ordered ``(key, class, state,
+      reduction, config-extra)`` scheme — the same digest the sync wire
+      validates), a SHA-256 content checksum of the payload, and per-state
+      container metadata (list/deque/dict structure, deque ``maxlen``,
+      dict keys).
+
+    The write is **temp-then-rename** (torchsnapshot's atomic manifest
+    design): everything lands in a hidden ``.tmp-*`` directory, is fsynced,
+    and is published with a single ``os.replace`` — a crash at any earlier
+    point leaves no ``ckpt-*`` entry, so a reader can never observe a
+    partial checkpoint. ``keep_last=N`` rotates old checkpoints after a
+    successful publish.
+
+``restore(obj, path)``
+    Validates the SHA-256 checksum and the schema digest *before* touching
+    any metric state, and raises a structured :class:`CheckpointError`
+    (``.reason`` in ``{"not_found", "corrupt_manifest", "corrupt_payload",
+    "checksum_mismatch", "schema_mismatch"}``) instead of silently loading
+    garbage. On success every metric's ``load_state_dict`` installs the
+    restored tree (placed on the metric's current device/sharding), and a
+    subsequent ``compute()`` is bit-identical to one taken at save time.
+
+Multi-process note: checkpoints are **per-process** — each rank saves its
+local replica into its own directory (state is process-local in the explicit
+sync model, and replicated-identical in the SPMD model where any one
+process's snapshot is the global truth). 64-bit state dtypes survive the npz
+round trip exactly, but installing them through ``load_state_dict`` follows
+JAX's ``jax_enable_x64`` setting like every other state write.
+
+Observability: ``resilience.checkpoint.saves`` / ``.restores`` /
+``.bytes`` (bytes written per save) in the obs registry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Tuple
+from zipfile import BadZipFile
+
+import numpy as np
+
+from torcheval_tpu.obs import registry as _obs
+
+_logger = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+_MANIFEST = "manifest.json"
+_PAYLOAD = "state.npz"
+_CKPT_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-"
+
+__all__ = [
+    "CheckpointError",
+    "save",
+    "restore",
+    "latest_checkpoint",
+    "list_checkpoints",
+]
+
+
+class CheckpointError(RuntimeError):
+    """Structured checkpoint failure.
+
+    ``reason`` is machine-readable: ``"not_found"`` (no checkpoint at the
+    path), ``"corrupt_manifest"`` (unparseable/incomplete manifest),
+    ``"corrupt_payload"`` (payload unreadable or missing leaves),
+    ``"checksum_mismatch"`` (payload bytes differ from the manifest's
+    SHA-256 — bit rot or a torn copy), ``"schema_mismatch"`` (the
+    checkpoint was taken from a different metric set/configuration than the
+    restore target), ``"unsupported"`` (a state the format cannot carry).
+    """
+
+    def __init__(self, reason: str, message: str) -> None:
+        super().__init__(f"[{reason}] {message}")
+        self.reason = reason
+
+
+# --------------------------------------------------------------- normalising
+def _as_metrics(obj: Any) -> Dict[str, Any]:
+    """Normalise every supported state holder to ``{key: Metric}`` — the
+    same shape the sync toolkit's collection wire uses, so the schema digest
+    is comparable across holder types. A bare ``Metric`` wraps under the
+    fixed key ``"metric"`` (matching ``MetricCollection``'s single-metric
+    spelling), so ``save(metric)`` → ``restore(fresh_metric)`` round-trips.
+    """
+    from torcheval_tpu.metrics.collection import MetricCollection
+    from torcheval_tpu.metrics.metric import Metric
+
+    if isinstance(obj, Metric):
+        return {"metric": obj}
+    if isinstance(obj, MetricCollection):
+        return obj.metrics
+    # ShardedEvaluator (avoid importing parallel here: it pulls mesh setup)
+    metrics = getattr(obj, "metrics", None)
+    if metrics is not None and all(
+        isinstance(m, Metric) for m in dict(metrics).values()
+    ):
+        return dict(metrics)
+    if isinstance(obj, dict) and obj and all(
+        isinstance(m, Metric) for m in obj.values()
+    ):
+        return dict(obj)
+    raise TypeError(
+        "save/restore accepts a Metric, a MetricCollection, a "
+        f"ShardedEvaluator, or a non-empty dict of Metrics; got {type(obj)!r}."
+    )
+
+
+def _schema_digest(metrics: Dict[str, Any]) -> List[int]:
+    from torcheval_tpu.metrics.toolkit import _schema_digest_row
+
+    return [int(v) for v in _schema_digest_row(metrics)]
+
+
+# ------------------------------------------------------------- tree flatten
+_JSON_KEY_TYPES = (str, int, float, bool, type(None))
+
+
+def _flatten_states(
+    metrics: Dict[str, Any],
+) -> Tuple[Dict[str, np.ndarray], List[dict]]:
+    """Flatten every metric's state tree into named npz leaves plus a
+    manifest entry per state carrying the container structure."""
+    arrays: Dict[str, np.ndarray] = {}
+    entries: List[dict] = []
+    n = 0
+
+    def leaf(value) -> str:
+        nonlocal n
+        key = f"a{n}"
+        n += 1
+        arrays[key] = np.asarray(value)
+        return key
+
+    for mkey, metric in metrics.items():
+        sd = metric.state_dict()
+        for name in metric._state_name_to_reduction:
+            value = sd[name]
+            entry: dict = {"metric": mkey, "state": name}
+            if isinstance(value, deque):
+                entry["kind"] = "deque"
+                entry["maxlen"] = value.maxlen
+                entry["leaves"] = [leaf(v) for v in value]
+            elif isinstance(value, list):
+                entry["kind"] = "list"
+                entry["leaves"] = [leaf(v) for v in value]
+            elif isinstance(value, dict):
+                bad = [k for k in value if not isinstance(k, _JSON_KEY_TYPES)]
+                if bad:
+                    raise CheckpointError(
+                        "unsupported",
+                        f"dict state {name!r} of metric {mkey!r} has "
+                        f"non-JSON-serialisable keys {bad!r}; checkpointing "
+                        "requires str/int/float/bool dict keys.",
+                    )
+                entry["kind"] = "dict"
+                entry["keys"] = list(value.keys())
+                entry["leaves"] = [leaf(v) for v in value.values()]
+            else:
+                entry["kind"] = "array"
+                entry["leaves"] = [leaf(value)]
+            entries.append(entry)
+    return arrays, entries
+
+
+def _rebuild_state(entry: dict, payload, default) -> Any:
+    """Inverse of one :func:`_flatten_states` entry, using the restore
+    target's registered ``default`` to re-impose container semantics the
+    wire format does not carry (defaultdict factories)."""
+    try:
+        leaves = [payload[k] for k in entry["leaves"]]
+    except KeyError as e:
+        raise CheckpointError(
+            "corrupt_payload",
+            f"payload is missing leaf {e} for state "
+            f"{entry['state']!r} of metric {entry['metric']!r}.",
+        ) from None
+    kind = entry["kind"]
+    if kind == "array":
+        return leaves[0]
+    if kind == "list":
+        return leaves
+    if kind == "deque":
+        return deque(leaves, maxlen=entry.get("maxlen"))
+    if kind == "dict":
+        out = dict(zip(entry["keys"], leaves))
+        if isinstance(default, defaultdict) and default.default_factory:
+            d = defaultdict(default.default_factory)
+            d.update(out)
+            return d
+        if isinstance(default, dict):
+            # mirror Metric.reset: plain-dict defaults get the reference's
+            # missing-key-is-zero semantics after any wholesale state write
+            from torcheval_tpu.metrics.metric import _zero_scalar
+
+            d = defaultdict(_zero_scalar)
+            d.update(out)
+            return d
+        return out
+    raise CheckpointError(
+        "corrupt_manifest", f"unknown state container kind {kind!r}."
+    )
+
+
+# --------------------------------------------------------------- dir layout
+def _step_of(name: str) -> Optional[int]:
+    if not name.startswith(_CKPT_PREFIX):
+        return None
+    try:
+        return int(name[len(_CKPT_PREFIX):])
+    except ValueError:
+        return None
+
+
+def list_checkpoints(directory: str) -> List[str]:
+    """Published checkpoint paths under ``directory``, oldest first."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    steps = sorted(
+        (s, n) for n in names if (s := _step_of(n)) is not None
+    )
+    return [os.path.join(directory, n) for _, n in steps]
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest published checkpoint path, or ``None``."""
+    ckpts = list_checkpoints(directory)
+    return ckpts[-1] if ckpts else None
+
+
+def _fsync_file(path: str) -> None:
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platform without directory fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+# --------------------------------------------------------------------- save
+def save(
+    obj: Any,
+    directory: str,
+    *,
+    step: Optional[int] = None,
+    keep_last: Optional[int] = None,
+) -> str:
+    """Write one atomic checkpoint of ``obj`` under ``directory``.
+
+    ``step`` defaults to one past the newest existing checkpoint. With
+    ``keep_last=N``, older checkpoints beyond the newest ``N`` are removed
+    after the new one is durably published (rotation can therefore never
+    leave fewer than one complete checkpoint behind). Returns the published
+    checkpoint path.
+    """
+    if keep_last is not None and keep_last < 1:
+        # validate BEFORE any side effect: rejecting the argument after the
+        # checkpoint has published would hand the caller an error plus a
+        # checkpoint it did not expect to exist
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}.")
+    metrics = _as_metrics(obj)
+    for m in metrics.values():
+        # capture the logical state: deferred pending chunks fold first
+        # (state_dict folds too — this makes the contract explicit and keeps
+        # it even if a subclass overrides state_dict)
+        m._fold_now()
+    with _obs.span("resilience.checkpoint.save"):
+        arrays, entries = _flatten_states(metrics)
+        os.makedirs(directory, exist_ok=True)
+        if step is None:
+            existing = [
+                s for n in os.listdir(directory)
+                if (s := _step_of(n)) is not None
+            ]
+            step = (max(existing) + 1) if existing else 0
+        final = os.path.join(directory, f"{_CKPT_PREFIX}{step:08d}")
+        if os.path.exists(final):
+            raise CheckpointError(
+                "unsupported", f"checkpoint step {step} already exists at {final}."
+            )
+        tmp = os.path.join(
+            directory, f"{_TMP_PREFIX}{_CKPT_PREFIX}{step:08d}-{os.getpid()}"
+        )
+        os.makedirs(tmp)
+        try:
+            payload_path = os.path.join(tmp, _PAYLOAD)
+            # exact bytes, uncompressed: the checksum (not zlib) is the
+            # integrity mechanism, and save sits on the eval hot path
+            np.savez(payload_path, **arrays)
+            digest = hashlib.sha256()
+            with open(payload_path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    digest.update(chunk)
+            manifest = {
+                "format_version": _FORMAT_VERSION,
+                "step": step,
+                "schema_digest": _schema_digest(metrics),
+                "payload": _PAYLOAD,
+                "payload_sha256": digest.hexdigest(),
+                "payload_bytes": os.path.getsize(payload_path),
+                "metrics": {
+                    k: type(m).__qualname__ for k, m in metrics.items()
+                },
+                "entries": entries,
+            }
+            manifest_path = os.path.join(tmp, _MANIFEST)
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_file(payload_path)
+            _fsync_dir(tmp)
+            # the atomic publish: a crash anywhere above leaves only a
+            # .tmp-* directory, which no reader ever considers
+            os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        _fsync_dir(directory)
+    nbytes = manifest["payload_bytes"] + os.path.getsize(
+        os.path.join(final, _MANIFEST)
+    )
+    _obs.counter("resilience.checkpoint.saves")
+    _obs.counter("resilience.checkpoint.bytes", float(nbytes))
+    if keep_last is not None:
+        for old in list_checkpoints(directory)[:-keep_last]:
+            shutil.rmtree(old, ignore_errors=True)
+    return final
+
+
+# ------------------------------------------------------------------ restore
+def _read_manifest(ckpt: str) -> dict:
+    manifest_path = os.path.join(ckpt, _MANIFEST)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        raise CheckpointError(
+            "not_found", f"no manifest at {manifest_path}."
+        ) from None
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointError(
+            "corrupt_manifest", f"unreadable manifest at {manifest_path}: {e}"
+        ) from None
+    for field in ("format_version", "schema_digest", "payload_sha256", "entries"):
+        if field not in manifest:
+            raise CheckpointError(
+                "corrupt_manifest",
+                f"manifest at {manifest_path} is missing {field!r}.",
+            )
+    if manifest["format_version"] != _FORMAT_VERSION:
+        raise CheckpointError(
+            "corrupt_manifest",
+            f"unsupported checkpoint format_version "
+            f"{manifest['format_version']} (this build reads {_FORMAT_VERSION}).",
+        )
+    return manifest
+
+
+def restore(obj: Any, path: str) -> Any:
+    """Restore ``obj``'s metric states from ``path`` — a checkpoint
+    directory, or a parent directory whose newest ``ckpt-*`` is used.
+
+    Validation order: manifest parse → payload checksum → schema digest →
+    payload decode. Any failure raises :class:`CheckpointError` *before*
+    any metric state is written, so a failed restore never leaves ``obj``
+    half-loaded. Returns ``obj``.
+    """
+    metrics = _as_metrics(obj)
+    ckpt = path
+    if not os.path.exists(os.path.join(ckpt, _MANIFEST)):
+        nested = latest_checkpoint(path)
+        if nested is None:
+            raise CheckpointError(
+                "not_found", f"no checkpoint found under {path!r}."
+            )
+        ckpt = nested
+    with _obs.span("resilience.checkpoint.restore"):
+        manifest = _read_manifest(ckpt)
+        payload_path = os.path.join(ckpt, manifest.get("payload", _PAYLOAD))
+        digest = hashlib.sha256()
+        try:
+            with open(payload_path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    digest.update(chunk)
+        except OSError as e:
+            raise CheckpointError(
+                "corrupt_payload", f"unreadable payload {payload_path}: {e}"
+            ) from None
+        if digest.hexdigest() != manifest["payload_sha256"]:
+            raise CheckpointError(
+                "checksum_mismatch",
+                f"payload {payload_path} does not match its manifest "
+                f"checksum (expected {manifest['payload_sha256']}, got "
+                f"{digest.hexdigest()}); refusing to load a torn or "
+                "bit-rotted checkpoint.",
+            )
+        if _schema_digest(metrics) != list(manifest["schema_digest"]):
+            raise CheckpointError(
+                "schema_mismatch",
+                f"checkpoint at {ckpt} was taken from a different metric "
+                "set than the restore target (metric keys, classes, state "
+                "names, reductions and fold-relevant configuration — e.g. "
+                "windowed metrics' window_size — must all match; saved "
+                f"metrics: {manifest.get('metrics')}).",
+            )
+        try:
+            with np.load(payload_path, allow_pickle=False) as payload:
+                trees: Dict[str, Dict[str, Any]] = {k: {} for k in metrics}
+                for entry in manifest["entries"]:
+                    mkey, sname = entry["metric"], entry["state"]
+                    if mkey not in metrics:
+                        raise CheckpointError(
+                            "schema_mismatch",
+                            f"manifest names unknown metric {mkey!r}.",
+                        )
+                    default = metrics[mkey]._state_name_to_default.get(sname)
+                    value = _rebuild_state(entry, payload, default)
+                    if (
+                        entry["kind"] == "array"
+                        and hasattr(default, "shape")
+                        and tuple(value.shape) != tuple(default.shape)
+                    ):
+                        # config drift the digest cannot see: two replicas
+                        # of the same class/state/reduction schema whose
+                        # constructor args size the state differently
+                        # (e.g. macro accuracy's per-class counters under
+                        # a different num_classes)
+                        raise CheckpointError(
+                            "schema_mismatch",
+                            f"state {sname!r} of metric {mkey!r} has shape "
+                            f"{tuple(value.shape)} in the checkpoint but "
+                            f"{tuple(default.shape)} in the restore target "
+                            "— same metric schema, drifted configuration "
+                            "(e.g. num_classes/num_tasks)?",
+                        )
+                    trees[mkey][sname] = value
+        except (ValueError, OSError, BadZipFile) as e:
+            raise CheckpointError(
+                "corrupt_payload", f"undecodable payload {payload_path}: {e}"
+            ) from None
+        for mkey, tree in trees.items():
+            metrics[mkey].load_state_dict(tree)
+    _obs.counter("resilience.checkpoint.restores")
+    return obj
