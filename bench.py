@@ -424,19 +424,31 @@ def config1_simple_accuracy():
     # the floor does — r3's 841M vs r4's 282M at 0.556 vs 0.909 ms floors
     # is the same ~3-6 dispatches either way. env_dispatch_floor (last row
     # of the bench) completes the (floor, python, device) triple.
+    # ISSUE 6 targets, regression-pinned: python_host < 1 ms (vs r05's
+    # 2.635 — concrete-type fast paths cut the per-update python to
+    # ~3.4 µs, host-side numpy defaults cut construction from 4 device
+    # dispatches per state to 1, and the donated window step pins its
+    # input refs until the program retires so the close DISPATCHES instead
+    # of blocking on the execution — without that pin, dropping the donated
+    # states' wrappers mid-flight stalled the host 40-90 ms per window and
+    # this row read ~100 ms) and floor_normalized_dispatches < 20 (vs
+    # r05's 119.7 — the run is now ONE window-step program: the vmapped
+    # stacked fold replaced the 200-step device-serial lax.scan, and the
+    # terminal compute rides inside the same program instead of its own
+    # dispatch).
     _floor_rows("config1", plain_s, tpu, emit_host_rows=True)
 
-    # collection path. Since round 3 counter metrics DEFER: update() is an
-    # O(1) host append and the counting kernel folds the pending batches in
-    # bulk — the row name keeps the r01/r02 "_fused" label for
+    # collection path. The row name keeps the r01/r02 "_fused" label for
     # round-over-round comparability, but the mechanism measured here is
-    # the deferred-fold lane (metrics/deferred.py). Since ISSUE 2 deferral
-    # IS the collection's only device lane (the per-batch fused
-    # collection.step jit is deleted) and the steady constant-batch loop
-    # takes the stacked/scan fold, so this row should MATCH the plain row
-    # above to within environment noise — r05's inversion (138.8M fused vs
-    # 159.4M plain) was collection bookkeeping that the update() host diet
-    # removed; an inversion here is a regression signal, not a lane
+    # the whole-window compiled eval step (ISSUE 6, metrics/deferred.py):
+    # update() appends each placed batch ONCE to the collection's shared
+    # EvalWindow (zero per-batch device dispatch, zero per-member python
+    # after the first batch validates the signature), and compute() closes
+    # the window as a single donated pjit program carrying the vmapped
+    # per-batch update math, the fold AND the terminal compute. The plain
+    # leg above rides the same program shape through the solo window step
+    # at compute(), so the two rows should MATCH to within environment
+    # noise — an inversion here is a regression signal, not a lane
     # difference. Measured from the interleaved alternation above.
     _emit(
         "config1_multiclass_accuracy_c5_fused",

@@ -1,0 +1,576 @@
+"""Whole-window compiled eval step (ISSUE 6): donation end-to-end, the
+collection's host-accumulator update lanes, and mid-window read paths.
+
+The tentpole contract: ``MetricCollection.update()`` appends each placed
+batch ONCE to a shared :class:`~torcheval_tpu.metrics.deferred.EvalWindow`
+(zero per-batch device dispatch for deferred members) and the window closes
+as ONE donated pjit program containing the per-batch update math, the fold,
+and — at ``compute()`` time — the terminal computes. These tests pin
+
+* donation end-to-end on a ``donation_pipelines()`` backend (CPU in this
+  suite): the window step really invalidates the donated state buffers, the
+  chunk stack is donated exactly when every chunk is library-owned,
+  ``state.py``'s copy-on-read template guard still holds, and every donated
+  dispatch pins its input refs until the program retires (dropping a donated
+  input's wrapper mid-flight blocks the host on the execution);
+* mid-window ``resilience.snapshot.save`` round-trips bit-identical
+  (pending window chunks fold before serialization);
+* every slow-path lane (kwargs, scalar args, signature changes, direct
+  member streaming, member-level reads/resets) agrees with standalone
+  metrics.
+"""
+
+import shutil
+import tempfile
+import unittest
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import torcheval_tpu.metrics.deferred as dmod
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    Mean,
+    MeanSquaredError,
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.metrics.state import zeros_state
+from torcheval_tpu.utils.platform import donation_pipelines
+
+RNG = np.random.default_rng(11)
+
+
+def _batch(n=32, c=4):
+    return (
+        RNG.random((n, c)).astype(np.float32),
+        RNG.integers(0, c, n),
+    )
+
+
+def _spy_window_dispatchers():
+    """Wrap the three window-step dispatchers, recording which fired."""
+    calls = {"plain": 0, "donated": 0, "donated_all": 0}
+    names = {
+        "_window_step_dispatch": "plain",
+        "_window_step_dispatch_donated": "donated",
+        "_window_step_dispatch_donated_all": "donated_all",
+    }
+    orig = {name: getattr(dmod, name) for name in names}
+
+    def wrap(name, kind):
+        real = orig[name]
+
+        def f(*a, **k):
+            calls[kind] += 1
+            return real(*a, **k)
+
+        return f
+
+    for name, kind in names.items():
+        setattr(dmod, name, wrap(name, kind))
+
+    def restore():
+        for k, v in orig.items():
+            setattr(dmod, k, v)
+
+    return calls, restore
+
+
+@unittest.skipUnless(
+    donation_pipelines(), "donation is gated off on this backend"
+)
+class TestWindowDonation(unittest.TestCase):
+    def test_donated_state_buffers_are_invalidated(self):
+        # the window step donates the full state tree: a raw reference
+        # captured from a state attribute before the fold must be DEAD
+        # afterwards (the documented donation caveat, now at window
+        # granularity), while reads through the metric stay exact
+        m = MulticlassAccuracy(num_classes=4)
+        col = MetricCollection(m)
+        x, t = _batch()
+        col.update(x, t)
+        stale = m.num_total  # pre-fold buffer (int32 -> aliasable in place)
+        out = float(col.compute())
+        self.assertAlmostEqual(out, float((x.argmax(1) == t).mean()), places=6)
+        with self.assertRaises(RuntimeError):
+            _ = stale + 1  # donated buffer: deleted by the window step
+
+    def test_chunk_stack_donated_only_when_library_owned(self):
+        # numpy batches: the collection's placement creates the device
+        # buffers, so the window owns them and the donate-everything
+        # dispatcher runs. jax.Array batches: the caller still holds the
+        # buffers — state-only donation.
+        x, t = _batch()
+        calls, restore = _spy_window_dispatchers()
+        try:
+            col = MetricCollection(MulticlassAccuracy(num_classes=4))
+            self.assertTrue(col._window.owned)
+            for _ in range(3):
+                col.update(x, t)  # numpy in: placement copies
+            self.assertTrue(col._window.owned)
+            with warnings.catch_warnings():
+                # the suppression contract: unusable chunk donations must
+                # not leak a UserWarning per window to the caller
+                warnings.simplefilter("error")
+                got = float(col.compute())
+            self.assertEqual(calls["donated_all"], 1)
+            self.assertEqual(calls["plain"], 0)
+
+            jx, jt = jnp.asarray(x), jnp.asarray(t)
+            col2 = MetricCollection(MulticlassAccuracy(num_classes=4))
+            for _ in range(3):
+                col2.update(jx, jt)  # caller-held jax buffers
+            self.assertFalse(col2._window.owned)
+            got2 = float(col2.compute())
+            self.assertEqual(calls["donated_all"], 1)  # unchanged
+            self.assertGreaterEqual(calls["donated"], 1)
+        finally:
+            restore()
+        self.assertAlmostEqual(got, float((x.argmax(1) == t).mean()), places=6)
+        self.assertAlmostEqual(got2, got, places=7)
+        # ...and the caller's arrays are still alive after the fold
+        self.assertEqual(int(jt.sum()), int(t.sum()))
+
+    def test_mixed_eager_member_blocks_chunk_donation(self):
+        # an eager member (sample cache) may retain the placed chunk
+        # buffers — the window must never claim ownership
+        from torcheval_tpu.metrics import BinaryAccuracy
+
+        col = MetricCollection(
+            {"bacc": BinaryAccuracy(), "auroc": BinaryAUROC()}
+        )
+        x = RNG.random(64).astype(np.float32)
+        t = RNG.integers(0, 2, 64).astype(np.float32)
+        col.update(x, t)
+        self.assertFalse(col._window.owned)
+        out = col.compute()
+        self.assertAlmostEqual(
+            float(out["bacc"]), float(((x >= 0.5) == t).mean()), places=6
+        )
+
+    def test_copy_on_read_template_guard_still_holds(self):
+        # state.py: with donation on, zeros_state must hand out FRESH
+        # buffers (a shared template would be invalidated by a donated
+        # window step) and state_dict snapshots must be real copies
+        self.assertIsNot(zeros_state((), dtype=jnp.int32), zeros_state((), dtype=jnp.int32))
+        col = MetricCollection(MulticlassAccuracy(num_classes=4))
+        x, t = _batch()
+        col.update(x, t)
+        sd = col.state_dicts()["metric"]  # folds, then copies
+        col.update(x, t)
+        col.compute()  # donated window step invalidates the live buffers
+        self.assertEqual(float(sd["num_total"]), float(x.shape[0]))
+        # a sibling fresh metric's default states were never aliased to the
+        # donated ones
+        fresh = MulticlassAccuracy(num_classes=4)
+        self.assertEqual(float(fresh.num_total), 0.0)
+
+    def test_donated_inputs_pinned_until_program_retires(self):
+        # deleting a donated input's python wrapper while its program is
+        # still executing blocks the host on the execution (measured
+        # 40-90 ms per window on XLA:CPU — the async-dispatch win of the
+        # one-program window gone), so every donated dispatch must park
+        # its input refs in the in-flight registry until the program's
+        # outputs are ready, and the next dispatch must sweep retired holds
+        col = MetricCollection(MulticlassAccuracy(num_classes=4))
+        m = col.metrics["metric"]
+        x, t = _batch()
+        for _ in range(3):
+            col.update(x, t)
+        donated = [getattr(m, n) for n in m._state_name_to_default]
+        col.compute()
+        held_ids = {
+            id(leaf)
+            for _, refs in dmod._inflight_donated
+            for leaf in jax.tree_util.tree_leaves(refs)
+        }
+        for arr in donated:
+            self.assertIn(id(arr), held_ids)  # pinned while in flight
+        # the program's outputs ARE the metric's new states: once they are
+        # ready the program has retired, and the next donated dispatch
+        # sweeps the hold
+        jax.block_until_ready([getattr(m, n) for n in m._state_name_to_default])
+        col2 = MetricCollection(MulticlassAccuracy(num_classes=4))
+        col2.update(x, t)
+        col2.compute()
+        held_ids = {
+            id(leaf)
+            for _, refs in dmod._inflight_donated
+            for leaf in jax.tree_util.tree_leaves(refs)
+        }
+        for arr in donated:
+            self.assertNotIn(id(arr), held_ids)  # retired hold swept
+
+    def test_orphaned_holds_reanchor_instead_of_dropping(self):
+        # an in-flight hold whose anchor probe raises was donated to a
+        # LATER dispatch — the program may still be executing, so the hold
+        # must re-anchor on the new dispatch's output (same-device programs
+        # retire in submission order), never drop mid-flight
+        class DeletedAnchor:
+            def is_ready(self):
+                raise RuntimeError("Array has been deleted")
+
+        sentinel = object()
+        saved = list(dmod._inflight_donated)
+        try:
+            dmod._inflight_donated[:] = [(DeletedAnchor(), (sentinel,))]
+            dmod._hold_donated_inputs(jnp.zeros(1), {"s": jnp.ones(1)})
+            held = [
+                leaf
+                for _, refs in dmod._inflight_donated
+                for leaf in jax.tree_util.tree_leaves(
+                    refs, is_leaf=lambda x: x is sentinel
+                )
+            ]
+            self.assertTrue(any(leaf is sentinel for leaf in held))
+            # the pre-dispatch sweep KEEPS raised-probe holds (it cannot
+            # prove retirement) and drops ready ones
+            dmod._inflight_donated[:] = [(DeletedAnchor(), (sentinel,))]
+            dmod._sweep_retired_holds()
+            self.assertEqual(len(dmod._inflight_donated), 1)
+            ready = jax.block_until_ready(jnp.zeros(1))
+            dmod._inflight_donated[:] = [(ready, (sentinel,))]
+            dmod._sweep_retired_holds()
+            self.assertEqual(dmod._inflight_donated, [])
+        finally:
+            dmod._inflight_donated[:] = saved
+
+    def test_mid_window_snapshot_save_roundtrips_bit_identical(self):
+        from torcheval_tpu.resilience import restore as ckpt_restore
+        from torcheval_tpu.resilience import save as ckpt_save
+
+        m = MulticlassAccuracy(num_classes=4)
+        col = MetricCollection(m)
+        x, t = _batch(48)
+        col.update(x, t)
+        col.update(x, t)
+        self.assertTrue(col._window.chunks)  # mid-window: open chunks
+        ckpt_dir = tempfile.mkdtemp(prefix="window_ckpt_")
+        try:
+            path = ckpt_save(m, ckpt_dir)
+            self.assertEqual(col._window.chunks, [])  # folded before serialize
+            fresh = MulticlassAccuracy(num_classes=4)
+            ckpt_restore(fresh, path)
+            for name in ("num_correct", "num_total"):
+                self.assertTrue(
+                    (
+                        np.asarray(getattr(fresh, name))
+                        == np.asarray(m.state_dict()[name])
+                    ).all()
+                )
+            self.assertEqual(float(fresh.compute()), float(m.compute()))
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+class TestWindowLanes(unittest.TestCase):
+    """The host-accumulator update lanes must all agree with standalone
+    metrics bit-for-bit."""
+
+    def test_member_state_dict_mid_window_drains_the_window(self):
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4),
+                "f1": MulticlassF1Score(num_classes=4, average="macro"),
+            }
+        )
+        x, t = _batch()
+        col.update(x, t)
+        self.assertTrue(col._window.chunks)
+        sd = col["acc"].state_dict()  # single-member read, shared window
+        self.assertEqual(float(sd["num_total"]), float(x.shape[0]))
+        self.assertEqual(col._window.chunks, [])
+        # the sibling's contribution survived the drain
+        out = col.compute()
+        import sklearn.metrics as sk
+
+        self.assertAlmostEqual(
+            float(out["f1"]),
+            float(sk.f1_score(t, x.argmax(1), average="macro")),
+            places=5,
+        )
+
+    def test_member_compute_mid_window_rides_the_window_close(self):
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4),
+                "cm": MulticlassConfusionMatrix(4),
+            }
+        )
+        x, t = _batch()
+        col.update(x, t)
+        got = float(col["acc"].compute())  # direct member read mid-window
+        self.assertAlmostEqual(got, float((x.argmax(1) == t).mean()), places=6)
+        self.assertEqual(int(np.asarray(col["cm"].compute()).sum()), x.shape[0])
+
+    def test_kwargs_lane_matches_standalone(self):
+        col = MetricCollection(MeanSquaredError())
+        ref = MeanSquaredError()
+        for _ in range(3):
+            x = RNG.random(32).astype(np.float32)
+            t = RNG.random(32).astype(np.float32)
+            w = RNG.random(32).astype(np.float32)
+            col.update(x, t, sample_weight=w)
+            ref.update(x, t, sample_weight=w)
+        self.assertAlmostEqual(
+            float(col.compute()), float(ref.compute()), places=6
+        )
+
+    def test_signature_change_mid_stream_through_collection(self):
+        # 1-D label-style batches then a 2-D score batch: the window must
+        # flush the old signature before accepting the new one
+        col = MetricCollection(MulticlassAccuracy(num_classes=4))
+        t1 = RNG.integers(0, 4, 16)
+        col.update(t1.astype(np.float32), t1)
+        col.update(t1.astype(np.float32), t1)
+        x2, t2 = _batch(24)
+        col.update(x2, t2)
+        correct = 32 + int((x2.argmax(1) == t2).sum())
+        self.assertAlmostEqual(float(col.compute()), correct / 56.0, places=6)
+
+    def test_ragged_batch_sizes_share_one_window(self):
+        # a batch-size change is NOT a signature flush (ragged leading dims
+        # coexist; the in-trace uniformity gate picks the per-chunk path)
+        col = MetricCollection(MulticlassAccuracy(num_classes=4))
+        x, t = _batch(60)
+        col.update(x[:20], t[:20])
+        col.update(x[20:], t[20:])
+        self.assertAlmostEqual(
+            float(col.compute()), float((x.argmax(1) == t).mean()), places=6
+        )
+
+    def test_direct_member_stream_interleaved_with_window(self):
+        # a member updated OUTSIDE the collection mid-window: its own
+        # pending folds solo at close, the shared window folds for everyone
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4),
+                "cm": MulticlassConfusionMatrix(4),
+            }
+        )
+        x, t = _batch(32)
+        ex, et = _batch(16)
+        col.update(x, t)
+        col["acc"].update(ex, et)  # direct, acc only
+        col.update(x, t)
+        out = col.compute()
+        X, T = np.concatenate([x, ex, x]), np.concatenate([t, et, t])
+        self.assertAlmostEqual(
+            float(out["acc"]), float((X.argmax(1) == T).mean()), places=6
+        )
+        self.assertEqual(int(np.asarray(out["cm"]).sum()), 64)
+
+    def test_member_reset_mid_window_keeps_sibling_contributions(self):
+        col = MetricCollection(
+            {
+                "a": MulticlassAccuracy(num_classes=4),
+                "b": MulticlassAccuracy(num_classes=4),
+            }
+        )
+        x, t = _batch(20)
+        col.update(x, t)
+        col["a"].reset()  # folds the shared window for b, then wipes a
+        x2, t2 = _batch(12)
+        col.update(x2, t2)
+        out = col.compute()
+        self.assertAlmostEqual(
+            float(out["a"]), float((x2.argmax(1) == t2).mean()), places=6
+        )
+        X, T = np.concatenate([x, x2]), np.concatenate([t, t2])
+        self.assertAlmostEqual(
+            float(out["b"]), float((X.argmax(1) == T).mean()), places=6
+        )
+
+    def test_metric_in_two_collections_drains_both_windows(self):
+        # a metric wrapped by several collections belongs to EVERY window:
+        # direct reads must drain them all (a single-slot back-reference
+        # would silently orphan the first collection's open chunks)
+        m = MulticlassAccuracy(num_classes=4)
+        col1 = MetricCollection({"acc": m})
+        col2 = MetricCollection({"acc": m})
+        x, t = _batch(32)
+        col1.update(x, t)  # sits in col1's window
+        self.assertEqual(float(m.state_dict()["num_total"]), 32.0)
+        x2, t2 = _batch(16)
+        col2.update(x2, t2)
+        got = float(m.compute())  # closes col2's window with the compute
+        X, T = np.concatenate([x, x2]), np.concatenate([t, t2])
+        self.assertAlmostEqual(got, float((X.argmax(1) == T).mean()), places=6)
+        # a COLLECTION-level compute must also see the other collection's
+        # open chunks: the terminal compute drains them before running
+        x3, t3 = _batch(8)
+        col1.update(x3, t3)
+        out = col2.compute()
+        X, T = np.concatenate([X, x3]), np.concatenate([T, t3])
+        self.assertAlmostEqual(
+            float(out["acc"]), float((X.argmax(1) == T).mean()), places=6
+        )
+
+    def test_dead_collection_windows_are_pruned_not_leaked(self):
+        import gc
+
+        m = MulticlassAccuracy(num_classes=4)
+        x, t = _batch(16)
+        for _ in range(5):  # re-wrap per "epoch", leave a window open
+            col = MetricCollection({"acc": m})
+            col.update(x, t)
+            del col
+        gc.collect()
+        # the dead collections' orphaned chunks still count (they were fed
+        # by the user), and the dead windows are pruned at the next read
+        self.assertEqual(float(m.state_dict()["num_total"]), 80.0)
+        self.assertEqual(len(m._defer_windows), 0)
+
+    def test_collection_reset_drops_the_window(self):
+        col = MetricCollection(Mean())
+        col.update(np.arange(8.0, dtype=np.float32))
+        col.reset()
+        self.assertEqual(col._window.chunks, [])
+        col.update(np.full(4, 2.0, dtype=np.float32))
+        self.assertEqual(float(col.compute()), 2.0)
+
+    def test_repeated_compute_is_stable(self):
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4),
+                "cm": MulticlassConfusionMatrix(4),
+            }
+        )
+        x, t = _batch()
+        col.update(x, t)
+        first = col.compute()
+        second = col.compute()  # chunk-less terminal-compute step
+        self.assertEqual(float(first["acc"]), float(second["acc"]))
+        self.assertTrue(
+            (np.asarray(first["cm"]) == np.asarray(second["cm"])).all()
+        )
+
+    def test_tracer_updates_fall_back_to_member_lane(self):
+        # a user jitting their eval step around the collection: tracer args
+        # must never sit in the window past their trace
+        col = MetricCollection(MulticlassAccuracy(num_classes=4))
+        x, t = _batch(16)
+
+        @jax.jit
+        def step(xs, ts):
+            col.update(xs, ts)
+            self.assertEqual(col._window.chunks, [])
+            return col.compute()
+
+        got = step(jnp.asarray(x), jnp.asarray(t))
+        self.assertAlmostEqual(
+            float(got), float((x.argmax(1) == t).mean()), places=6
+        )
+
+    def test_mixed_vmap_and_scan_members_share_one_window(self):
+        # TopKMultilabelAccuracy's fold has no batching rule
+        # (_fold_vmap=False) so it rides _stacked_fold's scan fallback while
+        # the sibling folds vmapped — both lanes slice ONE in-program chunk
+        # stack and must match standalone streams
+        col = MetricCollection(
+            {
+                "topk": TopKMultilabelAccuracy(criteria="hamming", k=2),
+                "ml": MultilabelAccuracy(criteria="hamming"),
+            }
+        )
+        ref_topk = TopKMultilabelAccuracy(criteria="hamming", k=2)
+        ref_ml = MultilabelAccuracy(criteria="hamming")
+        for _ in range(4):
+            x = RNG.random((16, 8)).astype(np.float32)
+            t = (RNG.random((16, 8)) > 0.5).astype(np.float32)
+            col.update(x, t)
+            ref_topk.update(x, t)
+            ref_ml.update(x, t)
+        got = col.compute()
+        self.assertAlmostEqual(
+            float(got["topk"]), float(ref_topk.compute()), places=6
+        )
+        self.assertAlmostEqual(
+            float(got["ml"]), float(ref_ml.compute()), places=6
+        )
+
+    def test_subclassed_update_override_runs_every_batch(self):
+        # the window fast path replays only the library's own _defer append,
+        # so a member whose update() is overridden outside the library must
+        # keep the per-member lane — its per-batch side effects (counters,
+        # logging, extra validation) run for EVERY batch, not just the first
+        class CountingAccuracy(MulticlassAccuracy):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.calls = 0
+
+            def update(self, input, target):
+                self.calls += 1
+                return super().update(input, target)
+
+        m = CountingAccuracy(num_classes=4)
+        col = MetricCollection(m)
+        self.assertFalse(col._window_armable)
+        x, t = _batch(48)
+        for i in range(3):
+            col.update(x[i * 16 : (i + 1) * 16], t[i * 16 : (i + 1) * 16])
+        self.assertEqual(m.calls, 3)
+        self.assertAlmostEqual(
+            float(col.compute()), float((x.argmax(1) == t).mean()), places=6
+        )
+        # shipped metrics keep the fast path armed
+        self.assertTrue(
+            MetricCollection(MulticlassAccuracy(num_classes=4))._window_armable
+        )
+
+    def test_subclassed_compute_override_is_honored(self):
+        # the window close runs the class-level _compute_fn INSTEAD of
+        # member compute(), so a compute() overridden outside the library
+        # must fall back to the member's own compute() (its state still
+        # folds with the window; only the terminal stays member-own)
+        class PercentAccuracy(MulticlassAccuracy):
+            def compute(self):
+                return super().compute() * 100.0
+
+        m = PercentAccuracy(num_classes=4)
+        col = MetricCollection({"acc": m})
+        self.assertEqual(col._window_compute_keys, ())
+        x, t = _batch(32)
+        col.update(x, t)
+        self.assertAlmostEqual(
+            float(col.compute()["acc"]),
+            float((x.argmax(1) == t).mean()) * 100.0,
+            places=4,
+        )
+        # shipped computes keep riding the in-program terminal
+        self.assertEqual(
+            MetricCollection(
+                {"acc": MulticlassAccuracy(num_classes=4)}
+            )._window_compute_keys,
+            ("acc",),
+        )
+
+    def test_rewrapping_per_epoch_does_not_accumulate_dead_windows(self):
+        # a long-lived metric re-wrapped by a fresh collection per epoch,
+        # with all reads going through the collection: the close() drain
+        # must prune windows whose owning collection died, or
+        # _defer_windows (each pinning its collection's member dict) grows
+        # O(epochs)
+        m = Mean()
+        total, count = 0.0, 0
+        for _ in range(6):
+            col = MetricCollection(m)
+            xs = RNG.random(16).astype(np.float32)
+            col.update(xs)
+            col.compute()
+            total += float(xs.sum())
+            count += 16
+            del col
+        self.assertLessEqual(len(m._defer_windows), 1)
+        self.assertAlmostEqual(float(m.compute()), total / count, places=5)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -90,10 +90,10 @@ class TestAnnotate(unittest.TestCase):
         self.assertIn(
             "collection.compute/metric.compute/BinaryAUROC", spans
         )
-        # the deferred group fold dispatch is attributed under the
-        # collection read that triggered it (update() itself dispatches
-        # nothing — that is the point of the unified deferred lane)
-        self.assertIn("collection.compute/jit/deferred.group_fold", spans)
+        # the whole-window step dispatch is attributed under the collection
+        # read that triggered it (update() itself dispatches nothing — that
+        # is the point of the window-accumulator lane)
+        self.assertIn("collection.compute/jit/deferred.window_step", spans)
 
     def test_evaluator_spans(self):
         obs.enable()

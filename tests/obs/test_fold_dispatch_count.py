@@ -1,16 +1,19 @@
-"""Dispatch-count regression test (ISSUE 2 satellite).
+"""Dispatch-count regression test (ISSUE 2 satellite; reworked for the
+whole-window compiled eval step, ISSUE 6).
 
-The unified eval hot loop's contract is structural: K ``update()`` calls
-under one budget window must cost O(1) fold *programs*, never O(K)
-dispatches. The PR-1 obs registry makes that an observable
-(``deferred.folds{entry=,path=}`` increments once per fold dispatch), so a
-future change that quietly reintroduces per-batch dispatch fails HERE in CI
-instead of at the next bench round.
+The eval hot loop's contract is structural: K ``update()`` calls under one
+budget window must cost ZERO device dispatches for deferred members, and the
+window must close as exactly ONE compiled program — never O(K) dispatches.
+The PR-1 obs registry makes that an observable
+(``deferred.window_steps{path=}`` increments once per window-step dispatch;
+``deferred.folds{entry=,path=}`` covers the standalone/legacy fold lane), so
+a future change that quietly reintroduces per-batch dispatch fails HERE in
+CI instead of at the next bench round.
 
-The companion assertion pins the retrace bound the stacked/scan fold path
-guarantees: a steady constant-batch loop compiles ``deferred.group_fold``
-for at most 2 distinct signatures per batch shape (the valve-cadence chunk
-count plus the final partial flush).
+The companion assertion pins the retrace bound of the stacked window step:
+a steady constant-batch loop compiles ``deferred.window_step`` for at most
+2 distinct signatures per batch shape — the valve-cadence fold program plus
+the window-closing program (final flush / terminal compute).
 """
 
 import unittest
@@ -32,10 +35,25 @@ from torcheval_tpu.obs import recompile
 RNG = np.random.default_rng(7)
 
 
-def _fold_dispatches():
+def _deferred_dispatches():
+    """Every deferred-machinery dispatch counter, window-step and legacy
+    fold lanes alike."""
     counters = obs.snapshot()["counters"]
     return {
-        k: v for k, v in counters.items() if k.startswith("deferred.folds")
+        k: v
+        for k, v in counters.items()
+        if k.startswith("deferred.window_steps") or k.startswith("deferred.folds")
+    }
+
+
+def _window_fold_steps():
+    """Window-step dispatches that folded chunks (path=stacked|concat);
+    path=compute steps fold nothing — they are the chunk-less terminal
+    compute of an already-folded window."""
+    return {
+        k: v
+        for k, v in _deferred_dispatches().items()
+        if k.startswith("deferred.window_steps") and "path=compute" not in k
     }
 
 
@@ -64,17 +82,28 @@ class TestFoldDispatchCount(unittest.TestCase):
         recompile.reset()
         for _ in range(K):
             col.update(x, t)
-        # the hot loop itself dispatched NO fold program (K << budget window)
-        self.assertEqual(_fold_dispatches(), {})
+        # the hot loop itself dispatched NOTHING: zero per-batch device
+        # dispatch for deferred members (K << budget window)
+        self.assertEqual(_deferred_dispatches(), {})
         col.compute()
-        total = sum(_fold_dispatches().values())
-        self.assertEqual(total, 1)  # one program for all 3 members × K batches
+        # one window-step program carries all 3 members × K batches' update
+        # math, the fold AND every member's terminal compute
+        self.assertEqual(sum(_deferred_dispatches().values()), 1)
+        self.assertEqual(
+            sum(_window_fold_steps().values()), 1
+        )  # ...and it was the chunk-folding kind
+        batches = obs.snapshot()["counters"].get(
+            "deferred.window_step_batches", 0.0
+        )
+        self.assertEqual(batches, float(K))
 
     def test_valve_cadence_stays_o1_programs_and_bounded_signatures(self):
         # shrink the window so the valve fires mid-stream: 3 windows of 8
-        # chunks + no remainder must be 3 programs (one per window), and —
-        # constant batch shape — at most 2 distinct deferred.group_fold
-        # signatures (the valve-cadence count; no partial flush here)
+        # chunks + no remainder must be 3 fold-bearing programs (one per
+        # window) plus one chunk-less terminal-compute step at compute(),
+        # and — constant batch shape — at most 2 distinct
+        # deferred.window_step signatures (the valve-cadence fold program
+        # and the window-closing program)
         K, window = 24, 8
         col = MetricCollection(
             {"mse": MeanSquaredError(), "r2": R2Score()}
@@ -87,21 +116,23 @@ class TestFoldDispatchCount(unittest.TestCase):
         for _ in range(K):
             col.update(x, t)
         col.compute()
-        total = sum(_fold_dispatches().values())
-        self.assertEqual(total, K // window)  # O(windows), never O(K)
-        group_traces = recompile.trace_counts().get(
-            "deferred.group_fold", {"distinct_signatures": 0}
+        self.assertEqual(
+            sum(_window_fold_steps().values()), K // window
+        )  # O(windows), never O(K)
+        step_traces = recompile.trace_counts().get(
+            "deferred.window_step", {"distinct_signatures": 0}
         )
-        self.assertLessEqual(group_traces["distinct_signatures"], 2)
+        self.assertLessEqual(step_traces["distinct_signatures"], 2)
         # and the result is still exact
         expected = float(np.square(np.asarray(t) - np.asarray(x)).mean())
         out = col.compute()
         self.assertAlmostEqual(float(out["mse"]), expected, places=6)
 
     def test_steady_loop_with_remainder_is_two_signatures(self):
-        # K not a multiple of the window: valve folds at the cadence count,
-        # the read folds the remainder — exactly the "≤2 signatures per
-        # batch shape" bound the scan path guarantees
+        # K not a multiple of the window: the valve folds at the cadence
+        # count, compute() folds the remainder WITH the terminal compute in
+        # the same program — exactly the "≤2 signatures per batch shape"
+        # bound the stacked window step guarantees
         K, window = 11, 4
         m = MulticlassAccuracy(num_classes=5)
         col = MetricCollection(m)
@@ -112,12 +143,32 @@ class TestFoldDispatchCount(unittest.TestCase):
         for _ in range(K):
             col.update(x, t)
         col.compute()
-        total = sum(_fold_dispatches().values())
-        self.assertEqual(total, 3)  # 2 valve windows + 1 remainder fold
-        group_traces = recompile.trace_counts().get(
-            "deferred.group_fold", {"distinct_signatures": 0}
+        # 2 valve windows + 1 remainder-fold-plus-compute step
+        self.assertEqual(sum(_deferred_dispatches().values()), 3)
+        step_traces = recompile.trace_counts().get(
+            "deferred.window_step", {"distinct_signatures": 0}
         )
-        self.assertLessEqual(group_traces["distinct_signatures"], 2)
+        self.assertLessEqual(step_traces["distinct_signatures"], 2)
+
+    def test_standalone_metric_fold_plus_compute_is_one_program(self):
+        # the solo lane rides the same window-step shape: a standalone
+        # metric's compute() folds its pending batches AND computes in ONE
+        # program (previously a fold dispatch + a compute dispatch)
+        m = MulticlassAccuracy(num_classes=6)
+        x = jnp.asarray(RNG.random((23, 6)).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 6, 23))
+        for _ in range(5):
+            m.update(x, t)
+        self.assertEqual(_deferred_dispatches(), {})
+        got = float(m.compute())
+        self.assertEqual(sum(_deferred_dispatches().values()), 1)
+        self.assertAlmostEqual(
+            got,
+            float(
+                (np.asarray(x).argmax(1) == np.asarray(t)).mean()
+            ),
+            places=6,
+        )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,81 @@
+"""Bench-script hygiene guards (ISSUE 6 satellites).
+
+Two classes of bench regressions have slipped through rounds before:
+
+* a reference-leg tensor conversion bypassing ``_to_torch`` — numpy views
+  of jax arrays are read-only, so a raw ``torch.from_numpy(np.asarray(x))``
+  re-fires the non-writable UserWarning the BENCH_r05 tail still carried
+  (PR 3 routed config4 through ``_to_torch`` but one call site survived
+  until PR 1's sweep; this pins ZERO raw call sites for good);
+* the config1 decomposition rows quietly dropping out of the ``--smoke``
+  completeness set — they are the regression pins for the window-step
+  targets (host < 1 ms, floor-normalized dispatches < 20), so the smoke
+  job must fail when they stop being emitted.
+
+These are source-level asserts (no bench execution): cheap enough for
+tier-1, strong enough to fail the PR that reintroduces either class.
+"""
+
+import os
+import re
+import unittest
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class TestBenchHygiene(unittest.TestCase):
+    def setUp(self):
+        with open(os.path.join(_REPO, "bench.py")) as f:
+            self.source = f.read()
+
+    def test_no_raw_from_numpy_call_sites(self):
+        # every reference-leg conversion must ride _to_torch (writable
+        # copy); np.asarray of a jax array is a read-only view and
+        # torch.from_numpy on it warns + aliases UB on write
+        code_lines = [
+            line
+            for line in self.source.splitlines()
+            if not line.lstrip().startswith("#")
+        ]
+        raw = [
+            line
+            for line in code_lines
+            if re.search(r"torch\.from_numpy\(np\.asarray", line)
+        ]
+        self.assertEqual(
+            raw,
+            [],
+            "bench.py regained a raw torch.from_numpy(np.asarray(...)) "
+            "call site — route it through _to_torch (see BENCH_r05's "
+            "non-writable UserWarning)",
+        )
+
+    def test_smoke_pins_window_step_rows(self):
+        import importlib.util
+
+        # import bench.py WITHOUT executing main(): the module only runs
+        # legs under __main__, so a plain import is side-effect-free
+        spec = importlib.util.spec_from_file_location(
+            "bench_under_test", os.path.join(_REPO, "bench.py")
+        )
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        expected = bench._EXPECTED_ROW_PREFIXES
+        for row in (
+            "config1_python_host_ms_per_run",
+            "config1_floor_normalized_dispatches",
+            "config1_adjacent_dispatch_floor",
+            "config1_device_plus_env_ms_per_run",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the window-step "
+                "perf targets lose their regression pin",
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

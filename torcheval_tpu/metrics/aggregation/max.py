@@ -25,6 +25,10 @@ def _max_deferred_fold(input):
     return {"max": jnp.max(input)}
 
 
+def _max_deferred_compute(max):
+    return max
+
+
 class Max(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming maximum over all seen elements.
 
@@ -34,6 +38,7 @@ class Max(DeferredFoldMixin, Metric[jax.Array]):
     _fold_fn = staticmethod(_max_deferred_fold)
     _fold_per_chunk = True
     _fold_reduce = staticmethod(jnp.maximum)
+    _compute_fn = staticmethod(_max_deferred_compute)  # identity: state IS the result
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
@@ -45,8 +50,7 @@ class Max(DeferredFoldMixin, Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return self.max
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["Max"]) -> "Max":
         metrics = list(metrics)

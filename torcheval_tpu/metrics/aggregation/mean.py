@@ -37,6 +37,10 @@ def _mean_deferred_fold(input, weight=None):
     return {"weighted_sum": weighted_sum, "weights": total_weight}
 
 
+def _mean_deferred_compute(weighted_sum, weights):
+    return safe_div(weighted_sum, weights)
+
+
 class Mean(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming weighted mean: ``sum(weight * input) / sum(weight)``.
 
@@ -48,6 +52,9 @@ class Mean(DeferredFoldMixin, Metric[jax.Array]):
 
     _fold_fn = staticmethod(_mean_deferred_fold)
     _fold_per_chunk = True
+    # pure terminal compute riding the window step; the no-update warning
+    # is host-side and hooks the result (_on_window_result)
+    _compute_fn = staticmethod(_mean_deferred_compute)
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
@@ -70,13 +77,11 @@ class Mean(DeferredFoldMixin, Metric[jax.Array]):
             self._defer(input, _weight_check(input, weight))
         return self
 
-    def compute(self) -> jax.Array:
+    def _on_window_result(self, result):
         # trace-safe + async: the no-update warning reads the value back on a
         # daemon thread (utils/tracing.py) so compute never blocks on the
-        # device stream; the returned expression itself is branch-free and
-        # jit-embeddable (no-update => 0.0 either way)
-        self._fold_now()
-
+        # device stream; it reads the POST-FOLD state attribute, so it holds
+        # whether the compute ran eagerly or inside the window-step program
         def _check(w) -> None:
             if w == 0.0:
                 _logger.warning(
@@ -84,7 +89,10 @@ class Mean(DeferredFoldMixin, Metric[jax.Array]):
                 )
 
         async_value_warn(_check, self.weights)
-        return safe_div(self.weighted_sum, self.weights)
+        return result
+
+    def compute(self) -> jax.Array:
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["Mean"]) -> "Mean":
         metrics = list(metrics)

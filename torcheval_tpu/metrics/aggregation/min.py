@@ -23,6 +23,10 @@ def _min_deferred_fold(input):
     return {"min": jnp.min(input)}
 
 
+def _min_deferred_compute(min):
+    return min
+
+
 class Min(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming minimum over all seen elements.
 
@@ -32,6 +36,7 @@ class Min(DeferredFoldMixin, Metric[jax.Array]):
     _fold_fn = staticmethod(_min_deferred_fold)
     _fold_per_chunk = True
     _fold_reduce = staticmethod(jnp.minimum)
+    _compute_fn = staticmethod(_min_deferred_compute)  # identity: state IS the result
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
@@ -43,8 +48,7 @@ class Min(DeferredFoldMixin, Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return self.min
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["Min"]) -> "Min":
         metrics = list(metrics)

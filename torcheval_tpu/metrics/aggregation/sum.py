@@ -30,6 +30,10 @@ def _sum_deferred_fold(input, weight=None):
     return {"weighted_sum": _sum_update(input, weight)}
 
 
+def _sum_deferred_compute(weighted_sum):
+    return weighted_sum
+
+
 class Sum(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming (weighted) sum.
 
@@ -38,6 +42,9 @@ class Sum(DeferredFoldMixin, Metric[jax.Array]):
 
     _fold_fn = staticmethod(_sum_deferred_fold)
     _fold_per_chunk = True
+    # identity terminal compute: inside the window step the folded state IS
+    # the result, so compute() costs zero extra dispatches
+    _compute_fn = staticmethod(_sum_deferred_compute)
 
     def __init__(self, *, device: DeviceLike = None) -> None:
         super().__init__(device=device)
@@ -61,8 +68,7 @@ class Sum(DeferredFoldMixin, Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return self.weighted_sum
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["Sum"]) -> "Sum":
         metrics = list(metrics)

@@ -75,6 +75,9 @@ class MulticlassAccuracy(DeferredFoldMixin, Metric[jax.Array]):
 
     _fold_fn = staticmethod(_acc_fold)
     _fold_per_chunk = True
+    # pure terminal compute: rides inside the window-step program at
+    # compute() time (metrics/deferred.py), zero extra dispatches
+    _compute_fn = staticmethod(_accuracy_compute)
 
     def __init__(
         self,
@@ -98,16 +101,18 @@ class MulticlassAccuracy(DeferredFoldMixin, Metric[jax.Array]):
         )
         self._init_deferred()
         self._fold_params = (self.average, self.num_classes, self.k)
+        self._compute_params = (self.average,)
+
+    def _update_check(self, input, target) -> None:
+        # shape-only: memoised per batch signature by the _defer fast path
+        _accuracy_update_input_check(input, target, self.num_classes, self.k)
 
     def update(self, input, target) -> "MulticlassAccuracy":
-        input, target = self._input(input), self._input(target)
-        _accuracy_update_input_check(input, target, self.num_classes, self.k)
-        self._defer(input, target)
+        self._defer(self._input(input), self._input(target))
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return _accuracy_compute(self.num_correct, self.num_total, self.average)
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["MulticlassAccuracy"]) -> "MulticlassAccuracy":
         metrics = list(metrics)
@@ -139,14 +144,15 @@ class BinaryAccuracy(MulticlassAccuracy):
         self.threshold = threshold
         self._fold_params = (threshold,)
 
-    def update(self, input, target) -> "BinaryAccuracy":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         _multilabel_shape_check(input, target)
         if target.ndim != 1:
             raise ValueError(
                 f"target should be a one-dimensional tensor, got shape {target.shape}."
             )
-        self._defer(input, target)
+
+    def update(self, input, target) -> "BinaryAccuracy":
+        self._defer(self._input(input), self._input(target))
         return self
 
 
@@ -171,10 +177,11 @@ class MultilabelAccuracy(MulticlassAccuracy):
         self.criteria = criteria
         self._fold_params = (threshold, criteria)
 
-    def update(self, input, target) -> "MultilabelAccuracy":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         _multilabel_shape_check(input, target)
-        self._defer(input, target)
+
+    def update(self, input, target) -> "MultilabelAccuracy":
+        self._defer(self._input(input), self._input(target))
         return self
 
 
@@ -203,6 +210,10 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
     """
 
     _fold_fn = staticmethod(_topk_fold)
+    # the streaming top-k engine's sharded Pallas lowering rides
+    # custom_partitioning, which has no jax.vmap batching rule — multi-chunk
+    # stacked folds keep the sequential lax.scan body instead
+    _fold_vmap = False
 
     def __init__(
         self,
@@ -228,13 +239,14 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
         self.topk_method = topk_method
         self._fold_params = (criteria, k, topk_method)
 
-    def update(self, input, target) -> "TopKMultilabelAccuracy":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         _multilabel_shape_check(input, target)
         if input.ndim != 2:
             raise ValueError(
                 "input should have shape (num_sample, num_classes) for k > 1, "
                 f"got shape {input.shape}."
             )
-        self._defer(input, target)
+
+    def update(self, input, target) -> "TopKMultilabelAccuracy":
+        self._defer(self._input(input), self._input(target))
         return self

@@ -54,6 +54,13 @@ def _binary_binned_fold(input, target, thresholds):
     return {"num_tp": tp, "num_fp": fp, "num_fn": fn}
 
 
+def _binary_binned_deferred_compute(threshold, num_tp, num_fp, num_fn):
+    """State-ordered terminal compute for the window-step program
+    (``threshold`` registers first; it passes through as the third output)."""
+    precision, recall = _binary_binned_compute(num_tp, num_fp, num_fn)
+    return precision, recall, threshold
+
+
 def _multiclass_binned_fold(input, target, thresholds, num_classes):
     tp, fp, fn = _multiclass_binned_update(
         input, target, jnp.asarray(thresholds, jnp.float32), num_classes
@@ -73,8 +80,8 @@ class BinaryBinnedPrecisionRecallCurve(
 
     _fold_per_chunk = True
 
-
     _fold_fn = staticmethod(_binary_binned_fold)
+    _compute_fn = staticmethod(_binary_binned_deferred_compute)
 
     def __init__(
         self, *, threshold: ThresholdSpec = 100, device: DeviceLike = None
@@ -94,18 +101,15 @@ class BinaryBinnedPrecisionRecallCurve(
         self._init_deferred()
         self._fold_params = (_threshold_fold_params(threshold),)
 
-    def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         _binary_precision_recall_curve_update_input_check(input, target)
-        self._defer(input, target)
+
+    def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
+        self._defer(self._input(input), self._input(target))
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        self._fold_now()
-        precision, recall = _binary_binned_compute(
-            self.num_tp, self.num_fp, self.num_fn
-        )
-        return precision, recall, self.threshold
+        return self._deferred_compute()
 
     def merge_state(
         self, metrics: Iterable["BinaryBinnedPrecisionRecallCurve"]

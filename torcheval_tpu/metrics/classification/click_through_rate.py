@@ -75,6 +75,9 @@ class ClickThroughRate(DeferredFoldMixin, Metric[jax.Array]):
 
     _fold_fn = staticmethod(_ctr_deferred_fold)
     _fold_per_chunk = True
+    # pure terminal compute (safe_div) riding the window-step program;
+    # update validation stays eager (it branches on the weights argument)
+    _compute_fn = staticmethod(_ctr_compute)
 
     def __init__(
         self, *, num_tasks: int = 1, device: DeviceLike = None
@@ -114,8 +117,7 @@ class ClickThroughRate(DeferredFoldMixin, Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return _ctr_compute(self.click_total, self.weight_total)
+        return self._deferred_compute()
 
     def merge_state(
         self, metrics: Iterable["ClickThroughRate"]

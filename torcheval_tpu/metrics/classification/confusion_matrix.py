@@ -43,7 +43,9 @@ class MulticlassConfusionMatrix(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming (num_classes, num_classes) confusion counts; rows = true."""
 
     _fold_fn = staticmethod(_cm_fold)
-
+    # pure terminal compute (count passthrough / normalization) riding the
+    # window-step program at compute() time
+    _compute_fn = staticmethod(normalize_confusion_matrix)
 
     def __init__(
         self,
@@ -63,16 +65,17 @@ class MulticlassConfusionMatrix(DeferredFoldMixin, Metric[jax.Array]):
         )
         self._init_deferred()
         self._fold_params = (num_classes,)
+        self._compute_params = (normalize,)
+
+    def _update_check(self, input, target) -> None:
+        _confusion_matrix_input_check(input, target, self.num_classes)
 
     def update(self, input, target) -> "MulticlassConfusionMatrix":
-        input, target = self._input(input), self._input(target)
-        _confusion_matrix_input_check(input, target, self.num_classes)
-        self._defer(input, target)
+        self._defer(self._input(input), self._input(target))
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return normalize_confusion_matrix(self.confusion_matrix, self.normalize)
+        return self._deferred_compute()
 
     def merge_state(
         self, metrics: Iterable["MulticlassConfusionMatrix"]
@@ -105,8 +108,9 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
         self.threshold = threshold
         self._fold_params = (threshold,)
 
-    def update(self, input, target) -> "BinaryConfusionMatrix":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         _confusion_matrix_input_check(input, target)
-        self._defer(input, target)
+
+    def update(self, input, target) -> "BinaryConfusionMatrix":
+        self._defer(self._input(input), self._input(target))
         return self

@@ -54,7 +54,10 @@ class MulticlassF1Score(DeferredFoldMixin, Metric[jax.Array]):
     """
 
     _fold_fn = staticmethod(_f1_fold)
-
+    # pure terminal compute: rides inside the window-step program at
+    # compute() time (metrics/deferred.py); the empty-class warning is
+    # host-side and hooks the result instead (_on_window_result)
+    _compute_fn = staticmethod(_f1_score_compute)
 
     def __init__(
         self,
@@ -74,20 +77,22 @@ class MulticlassF1Score(DeferredFoldMixin, Metric[jax.Array]):
             )
         self._init_deferred()
         self._fold_params = (self.num_classes, self.average)
+        self._compute_params = (self.average,)
+
+    def _update_check(self, input, target) -> None:
+        _f1_input_check(input, target, self.num_classes, "multiclass f1 score")
 
     def update(self, input, target) -> "MulticlassF1Score":
-        input, target = self._input(input), self._input(target)
-        _f1_input_check(input, target, self.num_classes, "multiclass f1 score")
-        self._defer(input, target)
+        self._defer(self._input(input), self._input(target))
         return self
 
-    def compute(self) -> jax.Array:
-        self._fold_now()
+    def _on_window_result(self, result):
         if self.average != "micro":
-            _warn_empty_classes(self.num_label)
-        return _f1_score_compute(
-            self.num_tp, self.num_label, self.num_prediction, self.average
-        )
+            _warn_empty_classes(self.num_label)  # async, post-fold state
+        return result
+
+    def compute(self) -> jax.Array:
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["MulticlassF1Score"]) -> "MulticlassF1Score":
         metrics = list(metrics)
@@ -121,12 +126,13 @@ class BinaryF1Score(MulticlassF1Score):
         self.threshold = threshold
         self._fold_params = (threshold,)
 
-    def update(self, input, target) -> "BinaryF1Score":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         if input.ndim != 1 or target.ndim != 1 or input.shape != target.shape:
             raise ValueError(
                 "input and target should be one-dimensional tensors of the same "
                 f"shape, got {input.shape} and {target.shape}."
             )
-        self._defer(input, target)
+
+    def update(self, input, target) -> "BinaryF1Score":
+        self._defer(self._input(input), self._input(target))
         return self

@@ -46,7 +46,9 @@ class MulticlassPrecision(DeferredFoldMixin, Metric[jax.Array]):
     """
 
     _fold_fn = staticmethod(_prec_fold)
-
+    # pure terminal compute inside the window-step program; the NaN-class
+    # warning is host-side and hooks the result (_on_window_result)
+    _compute_fn = staticmethod(_precision_compute)
 
     def __init__(
         self,
@@ -66,18 +68,22 @@ class MulticlassPrecision(DeferredFoldMixin, Metric[jax.Array]):
             )
         self._init_deferred()
         self._fold_params = (self.num_classes, self.average)
+        self._compute_params = (self.average,)
+
+    def _update_check(self, input, target) -> None:
+        _precision_input_check(input, target, self.num_classes)
 
     def update(self, input, target) -> "MulticlassPrecision":
-        input, target = self._input(input), self._input(target)
-        _precision_input_check(input, target, self.num_classes)
-        self._defer(input, target)
+        self._defer(self._input(input), self._input(target))
         return self
 
-    def compute(self) -> jax.Array:
-        self._fold_now()
+    def _on_window_result(self, result):
         if self.average in (None, "None"):
             _warn_nan_classes(self.num_tp, self.num_fp, "Precision")
-        return _precision_compute(self.num_tp, self.num_fp, self.num_label, self.average)
+        return result
+
+    def compute(self) -> jax.Array:
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["MulticlassPrecision"]) -> "MulticlassPrecision":
         metrics = list(metrics)
@@ -109,8 +115,7 @@ class BinaryPrecision(MulticlassPrecision):
         self.threshold = threshold
         self._fold_params = (threshold,)
 
-    def update(self, input, target) -> "BinaryPrecision":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         if input.shape != target.shape:
             raise ValueError(
                 "The `input` and `target` should have the same dimensions, "
@@ -120,5 +125,7 @@ class BinaryPrecision(MulticlassPrecision):
             raise ValueError(
                 f"target should be a one-dimensional tensor, got shape {target.shape}."
             )
-        self._defer(input, target)
+
+    def update(self, input, target) -> "BinaryPrecision":
+        self._defer(self._input(input), self._input(target))
         return self

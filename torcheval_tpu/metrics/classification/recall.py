@@ -49,7 +49,9 @@ class MulticlassRecall(DeferredFoldMixin, Metric[jax.Array]):
     """
 
     _fold_fn = staticmethod(_rec_fold)
-
+    # pure terminal compute inside the window-step program; the NaN-recall
+    # warning is host-side and hooks the result (_on_window_result)
+    _compute_fn = staticmethod(_recall_compute)
 
     def __init__(
         self,
@@ -69,20 +71,22 @@ class MulticlassRecall(DeferredFoldMixin, Metric[jax.Array]):
             )
         self._init_deferred()
         self._fold_params = (self.num_classes, self.average)
+        self._compute_params = (self.average,)
+
+    def _update_check(self, input, target) -> None:
+        _recall_input_check(input, target, self.num_classes)
 
     def update(self, input, target) -> "MulticlassRecall":
-        input, target = self._input(input), self._input(target)
-        _recall_input_check(input, target, self.num_classes)
-        self._defer(input, target)
+        self._defer(self._input(input), self._input(target))
         return self
 
-    def compute(self) -> jax.Array:
-        self._fold_now()
+    def _on_window_result(self, result):
         if self.average != "micro":
-            _warn_nan_recall(self.num_labels)
-        return _recall_compute(
-            self.num_tp, self.num_labels, self.num_predictions, self.average
-        )
+            _warn_nan_recall(self.num_labels)  # async, post-fold state
+        return result
+
+    def compute(self) -> jax.Array:
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["MulticlassRecall"]) -> "MulticlassRecall":
         metrics = list(metrics)
@@ -108,7 +112,7 @@ class BinaryRecall(DeferredFoldMixin, Metric[jax.Array]):
     """
 
     _fold_fn = staticmethod(_binrec_fold)
-
+    _compute_fn = staticmethod(_binary_recall_compute)
 
     def __init__(
         self, *, threshold: float = 0.5, device: DeviceLike = None
@@ -122,8 +126,7 @@ class BinaryRecall(DeferredFoldMixin, Metric[jax.Array]):
         self._init_deferred()
         self._fold_params = (threshold,)
 
-    def update(self, input, target) -> "BinaryRecall":
-        input, target = self._input(input), self._input(target)
+    def _update_check(self, input, target) -> None:
         if input.shape != target.shape:
             raise ValueError(
                 "The `input` and `target` should have the same dimensions, "
@@ -133,12 +136,13 @@ class BinaryRecall(DeferredFoldMixin, Metric[jax.Array]):
             raise ValueError(
                 f"target should be a one-dimensional tensor, got shape {target.shape}."
             )
-        self._defer(input, target)
+
+    def update(self, input, target) -> "BinaryRecall":
+        self._defer(self._input(input), self._input(target))
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return _binary_recall_compute(self.num_tp, self.num_true_labels)
+        return self._deferred_compute()
 
     def merge_state(self, metrics: Iterable["BinaryRecall"]) -> "BinaryRecall":
         metrics = list(metrics)

@@ -67,6 +67,9 @@ class WeightedCalibration(DeferredFoldMixin, Metric[jax.Array]):
 
     _fold_fn = staticmethod(_calibration_deferred_fold)
     _fold_per_chunk = True
+    # pure terminal compute riding the window-step program; update
+    # validation stays eager (it branches on the weight argument)
+    _compute_fn = staticmethod(_calibration_compute)
 
     def __init__(
         self, *, num_tasks: int = 1, device: DeviceLike = None
@@ -105,10 +108,7 @@ class WeightedCalibration(DeferredFoldMixin, Metric[jax.Array]):
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return _calibration_compute(
-            self.weighted_input_sum, self.weighted_label_sum
-        )
+        return self._deferred_compute()
 
     def merge_state(
         self, metrics: Iterable["WeightedCalibration"]

@@ -1,42 +1,50 @@
-"""MetricCollection: drive many metrics from one batch with minimal dispatch.
+"""MetricCollection: drive many metrics from one batch with one program.
 
 SURVEY §3.1 names the goal for the hot loop: "a single fused jit'd XLA
-computation (donated state in HBM)". Since the lane unification (ISSUE 2)
-the collection has ONE device pipeline and one host pipeline:
+computation (donated state in HBM)". Since the whole-window compiled eval
+step (ISSUE 6) the collection IS that computation, at window granularity:
 
 * **Deferred array-state metrics** (``metrics/deferred.py``: the counter
   families, regression/NE sufficient statistics, Sum/Mean/Max/Min, CTR,
-  calibration) make ``update`` an O(1) host append. The collection owns the
-  fold trigger: all deferred members' pending batches fold TOGETHER in one
-  XLA program per budget window (``group_fold``), so XLA CSEs their shared
-  math, and under a steady constant-batch loop the fold runs the scan-based
-  stacked path with an O(1) trace and retrace-signature space. This replaced
-  the old per-batch fused ``collection.step`` jit — one dispatch per batch
-  was still O(batches) dispatches; one fold per budget window is
-  O(total_bytes / budget).
+  calibration) never see per-batch python at all on the steady path.
+  ``update()`` is a pure host-side accumulator: it places each batch ONCE
+  and appends the placed refs to a collection-owned
+  :class:`~torcheval_tpu.metrics.deferred.EvalWindow` — zero per-batch
+  device dispatch AND zero per-member python. Validation runs through the
+  real member ``update()`` methods exactly once per batch signature (the
+  slow path below) and is memoised; every later same-signature batch takes
+  the append-only fast path. When the window closes — on the memory
+  budget, at ``compute()`` or ``state_dicts()`` — ONE donated pjit program
+  (``deferred.window_step``) contains every member's per-batch update math
+  over the stacked chunks, the fold into every state tree, and (at
+  ``compute()`` time) each member's terminal ``_compute_fn``, so XLA CSEs
+  the members' shared math and reuses the donated HBM in place.
 * **Host-state metrics** (sample caches, dict/deque fixtures, Throughput's
-  host scalars): eager path; their updates are O(1) host appends and were
-  never dispatch-bound.
+  host scalars) and custom array-state metrics without
+  ``DeferredFoldMixin``: eager path, their ``update`` runs per batch as
+  before. A collection containing any such member never donates the shared
+  chunk buffers (the eager members may hold references to them).
 
 Whatever the lane, the collection converts/places each batch argument ONCE
 (via the first metric's ``_input``, resolved at construction) and hands every
-member the same placed arrays — k metrics never pay k host→device transfers,
-and deferring members' pending lists share one buffer per batch. The
-per-argument "is this an array-like that needs placement" dispatch is
+member the same placed arrays — k metrics never pay k host→device transfers.
+The per-argument "is this an array-like that needs placement" dispatch is
 memoised per *type* at first sight, so the steady-loop ``update()`` does no
 ``hasattr`` protocol probing.
 
-A custom third-party metric with array state that does not opt into
-``DeferredFoldMixin`` simply runs its own eager ``update`` per batch — the
-pre-unification fused lane that re-traced such metrics into a per-batch
-program is gone (it measured *slower* than deferral and forced a
-``_states()``/``_set_states()`` save-restore round trip on every update).
+Batches whose derived chunk differs from the update args (keyword arguments,
+scalar weights that become extra chunk columns) keep the pre-window lane:
+member updates run per batch and the members' own pending lists group-fold
+in one program per window, exactly the ISSUE-2 behavior.
 
-Donation caveat (unchanged semantics, new trigger): after a deferred fold,
+Donation caveat (unchanged semantics, window trigger): after a window step,
 previously captured references to a member's state arrays are invalid on
 donating backends (their buffers were donated). Read state through the
-metric/collection (``compute``, ``state_dict``) instead of holding raw array
-refs across updates.
+metric/collection (``compute``, ``state_dicts``) instead of holding raw
+array refs across updates. Chunk buffers are donated only when every chunk
+in the window was created by this collection's own placement (host batches:
+numpy/python inputs), never when the caller handed in ``jax.Array``s or
+torch tensors it may still hold.
 """
 
 from __future__ import annotations
@@ -44,9 +52,12 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, Union
 
-from torcheval_tpu.metrics.deferred import group_fold
-from torcheval_tpu.metrics.metric import Metric
+import jax
+
+from torcheval_tpu.metrics.deferred import EvalWindow
+from torcheval_tpu.metrics.metric import _ARRAY_IMPL, Metric
 from torcheval_tpu.obs.annotate import traced as _traced
+from torcheval_tpu.utils.convert import _is_torch_tensor
 
 _logger = logging.getLogger(__name__)
 
@@ -73,9 +84,9 @@ class MetricCollection:
     Example::
 
         col = MetricCollection({
-            "acc": MulticlassAccuracy(num_classes=1000),   # deferred append
+            "acc": MulticlassAccuracy(num_classes=1000),   # window append
             "f1": MulticlassF1Score(num_classes=1000, average="macro"),
-            "mse": MeanSquaredError(),    # deferred append (same fold program)
+            "mse": MeanSquaredError(),    # window append (same program)
             "auroc": BinaryAUROC(),       # cache metric: eager append
         })
         for scores, labels in loader:
@@ -93,20 +104,67 @@ class MetricCollection:
         )
         if not self.metrics:
             raise ValueError("MetricCollection needs at least one metric.")
-        # deferred members fold TOGETHER (one dispatch, shared subcomputations
-        # CSE'd by XLA) with the collection owning the fold trigger
+        # deferred members share ONE window and fold/compute TOGETHER in a
+        # single window-step program per budget window (shared
+        # subcomputations CSE'd by XLA), with the collection owning the
+        # fold trigger
         self._deferred = {
             n: m for n, m in self.metrics.items() if getattr(m, "_defers", False)
         }
+        self._window = (
+            EvalWindow(self._deferred, owner=self) if self._deferred else None
+        )
         for m in self._deferred.values():
             m._defer_managed = True
+            # a LIST of windows: a metric wrapped by several collections
+            # belongs to each one's window, and every direct read must
+            # drain them all (Metric._fold_now) — a single-slot back
+            # reference would silently orphan the earlier windows' chunks
+            windows = getattr(m, "_defer_windows", None)
+            if windows is None:
+                windows = m._defer_windows = []
+            windows.append(self._window)
         # hot-loop precomputation (host-overhead diet): the placement closure,
         # the members' bound update methods, and the budget probe are all
         # resolved once here instead of per update() call
         self._place = next(iter(self.metrics.values()))._input
-        self._member_updates = tuple(m.update for m in self.metrics.values())
+        self._deferred_updates = tuple(
+            m.update for m in self._deferred.values()
+        )
+        self._eager_updates = tuple(
+            m.update for n, m in self.metrics.items() if n not in self._deferred
+        )
         self._defer_probe = (
             next(iter(self._deferred.values())) if self._deferred else None
+        )
+        # chunk buffers handed to eager members may be retained by them (a
+        # sample cache aliasing the placed batch), so a mixed collection
+        # never donates chunks — only all-deferred collections can prove
+        # window ownership
+        self._chunks_ownable = not self._eager_updates
+        # the window fast path appends the batch WITHOUT calling member
+        # update() methods again, so it is only safe when every deferred
+        # member runs the library's own update (whose whole per-batch effect
+        # is the _defer append the window replays). A subclass/third-party
+        # override may carry side effects (logging, extra validation) that
+        # must run per batch — those collections keep the per-member lane
+        self._window_armable = all(
+            getattr(type(m).update, "__module__", "").startswith(
+                "torcheval_tpu."
+            )
+            for m in self._deferred.values()
+        )
+        # same contract for the terminal compute: the window close runs the
+        # class-level _compute_fn INSTEAD of calling member compute(), so a
+        # member whose compute() is overridden outside the library (post-
+        # processing, unit changes) must fall back to its own compute() —
+        # the window still folds its state, only the terminal stays member-own
+        self._window_compute_keys = tuple(
+            n
+            for n, m in self._deferred.items()
+            if getattr(type(m).compute, "__module__", "").startswith(
+                "torcheval_tpu."
+            )
         )
 
     @_traced("collection.update")
@@ -116,41 +174,171 @@ class MetricCollection:
         # fold anyway, and eager/deferred members then hit _input's already-
         # placed fast path instead of re-transferring per metric
         place = self._place
-        args = tuple(
-            place(a) if _needs_placement(type(a)) else a for a in args
-        )
+        window = self._window
+        owned = self._chunks_ownable
+        # window-appendable: at least one positional arg, all placed, no
+        # kwargs — everything else routes through the member updates
+        direct = bool(args) and not kwargs
+        placed = []
+        for a in args:
+            if _needs_placement(type(a)):
+                p = place(a)
+                if p is a or _is_torch_tensor(a):
+                    # the caller may still hold this buffer (jax passthrough)
+                    # or alias it (torch via zero-copy dlpack): never donate
+                    owned = False
+                placed.append(p)
+            else:
+                placed.append(a)
+                direct = False  # python scalars etc.: member updates convert
+        args = tuple(placed)
         if kwargs:
             kwargs = {
                 k: place(v) if _needs_placement(type(v)) else v
                 for k, v in kwargs.items()
             }
-        for member_update in self._member_updates:
+        for member_update in self._eager_updates:
             member_update(*args, **kwargs)
-        probe = self._defer_probe
-        if probe is not None and (
-            # collection-owned budget trigger: every deferred member carries
-            # the same pending arrays, so one member's budget speaks for all
-            probe._pending_bytes >= probe._DEFER_BUDGET_BYTES
-            or len(probe._pending) >= probe._DEFER_MAX_CHUNKS
-        ):
-            group_fold(self._deferred)
+        if window is None:
+            return self
+        if direct and self._window_armable:
+            # signature compare without building a tuple per call: a flat
+            # loop against the cached (shape, dtype) pairs. The concrete
+            # ArrayImpl type compare stands in for the tracer check
+            # (tracers are not ArrayImpl) at pointer-compare cost.
+            sig = window.sig
+            match = sig is not None and len(sig) == len(args)
+            if match:
+                for a, sd in zip(args, sig):
+                    if (
+                        type(a) is not _ARRAY_IMPL
+                        or a.shape != sd[0]
+                        or a.dtype != sd[1]
+                    ):
+                        match = False
+                        break
+            if match:
+                # steady fast path: this exact batch signature has been
+                # validated through the member updates before — append the
+                # placed refs ONCE for the whole collection (byte size is a
+                # pure signature function, cached beside it: Array.nbytes
+                # costs ~4 µs per arg, half this path's budget)
+                window.append(args, window.sig_nbytes, owned)
+                self._window_budget_check()
+                return self
+            if not any(isinstance(a, jax.core.Tracer) for a in args):
+                self._ingest_new_signature(
+                    args,
+                    kwargs,
+                    tuple((a.shape, a.dtype) for a in args),
+                    owned,
+                )
+            else:
+                self._ingest_slow(args, kwargs)
+        else:
+            self._ingest_slow(args, kwargs)
+        self._window_budget_check()
         return self
+
+    def _ingest_new_signature(self, args, kwargs, sig, owned) -> None:
+        """First batch of a (full-shape) signature: run the real member
+        updates (their validation + per-member chunk derivation), then — if
+        every deferred member appended exactly the update args as its chunk —
+        migrate that one chunk into the shared window and arm the fast path
+        for the signature."""
+        window = self._window
+        if window.chunks:
+            head = window.chunks[0]
+            if len(head) != len(args) or any(
+                h.ndim != a.ndim
+                or h.dtype != a.dtype
+                or h.shape[1:] != a.shape[1:]
+                for h, a in zip(head, args)
+            ):
+                # defer-signature change: one fold never mixes signatures —
+                # close the open window before the members see the new batch
+                window.fold()
+        members = self._deferred.values()
+        depths = [len(m._pending) for m in members]
+        for member_update in self._deferred_updates:
+            member_update(*args, **kwargs)
+        # migration: every member's newly appended chunk must BE the update
+        # args (identity) — true for every shipped deferred metric fed
+        # positional batches; derived chunks (extra weight columns) keep the
+        # per-member pending lane
+        aligned = True
+        for m, depth in zip(members, depths):
+            p = m._pending
+            if (
+                len(p) != depth + 1
+                or len(p[-1]) != len(args)
+                or any(x is not y for x, y in zip(p[-1], args))
+            ):
+                aligned = False
+                break
+        if not aligned:
+            window.sig = None  # keep routing through member updates
+            return
+        nbytes = sum(int(a.nbytes) for a in args)
+        for m in members:
+            m._pending.pop()
+            m._pending_bytes = max(m._pending_bytes - nbytes, 0)
+        window.append(args, nbytes, owned)
+        window.sig = sig
+        window.sig_nbytes = nbytes
+
+    def _ingest_slow(self, args, kwargs) -> None:
+        """kwargs / scalar / tracer batches: the pre-window lane — member
+        updates run per batch and the members' own pending lists group-fold
+        per budget window."""
+        for member_update in self._deferred_updates:
+            member_update(*args, **kwargs)
+
+    def _window_budget_check(self) -> None:
+        # collection-owned budget trigger: window chunks plus any stray
+        # member pending (direct streaming / the kwargs lane) count against
+        # ONE budget, read from the probe member so per-instance overrides
+        # (tests, tuning) keep working
+        probe = self._defer_probe
+        window = self._window
+        if (
+            window.nbytes + probe._pending_bytes >= probe._DEFER_BUDGET_BYTES
+            or len(window.chunks) + len(probe._pending)
+            >= probe._DEFER_MAX_CHUNKS
+        ):
+            window.fold()
 
     @_traced("collection.compute")
     def compute(self) -> Any:
-        if self._deferred:
-            group_fold(self._deferred)
-        out = {n: m.compute() for n, m in self.metrics.items()}
-        return out["metric"] if self._single else out
+        out: Dict[str, Any] = {}
+        if self._window is not None:
+            # close the window WITH the terminal computes: members with a
+            # pure _compute_fn get their result from inside the same
+            # program that folds the last chunks (zero extra dispatches)
+            results = self._window.close(
+                compute_keys=self._window_compute_keys
+            )
+            for n, result in results.items():
+                out[n] = self.metrics[n]._on_window_result(result)
+        ordered = {
+            n: out[n] if n in out else m.compute()
+            for n, m in self.metrics.items()
+        }
+        return ordered["metric"] if self._single else ordered
 
     def reset(self) -> "MetricCollection":
+        if self._window is not None:
+            # a collection-level reset discards the whole open window (the
+            # same drop-pending semantics as Metric.reset) BEFORE member
+            # resets, so no member pays a fold for chunks being thrown away
+            self._window.clear()
         for m in self.metrics.values():
             m.reset()
         return self
 
     def state_dicts(self) -> Dict[str, Dict[str, Any]]:
-        if self._deferred:
-            group_fold(self._deferred)
+        if self._window is not None:
+            self._window.close()  # fold-only: snapshots want exact state
         return {n: m.state_dict() for n, m in self.metrics.items()}
 
     def load_state_dicts(

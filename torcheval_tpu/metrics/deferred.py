@@ -29,31 +29,52 @@ CSEs the members' shared math.
 The fold itself has two physical shapes, picked at trace time per pending
 signature — always ONE dispatch either way:
 
-* **Scan fold (the steady-loop path).** When every pending chunk shares one
-  full ``(shape, dtype)`` signature — the common case in a constant-batch
-  eval loop — the fold program stacks the chunks into ONE
-  ``(num_chunks, batch, ...)`` operand per update argument and runs
-  ``jax.lax.scan`` over the leading axis (Podracer's
-  many-logical-steps-in-one-device-program recipe, arXiv:2104.06272).
-  The metric math (``fold_fn``) is traced ONCE as the scan body instead of
-  being unrolled per chunk, so trace size and compile time are O(1) in the
-  chunk count, and the retrace-signature space is O(1) per batch shape — a
-  steady constant-batch loop compiles ``deferred.fold`` at most twice per
-  batch shape (the valve-cadence chunk count plus the final partial flush),
-  which the ``obs`` recompile watchdog verifies. The stack happens INSIDE
-  the jitted program: stacking on the host would pay one extra dispatch per
-  update argument, and dispatches are the scarce resource on a tunneled
-  chip. Applies to per-sample-reduce folds (``_fold_per_chunk``);
-  state threads through the scan carry, which is how non-additive states
-  (Max/Min extrema via ``_fold_reduce``) ride the same machinery.
+* **Stacked fold (the steady-loop path).** When every pending chunk shares
+  one full ``(shape, dtype)`` signature — the common case in a
+  constant-batch eval loop — the fold program stacks the chunks into ONE
+  ``(num_chunks, batch, ...)`` operand per update argument (Podracer's
+  many-logical-steps-in-one-device-program recipe, arXiv:2104.06272),
+  ``jax.vmap``s the metric math (``fold_fn``) over the leading axis, and
+  axis-reduces the per-chunk deltas (``sum``/``max``/``min`` matching
+  ``_fold_reduce``) before ONE combine with state. The math is traced ONCE,
+  so trace size and compile time are O(1) in the chunk count, and the
+  retrace-signature space is O(1) per batch shape — a steady constant-batch
+  loop compiles the fold at most twice per batch shape (the valve-cadence
+  chunk count plus the final partial flush), which the ``obs`` recompile
+  watchdog verifies. The vmap replaced the ISSUE-2 ``lax.scan``: both are
+  O(1)-trace, but the scan serialized the chunks on device (K dependent
+  steps of a tiny kernel — latency-bound on an accelerator) where the
+  vmapped fold exposes all K×batch samples to one parallel kernel. A
+  ``lax.scan`` fallback remains for third-party ``_fold_reduce`` callables
+  without a known axis reduction. The stack happens INSIDE the jitted
+  program: stacking on the host would pay one extra dispatch per update
+  argument, and dispatches are the scarce resource on a tunneled chip.
+  Applies to per-sample-reduce folds (``_fold_per_chunk``).
 * **Concat fold (everything else).** Concat-regime folds
   (``_fold_per_chunk = False``) take one ``jnp.concatenate`` over the
   pending columns — their count kernels want the whole stream as a single
   large-N operand. Ragged chunk signatures under a per-sample-reduce fold
   take the per-chunk accumulation loop (correct for any shape mix, trace is
-  O(chunk count) — which is why the scan path exists). Mesh-sharded pending
-  chunks also keep this path: the SPMD partitioner, not a leading stack
-  axis, should own the batch dimension.
+  O(chunk count) — which is why the stacked path exists). Mesh-sharded
+  pending chunks also keep this path: the SPMD partitioner, not a leading
+  stack axis, should own the batch dimension.
+
+**The whole-window compiled eval step (ISSUE 6).** A ``MetricCollection``
+no longer drives member ``update()`` methods per batch at all: its
+``update()`` is a pure host-side accumulator appending each placed batch
+ONCE to a collection-owned :class:`EvalWindow` (validation runs once per
+batch signature, through the real member updates, then is memoised). When
+the window closes — on the memory budget, at ``compute()`` or at
+``state_dicts()`` — ONE donated pjit program (:func:`window_step`) contains
+(a) every member's per-batch update math over the stacked chunks, (b) the
+fold into each member's state tree, and (c), at ``compute()`` time, each
+member's terminal ``_compute_fn``. ``donate_argnums`` covers both the state
+trees and the chunk stack (chunks only when every chunk buffer is
+library-owned — created by this collection's own host→device placement —
+never buffers the caller may still hold; see ``EvalWindow.owned``).
+Standalone deferred metrics ride the same program shape through
+``compute()`` (:meth:`DeferredFoldMixin._deferred_compute`): fold + terminal
+compute in one dispatch.
 
 Concat-regime folds (``_fold_per_chunk = False``: confusion, F1 triples)
 still see the whole stream as one large-N operand either way, so the
@@ -85,23 +106,30 @@ Donation caveat: on backends where ``donation_pipelines()`` is true, a fold
 donates the previous state buffers. A raw reference captured from a state
 attribute (``ref = m.num_total``) dies at the next fold — read state through
 ``state_dict()`` / ``compute()`` instead of holding array refs across
-updates.
+updates. Internally, every donated dispatch also pins its input refs until
+the program retires (``_inflight_donated``): deleting a donated input's
+python wrapper mid-flight blocks the host on the execution, which would
+turn the async one-program window back into a synchronous one.
 
 Observability: every fold dispatch increments ``deferred.folds{entry=,path=}``
-(and ``deferred.folded_chunks{entry=}`` with the chunk count) in the obs
-registry while obs is enabled — the counters a dispatch-count regression
-test asserts O(1) programs per budget window on (tests/obs).
+(and ``deferred.folded_chunks{entry=}`` with the chunk count); every
+whole-window step increments ``deferred.window_steps{path=}`` (and
+``deferred.window_step_batches`` with the chunk count) in the obs registry
+while obs is enabled — the counters a dispatch-count regression test
+asserts O(1) programs per budget window on (tests/obs).
 """
 
 from __future__ import annotations
 
+import warnings
 import weakref
 from functools import partial
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.metric import _ARRAY_IMPL
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.recompile import watched_jit as _watched_jit
 
@@ -170,7 +198,7 @@ def _uniform_chunks(chunks) -> bool:
     return True
 
 
-def _scan_fold(states_by_key, chunks, specs):
+def _scan_fold(states_by_key, chunks, specs, rest=None):
     """State-threading scan fold of uniform chunks for one or more
     ``(key, fold_fn, fold_params, fold_reduce)`` specs — the single shared
     scan recipe for the solo and group dispatch bodies (each member's fold
@@ -182,7 +210,9 @@ def _scan_fold(states_by_key, chunks, specs):
     folds them with the metric math traced ONCE. The first chunk folds
     OUTSIDE the scan so dtype promotion settles the carry structure (an
     int32 counter meeting a float delta promotes on the first combine; the
-    scan carry must be shape/dtype-stable)."""
+    scan carry must be shape/dtype-stable). A caller that already stacked
+    the columns (``_stacked_fold`` with mixed vmap/scan specs) passes the
+    tail as ``rest`` so one program never materializes the window twice."""
 
     def step(states, chunk):
         return {
@@ -195,11 +225,82 @@ def _scan_fold(states_by_key, chunks, specs):
     carry = step(states_by_key, chunks[0])
     if len(chunks) == 1:
         return carry
-    rest = tuple(jnp.stack(cols, axis=0) for cols in zip(*chunks[1:]))
+    if rest is None:
+        rest = tuple(jnp.stack(cols, axis=0) for cols in zip(*chunks[1:]))
     carry, _ = jax.lax.scan(
         lambda c, chunk: (step(c, chunk), None), carry, rest
     )
     return carry
+
+
+# _fold_reduce identity -> axis reduction over a stacked delta axis. The
+# stacked fold reduces each state's (num_chunks, ...) delta stack with the
+# matching axis kernel instead of threading a sequential carry — same
+# result (sums/extrema are order-insensitive beyond float associativity),
+# O(1) trace, and the chunk axis stays parallel on device. A third-party
+# ``_fold_reduce`` outside this table falls back to the sequential scan.
+_AXIS_REDUCERS = {None: jnp.sum, jnp.maximum: jnp.max, jnp.minimum: jnp.min}
+
+
+def _stacked_fold(states_by_key, chunks, specs):
+    """Parallel fold of uniform chunks for one or more ``(key, fold_fn,
+    fold_params, fold_reduce, fold_vmap)`` specs — the steady-loop fold
+    shape shared by the solo, group, and window-step bodies.
+
+    The chunks stack INSIDE the program (a host-side stack would pay an
+    extra dispatch per column) into one ``(num_chunks, batch, ...)`` operand
+    per column; every member's fold vmaps over the leading axis in ONE
+    ``jax.vmap`` (shared subcomputations dedupe per chunk, exactly as the
+    old shared-scan body deduped per step), the per-chunk deltas axis-reduce
+    (:data:`_AXIS_REDUCERS`), and each state combines with its delta once.
+    Unlike the scan it replaced, no carry means no dtype-promotion
+    staging — an int32 counter meeting a float delta promotes at the single
+    combine. Specs with an exotic ``fold_reduce`` or ``fold_vmap=False``
+    (fold kernels without a batching rule, e.g. ``custom_partitioning``
+    lowerings) take the sequential :func:`_scan_fold` inside the same
+    program."""
+    scan_specs = tuple(
+        s[:4] for s in specs if s[3] not in _AXIS_REDUCERS or not s[4]
+    )
+    specs = tuple(s for s in specs if s[3] in _AXIS_REDUCERS and s[4])
+    # one stack for both lanes: when vmap and scan specs mix in one program,
+    # the scan fallback slices the chunk axis of the vmap lane's stack
+    # instead of stacking the same O(window-bytes) columns a second time
+    # (the differing operand sets would defeat CSE)
+    stacked = (
+        tuple(jnp.stack(cols, axis=0) for cols in zip(*chunks))
+        if specs
+        else None
+    )
+    out = {}
+    if scan_specs:
+        out.update(
+            _scan_fold(
+                {s[0]: states_by_key[s[0]] for s in scan_specs},
+                chunks,
+                scan_specs,
+                rest=(
+                    tuple(col[1:] for col in stacked)
+                    if stacked is not None and len(chunks) > 1
+                    else None
+                ),
+            )
+        )
+        if not specs:
+            return out
+
+    def all_deltas(chunk):
+        return {
+            key: fold_fn(*chunk, *fold_params)
+            for key, fold_fn, fold_params, _, _ in specs
+        }
+
+    delta_stacks = jax.vmap(all_deltas)(stacked)
+    for key, _, _, fold_reduce, _ in specs:
+        red = _AXIS_REDUCERS[fold_reduce]
+        deltas = {n: red(v, axis=0) for n, v in delta_stacks[key].items()}
+        out[key] = _combine(states_by_key[key], deltas, fold_reduce)
+    return out
 
 
 def _fold_deltas(chunks, fold_fn, fold_params, per_chunk, fold_reduce):
@@ -229,11 +330,18 @@ def _fold_deltas(chunks, fold_fn, fold_params, per_chunk, fold_reduce):
 
 
 def _fold_body(
-    states, chunks, fold_fn, fold_params, per_chunk, fold_reduce, scan_ok
+    states,
+    chunks,
+    fold_fn,
+    fold_params,
+    per_chunk,
+    fold_reduce,
+    fold_vmap,
+    stack_ok,
 ):
-    if scan_ok and per_chunk and len(chunks) > 1 and _uniform_chunks(chunks):
-        spec = (("s", fold_fn, fold_params, fold_reduce),)
-        return _scan_fold({"s": states}, chunks, spec)["s"]
+    if stack_ok and per_chunk and len(chunks) > 1 and _uniform_chunks(chunks):
+        spec = (("s", fold_fn, fold_params, fold_reduce, fold_vmap),)
+        return _stacked_fold({"s": states}, chunks, spec)["s"]
     deltas = _fold_deltas(chunks, fold_fn, fold_params, per_chunk, fold_reduce)
     return _combine(states, deltas, fold_reduce)
 
@@ -242,14 +350,21 @@ def _fold_body(
 # cache keys on (fold_fn identity, fold_params, pending pytree signature), so
 # a fresh metric instance reuses the compiled fold instead of re-tracing a
 # wide concat program per instance (measured ~200 ms of host tracing for a
-# 200-chunk fold — more than the fold itself; the scan path cuts exactly
+# 200-chunk fold — more than the fold itself; the stacked path cuts exactly
 # that cost to O(1)).
 # watched_jit: the deferred fold is the canonical retrace-storm site (the
 # trace cache keys on the pending pytree signature — wildly varying batch
 # shapes recompile the fold per signature) and the watchdog's per-signature
 # counts make that visible; the scope name attributes the fold's device
 # time in XLA traces.
-_FOLD_STATICS = ("fold_fn", "fold_params", "per_chunk", "fold_reduce", "scan_ok")
+_FOLD_STATICS = (
+    "fold_fn",
+    "fold_params",
+    "per_chunk",
+    "fold_reduce",
+    "fold_vmap",
+    "stack_ok",
+)
 _fold_dispatch = partial(
     _watched_jit, name="deferred.fold", static_argnames=_FOLD_STATICS
 )(_fold_body)
@@ -261,45 +376,46 @@ _fold_dispatch_donated = partial(
 )(_fold_body)
 
 
-def _group_fold_body(states_by_member, chunks, specs, scan_ok):
-    """Fold SEVERAL metrics' pending batches (identical args) in one program.
+def _group_fold_core(states_by_member, chunks, specs, stack_ok):
+    """Fold SEVERAL metrics' pending batches (identical args) inside one
+    trace — the shared body of the group-fold and window-step programs.
 
     ``specs`` is a static tuple of ``(member_key, fold_fn, fold_params,
-    per_chunk, fold_reduce)``. Because every member folds the same arrays
+    per_chunk, fold_reduce, fold_vmap)`` — what :func:`_member_spec`
+    builds. Because every member folds the same arrays
     inside one XLA program, common subcomputations dedupe: a
     MulticlassConfusionMatrix and a MulticlassF1Score over the same batch
     share the argmax and (depending on lowerings) the count kernels instead
     of dispatching them twice.
 
-    Under a uniform pending signature (and ``scan_ok``), every per-chunk
-    member folds inside ONE shared ``lax.scan`` whose carry holds all their
-    states — the members' shared math dedupes per scan step, not just per
-    program; concat-regime members keep their large-N concatenated operand
-    in the same program.
+    Under a uniform pending signature (and ``stack_ok``), every per-chunk
+    member folds over ONE shared stacked operand set — the members' shared
+    math dedupes per chunk inside a single ``jax.vmap``
+    (:func:`_stacked_fold`); concat-regime members keep their large-N
+    concatenated operand in the same program.
     """
     uniform = (
-        scan_ok and len(chunks) > 1 and _uniform_chunks(chunks)
+        stack_ok and len(chunks) > 1 and _uniform_chunks(chunks)
     )
     out = {}
-    scan_specs = []
+    stacked_specs = []
     for spec in specs:
-        key, fold_fn, fold_params, per_chunk, fold_reduce = spec
+        key, fold_fn, fold_params, per_chunk, fold_reduce, fold_vmap = spec
         if uniform and per_chunk:
-            scan_specs.append(spec)
+            stacked_specs.append(
+                (key, fold_fn, fold_params, fold_reduce, fold_vmap)
+            )
             continue
         deltas = _fold_deltas(
             chunks, fold_fn, fold_params, per_chunk, fold_reduce
         )
         out[key] = _combine(states_by_member[key], deltas, fold_reduce)
-    if scan_specs:
+    if stacked_specs:
         out.update(
-            _scan_fold(
-                {s[0]: states_by_member[s[0]] for s in scan_specs},
+            _stacked_fold(
+                {s[0]: states_by_member[s[0]] for s in stacked_specs},
                 chunks,
-                tuple(
-                    (key, fold_fn, fold_params, fold_reduce)
-                    for key, fold_fn, fold_params, _, fold_reduce in scan_specs
-                ),
+                tuple(stacked_specs),
             )
         )
     return out
@@ -308,21 +424,192 @@ def _group_fold_body(states_by_member, chunks, specs, scan_ok):
 _group_fold_dispatch = partial(
     _watched_jit,
     name="deferred.group_fold",
-    static_argnames=("specs", "scan_ok"),
-)(_group_fold_body)
+    static_argnames=("specs", "stack_ok"),
+)(_group_fold_core)
 _group_fold_dispatch_donated = partial(
     _watched_jit,
     name="deferred.group_fold",
-    static_argnames=("specs", "scan_ok"),
+    static_argnames=("specs", "stack_ok"),
     donate_argnums=(0,),
-)(_group_fold_body)
+)(_group_fold_core)
 
 
-def _scan_allowed(chunks) -> bool:
-    """Host-side gate for the scan path: single-device pending arrays only.
-    Mesh-sharded chunks keep the concat/per-chunk program — a leading stack
-    axis would fight the SPMD partitioner for the batch dimension. (Shape
-    uniformity is checked inside the trace, where shapes are static.)"""
+def _window_step_body(states_by_member, chunks, specs, compute_specs, stack_ok):
+    """ONE compiled eval-window step: (a) every member's per-batch update
+    math over the in-program-stacked pending chunks, (b) the fold into each
+    member's state tree, and (c) optionally each member's terminal compute —
+    the whole window as a single XLA program ("compile the whole program,
+    not the ops", arXiv:2102.04611).
+
+    ``compute_specs`` is a static tuple of ``(member_key, compute_fn,
+    compute_params, state_names)``; each listed member's ``compute_fn`` runs
+    on its FOLDED states inside the same program (``state_names`` pins the
+    metric's registration order — the jit pytree flattening of the states
+    dict is key-sorted, so positional reads must not rely on dict order).
+    Returns ``(new_states_by_member, results_by_member)``.
+    """
+    if chunks:
+        states_by_member = _group_fold_core(
+            states_by_member, chunks, specs, stack_ok
+        )
+    results = {}
+    for key, compute_fn, compute_params, state_names in compute_specs:
+        member_states = states_by_member[key]
+        results[key] = compute_fn(
+            *(member_states[n] for n in state_names), *compute_params
+        )
+    return states_by_member, results
+
+
+_WINDOW_STATICS = ("specs", "compute_specs", "stack_ok")
+_window_step_dispatch = partial(
+    _watched_jit,
+    name="deferred.window_step",
+    static_argnames=_WINDOW_STATICS,
+)(_window_step_body)
+_window_step_dispatch_donated = partial(
+    _watched_jit,
+    name="deferred.window_step",
+    static_argnames=_WINDOW_STATICS,
+    donate_argnums=(0,),
+)(_window_step_body)
+# "donate everything": state trees AND the chunk stack. Only reached when
+# every chunk buffer is library-owned (EvalWindow.owned — buffers this
+# process created by placing a host batch, which no caller can still hold);
+# XLA then may reuse the chunk HBM for outputs in place. Chunk donations
+# XLA cannot alias are a no-op (the buffers free at pending-clear time
+# anyway), so the runtime's "donated buffers were not usable" warning is
+# suppressed at the dispatch site.
+_window_step_dispatch_donated_all = partial(
+    _watched_jit,
+    name="deferred.window_step",
+    static_argnames=_WINDOW_STATICS,
+    donate_argnums=(0, 1),
+)(_window_step_body)
+
+
+# Donated-input lifetime: dropping the LAST python reference to a donated
+# input array while its program is still executing blocks the host thread
+# until the execution retires — the runtime must resolve the donation hold
+# before the wrapper can die (measured 40-90 ms per eval window on XLA:CPU,
+# i.e. the entire async-dispatch win of the one-program window; non-donated
+# inputs delete without blocking). The buffers themselves live until the
+# execution consumes them regardless, so pinning the python wrappers costs
+# no device memory: every donated dispatch parks its input refs here keyed
+# by one output anchor, and the next dispatch sweeps entries whose programs
+# have retired (``anchor.is_ready()`` — non-blocking).
+_inflight_donated: List[Tuple[Any, Tuple[Any, ...]]] = []
+
+
+def _hold_donated_inputs(outputs: Any, *refs: Any) -> None:
+    """Pin ``refs`` (the just-donated dispatch inputs) until ``outputs``'
+    program retires; sweep holds whose programs already have. A hold whose
+    anchor raises on the ``is_ready`` probe was NOT necessarily retired: the
+    anchor (a prior dispatch's output) gets deleted precisely when a later
+    dispatch donates it, which can happen while the prior program is still
+    executing (back-to-back windows: a valve fold chased by the compute()
+    close). Dropping such a hold would release the prior window's donated
+    inputs mid-flight — the host stall this registry exists to prevent — so
+    orphaned holds re-anchor onto THIS dispatch's output instead:
+    same-device programs retire in submission order, so the new anchor is
+    ready only after every earlier program has retired."""
+    keep = []
+    orphaned = []
+    for anchor, held in _inflight_donated:
+        try:
+            if not anchor.is_ready():
+                keep.append((anchor, held))
+        except Exception:
+            orphaned.append(held)  # deleted anchor: donated to a later dispatch
+    anchor = next(
+        (
+            a
+            for a in jax.tree_util.tree_leaves(outputs)
+            if hasattr(a, "is_ready")
+        ),
+        None,
+    )
+    if anchor is not None:
+        keep.append((anchor, (*refs, *orphaned)))
+    _inflight_donated[:] = keep
+
+
+def _sweep_retired_holds() -> None:
+    """Drop holds whose programs have retired — called BEFORE a donated
+    dispatch, while the previous dispatch's anchor is still alive (the
+    dispatch itself donates-and-deletes it, after which the probe can only
+    raise). Without this pre-pass the steady loop would orphan every
+    window's hold into the next (the post-dispatch sweep always finds the
+    anchor deleted) and the re-anchor chain would grow O(windows). A raised
+    probe keeps the hold: it is re-anchored by the next
+    :func:`_hold_donated_inputs`."""
+    keep = []
+    for anchor, held in _inflight_donated:
+        try:
+            if anchor.is_ready():
+                continue
+        except Exception:
+            pass
+        keep.append((anchor, held))
+    _inflight_donated[:] = keep
+
+
+class _quiet_unusable_donations:
+    """Suppress the runtime's "donated buffers were not usable" warning
+    around the library's own donated dispatches: a donation XLA cannot
+    alias (a dtype/layout mismatch between a state and its successor, or a
+    chunk with no matching output) is an expected no-op on these internal
+    programs — the caller holds no donation decision to act on.
+
+    A per-dispatch ``catch_warnings`` context is deliberate, despite its
+    costs (it mutates process-global warning state, so a concurrent thread's
+    *identical-message* warning inside the window is swallowed too, and each
+    entry invalidates the interpreter's warning-registry caches): a
+    module-level filter installed once would be wiped by any user or pytest
+    ``catch_warnings``/``-W`` context and the warning would leak under
+    strict-warnings runs. Window closes are O(windows), not O(batches), so
+    the per-close cost is off the hot path."""
+
+    def __enter__(self):
+        self._ctx = warnings.catch_warnings()
+        self._ctx.__enter__()
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        return self
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+def _dispatch_maybe_donated(
+    donate: bool, dispatch, states, chunks, held_chunks=None, **kw
+):
+    """Run one fold/window dispatch, applying the whole donation protocol
+    when ``donate``: suppress the runtime's unusable-donation warning (these
+    are library-internal programs; the caller holds no donation decision to
+    act on) and pin the donated inputs until the program retires
+    (:func:`_hold_donated_inputs` — dropping a donated input's python
+    wrapper mid-flight blocks the host on the execution). The single owner
+    of this protocol: every donated dispatch site routes through here so a
+    future change to the hold/suppress rules cannot miss a path.
+    ``held_chunks`` additionally pins the chunk stack when it was donated
+    too (the window step's owned-chunks case)."""
+    if not donate:
+        return dispatch(states, chunks, **kw)
+    _sweep_retired_holds()
+    with _quiet_unusable_donations():
+        out = dispatch(states, chunks, **kw)
+    _hold_donated_inputs(out, states, held_chunks)
+    return out
+
+
+def _stack_allowed(chunks) -> bool:
+    """Host-side gate for the stacked path: single-device pending arrays
+    only. Mesh-sharded chunks keep the concat/per-chunk program — a leading
+    stack axis would fight the SPMD partitioner for the batch dimension.
+    (Shape uniformity is checked inside the trace, where shapes are
+    static.)"""
     for a in chunks[0]:
         try:
             if len(a.sharding.device_set) != 1:
@@ -333,9 +620,16 @@ def _scan_allowed(chunks) -> bool:
 
 
 def _member_spec(key, m) -> Tuple[Any, ...]:
-    """Static per-member fold spec for the group dispatchers."""
+    """Static per-member fold spec for the group/window dispatchers."""
     cls = type(m)
-    return (key, cls._fold_fn, m._fold_params, cls._fold_per_chunk, cls._fold_reduce)
+    return (
+        key,
+        cls._fold_fn,
+        m._fold_params,
+        cls._fold_per_chunk,
+        cls._fold_reduce,
+        cls._fold_vmap,
+    )
 
 
 def _count_fold(entry: str, path: str, n_chunks: int) -> None:
@@ -348,8 +642,10 @@ def _count_fold(entry: str, path: str, n_chunks: int) -> None:
 
 def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
     """Fold every member's pending batches in ONE dispatch when their pending
-    structures are identical (the MetricCollection case: every member was fed
-    the same placed arrays); falls back to per-member folds otherwise."""
+    structures are identical (members fed the same placed arrays through
+    their own ``update``; the collection's shared-window lane uses
+    :func:`window_step` instead); falls back to per-member folds
+    otherwise."""
     pending = [m for m in members.values() if getattr(m, "_pending", None)]
     if not pending:
         return
@@ -359,7 +655,7 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
     )
     if not aligned:
         for m in pending:
-            m._fold_now()
+            m._fold_own()
         return
     chunks = head
     specs = tuple(_member_spec(key, m) for key, m in members.items())
@@ -369,21 +665,179 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
     }
     from torcheval_tpu.utils.platform import donation_pipelines
 
-    dispatch = (
-        _group_fold_dispatch_donated
-        if donation_pipelines()
-        else _group_fold_dispatch
+    donate = donation_pipelines()
+    dispatch = _group_fold_dispatch_donated if donate else _group_fold_dispatch
+    stack_ok = _stack_allowed(chunks)
+    new_states = _dispatch_maybe_donated(
+        donate, dispatch, states, chunks, specs=specs, stack_ok=stack_ok
     )
-    scan_ok = _scan_allowed(chunks)
-    new_states = dispatch(states, chunks, specs=specs, scan_ok=scan_ok)
-    _count_fold("group_fold", "scan" if scan_ok else "concat", len(chunks))
-    # clear pending only after a successful dispatch (see _fold_now)
+    _count_fold("group_fold", "stacked" if stack_ok else "concat", len(chunks))
+    # clear pending only after a successful dispatch (see _fold_own)
     for m in pending:
         m._pending = []
         m._pending_bytes = 0
     for key, m in members.items():
         for n, v in new_states[key].items():
             setattr(m, n, v)
+
+
+def window_step(
+    members: Dict[str, "DeferredFoldMixin"],
+    chunks: Tuple[Tuple[jax.Array, ...], ...],
+    *,
+    compute_keys: Iterable[str] = (),
+    owned_chunks: bool = False,
+) -> Dict[str, Any]:
+    """Dispatch ONE whole-window program: fold ``chunks`` into every
+    member's state and, for ``compute_keys`` members with a ``_compute_fn``,
+    run the terminal compute on the folded states inside the same program.
+
+    Donation ("donate everything", ISSUE 6): on ``donation_pipelines()``
+    backends the state trees are always donated; the chunk stack is donated
+    too when ``owned_chunks`` — the caller vouches every chunk buffer was
+    created by its own placement (a caller-held buffer must never be
+    donated: its next read would hit a deleted array). New states are bound
+    onto the members before returning; the returned dict maps each computed
+    member key to its result. Callers own pending-list clearing (only after
+    this returns, so a failed dispatch never discards valid batches)."""
+    compute_keys = set(compute_keys)
+    compute_specs = tuple(
+        (
+            key,
+            type(m)._compute_fn,
+            tuple(m._compute_params),
+            tuple(m._state_name_to_default),
+        )
+        for key, m in members.items()
+        if key in compute_keys and type(m)._compute_fn is not None
+    )
+    if not chunks and not compute_specs:
+        return {}
+    specs = tuple(_member_spec(key, m) for key, m in members.items())
+    states = {
+        key: {n: getattr(m, n) for n in m._state_name_to_default}
+        for key, m in members.items()
+    }
+    from torcheval_tpu.utils.platform import donation_pipelines
+
+    donate = donation_pipelines()
+    stack_ok = _stack_allowed(chunks) if chunks else True
+    donate_chunks = donate and owned_chunks and bool(chunks)
+    if donate_chunks:
+        dispatch = _window_step_dispatch_donated_all
+    elif donate:
+        dispatch = _window_step_dispatch_donated
+    else:
+        dispatch = _window_step_dispatch
+    new_states, results = _dispatch_maybe_donated(
+        donate,
+        dispatch,
+        states,
+        chunks,
+        held_chunks=chunks if donate_chunks else None,
+        specs=specs,
+        compute_specs=compute_specs,
+        stack_ok=stack_ok,
+    )
+    path = ("stacked" if stack_ok else "concat") if chunks else "compute"
+    _obs.counter("deferred.window_steps", path=path)
+    if chunks:
+        _obs.counter("deferred.window_step_batches", float(len(chunks)))
+    for key, m in members.items():
+        for n, v in new_states[key].items():
+            setattr(m, n, v)
+    return results
+
+
+class EvalWindow:
+    """Collection-owned pending-batch window shared by every deferred member.
+
+    ``MetricCollection.update()`` appends each placed batch here ONCE
+    (instead of once per member), and the window closes as a single
+    :func:`window_step` program. ``owned`` tracks whether EVERY chunk buffer
+    was created by the collection's own host→device placement — the
+    precondition for donating the chunk stack (a buffer the caller may
+    still reference is never donated). ``sig`` caches the full
+    ``(shape, dtype)`` batch signature the collection's fast path was last
+    validated against. ``owner`` weak-references the owning collection:
+    members prune windows whose collection died (folding any orphaned
+    chunks first — those updates belong to the metric whatever happened to
+    the wrapper), so a long-lived metric re-wrapped per epoch never
+    accumulates dead windows (:meth:`DeferredFoldMixin._live_windows`)."""
+
+    __slots__ = (
+        "members",
+        "chunks",
+        "nbytes",
+        "sig",
+        "sig_nbytes",
+        "owned",
+        "owner",
+    )
+
+    def __init__(
+        self, members: Dict[str, "DeferredFoldMixin"], owner: Any = None
+    ) -> None:
+        self.members = members
+        self.chunks: List[Tuple[jax.Array, ...]] = []
+        self.nbytes = 0
+        self.sig: Optional[Tuple[Any, ...]] = None
+        self.sig_nbytes = 0  # cached per-batch bytes of ``sig``
+        self.owned = True
+        # ownerless windows (direct construction) count as always-alive
+        self.owner = weakref.ref(owner) if owner is not None else (lambda: self)
+
+    def append(self, chunk: Tuple[jax.Array, ...], nbytes: int, owned: bool) -> None:
+        self.chunks.append(chunk)
+        self.nbytes += nbytes
+        self.owned = self.owned and owned
+
+    def clear(self) -> None:
+        self.chunks = []
+        self.nbytes = 0
+        self.owned = True
+
+    def fold(self) -> None:
+        """Mid-stream budget valve: fold the open window, no terminal
+        compute."""
+        self.close()
+
+    def close(self, compute_keys: Iterable[str] = ()) -> Dict[str, Any]:
+        """Fold everything pending into member state in O(1) programs and
+        optionally run ``compute_keys`` members' terminal computes in the
+        same program. Everything a computed member's logical state depends
+        on folds FIRST: its OTHER collections' open windows (a metric can
+        be wrapped by several collections) and stray member-own pending
+        chunks (a member streamed into directly) — grouped into one program
+        where their pending lists align — so a terminal compute always sees
+        the member's complete stream."""
+        for key in compute_keys:
+            m = self.members.get(key)
+            if m is None:
+                continue
+            # _live_windows (not the raw list): this is the read path a
+            # collection-wrapped metric takes every epoch, so it must also
+            # prune windows whose owning collection died — otherwise a
+            # long-lived metric re-wrapped per epoch accumulates dead
+            # windows (each pinning its collection's member dict) forever
+            live = getattr(m, "_live_windows", None)
+            windows = (
+                live() if live is not None else getattr(m, "_defer_windows", ())
+            )
+            for w in windows:
+                if w is not self and w.chunks:
+                    w.close()
+        if any(getattr(m, "_pending", None) for m in self.members.values()):
+            group_fold(self.members)
+        chunks = tuple(self.chunks)
+        results = window_step(
+            self.members,
+            chunks,
+            compute_keys=compute_keys,
+            owned_chunks=self.owned and bool(chunks),
+        )
+        self.clear()
+        return results
 
 
 class DeferredFoldMixin:
@@ -395,20 +849,29 @@ class DeferredFoldMixin:
             ...                                    # math on one (stream of)
             return {"num_tp": ..., "num_fp": ...}  # batches -> {state: delta}
 
+        def _my_compute(num_tp, num_fp, threshold):  # MODULE-level pure fn:
+            return ...                               # folded states -> result
+
         class MyMetric(DeferredFoldMixin, Metric[jax.Array]):
             _fold_fn = staticmethod(_my_fold)
+            _compute_fn = staticmethod(_my_compute)  # optional (see below)
 
             def __init__(self, ...):
                 super().__init__(device=device)
                 self._add_state(...)
                 self._init_deferred()
                 self._fold_params = (threshold,)   # hashable statics
+                self._compute_params = (threshold,)
+
+            def _update_check(self, input, target):
+                _my_input_check(input, target)     # shape/dtype only
 
             def update(self, input, target):
-                input, target = self._input(input), self._input(target)
-                _my_input_check(input, target)
-                self._defer(input, target)
+                self._defer(self._input(input), self._input(target))
                 return self
+
+            def compute(self):
+                return self._deferred_compute()
 
     ``_fold_fn`` must be a module-level function (shared identity across
     instances — it keys the shared jit cache) taking the update args (a whole
@@ -417,9 +880,19 @@ class DeferredFoldMixin:
     (a per-sample weight) defer as extra positional chunk columns; the fold
     fn discriminates on arity. Deltas merge into state with ``_fold_reduce``
     (``None`` = add; ``jnp.maximum``/``jnp.minimum`` thread extrema states).
-    ``compute``/``merge_state`` implementations must call ``_fold_now()``
-    (and fold merge sources) before reading state; the :class:`Metric` base
-    class folds in ``state_dict``/``to``/``_prepare_for_merge_state``/pickle.
+
+    ``_compute_fn`` (optional) is the pure terminal compute
+    ``(*states_in_registration_order, *_compute_params) -> result``; metrics
+    that set it and route ``compute()`` through :meth:`_deferred_compute`
+    get fold + compute fused into ONE window-step program (and their
+    terminal compute rides a ``MetricCollection``'s window close). Host-side
+    compute behavior (async warnings) moves to :meth:`_on_window_result`.
+    ``_update_check`` (optional) holds the shape/dtype update validation —
+    it runs once per batch signature and is memoised by the ``_defer`` fast
+    path. ``compute``/``merge_state`` implementations that do NOT use
+    ``_deferred_compute`` must call ``_fold_now()`` (and fold merge sources)
+    before reading state; the :class:`Metric` base class folds in
+    ``state_dict``/``to``/``_prepare_for_merge_state``/pickle.
     """
 
     # pending-args budget before a fold is forced. 256 MB holds e.g. 32 chunks
@@ -437,16 +910,34 @@ class DeferredFoldMixin:
 
     _fold_params: Tuple[Any, ...] = ()
     # True for folds that are per-sample independent + reduce (accuracy
-    # family, regression/NE sufficient statistics, aggregations): the scan
-    # path folds chunk-wise with the math traced once, and the ragged
-    # fallback accumulates per chunk — both beat a many-operand concat.
-    # Count kernels (confusion, F1 triples) keep the concat to stay in
-    # their measured large-N regime.
+    # family, regression/NE sufficient statistics, aggregations): the
+    # stacked path folds chunk-wise with the math traced once, and the
+    # ragged fallback accumulates per chunk — both beat a many-operand
+    # concat. Count kernels (confusion, F1 triples) keep the concat to stay
+    # in their measured large-N regime.
     _fold_per_chunk: bool = False
     # None = states merge by addition. Non-additive states (Max/Min extrema)
     # set a module-level combine (e.g. ``staticmethod(jnp.maximum)``) and the
     # fold threads state through it instead.
     _fold_reduce: Optional[Any] = None
+    # False when the fold kernel cannot ride jax.vmap (a lowering without a
+    # batching rule, e.g. custom_partitioning); such folds keep the
+    # sequential lax.scan inside the stacked program.
+    _fold_vmap: bool = True
+    # Module-level pure terminal compute: ``_compute_fn(*states_in_
+    # registration_order, *_compute_params) -> result``. Metrics that set it
+    # route ``compute()`` through :meth:`_deferred_compute`, which folds any
+    # pending batches AND runs this inside ONE window-step program. ``None``
+    # = the metric's compute has host-side behavior (value-dependent errors,
+    # blocking reads) and runs eagerly after a fold-only window close.
+    _compute_fn: Optional[Any] = None
+    _compute_params: Tuple[Any, ...] = ()
+    # Optional signature-memoised update validation: a metric that defines
+    # ``_update_check(*update_args)`` (shape/dtype checks only — it is
+    # SKIPPED for a batch whose full signature matches the last validated
+    # one) may drop the eager per-call check from ``update()``. ``None`` =
+    # the metric validates eagerly in ``update()`` as before.
+    _update_check: Optional[Any] = None
 
     def _init_deferred(self) -> None:
         global _defer_seq_counter
@@ -456,6 +947,11 @@ class DeferredFoldMixin:
         # _pending — _defer compares one tuple instead of re-deriving the
         # head chunk's signature attribute-by-attribute on every call
         self._pending_sig: Optional[Tuple[Any, ...]] = None
+        # (shapes, dtypes, nbytes) of the last VALIDATED batch: the _defer
+        # fast path compares full shapes/dtypes against this and, on a hit,
+        # skips validation, flush checks and the per-array nbytes reads
+        # (~half the append cost on a steady loop is jax.Array.nbytes)
+        self._defer_cache: Optional[Tuple[Any, ...]] = None
         # registration order: the stable tie-break for group-member ordering
         # (jit caches on the static specs tuple; WeakSet iteration order and
         # id() are both unstable)
@@ -469,6 +965,44 @@ class DeferredFoldMixin:
 
     # -------------------------------------------------------------- machinery
     def _defer(self, *args: jax.Array) -> None:
+        cache = self._defer_cache
+        if cache is not None:
+            shapes, dtypes, nbytes = cache
+            if len(args) == len(shapes):
+                # one flat loop, no genexpr/tuple allocation: a concrete
+                # ArrayImpl type compare (excludes tracers for free) plus
+                # per-arg shape/dtype equality against the cached signature
+                for i, a in enumerate(args):
+                    if (
+                        type(a) is not _ARRAY_IMPL
+                        or a.shape != shapes[i]
+                        or a.dtype != dtypes[i]
+                    ):
+                        break
+                else:
+                    # steady-loop fast path: identical full signature to the
+                    # last validated batch — the (shape-only) validation, the
+                    # signature-flush check and the byte accounting are all
+                    # functions of that signature, so none re-run. The budget
+                    # probe inlines the (unscaled) thresholds; the full check
+                    # re-tests with the managed 2x scale before acting.
+                    self._pending.append(args)
+                    pb = self._pending_bytes = self._pending_bytes + nbytes
+                    if (
+                        pb >= self._DEFER_BUDGET_BYTES
+                        or len(self._pending) >= self._DEFER_MAX_CHUNKS
+                    ):
+                        self._defer_budget_check()
+                    return
+        self._defer_slow(args)
+
+    def _defer_slow(self, args: Tuple[jax.Array, ...]) -> None:
+        check = self._update_check
+        if check is not None:
+            # shape/dtype validation runs here (once per signature, the
+            # fast path above memoises it) — tracers included: the checks
+            # are host-metadata only and must surface inside a user's trace
+            check(*args)
         if any(_is_tracer(a) for a in args):
             # inside an enclosing trace: fold eagerly so no tracer outlives
             # its trace in the pending list
@@ -479,10 +1013,19 @@ class DeferredFoldMixin:
             # arity/rank/width/dtype change: one fold never mixes signatures
             # (concatenation would be illegal or silently promote) — flush
             # the old signature FIRST, then append the new chunk
-            self._fold_now()
+            self._fold_own()
         self._pending.append(args)
         self._pending_sig = sig
-        self._pending_bytes += sum(int(a.nbytes) for a in args)
+        nbytes = sum(int(a.nbytes) for a in args)
+        self._pending_bytes += nbytes
+        self._defer_cache = (
+            tuple(a.shape for a in args),
+            tuple(a.dtype for a in args),
+            nbytes,
+        )
+        self._defer_budget_check()
+
+    def _defer_budget_check(self) -> None:
         # _defer_managed: a MetricCollection owns the fold trigger so sibling
         # metrics fold in ONE dispatch (XLA CSEs shared math, e.g. confusion
         # matrix + F1 over the same batch). A managed member streamed into
@@ -501,7 +1044,7 @@ class DeferredFoldMixin:
                 self._pending_bytes >= scale * self._DEFER_BUDGET_BYTES
                 or len(self._pending) >= scale * self._DEFER_MAX_CHUNKS
             ):
-                self._fold_now()
+                self._fold_own()
 
     def _apply_deltas(self, deltas: Dict[str, jax.Array]) -> None:
         red = type(self)._fold_reduce or _add
@@ -551,15 +1094,16 @@ class DeferredFoldMixin:
         }
         from torcheval_tpu.utils.platform import donation_pipelines
 
+        donate = donation_pipelines()
         dispatch = (
-            _group_fold_dispatch_donated
-            if donation_pipelines()
-            else _group_fold_dispatch
+            _group_fold_dispatch_donated if donate else _group_fold_dispatch
         )
-        scan_ok = _scan_allowed(chunks)
-        new_states = dispatch(states, chunks, specs=specs, scan_ok=scan_ok)
+        stack_ok = _stack_allowed(chunks)
+        new_states = _dispatch_maybe_donated(
+            donate, dispatch, states, chunks, specs=specs, stack_ok=stack_ok
+        )
         _count_fold(
-            "group_fold", "scan" if scan_ok else "concat", len(chunks)
+            "group_fold", "stacked" if stack_ok else "concat", len(chunks)
         )
         for i, m in enumerate(group):
             m._pending = m._pending[common:]
@@ -569,11 +1113,39 @@ class DeferredFoldMixin:
             for n, v in new_states[str(i)].items():
                 setattr(m, n, v)
 
+    def _live_windows(self) -> Tuple["EvalWindow", ...]:
+        """The shared windows this metric still belongs to, pruning windows
+        whose owning collection died — after folding any orphaned chunks
+        (they carry updates the user fed; the wrapper's lifetime must not
+        lose them). Keeps a long-lived metric re-wrapped per epoch from
+        accumulating dead windows (and their members) forever."""
+        windows = getattr(self, "_defer_windows", None)
+        if not windows:
+            return ()
+        dead = [w for w in windows if w.owner() is None]
+        for w in dead:
+            if w.chunks:
+                w.close()
+            windows.remove(w)
+        return tuple(windows)
+
     def _fold_now(self) -> None:
-        """Fold all pending batches into the metric state: one dispatch —
-        shared with every standalone peer metric whose pending chunks are
-        an identity-prefix match (see :meth:`_group_fold_attempt`); any
-        remainder folds solo so the full-fold contract holds."""
+        """Fold every pending batch this metric's logical state depends on:
+        EVERY collection-owned shared :class:`EvalWindow` this metric
+        belongs to (their chunks carry this metric's not-yet-folded
+        updates — a metric can be wrapped by several collections) and then
+        the metric's own pending list."""
+        for w in self._live_windows():
+            if w.chunks:
+                w.close()
+        self._fold_own()
+
+    def _fold_own(self) -> None:
+        """Fold this metric's OWN pending batches into its state: one
+        dispatch — shared with every standalone peer metric whose pending
+        chunks are an identity-prefix match (see
+        :meth:`_group_fold_attempt`); any remainder folds solo so the
+        full-fold contract holds."""
         pending = getattr(self, "_pending", None)
         if not pending:
             return
@@ -585,22 +1157,23 @@ class DeferredFoldMixin:
 
         # donation keeps counters updating in place in HBM; gated off on
         # tunneled backends where it serialises dispatches (utils/platform.py)
-        dispatch = (
-            _fold_dispatch_donated if donation_pipelines() else _fold_dispatch
-        )
+        donate = donation_pipelines()
+        dispatch = _fold_dispatch_donated if donate else _fold_dispatch
         states = {n: getattr(self, n) for n in self._state_name_to_default}
         cls = type(self)
-        scan_ok = _scan_allowed(pending)
-        new_states = dispatch(
-            states,
-            pending,
+        stack_ok = _stack_allowed(pending)
+        fold_kwargs = dict(
             fold_fn=cls._fold_fn,
             fold_params=self._fold_params,
             per_chunk=cls._fold_per_chunk,
             fold_reduce=cls._fold_reduce,
-            scan_ok=scan_ok,
+            fold_vmap=cls._fold_vmap,
+            stack_ok=stack_ok,
         )
-        _count_fold("fold", "scan" if scan_ok else "concat", len(pending))
+        new_states = _dispatch_maybe_donated(
+            donate, dispatch, states, pending, **fold_kwargs
+        )
+        _count_fold("fold", "stacked" if stack_ok else "concat", len(pending))
         # clear pending only after a successful dispatch: a fold that raises
         # (bad batch reaching the trace) must not silently discard the valid
         # batches queued alongside it
@@ -609,11 +1182,64 @@ class DeferredFoldMixin:
         for name, value in new_states.items():
             setattr(self, name, value)
 
+    def _on_window_result(self, result):
+        """Hook for host-side compute post-processing (async warnings and
+        the like) applied to an in-program terminal-compute result exactly
+        as the metric's own ``compute()`` would. Default: identity."""
+        return result
+
+    def _deferred_compute(self):
+        """``compute()`` body for metrics with a pure ``_compute_fn``: fold
+        any pending batches AND run the terminal compute inside ONE
+        window-step program (a solo window step, or this member's compute
+        riding the last open collection window's close — ``close()`` itself
+        drains this member's earlier windows of other collections fold-only
+        first). With nothing pending, the compute expression dispatches
+        alone, exactly as before."""
+        cls = type(self)
+        open_windows = [w for w in self._live_windows() if w.chunks]
+        if open_windows:
+            last = open_windows[-1]
+            key = next(k for k, v in last.members.items() if v is self)
+            results = last.close(compute_keys=(key,))
+            if key in results:
+                return self._on_window_result(results[key])
+        elif self._pending:
+            if not getattr(self, "_defer_managed", False):
+                # peers holding the same stream fold together first;
+                # whatever remains is this metric's alone and fuses with
+                # its compute
+                self._group_fold_attempt()
+            pending = tuple(self._pending)
+            if pending:
+                results = window_step(
+                    {"s": self}, pending, compute_keys=("s",)
+                )
+                self._pending = []
+                self._pending_bytes = 0
+                if "s" in results:
+                    return self._on_window_result(results["s"])
+        result = cls._compute_fn(
+            *(getattr(self, n) for n in self._state_name_to_default),
+            *self._compute_params,
+        )
+        return self._on_window_result(result)
+
     # ------------------------------------------------------ lifecycle hooks
     def reset(self):
+        for w in self._live_windows():
+            if w.chunks:
+                # a shared window's chunks belong to EVERY member: fold them
+                # so the siblings keep their contributions (self's fold
+                # lands in state this reset is about to wipe — a
+                # member-level reset discards exactly its own stream,
+                # nothing else's). MetricCollection.reset clears its window
+                # first, so a whole-collection reset never pays this fold.
+                w.close()
         self._pending = []
         self._pending_bytes = 0
         self._pending_sig = None
+        self._defer_cache = None
         return super().reset()
 
     # NOTE no load_state_dict override: the base class folds pending chunks
@@ -627,9 +1253,12 @@ class DeferredFoldMixin:
         self._fold_now()
         state = super().__getstate__()
         state["_pending"] = []
-        # management is a live relationship with one collection instance; a
-        # restored/cloned metric answers to no collection and must self-fold
+        # management (and window membership) is a live relationship with
+        # collection instances; a restored/cloned metric answers to no
+        # collection and must self-fold
         state.pop("_defer_managed", None)
+        state.pop("_defer_windows", None)
+        state.pop("_defer_cache", None)
         return state
 
     def __setstate__(self, state) -> None:
@@ -638,11 +1267,22 @@ class DeferredFoldMixin:
         self._pending = []
         self._pending_bytes = 0
         self._pending_sig = None
+        self._defer_cache = None
         _live_deferred.add(self)
 
     def __deepcopy__(self, memo):
         self._fold_now()
-        new = super().__deepcopy__(memo)
+        # the shared window back-references must not ride the copy: deep-
+        # copying them would clone the whole collection membership (and the
+        # clone answers to no collection anyway)
+        d = self.__dict__
+        windows = d.pop("_defer_windows", None)
+        try:
+            new = super().__deepcopy__(memo)
+        finally:
+            if windows is not None:
+                d["_defer_windows"] = windows
         new.__dict__.pop("_defer_managed", None)
+        new._defer_cache = None
         _live_deferred.add(new)  # clones group with future same-batch peers
         return new

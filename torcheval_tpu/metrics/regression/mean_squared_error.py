@@ -44,6 +44,12 @@ def _mse_deferred_fold(input, target, sample_weight=None):
     return {"sum_squared_error": sse, "sum_weight": sw}
 
 
+def _mse_deferred_compute(sum_squared_error, sum_weight, multioutput):
+    """State-ordered adapter for the window-step terminal compute (the
+    functional takes ``multioutput`` between the two states)."""
+    return _mean_squared_error_compute(sum_squared_error, multioutput, sum_weight)
+
+
 class MeanSquaredError(DeferredFoldMixin, Metric[jax.Array]):
     """Streaming mean squared error with optional per-sample weights.
 
@@ -55,6 +61,7 @@ class MeanSquaredError(DeferredFoldMixin, Metric[jax.Array]):
 
     _fold_fn = staticmethod(_mse_deferred_fold)
     _fold_per_chunk = True
+    _compute_fn = staticmethod(_mse_deferred_compute)
 
     def __init__(
         self,
@@ -72,6 +79,10 @@ class MeanSquaredError(DeferredFoldMixin, Metric[jax.Array]):
             "sum_weight", zeros_state((), dtype=jnp.int32), reduction=Reduction.SUM
         )
         self._init_deferred()
+        self._compute_params = (multioutput,)
+
+    def _update_check(self, input, target, sample_weight=None) -> None:
+        _mean_squared_error_update_input_check(input, target, sample_weight)
 
     def update(
         self,
@@ -82,20 +93,14 @@ class MeanSquaredError(DeferredFoldMixin, Metric[jax.Array]):
     ) -> "MeanSquaredError":
         input = self._input(input)
         target = self._input(target)
-        if sample_weight is not None:
-            sample_weight = self._input(sample_weight)
-        _mean_squared_error_update_input_check(input, target, sample_weight)
         if sample_weight is None:
             self._defer(input, target)
         else:
-            self._defer(input, target, sample_weight)
+            self._defer(input, target, self._input(sample_weight))
         return self
 
     def compute(self) -> jax.Array:
-        self._fold_now()
-        return _mean_squared_error_compute(
-            self.sum_squared_error, self.multioutput, self.sum_weight
-        )
+        return self._deferred_compute()
 
     def merge_state(
         self, metrics: Iterable["MeanSquaredError"]

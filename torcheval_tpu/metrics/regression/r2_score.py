@@ -74,13 +74,16 @@ class R2Score(DeferredFoldMixin, Metric[jax.Array]):
             self._add_state(name, default, reduction=Reduction.SUM)
         self._init_deferred()
 
-    def update(self, input, target) -> "R2Score":
-        input = self._input(input)
-        target = self._input(target)
+    def _update_check(self, input, target) -> None:
         _r2_score_update_input_check(input, target)
-        self._defer(input, target)
+
+    def update(self, input, target) -> "R2Score":
+        self._defer(self._input(input), self._input(target))
         return self
 
+    # NOTE no _compute_fn: _r2_score_compute reads num_obs on the host
+    # (insufficient-data errors) — it cannot ride inside the window-step
+    # program, so compute() stays the eager fold-then-compute pair.
     def compute(self) -> jax.Array:
         self._fold_now()
         return _r2_score_compute(

@@ -92,7 +92,11 @@ def _put_leaf(value, device, *, strict_layout: bool = False):
     """
     import numpy as np
 
-    value = jnp.asarray(value) if not hasattr(value, "dtype") else value
+    if not isinstance(value, (jax.Array, np.ndarray)):
+        # scalars, python sequences, torch tensors: jnp.asarray as before.
+        # numpy stays raw — device_put places it natively in one transfer,
+        # where asarray would pay a separate transfer dispatch first.
+        value = jnp.asarray(value)
     if isinstance(value, jax.Array) and not isinstance(
         device, jax.sharding.Sharding
     ):
@@ -165,7 +169,10 @@ def put_state(value: TState, device) -> TState:
             d.update(out)
             return d
         return out
-    return _put_leaf(jnp.asarray(value), device)
+    # host array-likes (numpy defaults) go straight to _put_leaf's
+    # device_put — a jnp.asarray here would pay a separate transfer dispatch
+    # before the placement
+    return _put_leaf(value, device)
 
 
 @functools.lru_cache(maxsize=256)
@@ -173,31 +180,44 @@ def _zeros_template(shape, dtype):
     return jnp.zeros(shape, dtype)
 
 
-def zeros_state(shape=(), dtype=jnp.float32) -> jax.Array:
+def zeros_state(shape=(), dtype=jnp.float32):
     """A zeros array for a state default.
 
     On backends where donation is off (``utils/platform.py`` — every buffer
-    stays immutable forever), the SAME cached template is returned for a
-    given (shape, dtype): metric construction then costs zero device
-    dispatches for its defaults, where a fresh ``jnp.zeros`` per state per
-    instance paid one dispatch each (0.2-8 ms on a tunneled chip). With
-    donation on, a fresh array is returned — a shared template could be
-    invalidated by a donated fold.
+    stays immutable forever), the SAME cached device template is returned
+    for a given (shape, dtype): ``copy_state`` aliases it and ``put_state``
+    passes it through, so metric construction/reset costs ZERO device
+    dispatches (0.2-8 ms each on a tunneled chip). With donation on, a
+    shared device template would be invalidated by the first donated window
+    step (ISSUE 6 donates EVERY live state tree at window close), so a
+    HOST-side ``np.zeros`` is returned instead: defaults are schema
+    templates that only become device state through ``put_state`` (at
+    ``_add_state`` and every ``reset``), which mints the fresh placed
+    buffer in ONE transfer — where a fresh ``jnp.zeros`` default paid 3-4
+    dispatches per state for buffers that were immediately copied again
+    (~0.9 ms per 2-state metric construction on the bench CPU, the whole
+    per-run host budget). The live-state freshness guard is
+    regression-tested in tests/metrics/test_window_step.py (copy-on-read
+    template guard).
     """
+    import numpy as np
+
     from torcheval_tpu.utils.platform import donation_pipelines
 
     shape = tuple(shape) if hasattr(shape, "__len__") else (shape,)
     if donation_pipelines():
-        return jnp.zeros(shape, dtype)
+        return np.zeros(shape, jnp.dtype(dtype))
     return _zeros_template(shape, jnp.dtype(dtype))
 
 
 def _copy_leaf(value):
-    # real buffer copies, not aliases: donated-state updates
-    # (metrics/collection.py) invalidate live buffers, so a default snapshot
-    # or state_dict that merely shared the array would die with it. Arrays
-    # are immutable, but buffer LIFETIME is not — EXCEPT when this process
-    # never donates (tunneled backends gate donation off, utils/platform.py):
+    # real buffer copies, not aliases: the donated window step / deferred
+    # folds (metrics/deferred.py) invalidate live state buffers — and the
+    # window step also donates library-owned CHUNK buffers — so a default
+    # snapshot or state_dict that merely shared the array would die with
+    # it. Arrays are immutable, but buffer LIFETIME is not — EXCEPT when
+    # this process never donates (tunneled backends gate donation off,
+    # utils/platform.py):
     # then aliasing an immutable array is safe and skips a device dispatch.
     # That dispatch is the dominant cost of metric construction/reset on a
     # tunneled chip: ~2 copy dispatches per state × a 0.2-8 ms floor was
