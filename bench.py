@@ -29,6 +29,14 @@ and main() exits non-zero unless EVERY expected metric row was emitted — so
 a bench leg broken by a library change fails the PR's unit-test workflow
 instead of surfacing at the next driver round. Smoke numbers are
 meaningless as measurements; only completeness is asserted.
+
+``--trace [out.json]`` (ISSUE 7 satellite) records the obs event timeline
+for the whole run and writes it as Chrome/Perfetto ``trace_event`` JSON
+(load it at chrome://tracing or ui.perfetto.dev): every window-step
+dispatch, jit compile, sync round and checkpoint lands as a timeline bar.
+``--smoke`` additionally drops the trace plus the obs registry snapshot
+into ``$TORCHEVAL_TPU_TEST_ARTIFACT_DIR`` (default ``test-artifacts/``),
+which CI uploads on every run — each PR leaves a loadable flight record.
 """
 
 import json
@@ -44,8 +52,26 @@ import numpy as np
 _OBS = "--obs" in sys.argv
 _SMOKE = "--smoke" in sys.argv
 
+
+def _trace_arg():
+    if "--trace" not in sys.argv:
+        return None
+    i = sys.argv.index("--trace")
+    if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("--"):
+        return sys.argv[i + 1]
+    return "bench_trace.json"
+
+
+_TRACE = _trace_arg()
+
 # every emitted metric name, for the --smoke completeness assertion
 _EMITTED = []
+
+# rank-tagged timeline events collected from the config5 sync worker
+# processes (the only place toolkit sync rounds happen in a bench run);
+# merged into the exported Chrome trace so the flight record shows the
+# cross-process collective bars, not only the parent's dispatches
+_EXTRA_EVENTS = []
 
 
 def _to_torch(arr):
@@ -775,6 +801,10 @@ def config5_explicit_sync_4proc():
         tmpdir = tempfile.mkdtemp(prefix=f"sync_bench_{mode}_")
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # each process models one host
+        if _OBS or _TRACE or _SMOKE:
+            # workers record their obs timelines (sync rounds live there)
+            # and ship the events back for the merged Chrome trace
+            env["TORCHEVAL_TPU_BENCH_OBS"] = "1"
         procs = []
         try:
             # per-rank output goes to FILES, not pipes: a rank whose JAX
@@ -827,6 +857,16 @@ def config5_explicit_sync_4proc():
             for r in range(world):
                 with open(os.path.join(tmpdir, f"{mode}_rank{r}.json")) as f:
                     per_rank.append(json.load(f))
+                ev_path = os.path.join(tmpdir, f"{mode}_rank{r}_events.json")
+                if os.path.exists(ev_path):
+                    with open(ev_path) as f:
+                        dump = json.load(f)
+                    # pid r+1: the parent's own events render as pid 0, so
+                    # worker rank 0 must not collide with the parent row
+                    _EXTRA_EVENTS.extend(
+                        {**e, "rank": dump["rank"] + 1}
+                        for e in dump["events"]
+                    )
         finally:
             # a rank that died at startup leaves its peers blocked in
             # rendezvous (Gloo waits ~30 min) — never leak them past the leg
@@ -1029,10 +1069,16 @@ def main() -> None:
     # JSON line as the round's number — keep that contract. Legs after the
     # headline are isolated: one leg failing (e.g. a rendezvous flake in the
     # 4-process world) must not erase every later row from the round record.
-    if _OBS:
+    if _OBS or _TRACE or _SMOKE:
+        # --smoke records too: the CI artifact below carries the trace +
+        # snapshot of every run, so a perf regression's flight record is
+        # already uploaded when someone goes looking
         from torcheval_tpu import obs
 
         obs.enable()
+        # a full bench run emits far more timeline events than the default
+        # ring: size it so early compile bars survive to the export
+        obs.set_timeline_capacity(1 << 18)
     headline_10m()
     # smoke: scaled headline legs shrink to n_chunks=10 of the smoke
     # BIG_CHUNK so the compaction path still FIRES at both thresholds
@@ -1072,6 +1118,30 @@ def main() -> None:
             ),
             flush=True,
         )
+    if _TRACE or _SMOKE:
+        from torcheval_tpu import obs
+
+        trace_json = obs.chrome_trace(extra_events=_EXTRA_EVENTS)
+        if _TRACE:
+            with open(_TRACE, "w") as f:
+                f.write(trace_json)
+            print(f"# chrome trace written to {_TRACE}", file=sys.stderr)
+        if _SMOKE:
+            art = os.environ.get(
+                "TORCHEVAL_TPU_TEST_ARTIFACT_DIR", "test-artifacts"
+            )
+            os.makedirs(art, exist_ok=True)
+            with open(os.path.join(art, "bench_trace.json"), "w") as f:
+                f.write(trace_json)
+            with open(os.path.join(art, "bench_obs_snapshot.json"), "w") as f:
+                json.dump(
+                    {
+                        "obs_snapshot": obs.snapshot(),
+                        "obs_trace_counts": obs.trace_counts(),
+                    },
+                    f,
+                    indent=2,
+                )
     if _SMOKE:
         missing = [
             p

@@ -93,6 +93,17 @@ def main() -> None:
         from torcheval_tpu.metrics import MulticlassAccuracy
         from torcheval_tpu.metrics.toolkit import sync_and_compute
 
+        # flight-recorder leg (bench --trace/--smoke): the parent cannot see
+        # sync rounds — they happen HERE, in the worker processes — so when
+        # asked it records this rank's obs timeline and ships the events
+        # back for the parent to merge rank-tagged into the exported Chrome
+        # trace. Opt-in only: recording adds spans inside the timed runs.
+        record_obs = bool(os.environ.get("TORCHEVAL_TPU_BENCH_OBS"))
+        if record_obs:
+            from torcheval_tpu import obs
+
+            obs.enable()
+
         js, jl = jnp.asarray(scores), jnp.asarray(labels)
         m = MulticlassAccuracy(num_classes=NUM_CLASSES)
 
@@ -141,6 +152,13 @@ def main() -> None:
     os.makedirs(outdir, exist_ok=True)
     with open(os.path.join(outdir, f"{mode}_rank{rank}.json"), "w") as f:
         json.dump({"rank": rank, "times": times, "value": float(value)}, f)
+    if mode == "tpu" and os.environ.get("TORCHEVAL_TPU_BENCH_OBS"):
+        from torcheval_tpu import obs
+
+        with open(
+            os.path.join(outdir, f"{mode}_rank{rank}_events.json"), "w"
+        ) as f:
+            json.dump({"rank": rank, "events": obs.timeline_events()}, f)
 
 
 if __name__ == "__main__":

@@ -713,7 +713,27 @@ class TestObsCollectiveAccounting(unittest.TestCase):
             snap["counters"]["toolkit.sync.lane_bytes{lane=CAT}"], 0
         )
         self.assertEqual(snap["gauges"]["toolkit.sync.world_size"], 1)
-        self.assertEqual(snap["spans"]["toolkit.sync.round"]["count"], 2)
+        # spans are per-(lane, round) series since ISSUE 7 (the flight
+        # recorder labels each exchange): descriptor + payload, typed lane
+        round_spans = {
+            k: v
+            for k, v in snap["spans"].items()
+            if k.startswith("toolkit.sync.round{")
+        }
+        self.assertEqual(sum(v["count"] for v in round_spans.values()), 2)
+        self.assertIn(
+            "toolkit.sync.round{lane=typed,round=descriptor}", round_spans
+        )
+        self.assertIn(
+            "toolkit.sync.round{lane=typed,round=payload}", round_spans
+        )
+        # the per-lane latency histogram recorded both rounds
+        self.assertEqual(
+            snap["histograms"]["toolkit.sync.round_seconds{lane=typed}"][
+                "count"
+            ],
+            2,
+        )
 
     def test_world_size_one_sync_enters_no_collective(self):
         from torcheval_tpu import obs

@@ -1,0 +1,129 @@
+"""Worker for the 4-process ``obs.sync_snapshot`` test (ISSUE 7 acceptance).
+
+Each process joins a real ``jax.distributed`` CPU world, records per-rank
+obs instruments (counter/labelled counter/gauge/histogram/span — the span
+also lands a timeline event), and then:
+
+1. **healthy leg** — ``obs.sync_snapshot(timeout_s=60)`` merges every
+   rank's registry; the worker asserts locally that the merge cost exactly
+   ONE ``toolkit.sync.rounds`` increment (the one-collective-round
+   acceptance criterion), and writes the merged view for the parent's
+   cross-rank assertions;
+2. **degraded leg** — the chaos hooks (armed by the parent via
+   ``TORCHEVAL_TPU_CHAOS_*``, the PR 5 fault-injection harness) delay
+   rank ``STRAGGLER_RANK`` past every deadline as it enters the second
+   snapshot round; the survivors' ``sync_snapshot(timeout_s=,
+   on_failure="local")`` must come back within the deadline with the LOCAL
+   single-rank view flagged ``degraded`` and the
+   ``toolkit.sync.timeouts{policy=local}`` counter bumped. The straggler
+   burns its own budget sleeping and degrades the same way.
+
+Run:  python mp_obs_worker.py <rank> <world> <port> <outdir>
+Writes <outdir>/rank<r>.json plus <outdir>/rank<r>.obs.json (CI triage
+artifact, same pattern as the fault-injection worlds).
+"""
+
+import json
+import os
+import sys
+import time
+
+TIMEOUT_S = 6.0
+STRAGGLE_S = 14.0
+STRAGGLER_RANK = 2
+# healthy snapshot = collective round 1; the degraded leg's snapshot is
+# round 2, which is where the parent arms the chaos delay
+DEGRADED_ROUND = 2
+
+
+def main() -> None:
+    rank, world, port, outdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["MASTER_ADDR"] = "localhost"
+    os.environ["MASTER_PORT"] = port
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["RANK"] = str(rank)
+    from torcheval_tpu.parallel import init_from_env
+
+    got_rank, got_world = init_from_env()
+    assert (got_rank, got_world) == (rank, world)
+
+    from torcheval_tpu import obs
+
+    obs.enable()
+    results = {"rank": rank}
+
+    # per-rank instrument values the parent can compute oracles for
+    obs.counter("mp.obs.batches", float(rank + 1))
+    obs.counter("mp.obs.lane", 1.0, lane=f"L{rank % 2}")
+    obs.gauge("mp.obs.rss", float(100 + rank))
+    for i in range(rank + 1):
+        obs.histo("mp.obs.lat", 0.001 * (i + 1))
+    with obs.span("mp.obs.work", rank_tag=str(rank)):
+        time.sleep(0.001)
+
+    # --- healthy leg: ONE collective round merges the whole world
+    before = obs.snapshot()["counters"].get("toolkit.sync.rounds", 0.0)
+    view = obs.sync_snapshot(timeout_s=60.0)
+    after = obs.snapshot()["counters"].get("toolkit.sync.rounds", 0.0)
+    results["rounds_delta"] = after - before
+    results["view_world_size"] = view["world_size"]
+    results["view_ranks"] = view["ranks"]
+    results["view_degraded"] = view["degraded"]
+    results["view_counters"] = {
+        k: v for k, v in view["counters"].items() if k.startswith("mp.obs")
+    }
+    results["view_gauges"] = {
+        k: v for k, v in view["gauges"].items() if k.startswith("mp.obs")
+    }
+    results["view_histo"] = view["histograms"].get("mp.obs.lat")
+    results["view_span_count"] = sum(
+        v["count"]
+        for k, v in view["spans"].items()
+        if k.startswith("mp.obs.work")
+    )
+    results["event_ranks"] = sorted(
+        {e["rank"] for e in view["events"] if e["name"] == "mp.obs.work"}
+    )
+
+    # --- degraded leg: chaos delays STRAGGLER_RANK entering this round
+    t0 = time.monotonic()
+    view2 = obs.sync_snapshot(timeout_s=TIMEOUT_S, on_failure="local")
+    results["degraded_elapsed_s"] = time.monotonic() - t0
+    results["view2_degraded"] = view2["degraded"]
+    results["view2_world_size"] = view2["world_size"]
+    # degraded-local still answers from THIS rank's registry
+    results["view2_local_counter"] = view2["counters"].get("mp.obs.batches")
+    snap = obs.snapshot()
+    results["timeouts_local"] = snap["counters"].get(
+        "toolkit.sync.timeouts{policy=local}", 0.0
+    )
+
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, f"rank{rank}.obs.json"), "w") as f:
+        json.dump(snap, f, indent=2)
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # rank 0 hosts the coordination service; the coordination client
+    # hard-aborts any process outliving the leader, so the leader holds
+    # until the delayed straggler has finished its budget-expired degrade
+    # and written its results (the PR 5 straggler-world choreography)
+    hold_s = float(os.environ.get("TORCHEVAL_TPU_CHAOS_HOLD_S", "0"))
+    if rank == 0 and hold_s > 0:
+        time.sleep(hold_s)
+    # hard exit: after a degraded sync the peers must not risk wedging in
+    # interpreter teardown on a world with an abandoned collective
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

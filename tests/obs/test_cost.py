@@ -1,0 +1,140 @@
+"""Device cost attribution coverage (ISSUE 7 tentpole leg 2): per-entry
+``obs.cost.*`` gauges off the lowered/compiled objects at watched_jit
+compile time, capture only on compile-bearing dispatches, and the
+recompile-watchdog suppression of the analysis re-lowering.
+"""
+
+import unittest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import cost
+
+
+class CostTestCase(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs.reset()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+
+
+class TestCapture(CostTestCase):
+    def test_compile_emits_per_entry_gauges(self):
+        obs.enable()
+        f = obs.watched_jit(lambda x: x * 2.0 + 1.0, name="cost.entry.a")
+        f(jnp.ones((16,), jnp.float32))
+        gauges = obs.snapshot()["gauges"]
+        self.assertGreater(gauges["obs.cost.flops{entry=cost.entry.a}"], 0.0)
+        self.assertGreater(
+            gauges["obs.cost.bytes_accessed{entry=cost.entry.a}"], 0.0
+        )
+        # CPU exposes memory stats too; where a backend doesn't, the gauge
+        # is simply absent (capture stages down, never raises)
+        self.assertIn("obs.cost.hbm_bytes{entry=cost.entry.a}", gauges)
+        self.assertEqual(
+            obs.snapshot()["counters"][
+                "obs.cost.captures{entry=cost.entry.a}"
+            ],
+            1.0,
+        )
+
+    def test_cache_hit_does_not_recapture(self):
+        obs.enable()
+        f = obs.watched_jit(lambda x: x + 1.0, name="cost.entry.b")
+        for _ in range(4):
+            f(jnp.ones((8,), jnp.float32))
+        self.assertEqual(
+            obs.snapshot()["counters"][
+                "obs.cost.captures{entry=cost.entry.b}"
+            ],
+            1.0,
+        )
+
+    def test_recompile_updates_gauge_to_newest_program(self):
+        obs.enable()
+        f = obs.watched_jit(lambda x: x * x, name="cost.entry.c")
+        f(jnp.ones((8,), jnp.float32))
+        small = obs.snapshot()["gauges"][
+            "obs.cost.bytes_accessed{entry=cost.entry.c}"
+        ]
+        f(jnp.ones((4096,), jnp.float32))  # new signature: recompiles
+        big = obs.snapshot()["gauges"][
+            "obs.cost.bytes_accessed{entry=cost.entry.c}"
+        ]
+        # last-write-wins: the gauge reports the NEWEST program's cost
+        self.assertGreater(big, small)
+        self.assertEqual(
+            obs.snapshot()["counters"][
+                "obs.cost.captures{entry=cost.entry.c}"
+            ],
+            2.0,
+        )
+
+    def test_disabled_captures_nothing(self):
+        f = obs.watched_jit(lambda x: x + 1.0, name="cost.entry.d")
+        f(jnp.ones((8,), jnp.float32))
+        snap = obs.snapshot()
+        self.assertEqual(
+            [k for k in snap["gauges"] if k.startswith("obs.cost")], []
+        )
+
+    def test_compile_span_recorded(self):
+        obs.enable()
+        f = obs.watched_jit(lambda x: x - 1.0, name="cost.entry.e")
+        f(jnp.ones((8,), jnp.float32))
+        spans = obs.snapshot()["spans"]
+        self.assertEqual(spans["jit.compile/cost.entry.e"]["count"], 1)
+        self.assertGreater(
+            spans["jit.compile/cost.entry.e"]["total_seconds"], 0.0
+        )
+        # the capture itself is timed too (its compile() may duplicate work;
+        # the span makes that cost visible instead of hidden)
+        self.assertEqual(spans["obs.cost.capture{entry=cost.entry.e}"]["count"], 1)
+
+    def test_capture_relowering_invisible_to_watchdog(self):
+        obs.enable()
+        f = obs.watched_jit(lambda x: x * 3.0, name="cost.entry.f")
+        f(jnp.ones((8,), jnp.float32))
+        # cost.capture re-lowered the entry (re-running the traced body);
+        # the watchdog must have seen exactly ONE trace, not two
+        self.assertEqual(
+            obs.snapshot()["counters"]["recompile.traces{entry=cost.entry.f}"],
+            1.0,
+        )
+        counts = obs.trace_counts()["cost.entry.f"]
+        self.assertEqual(counts["traces"], 1)
+        self.assertEqual(counts["distinct_signatures"], 1)
+
+    def test_capture_error_downgrades_to_counter(self):
+        obs.enable()
+
+        class Broken:
+            def lower(self, *a, **k):
+                raise RuntimeError("no lowering")
+
+        cost.capture("cost.entry.broken", Broken(), (), {})
+        snap = obs.snapshot()
+        self.assertEqual(
+            snap["counters"][
+                "obs.cost.capture_errors{entry=cost.entry.broken}"
+            ],
+            1.0,
+        )
+
+    def test_sum_property_handles_dict_and_list_forms(self):
+        # recent jaxlibs return a dict of properties; older ones a list of
+        # per-computation dicts — both forms sum (the tools/flops.py rule)
+        self.assertEqual(cost._sum_property({"flops": 5.0}, "flops"), 5.0)
+        self.assertEqual(
+            cost._sum_property([{"flops": 2.0}, {"flops": 3.0}], "flops"), 5.0
+        )
+        self.assertEqual(cost._sum_property(None, "flops"), 0.0)
+        self.assertEqual(cost._sum_property({}, "flops"), 0.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,79 @@
+"""Doc-drift lint (ISSUE 7 satellite): docs/observability.md's metric
+inventory table and the library's actual metric-name literals must agree
+BOTH ways.
+
+The inventory table is the operator's contract — dashboards and alerts are
+built off it — and nothing else stops it rotting: a new
+``obs.counter("x.y")`` call site ships silently, a renamed metric leaves a
+stale row. This test scans every ``.counter( ".." )`` / ``.gauge( ".." )``
+/ ``.histo( ".." )`` string-literal call site under ``torcheval_tpu/``
+(whitespace/newline tolerant — several sites are black-wrapped) and parses
+the backticked first-cell names out of the doc's ``## Metric inventory``
+table, then asserts set equality with a diff naming the drifted side.
+"""
+
+import os
+import re
+import unittest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_PKG = os.path.join(_REPO, "torcheval_tpu")
+_DOC = os.path.join(_REPO, "docs", "observability.md")
+
+# a metric-recording call: any receiver (obs., _obs., reg., registry., ...)
+# whose first argument is a string literal. \s* spans the line breaks that
+# formatting puts between the paren and the name.
+_CALL = re.compile(r'\.(counter|gauge|histo)\(\s*"([^"]+)"')
+
+# an inventory row's first cell: | `name` or | `name{labels}` |
+_ROW = re.compile(r"^\|\s*`([^`{]+)(?:\{[^`]*\})?`\s*\|")
+
+
+def _code_metric_names():
+    names = set()
+    for dirpath, _dirnames, filenames in os.walk(_PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                src = f.read()
+            for _kind, name in _CALL.findall(src):
+                names.add(name)
+    return names
+
+
+def _doc_inventory_names():
+    with open(_DOC) as f:
+        doc = f.read()
+    m = re.search(r"^## Metric inventory$(.*?)^## ", doc, re.M | re.S)
+    assert m, "docs/observability.md lost its '## Metric inventory' section"
+    names = set()
+    for line in m.group(1).splitlines():
+        row = _ROW.match(line.strip())
+        if row and row.group(1) not in ("metric", "---"):
+            names.add(row.group(1))
+    return names
+
+
+class TestDocInventory(unittest.TestCase):
+    def test_code_and_doc_inventory_agree(self):
+        code = _code_metric_names()
+        doc = _doc_inventory_names()
+        # sanity: both scans actually found things (a regex rotting to an
+        # empty set would otherwise pass vacuously)
+        self.assertGreater(len(code), 20)
+        undocumented = sorted(code - doc)
+        stale = sorted(doc - code)
+        self.assertFalse(
+            undocumented or stale,
+            "metric inventory drift — "
+            f"recorded in code but missing from docs/observability.md: "
+            f"{undocumented}; documented but no longer recorded: {stale}",
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -96,11 +96,79 @@ class TestRegistry(unittest.TestCase):
     def test_reset(self):
         self.reg.counter("c")
         self.reg.gauge("g", 1)
+        self.reg.histo("h", 0.5)
         with self.reg.span("s"):
             pass
         self.reg.reset()
         snap = self.reg.snapshot()
-        self.assertEqual(snap, {"counters": {}, "gauges": {}, "spans": {}})
+        self.assertEqual(
+            snap,
+            {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}},
+        )
+
+    def test_histogram_counts_sum_and_percentiles(self):
+        for v in (0.001, 0.001, 0.001, 0.1):
+            self.reg.histo("lat", v)
+        h = self.reg.snapshot()["histograms"]["lat"]
+        self.assertEqual(h["count"], 4)
+        self.assertAlmostEqual(h["sum"], 0.103, places=9)
+        # p50 falls in the 0.001 bucket, p99 in the 0.1 bucket (log2 edges)
+        self.assertLess(h["p50"], 0.01)
+        self.assertGreater(h["p99"], 0.05)
+
+    def test_histogram_labels_are_distinct_series(self):
+        self.reg.histo("rt", 1.0, lane="typed")
+        self.reg.histo("rt", 1.0, lane="object")
+        self.reg.histo("rt", 1.0, lane="typed")
+        snap = self.reg.snapshot()["histograms"]
+        self.assertEqual(snap["rt{lane=typed}"]["count"], 2)
+        self.assertEqual(snap["rt{lane=object}"]["count"], 1)
+
+    def test_span_percentiles_in_snapshot(self):
+        for _ in range(4):
+            with self.reg.span("p"):
+                time.sleep(0.001)
+        s = self.reg.snapshot()["spans"]["p"]
+        for q in ("p50", "p95", "p99"):
+            self.assertGreater(s[q], 0.0)
+            self.assertLessEqual(s["p50"], s["p99"])
+
+    def test_histogram_bucket_edges_are_static_and_mergeable(self):
+        from torcheval_tpu.obs.registry import (
+            HISTOGRAM_BUCKETS,
+            bucket_index,
+            bucket_upper_edge,
+        )
+
+        # a value never lands above its bucket's inclusive upper edge, and
+        # always above the previous edge — the invariant bucket-summed
+        # cross-rank merges (and the Prometheus cumulative-le lines) rely on
+        for v in (1e-9, 3e-7, 0.001, 0.25, 0.5, 1.0, 7.0, 1e6):
+            i = bucket_index(v)
+            self.assertLessEqual(v, bucket_upper_edge(i))
+            if 0 < i < HISTOGRAM_BUCKETS - 1:
+                self.assertGreater(v, bucket_upper_edge(i - 1))
+
+    def test_histogram_non_finite_values_clamped_not_poisoning(self):
+        import math
+
+        from torcheval_tpu.obs.registry import (
+            HISTOGRAM_BUCKETS,
+            bucket_index,
+        )
+
+        # frexp reports exponent 0 for non-finite input — without the clamp
+        # inf/NaN would land mid-range and poison _sum forever
+        self.assertEqual(bucket_index(math.inf), HISTOGRAM_BUCKETS - 1)
+        self.assertEqual(bucket_index(math.nan), 0)
+        self.assertEqual(bucket_index(-math.inf), 0)
+        self.reg.histo("h", 1.0)
+        self.reg.histo("h", math.inf)
+        self.reg.histo("h", math.nan)
+        h = self.reg.snapshot()["histograms"]["h"]
+        self.assertEqual(h["count"], 3)
+        self.assertEqual(h["sum"], 1.0)  # non-finite excluded from _sum
+        self.assertTrue(math.isfinite(h["p50"]))
 
 
 class TestModuleLevelGating(unittest.TestCase):
@@ -118,7 +186,10 @@ class TestModuleLevelGating(unittest.TestCase):
         with obs.span("s"):
             pass
         snap = obs.snapshot()
-        self.assertEqual(snap, {"counters": {}, "gauges": {}, "spans": {}})
+        self.assertEqual(
+            snap,
+            {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}},
+        )
 
     def test_enabled_records(self):
         obs.enable()
@@ -204,7 +275,21 @@ class TestExport(unittest.TestCase):
                 seen.add(current)
             else:
                 name = line.split("{")[0].split(" ")[0]
-                self.assertEqual(name, current)
+                # histogram families: _bucket/_sum/_count samples live
+                # under the family's single # TYPE header
+                self.assertTrue(
+                    name == current
+                    or (
+                        current is not None
+                        and name
+                        in (
+                            current + "_bucket",
+                            current + "_sum",
+                            current + "_count",
+                        )
+                    ),
+                    f"sample {name} outside family {current}",
+                )
 
     def test_label_value_escaping(self):
         reg = Registry()
@@ -217,7 +302,7 @@ class TestExport(unittest.TestCase):
         self.assertEqual(prometheus_text(reg), "")
         self.assertEqual(
             json.loads(to_json(reg)),
-            {"counters": {}, "gauges": {}, "spans": {}},
+            {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}},
         )
 
 

@@ -1,0 +1,107 @@
+"""Cross-module ``obs.reset()`` consistency (ISSUE 7 satellite): ONE reset
+drops every registry instrument (cost gauges included), clears the event
+timeline ring, clears recompile-watchdog bookkeeping AND re-arms its
+once-per-entry storm warnings, and forgets telemetry ``log_once`` keys —
+so a "fresh run" is fresh in every leg of the flight recorder at once.
+Before this lived in one place, a reset left stale watchdog state
+warning-suppressed while the counters it explained were gone.
+"""
+
+import logging
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import recompile, trace
+from torcheval_tpu.utils import telemetry
+
+
+def _capture_telemetry():
+    logger = logging.getLogger("torcheval_tpu.api_usage")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger.addHandler(handler)
+    return logger, handler, records
+
+
+class TestCrossModuleReset(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs.reset()
+        self._threshold = obs.retrace_threshold()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+        obs.set_retrace_threshold(self._threshold)
+
+    def test_one_reset_clears_every_leg(self):
+        obs.enable()
+        # populate all four legs: registry instruments + cost gauges (a
+        # compile-bearing watched_jit call), timeline events, watchdog
+        # bookkeeping, a consumed log_once key
+        f = obs.watched_jit(lambda x: x + 1.0, name="reset.entry")
+        f(jnp.ones((4,), jnp.float32))
+        obs.histo("reset.h", 0.1)
+        telemetry.log_once("reset.test.key", "hello")
+        snap = obs.snapshot()
+        self.assertIn("obs.cost.flops{entry=reset.entry}", snap["gauges"])
+        self.assertGreater(trace.event_count(), 0)
+        self.assertIn("reset.entry", obs.trace_counts())
+
+        obs.reset()
+
+        snap = obs.snapshot()
+        self.assertEqual(
+            snap,
+            {"counters": {}, "gauges": {}, "histograms": {}, "spans": {}},
+        )
+        self.assertEqual(trace.event_count(), 0)
+        self.assertEqual(trace.dropped(), 0)
+        self.assertEqual(obs.trace_counts(), {})
+        # the log_once key re-armed: a fresh run logs again
+        logger, handler, records = _capture_telemetry()
+        try:
+            telemetry.log_once("reset.test.key", "hello again")
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            [r.getMessage() for r in records], ["hello again"]
+        )
+
+    def test_reset_rearms_storm_warning(self):
+        obs.set_retrace_threshold(3)
+        f = obs.watched_jit(lambda x: x * 2.0, name="reset.storm.entry")
+        logger, handler, records = _capture_telemetry()
+        try:
+            for n in range(1, 8):
+                f(jnp.asarray(np.ones(n, np.float32)))  # new shape each call
+            first = sum(
+                "reset.storm.entry" in r.getMessage() for r in records
+            )
+            obs.reset()
+            # the storm condition re-triggers on the next retrace and the
+            # re-armed warning fires AGAIN (fresh-run semantics)
+            for n in range(8, 15):
+                f(jnp.asarray(np.ones(n, np.float32)))
+            second = sum(
+                "reset.storm.entry" in r.getMessage() for r in records
+            )
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(first, 1)
+        self.assertEqual(second, 2)
+
+    def test_reset_while_disabled_is_safe_and_total(self):
+        obs.enable()
+        obs.counter("reset.c")
+        obs.disable()
+        obs.reset()  # must not depend on the enable flag
+        self.assertEqual(obs.snapshot()["counters"], {})
+
+
+if __name__ == "__main__":
+    unittest.main()

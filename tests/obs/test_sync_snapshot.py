@@ -1,0 +1,217 @@
+"""Cross-rank obs aggregation, single-process coverage (ISSUE 7 tentpole
+leg 4): the merge semantics, the fixed-size wire encoding with its staged
+truncation, and the world-size-1 short circuit. The real 4-process world
+(one-collective-round assertion, degraded-local fault leg) lives in
+test_sync_snapshot_mp.py.
+"""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import distributed as dist
+
+
+def _payload(rank, **over):
+    p = {
+        "rank": rank,
+        "counters": [],
+        "gauges": [],
+        "histos": [],
+        "spans": [],
+        "events": [],
+        "truncated": False,
+    }
+    p.update(over)
+    return p
+
+
+class SyncSnapshotTestCase(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs.reset()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+
+
+class TestMerge(SyncSnapshotTestCase):
+    def test_counters_summed_across_ranks(self):
+        view = dist._merge(
+            [
+                _payload(0, counters=[("c", (), 1.0), ("d", (("k", "v"),), 2.0)]),
+                _payload(1, counters=[("c", (), 10.0)]),
+            ],
+            2,
+        )
+        self.assertEqual(view["counters"]["c"], 11.0)
+        self.assertEqual(view["counters"]["d{k=v}"], 2.0)
+        self.assertEqual(view["world_size"], 2)
+        self.assertEqual(view["ranks"], [0, 1])
+        self.assertFalse(view["degraded"])
+
+    def test_gauges_keep_per_rank_identity(self):
+        view = dist._merge(
+            [
+                _payload(0, gauges=[("g", (), 5.0)]),
+                _payload(1, gauges=[("g", (), 7.0)]),
+            ],
+            2,
+        )
+        # last-write-wins has no cross-rank meaning: one series per rank
+        self.assertEqual(view["gauges"]["g{rank=0}"], 5.0)
+        self.assertEqual(view["gauges"]["g{rank=1}"], 7.0)
+
+    def test_histograms_bucket_summed(self):
+        from torcheval_tpu.obs.registry import HISTOGRAM_BUCKETS, bucket_index
+
+        b0 = [0] * HISTOGRAM_BUCKETS
+        b1 = [0] * HISTOGRAM_BUCKETS
+        for v in (0.001, 0.002):
+            b0[bucket_index(v)] += 1
+        b1[bucket_index(0.004)] += 1
+        view = dist._merge(
+            [
+                _payload(0, histos=[("h", (), (tuple(b0), 2, 0.003))]),
+                _payload(1, histos=[("h", (), (tuple(b1), 1, 0.004))]),
+            ],
+            2,
+        )
+        h = view["histograms"]["h"]
+        self.assertEqual(h["count"], 3)
+        self.assertAlmostEqual(h["sum"], 0.007)
+        # percentiles re-estimated on the MERGED buckets
+        self.assertGreater(h["p99"], h["p50"])
+
+    def test_spans_summed_with_max_of_max(self):
+        from torcheval_tpu.obs.registry import HISTOGRAM_BUCKETS, bucket_index
+
+        def span_val(seconds_list):
+            b = [0] * HISTOGRAM_BUCKETS
+            for s in seconds_list:
+                b[bucket_index(s)] += 1
+            return (
+                len(seconds_list),
+                sum(seconds_list),
+                max(seconds_list),
+                tuple(b),
+            )
+
+        view = dist._merge(
+            [
+                _payload(0, spans=[("s", (), span_val([0.001, 0.002]))]),
+                _payload(1, spans=[("s", (), span_val([0.030]))]),
+            ],
+            2,
+        )
+        s = view["spans"]["s"]
+        self.assertEqual(s["count"], 3)
+        self.assertAlmostEqual(s["total_seconds"], 0.033)
+        self.assertAlmostEqual(s["max_seconds"], 0.030)
+        self.assertGreater(s["p99"], s["p50"])
+
+    def test_events_rank_tagged_and_ordered(self):
+        e = {"name": "x", "kind": "t", "ts": 2.0, "dur": 0.0, "labels": {}, "tid": 1}
+        view = dist._merge(
+            [
+                _payload(1, events=[{**e, "ts": 1.0}]),
+                _payload(0, events=[{**e, "ts": 3.0}, {**e, "ts": 2.0}]),
+            ],
+            2,
+        )
+        got = [(ev["rank"], ev["ts"]) for ev in view["events"]]
+        # ordered (rank, ts): per-process clocks are not comparable, so no
+        # cross-rank time interleave is attempted
+        self.assertEqual(got, [(0, 2.0), (0, 3.0), (1, 1.0)])
+
+    def test_truncated_ranks_surfaced(self):
+        view = dist._merge(
+            [_payload(0), _payload(2, truncated=True), _payload(1)], 3
+        )
+        self.assertEqual(view["truncated_ranks"], [2])
+
+
+class TestWire(SyncSnapshotTestCase):
+    def test_encode_decode_round_trip(self):
+        p = _payload(3, counters=[("c", (("k", "v"),), 4.0)])
+        buf = dist._encode(p, 1 << 16)
+        self.assertEqual(buf.dtype, np.uint8)
+        self.assertEqual(buf.size, 1 << 16)
+        self.assertEqual(dist._decode(buf), p)
+
+    def test_over_budget_drops_events_first(self):
+        big_events = [
+            {"name": f"e{i}", "kind": "t", "ts": float(i), "dur": 0.0,
+             "labels": {"i": i}, "tid": 1}
+            for i in range(2000)
+        ]
+        p = _payload(1, counters=[("c", (), 1.0)], events=big_events)
+        buf = dist._encode(p, 1 << 14)  # too small for the events
+        got = dist._decode(buf)
+        self.assertTrue(got["truncated"])
+        self.assertEqual(got["events"], [])
+        # instruments survived the first truncation stage
+        self.assertEqual(got["counters"], [("c", (), 1.0)])
+
+    def test_tiny_budget_degrades_to_stub_never_raises(self):
+        p = _payload(
+            2,
+            counters=[(f"c{i}", (), float(i)) for i in range(5000)],
+        )
+        buf = dist._encode(p, 256)
+        got = dist._decode(buf)
+        self.assertEqual(got["rank"], 2)
+        self.assertTrue(got["truncated"])
+        self.assertEqual(got["counters"], [])
+
+    def test_absurd_budget_sends_empty_buffer_never_crashes(self):
+        # budget too small for even the stage-3 stub pickle: the encoder
+        # must not raise mid-collective (numpy broadcast error) — it sends
+        # an empty buffer the peers decode as None and drop from the merge
+        p = _payload(1, counters=[("c", (), 1.0)])
+        buf = dist._encode(p, 16)
+        self.assertEqual(buf.size, 16)
+        self.assertIsNone(dist._decode(buf))
+
+    def test_decode_garbage_returns_none(self):
+        self.assertIsNone(dist._decode(np.zeros(64, dtype=np.uint8)))
+        junk = np.full(64, 255, dtype=np.uint8)
+        self.assertIsNone(dist._decode(junk))
+
+
+class TestWorldSizeOne(SyncSnapshotTestCase):
+    def test_local_view_same_shape_no_collective(self):
+        obs.enable()
+        obs.counter("mp.c", 3.0)
+        obs.gauge("mp.g", 9.0)
+        obs.histo("mp.h", 0.5)
+        with obs.span("mp.s"):
+            pass
+        view = obs.sync_snapshot()
+        self.assertEqual(view["world_size"], 1)
+        self.assertEqual(view["ranks"], [0])
+        self.assertFalse(view["degraded"])
+        self.assertEqual(view["counters"]["mp.c"], 3.0)
+        self.assertEqual(view["gauges"]["mp.g{rank=0}"], 9.0)
+        self.assertEqual(view["histograms"]["mp.h"]["count"], 1)
+        self.assertEqual(view["spans"]["mp.s"]["count"], 1)
+        # the span mirrored into the timeline and arrives rank-tagged
+        self.assertTrue(
+            any(e["name"] == "mp.s" and e["rank"] == 0 for e in view["events"])
+        )
+        # no collective ran at world size 1
+        self.assertNotIn(
+            "toolkit.sync.rounds", obs.snapshot()["counters"]
+        )
+
+    def test_bad_policy_and_budget_rejected(self):
+        with self.assertRaises(ValueError):
+            obs.sync_snapshot(on_failure="retry")
+        with self.assertRaises(ValueError):
+            obs.sync_snapshot(max_bytes=4)
+
+
+if __name__ == "__main__":
+    unittest.main()

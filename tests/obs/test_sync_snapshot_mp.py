@@ -1,0 +1,186 @@
+"""``obs.sync_snapshot`` on a real 4-process ``jax.distributed`` world
+(ISSUE 7 acceptance): per-rank registries merged in exactly ONE collective
+round, and the degraded-local path proven with a chaos-delayed straggler —
+the PR 5 fault-injection harness reused against the obs wire.
+
+One world, both legs: every rank records distinct instruments, snapshot 1
+(collective round 1) is healthy and asserts the merge semantics; snapshot 2
+(round 2) runs with rank 2 chaos-delayed past every deadline, so the
+survivors must degrade to their local view within ``TIMEOUT_S``.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import unittest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_WORKER = os.path.join(_HERE, "mp_obs_worker.py")
+
+sys.path.insert(0, _HERE)
+from mp_obs_worker import (  # noqa: E402
+    DEGRADED_ROUND,
+    STRAGGLE_S,
+    STRAGGLER_RANK,
+    TIMEOUT_S,
+)
+
+WORLD = 4
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _artifact_dir() -> str:
+    """Worker results + obs snapshots; CI points this at the uploaded
+    test-artifacts/ directory so a hung run leaves a diagnosable trace."""
+    base = os.environ.get("TORCHEVAL_TPU_TEST_ARTIFACT_DIR")
+    if base:
+        d = os.path.join(base, "obs_sync_snapshot")
+        os.makedirs(d, exist_ok=True)
+        return d
+    import tempfile
+
+    return tempfile.mkdtemp(prefix="tpu_obs_snap_")
+
+
+def _launch_world(tmpdir: str) -> list:
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # each worker models one single-device host
+    env.update(
+        {
+            # PR 5 chaos harness: delay the straggler entering the round
+            # that carries the second (degraded-leg) sync_snapshot
+            "TORCHEVAL_TPU_CHAOS": "1",
+            "TORCHEVAL_TPU_CHAOS_RANK": str(STRAGGLER_RANK),
+            "TORCHEVAL_TPU_CHAOS_ROUND": str(DEGRADED_ROUND),
+            "TORCHEVAL_TPU_CHAOS_ACTION": "delay",
+            "TORCHEVAL_TPU_CHAOS_DELAY_S": str(STRAGGLE_S),
+            # leader holds the coordinator alive until the straggler has
+            # woken, degraded, and written its results
+            "TORCHEVAL_TPU_CHAOS_HOLD_S": str(STRAGGLE_S - TIMEOUT_S + 8.0),
+        }
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(r), str(WORLD), str(port), tmpdir],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for r in range(WORLD)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode(errors="replace"))
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise AssertionError(
+                f"worker rank {r} exited {p.returncode}:\n{out[-4000:]}"
+            )
+    results = []
+    for r in range(WORLD):
+        with open(os.path.join(tmpdir, f"rank{r}.json")) as f:
+            results.append(json.load(f))
+    return results
+
+
+class TestSyncSnapshotWorld(unittest.TestCase):
+    """One 4-process launch, many assertions (distributed init dominates)."""
+
+    @classmethod
+    def setUpClass(cls):
+        cls.tmpdir = _artifact_dir()
+        cls.results = _launch_world(cls.tmpdir)
+
+    def test_merge_cost_exactly_one_collective_round(self):
+        # THE acceptance criterion: the whole-world merge is one round on
+        # every rank, observable via toolkit.sync.rounds
+        for res in self.results:
+            self.assertEqual(res["rounds_delta"], 1.0)
+
+    def test_world_view_identical_shape_on_every_rank(self):
+        for res in self.results:
+            self.assertEqual(res["view_world_size"], WORLD)
+            self.assertEqual(res["view_ranks"], list(range(WORLD)))
+            self.assertFalse(res["view_degraded"])
+
+    def test_counters_summed_across_ranks(self):
+        # per-rank value is rank+1 -> global 1+2+3+4
+        for res in self.results:
+            self.assertEqual(res["view_counters"]["mp.obs.batches"], 10.0)
+            # labelled series merge per (name, labels): ranks 0,2 -> L0,
+            # ranks 1,3 -> L1, two increments each
+            self.assertEqual(res["view_counters"]["mp.obs.lane{lane=L0}"], 2.0)
+            self.assertEqual(res["view_counters"]["mp.obs.lane{lane=L1}"], 2.0)
+
+    def test_gauges_keep_per_rank_identity(self):
+        for res in self.results:
+            for r in range(WORLD):
+                self.assertEqual(
+                    res["view_gauges"][f"mp.obs.rss{{rank={r}}}"],
+                    float(100 + r),
+                )
+
+    def test_histograms_bucket_summed(self):
+        # rank r recorded r+1 samples -> merged count 1+2+3+4
+        for res in self.results:
+            self.assertEqual(res["view_histo"]["count"], 10)
+            self.assertGreater(res["view_histo"]["p95"], 0.0)
+
+    def test_spans_and_timeline_cover_every_rank(self):
+        for res in self.results:
+            self.assertEqual(res["view_span_count"], WORLD)
+            self.assertEqual(res["event_ranks"], list(range(WORLD)))
+
+    def test_degraded_leg_returns_local_within_deadline(self):
+        for res in self.results:
+            self.assertTrue(res["view2_degraded"])
+            self.assertEqual(res["view2_world_size"], 1)
+            # the local fallback still answers from this rank's registry
+            self.assertEqual(
+                res["view2_local_counter"], float(res["rank"] + 1)
+            )
+            self.assertEqual(res["timeouts_local"], 1.0)
+
+    def test_survivors_did_not_wait_for_the_straggler(self):
+        for res in self.results:
+            if res["rank"] == STRAGGLER_RANK:
+                # the straggler burned its budget sleeping: its own degrade
+                # includes the chaos delay
+                self.assertGreaterEqual(
+                    res["degraded_elapsed_s"], STRAGGLE_S - 1.0
+                )
+            else:
+                self.assertLess(
+                    res["degraded_elapsed_s"], STRAGGLE_S - 1.0
+                )
+                self.assertGreaterEqual(
+                    res["degraded_elapsed_s"], TIMEOUT_S - 1.0
+                )
+
+    def test_obs_snapshots_written_for_ci_triage(self):
+        for r in range(WORLD):
+            path = os.path.join(self.tmpdir, f"rank{r}.obs.json")
+            self.assertTrue(os.path.exists(path))
+            with open(path) as f:
+                snap = json.load(f)
+            self.assertIn("counters", snap)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,6 +1,8 @@
 """``utils/telemetry.py`` coverage (ISSUE 1 satellite): sink registration /
 removal, once-per-key semantics, broken-sink isolation, and the ``log_once``
-helper the recompile watchdog warns through."""
+helper the recompile watchdog warns through. Plus Prometheus export
+hardening (ISSUE 7 satellite): label-escaping edge cases and the histogram
+text exposition."""
 
 import logging
 import unittest
@@ -141,6 +143,123 @@ class TestTelemetry(unittest.TestCase):
         key = PREFIX + "first"
         self.assertTrue(telemetry._first_time(key))
         self.assertFalse(telemetry._first_time(key))
+
+
+class TestPrometheusHardening(unittest.TestCase):
+    """Export edge cases a fleet scraper would reject or misparse
+    (ISSUE 7 satellite): text-format label escaping and the
+    ``# TYPE histogram`` exposition contract."""
+
+    def _reg(self):
+        from torcheval_tpu.obs.registry import Registry
+
+        return Registry()
+
+    def _text(self, reg):
+        from torcheval_tpu.obs.export import prometheus_text
+
+        return prometheus_text(reg)
+
+    def test_label_value_escaping_each_case(self):
+        # per the text-format spec, label VALUES escape exactly three
+        # characters: backslash, double-quote, newline
+        for raw, escaped in (
+            ('say "hi"', 'say \\"hi\\"'),
+            ("back\\slash", "back\\\\slash"),
+            ("line\nbreak", "line\\nbreak"),
+        ):
+            reg = self._reg()
+            reg.counter("c", 1, k=raw)
+            self.assertIn(f'k="{escaped}"', self._text(reg))
+
+    def test_label_value_escaping_combined_and_ordered(self):
+        # backslash escapes FIRST: escaping the quote before the backslash
+        # would double-escape ("\\\"" becoming "\\\\\"")
+        reg = self._reg()
+        reg.counter("c", 1, k='\\"\n')
+        self.assertIn('k="\\\\\\"\\n"', self._text(reg))
+
+    def test_label_name_sanitised_to_charset(self):
+        reg = self._reg()
+        reg.counter("c", 1, **{"bad-name.x": "v"})
+        text = self._text(reg)
+        self.assertIn('bad_name_x="v"', text)
+
+    def test_metric_name_sanitised_and_never_digit_led(self):
+        reg = self._reg()
+        reg.counter("0weird/name", 1)
+        text = self._text(reg)
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            self.assertRegex(name, r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+    def test_histogram_exposition_shape(self):
+        from torcheval_tpu.obs.registry import bucket_upper_edge, bucket_index
+
+        reg = self._reg()
+        for v in (0.001, 0.001, 0.1):
+            reg.histo("lat_seconds", v, lane="typed")
+        text = self._text(reg)
+        self.assertIn("# TYPE lat_seconds histogram", text)
+        # cumulative bucket lines over the POPULATED edges only, plus +Inf
+        lo = bucket_upper_edge(bucket_index(0.001))
+        hi = bucket_upper_edge(bucket_index(0.1))
+        self.assertIn(f'lat_seconds_bucket{{lane="typed",le="{lo:g}"}} 2', text)
+        self.assertIn(f'lat_seconds_bucket{{lane="typed",le="{hi:g}"}} 3', text)
+        self.assertIn('lat_seconds_bucket{lane="typed",le="+Inf"} 3', text)
+        self.assertIn('lat_seconds_count{lane="typed"} 3', text)
+        self.assertIn('lat_seconds_sum{lane="typed"} 0.102', text)
+
+    def test_histogram_bucket_lines_cumulative_and_monotone(self):
+        import re as _re
+
+        reg = self._reg()
+        for i in range(40):
+            reg.histo("h", 0.0001 * (1 + i % 7))
+        text = self._text(reg)
+        counts = [
+            float(m.group(2))
+            for m in _re.finditer(r'h_bucket\{le="([^"]+)"\} (\S+)', text)
+        ]
+        self.assertGreater(len(counts), 1)
+        self.assertEqual(counts, sorted(counts))
+        self.assertEqual(counts[-1], 40)
+
+    def test_histogram_family_lines_contiguous_under_one_header(self):
+        # _bucket/_sum/_count must form ONE group under ONE # TYPE header —
+        # scrapers treat a family split across headers as a parse error
+        reg = self._reg()
+        reg.histo("h", 0.5, lane="a")
+        reg.histo("h", 0.5, lane="b")
+        reg.counter("other", 1)
+        text = self._text(reg)
+        lines = text.splitlines()
+        h_header = [i for i, l in enumerate(lines) if l == "# TYPE h histogram"]
+        self.assertEqual(len(h_header), 1)
+        i = h_header[0] + 1
+        family = set()
+        while i < len(lines) and not lines[i].startswith("#"):
+            family.add(lines[i].split("{")[0].split(" ")[0])
+            i += 1
+        self.assertEqual(family, {"h_bucket", "h_sum", "h_count"})
+        # nothing h-flavored appears outside the family block
+        for j, line in enumerate(lines):
+            if line.startswith("h_"):
+                self.assertTrue(h_header[0] < j < i)
+
+    def test_span_histogram_family_exposed(self):
+        reg = self._reg()
+        with reg.span("outer"):
+            pass
+        text = self._text(reg)
+        self.assertIn("# TYPE torcheval_tpu_span_seconds histogram", text)
+        self.assertIn(
+            'torcheval_tpu_span_seconds_bucket{path="outer",le="+Inf"} 1',
+            text,
+        )
+        self.assertIn('torcheval_tpu_span_seconds_count{path="outer"} 1', text)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,211 @@
+"""Event-timeline coverage (ISSUE 7 tentpole leg 1): the bounded ring, the
+registry span sink, the dispatch-site hooks, and the Chrome/Perfetto
+``trace_event`` export.
+
+The flight-recorder contract under test:
+
+* disabled path records NOTHING (one global read per hook — the host-diet
+  guard in test_host_overhead.py pins the per-update cost; here we pin the
+  semantics);
+* every default-registry span close mirrors into the ring as a complete
+  event with a real start time and duration;
+* the ring is bounded: overflow evicts oldest and counts ``dropped()``;
+* ``chrome_trace()`` emits loadable ``trace_event`` JSON — phase ``X`` for
+  durations, ``i`` for instants, microsecond timestamps, args carrying the
+  labels.
+"""
+
+import json
+import time
+import unittest
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import trace
+
+
+class TraceTestCase(unittest.TestCase):
+    def setUp(self):
+        obs.disable()
+        obs.reset()
+        self._cap = trace.capacity()
+
+    def tearDown(self):
+        obs.disable()
+        obs.reset()
+        trace.set_capacity(self._cap)
+
+
+class TestRing(TraceTestCase):
+    def test_disabled_records_nothing(self):
+        trace.instant("x", kind="test")
+        trace.complete("y", time.perf_counter(), 0.001, kind="test")
+        self.assertEqual(trace.events(), [])
+        self.assertEqual(trace.event_count(), 0)
+
+    def test_instant_and_complete_recorded_when_enabled(self):
+        obs.enable()
+        trace.instant("i.name", kind="window", chunks=3)
+        t0 = time.perf_counter()
+        trace.complete("c.name", t0, 0.25, kind="sync", lane="typed")
+        events = trace.events()
+        self.assertEqual([e["name"] for e in events], ["i.name", "c.name"])
+        inst, comp = events
+        self.assertEqual(inst["dur"], 0.0)
+        self.assertEqual(inst["kind"], "window")
+        self.assertEqual(inst["labels"], {"chunks": 3})
+        self.assertEqual(comp["dur"], 0.25)
+        self.assertEqual(comp["labels"], {"lane": "typed"})
+        # ts is seconds since module epoch: positive, ordered
+        self.assertGreaterEqual(comp["ts"], 0.0)
+
+    def test_registry_spans_mirror_into_ring(self):
+        obs.enable()
+        with obs.span("outer", tag="t"):
+            time.sleep(0.001)
+        events = trace.events()
+        self.assertEqual(len(events), 1)
+        (ev,) = events
+        self.assertEqual(ev["name"], "outer")
+        self.assertEqual(ev["kind"], "span")
+        self.assertEqual(ev["labels"], {"tag": "t"})
+        self.assertGreater(ev["dur"], 0.0)
+
+    def test_non_default_registry_spans_do_not_mirror(self):
+        obs.enable()
+        reg = obs.Registry()
+        with reg.span("private"):
+            pass
+        self.assertEqual(trace.events(), [])
+
+    def test_ring_bounded_evicts_oldest_and_counts_dropped(self):
+        obs.enable()
+        trace.set_capacity(4)
+        for i in range(7):
+            trace.instant(f"e{i}", kind="test")
+        names = [e["name"] for e in trace.events()]
+        self.assertEqual(names, ["e3", "e4", "e5", "e6"])
+        self.assertEqual(trace.dropped(), 3)
+
+    def test_clear_resets_ring_and_dropped(self):
+        obs.enable()
+        trace.set_capacity(2)
+        for i in range(5):
+            trace.instant(f"e{i}", kind="test")
+        trace.clear()
+        self.assertEqual(trace.events(), [])
+        self.assertEqual(trace.dropped(), 0)
+
+    def test_set_capacity_keeps_newest(self):
+        obs.enable()
+        for i in range(6):
+            trace.instant(f"e{i}", kind="test")
+        trace.set_capacity(3)
+        self.assertEqual(
+            [e["name"] for e in trace.events()], ["e3", "e4", "e5"]
+        )
+        # the shrink evicted 3 events — dropped() must own up to them
+        self.assertEqual(trace.dropped(), 3)
+        with self.assertRaises(ValueError):
+            trace.set_capacity(0)
+
+
+class TestChromeTrace(TraceTestCase):
+    def test_chrome_trace_schema(self):
+        obs.enable()
+        trace.instant("moment", kind="window", chunks=2)
+        with obs.span("work"):
+            time.sleep(0.001)
+        doc = json.loads(obs.chrome_trace())
+        self.assertIn("traceEvents", doc)
+        self.assertEqual(doc["displayTimeUnit"], "ms")
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        inst = by_name["moment"]
+        self.assertEqual(inst["ph"], "i")
+        self.assertEqual(inst["s"], "t")
+        self.assertEqual(inst["args"], {"chunks": 2})
+        comp = by_name["work"]
+        self.assertEqual(comp["ph"], "X")
+        self.assertGreater(comp["dur"], 0.0)  # microseconds
+        for e in doc["traceEvents"]:
+            # the trace_event required fields, all JSON-native types
+            self.assertIsInstance(e["name"], str)
+            self.assertIsInstance(e["cat"], str)
+            self.assertIsInstance(e["pid"], int)
+            self.assertIsInstance(e["tid"], int)
+            self.assertIsInstance(e["ts"], (int, float))
+
+    def test_chrome_trace_merges_extra_rank_tagged_events(self):
+        obs.enable()
+        trace.instant("local", kind="test")
+        extra = [
+            {
+                "name": "remote",
+                "kind": "test",
+                "ts": 1.0,
+                "dur": 0.5,
+                "labels": {"k": "v"},
+                "tid": 7,
+                "rank": 3,
+            }
+        ]
+        doc = json.loads(obs.chrome_trace(extra))
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        self.assertEqual(by_name["remote"]["pid"], 3)  # rank becomes pid
+        self.assertEqual(by_name["remote"]["ph"], "X")
+        self.assertEqual(by_name["local"]["pid"], 0)
+
+    def test_dropped_count_exported(self):
+        obs.enable()
+        trace.set_capacity(1)
+        trace.instant("a", kind="test")
+        trace.instant("b", kind="test")
+        doc = json.loads(obs.chrome_trace())
+        self.assertEqual(doc["otherData"]["dropped_events"], 1)
+
+
+class TestDispatchSiteHooks(TraceTestCase):
+    """The flight recorder sees the real eval machinery: window lifecycle
+    events from the collection fast path, window-step dispatch bars, jit
+    trace/cache-hit instants."""
+
+    def test_window_lifecycle_and_step_events(self):
+        import numpy as np
+
+        from torcheval_tpu.metrics import Mean, MetricCollection
+
+        obs.enable()
+        col = MetricCollection({"m": Mean()})
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            col.update(rng.random(32, dtype=np.float32))
+        col.compute()
+        names = [e["name"] for e in trace.events()]
+        self.assertIn("deferred.window.open", names)
+        self.assertIn("deferred.window.append", names)
+        self.assertIn("deferred.window.close", names)
+        self.assertIn("deferred.window_step.dispatch", names)
+        # the dispatch bar carries the window occupancy
+        (step,) = [
+            e
+            for e in trace.events()
+            if e["name"] == "deferred.window_step.dispatch"
+        ]
+        self.assertEqual(step["labels"]["batches"], 3)
+        self.assertGreater(step["dur"], 0.0)
+
+    def test_watched_jit_trace_vs_cache_hit(self):
+        import jax.numpy as jnp
+
+        obs.enable()
+        f = obs.watched_jit(lambda x: x + 1, name="trace.test.entry")
+        f(jnp.ones((3,)))
+        f(jnp.ones((3,)))
+        names = [e["name"] for e in trace.events()]
+        self.assertIn("watched_jit.trace", names)
+        self.assertIn("watched_jit.cache_hit", names)
+        # the compile-bearing dispatch also records a jit.compile span bar
+        self.assertIn("jit.compile/trace.test.entry", names)
+
+
+if __name__ == "__main__":
+    unittest.main()

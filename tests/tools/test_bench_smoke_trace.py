@@ -1,0 +1,135 @@
+"""``bench.py --smoke --trace`` flight-record acceptance (ISSUE 7): the
+smoke run must leave a loadable Chrome trace and an obs snapshot whose cost
+gauges attribute every compiled window-step program.
+
+One subprocess bench run per class (the priciest fixture in tests/tools —
+~1 min at smoke sizes), then schema + content assertions on the artifacts:
+
+* the trace is valid Chrome/Perfetto ``trace_event`` JSON (required keys
+  per phase, µs timestamps, non-negative durations);
+* it contains the events the flight recorder exists for — window-step
+  dispatches, ``jit.compile/deferred.window_step`` bars, and
+  ``toolkit.sync.round`` spans (which happen only inside the config5
+  4-process sync workers; their rank-tagged timelines must survive the
+  merge into the parent's export under worker pids);
+* the snapshot's ``obs.cost.{flops,bytes_accessed,hbm_bytes}{entry=}``
+  gauges exist for every entry the cost leg captured — the window step
+  included — so dispatch-equivalent floor rows sit next to device cost.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import unittest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+
+
+class TestBenchSmokeTrace(unittest.TestCase):
+    trace = None
+    snapshot = None
+
+    @classmethod
+    def setUpClass(cls):
+        import tempfile
+
+        cls._tmp = tempfile.TemporaryDirectory(prefix="bench_smoke_trace_")
+        art = os.path.join(cls._tmp.name, "artifacts")
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "TORCHEVAL_TPU_TEST_ARTIFACT_DIR": art,
+            }
+        )
+        trace_path = os.path.join(cls._tmp.name, "trace.json")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py"),
+             "--smoke", "--trace", trace_path],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=cls._tmp.name,
+        )
+        assert proc.returncode == 0, (
+            f"bench --smoke --trace exited {proc.returncode}:\n"
+            f"{proc.stderr[-3000:]}"
+        )
+        with open(trace_path) as f:
+            cls.trace = json.load(f)
+        # --smoke must drop BOTH artifacts into the artifact dir too (the
+        # copies CI uploads on every run)
+        with open(os.path.join(art, "bench_trace.json")) as f:
+            assert json.load(f)["traceEvents"], "artifact trace empty"
+        with open(os.path.join(art, "bench_obs_snapshot.json")) as f:
+            cls.snapshot = json.load(f)["obs_snapshot"]
+
+    @classmethod
+    def tearDownClass(cls):
+        cls._tmp.cleanup()
+
+    def test_trace_event_schema(self):
+        doc = self.trace
+        self.assertIsInstance(doc["traceEvents"], list)
+        self.assertGreater(len(doc["traceEvents"]), 100)
+        for e in doc["traceEvents"]:
+            self.assertIn(e["ph"], ("X", "i"))
+            for key in ("name", "cat", "pid", "tid", "ts", "args"):
+                self.assertIn(key, e)
+            self.assertGreaterEqual(e["ts"], 0.0)
+            if e["ph"] == "X":
+                self.assertGreater(e["dur"], 0.0)
+            else:
+                self.assertEqual(e.get("s"), "t")
+
+    def test_contains_window_step_compile_and_sync_events(self):
+        names = {e["name"] for e in self.trace["traceEvents"]}
+        for required in (
+            "deferred.window_step.dispatch",
+            "deferred.window.append",
+            "jit.compile/deferred.window_step",
+        ):
+            self.assertIn(required, names)
+        # sync rounds record inside the sync API's span, so their timeline
+        # name is the NESTED path (toolkit.sync_and_compute/.../
+        # toolkit.sync.round) — match the leaf
+        self.assertTrue(
+            any(n.endswith("toolkit.sync.round") for n in names), names
+        )
+
+    def test_sync_rounds_carry_worker_pids(self):
+        # the config5 sync workers' events merge in under pid rank+1; the
+        # parent's own events are pid 0
+        sync_pids = {
+            e["pid"]
+            for e in self.trace["traceEvents"]
+            if e["name"].endswith("toolkit.sync.round")
+        }
+        self.assertTrue(sync_pids)
+        self.assertNotIn(0, sync_pids)
+
+    def test_cost_gauges_cover_every_captured_entry(self):
+        gauges = self.snapshot["gauges"]
+        counters = self.snapshot["counters"]
+        entries = {
+            m.group(1)
+            for k in counters
+            if (m := re.match(r"obs\.cost\.captures\{entry=(.+)\}", k))
+        }
+        self.assertIn("deferred.window_step", entries)
+        for entry in entries:
+            for g in ("flops", "bytes_accessed", "hbm_bytes"):
+                self.assertIn(f"obs.cost.{g}{{entry={entry}}}", gauges)
+
+    def test_window_occupancy_histogram_recorded(self):
+        histos = self.snapshot["histograms"]
+        self.assertIn("deferred.window_occupancy", histos)
+        self.assertGreater(histos["deferred.window_occupancy"]["count"], 0)
+
+
+if __name__ == "__main__":
+    unittest.main()

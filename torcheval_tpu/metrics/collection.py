@@ -56,6 +56,8 @@ import jax
 
 from torcheval_tpu.metrics.deferred import EvalWindow
 from torcheval_tpu.metrics.metric import _ARRAY_IMPL, Metric
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _obs_trace
 from torcheval_tpu.obs.annotate import traced as _traced
 from torcheval_tpu.utils.convert import _is_torch_tensor
 
@@ -306,6 +308,15 @@ class MetricCollection:
             or len(window.chunks) + len(probe._pending)
             >= probe._DEFER_MAX_CHUNKS
         ):
+            if _obs._enabled:
+                # the mid-stream budget valve firing is a timeline moment:
+                # it explains every fold that happens before a compute()
+                _obs_trace.instant(
+                    "deferred.window.valve",
+                    kind="window",
+                    chunks=len(window.chunks),
+                    bytes=window.nbytes,
+                )
             window.fold()
 
     @_traced("collection.compute")

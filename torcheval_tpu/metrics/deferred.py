@@ -121,6 +121,7 @@ asserts O(1) programs per budget window on (tests/obs).
 
 from __future__ import annotations
 
+import time
 import warnings
 import weakref
 from functools import partial
@@ -131,6 +132,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu.metrics.metric import _ARRAY_IMPL
 from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _trace
 from torcheval_tpu.obs.recompile import watched_jit as _watched_jit
 
 
@@ -515,12 +517,21 @@ def _hold_donated_inputs(outputs: Any, *refs: Any) -> None:
     ready only after every earlier program has retired."""
     keep = []
     orphaned = []
+    retired = 0
     for anchor, held in _inflight_donated:
         try:
             if not anchor.is_ready():
                 keep.append((anchor, held))
+            else:
+                retired += 1
         except Exception:
             orphaned.append(held)  # deleted anchor: donated to a later dispatch
+    if retired and _obs._enabled:
+        # flight-recorder leg of the donated-hold protocol: how many earlier
+        # windows' input pins this dispatch released (their programs retired)
+        _trace.instant(
+            "deferred.window_step.retire", kind="window", holds=retired
+        )
     anchor = next(
         (
             a
@@ -544,14 +555,20 @@ def _sweep_retired_holds() -> None:
     probe keeps the hold: it is re-anchored by the next
     :func:`_hold_donated_inputs`."""
     keep = []
+    retired = 0
     for anchor, held in _inflight_donated:
         try:
             if anchor.is_ready():
+                retired += 1
                 continue
         except Exception:
             pass
         keep.append((anchor, held))
     _inflight_donated[:] = keep
+    if retired and _obs._enabled:
+        _trace.instant(
+            "deferred.window_step.retire", kind="window", holds=retired
+        )
 
 
 class _quiet_unusable_donations:
@@ -635,9 +652,17 @@ def _member_spec(key, m) -> Tuple[Any, ...]:
 def _count_fold(entry: str, path: str, n_chunks: int) -> None:
     """Obs accounting: one increment per fold *dispatch* — the quantity the
     dispatch-count regression test bounds (O(1) programs per budget window,
-    never O(batches))."""
+    never O(batches)) — plus a timeline instant so the flight recorder
+    shows WHEN each legacy-lane fold fired."""
     _obs.counter("deferred.folds", entry=entry, path=path)
     _obs.counter("deferred.folded_chunks", float(n_chunks), entry=entry)
+    _trace.instant(
+        "deferred.fold.dispatch",
+        kind="window",
+        entry=entry,
+        path=path,
+        chunks=n_chunks,
+    )
 
 
 def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
@@ -729,6 +754,7 @@ def window_step(
         dispatch = _window_step_dispatch_donated
     else:
         dispatch = _window_step_dispatch
+    t0 = time.perf_counter()
     new_states, results = _dispatch_maybe_donated(
         donate,
         dispatch,
@@ -743,6 +769,22 @@ def window_step(
     _obs.counter("deferred.window_steps", path=path)
     if chunks:
         _obs.counter("deferred.window_step_batches", float(len(chunks)))
+        # realized window occupancy as a distribution, not only a mean:
+        # p50/p95 of batches-per-window is the valve-cadence health signal
+        _obs.histo("deferred.window_occupancy", float(len(chunks)))
+    if _obs._enabled:
+        # host-side dispatch duration (the program itself runs async): the
+        # timeline bar for ONE whole-window program entering the device
+        _trace.complete(
+            "deferred.window_step.dispatch",
+            t0,
+            time.perf_counter() - t0,
+            kind="window",
+            path=path,
+            batches=len(chunks),
+            computes=len(compute_specs),
+            donated=bool(donate),
+        )
     for key, m in members.items():
         for n, v in new_states[key].items():
             setattr(m, n, v)
@@ -788,6 +830,17 @@ class EvalWindow:
         self.owner = weakref.ref(owner) if owner is not None else (lambda: self)
 
     def append(self, chunk: Tuple[jax.Array, ...], nbytes: int, owned: bool) -> None:
+        if _obs._enabled:
+            # call-site guard (not inside instant()): the armed fast path
+            # must not even build a labels dict while obs is disabled — the
+            # host-overhead guard test pins zero obs allocations per update
+            _trace.instant(
+                "deferred.window.open" if not self.chunks
+                else "deferred.window.append",
+                kind="window",
+                chunks=len(self.chunks) + 1,
+                bytes=nbytes,
+            )
         self.chunks.append(chunk)
         self.nbytes += nbytes
         self.owned = self.owned and owned
@@ -811,6 +864,14 @@ class EvalWindow:
         chunks (a member streamed into directly) — grouped into one program
         where their pending lists align — so a terminal compute always sees
         the member's complete stream."""
+        compute_keys = tuple(compute_keys)
+        if _obs._enabled:
+            _trace.instant(
+                "deferred.window.close",
+                kind="window",
+                chunks=len(self.chunks),
+                computes=len(compute_keys),
+            )
         for key in compute_keys:
             m = self.members.get(key)
             if m is None:

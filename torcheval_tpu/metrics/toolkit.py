@@ -478,12 +478,21 @@ def _allgather_stacked(
             lambda: _allgather_stacked_impl(x, group), round_label, lane
         )
     world = len(group) if group is not None else _world_size()
-    with _obs.span("toolkit.sync.round"):
+    t0 = time.perf_counter()
+    # per-(lane, round) span series: the labels ride into the snapshot keys
+    # AND the timeline event (the flight recorder shows which exchange each
+    # round was, not only that "a round" happened)
+    with _obs.span("toolkit.sync.round", lane=lane, round=round_label):
         out = _run_guarded(
             lambda: _allgather_stacked_impl(x, group), round_label, lane
         )
     _obs.counter("toolkit.sync.rounds")
     _obs.counter("toolkit.sync.payload_bytes", float(x.nbytes))
+    # latency DISTRIBUTION per lane, not only total/max: a straggling rank
+    # shows up as a fat p99 here long before a deadline fires
+    _obs.histo(
+        "toolkit.sync.round_seconds", time.perf_counter() - t0, lane=lane
+    )
     _obs.gauge("toolkit.sync.world_size", world)
     return out
 

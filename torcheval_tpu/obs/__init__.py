@@ -1,39 +1,47 @@
-"""``torcheval_tpu.obs``: observability for the whole eval stack.
+"""``torcheval_tpu.obs``: the eval stack's flight recorder.
 
-One subsystem, four pieces (ISSUE 1 tentpole):
+One subsystem, grown from a counter registry (ISSUE 1) into four legs
+(ISSUE 7):
 
 * **Registry** (``registry.py``) — thread-safe process-wide counters,
-  gauges and nested span timers; JSON snapshot + Prometheus exposition
-  (``export.py``).
-* **Profiler annotation** (``annotate.py``) — ``Metric.update/compute/
-  merge_state``, ``MetricCollection``, ``ShardedEvaluator`` and every ops
-  kernel entry point carry ``jax.named_scope`` names into XLA traces, plus
-  host spans/``TraceAnnotation`` while enabled. Disabled path is one global
-  read per call — no jit-traced branching anywhere.
-* **Recompile watchdog** (``recompile.py``) — per-entry-point abstract
-  signature → trace counts through :func:`~torcheval_tpu.obs.recompile.
-  watched_jit`; warns once per entry point on retrace storms. Always on
-  (bookkeeping runs only at trace time).
-* **Collective accounting** — ``metrics/toolkit.py`` and
-  ``ops/dist_curves.py`` report sync rounds, payload bytes per
-  ``Reduction`` lane, wall time and world size into the registry, so the
-  two-collective-round invariant is an observable, not only a test
-  assertion.
+  gauges, log2-bucket histograms (p50/p95/p99 in ``snapshot()``) and
+  nested span timers; JSON snapshot + Prometheus exposition with proper
+  ``# TYPE histogram`` families (``export.py``).
+* **Event timeline** (``trace.py``) — a bounded ring of structured events
+  (``ts, dur, name, kind, labels``) fed by every registry span and by
+  hooks at each dispatch site: window open/append/valve/close and
+  window-step dispatch/retire (``metrics/deferred.py``), ``watched_jit``
+  trace vs cache-hit, sync rounds per lane (``metrics/toolkit.py``),
+  checkpoint save/restore and chaos injections (``resilience/``).
+  ``obs.chrome_trace()`` exports Chrome/Perfetto ``trace_event`` JSON.
+* **Device cost attribution** (``cost.py``) — at every ``watched_jit``
+  compile (window steps included), ``cost_analysis()`` /
+  ``memory_analysis()`` feed ``obs.cost.{flops,bytes_accessed,hbm_bytes}
+  {entry=}`` gauges plus a ``jit.compile/<entry>`` span, so the BENCH
+  dispatch-equivalent rows sit next to what each program costs on device.
+* **Cross-rank aggregation** (``distributed.py``) — ``obs.sync_snapshot()``
+  merges every rank's registry (counters summed, gauges rank-labelled,
+  histograms bucket-summed, timeline rank-tagged) over the toolkit
+  allgather funnel in ONE collective round, honoring the PR 5
+  ``timeout_s`` / degraded-local semantics.
 
-The resilience layer (ISSUE 5) reports here too:
-``toolkit.sync.timeouts{policy=raise|local}`` (sync deadline expiries and
-degraded-mode falls), ``resilience.checkpoint.{saves,restores,bytes}`` and
-``bootstrap.retries`` — see docs/robustness.md.
+The recompile watchdog (``recompile.py``) and profiler annotation
+(``annotate.py``) ride along unchanged in contract: always-on trace-time
+bookkeeping, one-global-read disabled paths everywhere.
 
 Usage::
 
     from torcheval_tpu import obs
     obs.enable()
     ... run the eval loop ...
-    print(obs.to_json(indent=2))        # or obs.prometheus_text()
-    obs.snapshot()["counters"]["toolkit.sync.rounds"]
+    print(obs.to_json(indent=2))          # or obs.prometheus_text()
+    open("trace.json", "w").write(obs.chrome_trace())
+    obs.sync_snapshot(timeout_s=30, on_failure="local")  # cluster view
 """
 
+from torcheval_tpu.obs import recompile as _recompile_mod
+from torcheval_tpu.obs import trace as _trace_mod
+from torcheval_tpu.obs.distributed import sync_snapshot
 from torcheval_tpu.obs.export import prometheus_text, to_json
 from torcheval_tpu.obs.recompile import (
     retrace_threshold,
@@ -42,6 +50,7 @@ from torcheval_tpu.obs.recompile import (
     watched_jit,
 )
 from torcheval_tpu.obs.registry import (
+    Histogram,
     Registry,
     counter,
     default_registry,
@@ -49,25 +58,52 @@ from torcheval_tpu.obs.registry import (
     enable,
     enabled,
     gauge,
-    reset,
+    histo,
     snapshot,
     span,
 )
+from torcheval_tpu.obs.trace import chrome_trace
+from torcheval_tpu.obs.trace import events as timeline_events
+from torcheval_tpu.obs.trace import set_capacity as set_timeline_capacity
+from torcheval_tpu.utils.telemetry import reset_once_keys as _reset_once_keys
+
+
+def reset() -> None:
+    """ONE consistent reset across the whole obs subsystem (ISSUE 7
+    satellite): drops every registry instrument (counters, gauges,
+    histograms, spans — cost gauges included), clears the event timeline
+    ring, clears recompile-watchdog bookkeeping AND re-arms its
+    once-per-entry storm warnings, and forgets every telemetry
+    ``log_once`` key (watchdog + degraded-sync warnings fire again;
+    API-usage keys re-log too — fresh-run semantics). Before this lived in
+    one place, a "reset" left stale watchdog state warning-suppressed
+    while the counters it explained were gone."""
+    default_registry.reset()
+    _trace_mod.clear()
+    _recompile_mod.reset()
+    _reset_once_keys()
+
 
 __all__ = [
+    "Histogram",
     "Registry",
+    "chrome_trace",
     "counter",
     "default_registry",
     "disable",
     "enable",
     "enabled",
     "gauge",
+    "histo",
     "prometheus_text",
     "reset",
     "retrace_threshold",
     "set_retrace_threshold",
+    "set_timeline_capacity",
     "snapshot",
     "span",
+    "sync_snapshot",
+    "timeline_events",
     "to_json",
     "trace_counts",
     "watched_jit",

@@ -1,0 +1,118 @@
+"""Per-program XLA cost attribution: what each compiled entry point costs
+on device (ISSUE 7 tentpole leg 2).
+
+The BENCH floor rows express a leg's host time as dispatch-equivalents, but
+nothing in the registry said what each dispatched PROGRAM costs on device.
+This module closes that gap: on every ``watched_jit`` compile (the window
+step, the fold dispatchers, every ops kernel), :func:`capture` pulls
+``cost_analysis()`` off the freshly lowered computation — and
+``memory_analysis()`` off its compiled executable — and publishes per-entry
+gauges:
+
+* ``obs.cost.flops{entry=}`` — XLA's exact FLOP count for the program
+  (multiplies and adds counted separately, the ``tools/flops.py`` unit);
+* ``obs.cost.bytes_accessed{entry=}`` — total bytes the program reads and
+  writes per execution (the roofline numerator);
+* ``obs.cost.hbm_bytes{entry=}`` — resident device memory of one execution:
+  argument + output + temp + alias buffer bytes from
+  ``CompiledMemoryStats``.
+
+Gauges are last-write-wins per entry label: an entry that recompiles for a
+new batch signature reports its NEWEST program's cost (the one the loop is
+actually running), while ``obs.cost.captures{entry=}`` counts how many
+compiles were attributed. Dispatch-equivalents (BENCH floor rows) finally
+sit next to what each program actually costs on device.
+
+Cost model: :func:`capture` runs only (a) while obs is enabled AND (b) at a
+call that actually traced — never on the jit cache-hit path, never while
+disabled. It re-lowers the entry point to get at the analysis objects
+(``jitted.lower(...)``; the analysis-side ``compile()`` may duplicate the
+XLA compile the dispatch just paid — accepted: compiles are rare by
+construction, milliseconds at minimum, and attribution is opt-in via
+``obs.enable()``). The re-lowering re-runs the traced Python body, so the
+recompile watchdog suppresses its bookkeeping under :func:`capturing` —
+trace counts and storm warnings see only REAL compiles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+from torcheval_tpu.obs import registry as _registry
+
+_local = threading.local()
+
+
+def capturing() -> bool:
+    """True while this thread is inside a cost-capture re-lowering — the
+    recompile watchdog's probe checks this to keep the analysis pass out of
+    its trace counts and storm detection."""
+    return getattr(_local, "active", False)
+
+
+def _sum_property(analysis: Any, key: str) -> float:
+    """Total ``key`` across an XLA cost-analysis result, which is a dict of
+    properties on recent jaxlibs and a list of per-computation dicts on
+    older ones (the ``tools/flops.py`` compatibility rule)."""
+    if not analysis:
+        return 0.0
+    if isinstance(analysis, (list, tuple)):
+        return float(sum(c.get(key, 0.0) for c in analysis))
+    return float(analysis.get(key, 0.0))
+
+
+def _memory_bytes(stats: Any) -> float:
+    """One execution's resident device bytes from ``CompiledMemoryStats``."""
+    if stats is None:
+        return 0.0
+    total = 0.0
+    for attr in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "alias_size_in_bytes",
+    ):
+        total += float(getattr(stats, attr, 0) or 0)
+    return total
+
+
+def capture(entry: str, jitted: Any, args: tuple, kwargs: Dict[str, Any]) -> None:
+    """Attribute the program ``jitted`` just compiled for ``(args, kwargs)``
+    to per-entry cost gauges. Called by ``watched_jit`` after a dispatch
+    whose probe detected a trace; a failure here must never break the
+    dispatch path — it downgrades to a ``obs.cost.capture_errors`` count."""
+    if not _registry._enabled:
+        return
+    reg = _registry.default_registry
+    t0 = time.perf_counter()
+    _local.active = True
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+        analysis = lowered.cost_analysis()
+        reg.gauge(
+            "obs.cost.flops", _sum_property(analysis, "flops"), entry=entry
+        )
+        reg.gauge(
+            "obs.cost.bytes_accessed",
+            _sum_property(analysis, "bytes accessed"),
+            entry=entry,
+        )
+        try:
+            stats = lowered.compile().memory_analysis()
+            reg.gauge(
+                "obs.cost.hbm_bytes", _memory_bytes(stats), entry=entry
+            )
+        except Exception:
+            # backends without memory stats: flops/bytes gauges stand alone
+            pass
+        reg.counter("obs.cost.captures", entry=entry)
+    except Exception:
+        reg.counter("obs.cost.capture_errors", entry=entry)
+    finally:
+        _local.active = False
+        # observe_span also lands the timeline event via the span sink
+        reg.observe_span(
+            "obs.cost.capture", time.perf_counter() - t0, entry=entry
+        )

@@ -1,0 +1,262 @@
+"""Cross-rank obs aggregation: every rank's registry + timeline merged into
+one queryable cluster view in ONE collective round (ISSUE 7 tentpole leg 4).
+
+The fault tests already dump per-rank obs snapshots as separate JSON files;
+answering "which rank is the straggler" then means hand-joining four
+documents. :func:`sync_snapshot` does the join in-library, over the same
+toolkit allgather funnel every metric sync rides — so it inherits the chaos
+hooks, the deadline watchdog and the degraded-local policy of PR 5 for
+free, and its wire cost is observable (exactly ONE
+``toolkit.sync.rounds`` increment).
+
+Wire: each rank pickles a structured dump of its default registry
+(counters/gauges/histograms/spans as ``(name, labels, value)`` items) plus
+its timeline events, pads it into a fixed ``max_bytes`` buffer with an
+8-byte length header, and ONE ``_allgather_stacked`` round moves every
+rank's buffer (fixed-size payloads are what make a single round possible —
+a variable-length exchange needs a length round first, like the object
+lane's two rounds). A rank whose dump exceeds the budget degrades in
+stages — events dropped first (they dominate), then everything but a stub —
+and flags itself ``truncated``; it never raises one-sidedly (that would
+hang the peers mid-collective) and never sends more than ``max_bytes``.
+
+Merge semantics, per instrument:
+
+* **counters** — summed across ranks (same ``(name, labels)`` series);
+* **gauges** — last-write-wins has no cross-rank meaning, so each rank's
+  value keeps its identity under an appended ``rank=`` label;
+* **histograms** — bucket-summed (the fixed log2 edges are identical on
+  every process by construction), percentiles re-estimated on the merged
+  buckets;
+* **spans** — counts and totals summed, max of max, buckets summed;
+* **timeline events** — rank-tagged and concatenated, ordered by
+  ``(rank, ts)``; per-process ``perf_counter`` clocks are NOT comparable,
+  so no cross-rank time alignment is attempted (Chrome trace renders each
+  rank as its own process row via the ``rank`` pid).
+
+Failure semantics (the PR 5 contract): ``timeout_s`` bounds the single
+round; on expiry — or a transport error from a dead peer —
+``on_failure="raise"`` raises the :class:`~torcheval_tpu.metrics.toolkit.
+SyncError` while ``"local"`` warns once, bumps
+``toolkit.sync.timeouts{policy=local}`` and returns the LOCAL single-rank
+view with ``"degraded": True``, so a monitoring loop keeps reporting
+through a preemption instead of wedging.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torcheval_tpu.obs import registry as _registry
+from torcheval_tpu.obs import trace as _trace
+from torcheval_tpu.obs.registry import (
+    format_key,
+    percentile_from_buckets,
+)
+
+_HEADER_BYTES = 8
+DEFAULT_MAX_BYTES = 1 << 20  # per-rank snapshot budget; see sync_snapshot()
+
+
+def _local_payload(rank: int) -> Dict[str, Any]:
+    """This rank's registry + timeline as a plain picklable structure
+    (items keep the registry's ``(name, labels, value)`` form so the merge
+    is structural, not string-parsing)."""
+    counters: List[Tuple[str, tuple, float]] = []
+    gauges: List[Tuple[str, tuple, float]] = []
+    histos: List[Tuple[str, tuple, Any]] = []
+    spans: List[Tuple[str, tuple, Any]] = []
+    for kind, name, labels, value in _registry.default_registry._items():
+        if kind == "counter":
+            counters.append((name, labels, value))
+        elif kind == "gauge":
+            gauges.append((name, labels, value))
+        elif kind == "histo":
+            histos.append((name, labels, value))
+        else:
+            spans.append((name, labels, value))
+    return {
+        "rank": rank,
+        "counters": counters,
+        "gauges": gauges,
+        "histos": histos,
+        "spans": spans,
+        "events": _trace.events(),
+        "truncated": False,
+    }
+
+
+def _encode(payload: Dict[str, Any], max_bytes: int) -> np.ndarray:
+    """Fixed-size wire buffer: 8-byte little-endian length + pickle. Over
+    budget, stage down (drop events, then everything but a stub) — NEVER
+    raise one-sidedly, never exceed ``max_bytes``."""
+    budget = max_bytes - _HEADER_BYTES
+    stages = [
+        payload,
+        {**payload, "events": [], "truncated": True},
+        {"rank": payload["rank"], "counters": [], "gauges": [], "histos": [],
+         "spans": [], "events": [], "truncated": True},
+    ]
+    raw = b""
+    for stage in stages:
+        raw = pickle.dumps(stage)
+        if len(raw) <= budget:
+            break
+    if len(raw) > budget:
+        # even the stub does not fit (absurdly small max_bytes): send an
+        # empty buffer — the peers decode it as None and drop this rank
+        # from the merge, which still beats crashing mid-collective
+        raw = b""
+    buf = np.zeros(max_bytes, dtype=np.uint8)
+    buf[:_HEADER_BYTES] = np.frombuffer(
+        len(raw).to_bytes(_HEADER_BYTES, "little"), dtype=np.uint8
+    )
+    buf[_HEADER_BYTES : _HEADER_BYTES + len(raw)] = np.frombuffer(
+        raw, dtype=np.uint8
+    )
+    return buf
+
+
+def _decode(buf: np.ndarray) -> Optional[Dict[str, Any]]:
+    try:
+        n = int.from_bytes(buf[:_HEADER_BYTES].tobytes(), "little")
+        if n <= 0 or n > buf.size - _HEADER_BYTES:
+            return None
+        return pickle.loads(buf[_HEADER_BYTES : _HEADER_BYTES + n].tobytes())
+    except Exception:
+        return None
+
+
+def _merge(
+    payloads: List[Dict[str, Any]],
+    world_size: int,
+    *,
+    degraded: bool = False,
+) -> Dict[str, Any]:
+    """Fold per-rank payloads into one cluster view (see module doc for the
+    per-instrument semantics)."""
+    counters: Dict[Tuple[str, tuple], float] = {}
+    gauges: Dict[Tuple[str, tuple], float] = {}
+    histos: Dict[Tuple[str, tuple], list] = {}  # [buckets, count, sum]
+    spans: Dict[Tuple[str, tuple], list] = {}  # [count, total, max, buckets]
+    events: List[Dict[str, Any]] = []
+    truncated_ranks: List[int] = []
+    for p in payloads:
+        rank = p.get("rank", 0)
+        if p.get("truncated"):
+            truncated_ranks.append(rank)
+        for name, labels, value in p.get("counters", ()):
+            key = (name, tuple(labels))
+            counters[key] = counters.get(key, 0.0) + value
+        for name, labels, value in p.get("gauges", ()):
+            key = (name, tuple(labels) + (("rank", str(rank)),))
+            gauges[key] = value
+        for name, labels, value in p.get("histos", ()):
+            buckets, count, total = value
+            key = (name, tuple(labels))
+            acc = histos.get(key)
+            if acc is None:
+                histos[key] = [list(buckets), count, total]
+            else:
+                for i, c in enumerate(buckets):
+                    acc[0][i] += c
+                acc[1] += count
+                acc[2] += total
+        for name, labels, value in p.get("spans", ()):
+            count, total, mx, buckets = value
+            key = (name, tuple(labels))
+            acc = spans.get(key)
+            if acc is None:
+                spans[key] = [count, total, mx, list(buckets)]
+            else:
+                acc[0] += count
+                acc[1] += total
+                acc[2] = max(acc[2], mx)
+                for i, c in enumerate(buckets):
+                    acc[3][i] += c
+        for e in p.get("events", ()):
+            events.append({**e, "rank": rank})
+    events.sort(key=lambda e: (e.get("rank", 0), e.get("ts", 0.0)))
+    return {
+        "world_size": world_size,
+        "ranks": sorted(p.get("rank", 0) for p in payloads),
+        "degraded": degraded,
+        "truncated_ranks": sorted(truncated_ranks),
+        "counters": {
+            format_key(n, lb): v for (n, lb), v in counters.items()
+        },
+        "gauges": {format_key(n, lb): v for (n, lb), v in gauges.items()},
+        "histograms": {
+            format_key(n, lb): {
+                "count": count,
+                "sum": total,
+                "p50": percentile_from_buckets(buckets, count, 0.50),
+                "p95": percentile_from_buckets(buckets, count, 0.95),
+                "p99": percentile_from_buckets(buckets, count, 0.99),
+            }
+            for (n, lb), (buckets, count, total) in histos.items()
+        },
+        "spans": {
+            format_key(n, lb): {
+                "count": count,
+                "total_seconds": total,
+                "max_seconds": mx,
+                "p50": percentile_from_buckets(buckets, count, 0.50),
+                "p95": percentile_from_buckets(buckets, count, 0.95),
+                "p99": percentile_from_buckets(buckets, count, 0.99),
+            }
+            for (n, lb), (count, total, mx, buckets) in spans.items()
+        },
+        "events": events,
+    }
+
+
+def sync_snapshot(
+    *,
+    timeout_s: Optional[float] = None,
+    on_failure: str = "raise",
+    processes: Optional[Sequence[int]] = None,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+) -> Dict[str, Any]:
+    """Merge every rank's obs registry and timeline into one cluster view
+    over exactly ONE collective round.
+
+    ``timeout_s`` / ``on_failure`` follow the PR 5 sync contract
+    (``"local"`` degrades to this rank's view with ``"degraded": True``);
+    ``processes`` restricts the exchange to a toolkit subgroup;
+    ``max_bytes`` is the per-rank wire budget and MUST be identical on
+    every calling rank (it fixes the collective's buffer shape — that is
+    what makes one round sufficient). At world size 1 no collective runs;
+    the local view is returned in the same shape.
+    """
+    # toolkit is imported lazily: obs must stay importable without pulling
+    # the whole metrics stack (and toolkit itself imports obs.registry)
+    from torcheval_tpu.metrics import toolkit as tk
+
+    if max_bytes <= _HEADER_BYTES:
+        raise ValueError(f"max_bytes must be > {_HEADER_BYTES}, got {max_bytes}.")
+    tk._check_failure_policy(on_failure)
+    group = tk._resolve_group(processes)
+    world = len(group) if group is not None else tk._world_size()
+    rank = tk._process_index()
+    # the merge is itself a sync API: span + timeline event like every
+    # other sync entry point (fires at world size 1 too — the flight
+    # recorder shows the snapshot was taken even when no collective ran)
+    with _registry.span("obs.sync_snapshot", world=world):
+        local = _local_payload(rank)
+        if world == 1:
+            return _merge([local], 1)
+        buf = _encode(local, max_bytes)
+        try:
+            with tk._sync_deadline(timeout_s):
+                gathered = tk._allgather_stacked(
+                    buf, group, "obs-snapshot", "obs"
+                ).reshape(world, max_bytes)
+        except tk.SyncError as err:
+            tk._sync_failure(err, on_failure)
+            return _merge([local], 1, degraded=True)
+        payloads = [p for r in range(world) if (p := _decode(gathered[r]))]
+        return _merge(payloads, world)

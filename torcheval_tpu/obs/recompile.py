@@ -36,11 +36,14 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
+from torcheval_tpu.obs import cost as _cost
 from torcheval_tpu.obs import registry as _registry
+from torcheval_tpu.obs import trace as _trace
 from torcheval_tpu.obs.annotate import annotated_call
 from torcheval_tpu.utils.telemetry import log_once, reset_once_keys
 
@@ -120,7 +123,12 @@ def record_trace(
     sharing the \"deferred.fold\" label, or several metric classes' folds
     sharing one dispatcher with distinct static fold_fns, each trace
     exactly once). The module-wide ``_traces`` table keeps the full
-    per-label view for :func:`trace_counts`/export."""
+    per-label view for :func:`trace_counts`/export.
+
+    A cost-capture re-lowering (``obs/cost.py``) re-runs the traced body
+    purely for analysis — not a real compile, so it is invisible here."""
+    if _cost.capturing():
+        return
     static_key, dynamic = split_signature(args, kwargs)
     with _lock:
         per_entry = _traces.setdefault(name, {})
@@ -134,6 +142,7 @@ def record_trace(
             seen.add(dynamic)
             distinct = len(seen)
     _registry.counter("recompile.traces", entry=name)
+    _trace.instant("watched_jit.trace", kind="jit", entry=name)
     if distinct >= _threshold:
         log_once(
             _WARN_KEY_PREFIX + name,
@@ -186,8 +195,12 @@ def watched_jit(
     * ``jax.named_scope`` around the traced body — XLA profiler attribution
       per entry point with zero run-time cost;
     * while obs is enabled: a ``TraceAnnotation`` + registry span around
-      each dispatch and a ``jit.calls{entry=...}`` counter. Disabled path:
-      one module-global read on top of the plain jitted call.
+      each dispatch, a ``jit.calls{entry=...}`` counter, a timeline event
+      per dispatch (trace vs cache hit), and — on calls that actually
+      traced — a ``jit.compile/<entry>`` span measuring the compile-bearing
+      dispatch plus device cost attribution (``obs/cost.py``:
+      ``obs.cost.{flops,bytes_accessed,hbm_bytes}{entry=}``). Disabled
+      path: one module-global read on top of the plain jitted call.
 
     Usable as ``@watched_jit``, ``@watched_jit(name=...)``, or
     ``functools.partial``-style with jit kwargs
@@ -200,9 +213,16 @@ def watched_jit(
     # warning counts retraces of one program (one jit instance, one static
     # configuration), never across instances that share a label
     groups: Dict[Any, set] = {}
+    # trace-detection cell: the probe flips it, the obs-enabled dispatch
+    # wrapper clears-then-checks it around each call, so a compile-bearing
+    # dispatch is distinguishable from a cache hit without touching jit
+    # internals. A benign race under concurrent dispatch of one entry
+    # (worst case: one missed or spurious cost capture), never corruption.
+    state = {"traced": False}
 
     @functools.wraps(fun)
     def probe(*args, **kwargs):
+        state["traced"] = True
         record_trace(label, args, kwargs, groups)
         with jax.named_scope(label):
             return fun(*args, **kwargs)
@@ -213,8 +233,27 @@ def watched_jit(
     def call(*args, **kwargs):
         if not _registry._enabled:
             return jitted(*args, **kwargs)
-        _registry.default_registry.counter("jit.calls", entry=label)
-        return annotated_call(f"jit/{label}", jitted, args, kwargs)
+        reg = _registry.default_registry
+        reg.counter("jit.calls", entry=label)
+        state["traced"] = False
+        t0 = time.perf_counter()
+        out = annotated_call(f"jit/{label}", jitted, args, kwargs)
+        if state["traced"]:
+            state["traced"] = False
+            # this dispatch paid the XLA compile: record it as a span (the
+            # compile-time attribution the cost gauges sit beside) and pull
+            # the program's device cost off the lowered/compiled objects
+            compile_s = time.perf_counter() - t0
+            # observe_span also lands the timeline complete event via the
+            # registry's span sink — one "jit.compile/<entry>" bar per
+            # compile-bearing dispatch
+            reg.observe_span(f"jit.compile/{label}", compile_s)
+            _cost.capture(label, jitted, args, kwargs)
+        else:
+            _trace.instant(
+                "watched_jit.cache_hit", kind="jit", entry=label
+            )
+        return out
 
     # expose the underlying jit object (and its lower/eval_shape, which
     # HLO-inspecting tests and tooling call directly on jit entry points)

@@ -28,21 +28,31 @@ Instruments:
 * **Counter** — monotone accumulator (``inc``); e.g. sync rounds, payload
   bytes, kernel calls.
 * **Gauge** — last-written value (``set``); e.g. participating world size.
+* **Histogram** — fixed log2-bucket latency/size distribution (``record``):
+  O(buckets) memory forever, mergeable across ranks by bucket summation
+  (every process shares the same static edges), p50/p95/p99 in
+  ``snapshot()`` and proper ``# TYPE histogram`` Prometheus exposition.
 * **Span timer** — aggregated wall-time statistics per span *path*. Spans
   nest: a span opened while another is active on the same thread records
   under ``"outer/inner"``, so time attributes hierarchically
-  (``collection.update/metric.update/BinaryAUROC``).
+  (``collection.update/metric.update/BinaryAUROC``). Each span path also
+  feeds a log2 latency histogram (same bucket scheme), so ``snapshot()``
+  reports percentiles, not only min/max/sum.
 
-All three key on ``(name, labels)`` where labels are an optional small dict
-(e.g. ``lane="SUM"``) — the Prometheus label model, which ``obs/export.py``
-serialises directly.
+All instruments key on ``(name, labels)`` where labels are an optional small
+dict (e.g. ``lane="SUM"``) — the Prometheus label model, which
+``obs/export.py`` serialises directly. Spans recorded on the process-wide
+default registry additionally feed the event timeline ring
+(``obs/trace.py``) through a module-level sink, so the flight recorder sees
+every span as a Chrome-trace complete event for free.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # Module-level enable flag. Read directly (`if not _enabled: return`) by the
 # instrumentation helpers; mutate only through enable()/disable() so future
@@ -75,6 +85,108 @@ def _label_key(labels: Dict[str, Any]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def format_key(name: str, labels: _LabelKey) -> str:
+    """``name`` or ``name{k=v,...}`` — the snapshot-key spelling shared by
+    :meth:`Registry.snapshot` and the cross-rank merge (``obs/distributed``),
+    so local and cluster views correlate 1:1."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+# Sink wired by ``obs/trace.py`` at import: spans recorded on the DEFAULT
+# registry (the only one the library reports into) are mirrored into the
+# event timeline ring as complete events. Signature:
+# ``(path, labels, t0_perf_counter, seconds) -> None``.
+_span_sink: Optional[Callable[[str, _LabelKey, float, float], None]] = None
+
+
+# ------------------------------------------------------- histogram buckets
+# One static log2 bucket scheme for every histogram in the process (and the
+# fleet: merging across ranks is bucket summation ONLY because the edges are
+# compile-time constants, never data-dependent). Bucket ``i`` counts values
+# in ``(2^(MIN_EXP+i), 2^(MIN_EXP+i+1)]``; the range spans ~7.5e-9 (under
+# any measurable host latency in seconds) to ~1.4e11 (covers byte sizes and
+# chunk counts too). O(buckets) memory per series, forever.
+HISTOGRAM_MIN_EXP = -27
+HISTOGRAM_BUCKETS = 64
+
+
+def bucket_index(value: float) -> int:
+    """Fixed log2 bucket for ``value`` (<=0 and NaN clamp to the first
+    bucket, +inf to the last — ``math.frexp`` reports exponent 0 for
+    non-finite input, which would otherwise mis-bucket them mid-range)."""
+    if value <= 0.0 or value != value:
+        return 0
+    if value == math.inf:
+        return HISTOGRAM_BUCKETS - 1
+    m, e = math.frexp(value)  # value = m * 2^e, 0.5 <= m < 1
+    # value in (2^(e-1), 2^e] -> upper edge 2^e, except the exact power of
+    # two 2^(e-1) (m == 0.5), which belongs UNDER its own edge so the
+    # Prometheus cumulative-le contract (count of values <= le) holds
+    idx = e - 1 - HISTOGRAM_MIN_EXP
+    if m == 0.5:
+        idx -= 1
+    if idx < 0:
+        return 0
+    if idx >= HISTOGRAM_BUCKETS:
+        return HISTOGRAM_BUCKETS - 1
+    return idx
+
+
+def bucket_upper_edge(i: int) -> float:
+    """Inclusive upper bound of bucket ``i``."""
+    return 2.0 ** (HISTOGRAM_MIN_EXP + i + 1)
+
+
+def percentile_from_buckets(
+    buckets, count: int, q: float
+) -> float:
+    """Estimate the ``q``-quantile (0..1) from log2 bucket counts by linear
+    interpolation inside the containing bucket. Shared by local snapshots
+    and the cross-rank merge (bucket-summed histograms keep the same
+    estimator)."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    cum = 0.0
+    for i, c in enumerate(buckets):
+        if not c:
+            continue
+        if cum + c >= target:
+            lower = bucket_upper_edge(i - 1) if i > 0 else 0.0
+            upper = bucket_upper_edge(i)
+            frac = (target - cum) / c
+            return lower + frac * (upper - lower)
+        cum += c
+    return bucket_upper_edge(HISTOGRAM_BUCKETS - 1)
+
+
+class Histogram:
+    """Fixed-edge log2 histogram: O(buckets) memory, mergeable by bucket
+    summation (identical static edges on every process)."""
+
+    __slots__ = ("buckets", "count", "sum")
+
+    def __init__(self) -> None:
+        self.buckets: List[int] = [0] * HISTOGRAM_BUCKETS
+        self.count = 0
+        self.sum = 0.0
+
+    def record(self, value: float) -> None:
+        self.buckets[bucket_index(value)] += 1
+        self.count += 1
+        # a single inf/NaN observation must not poison the series' _sum
+        # forever (Prometheus _sum lines and cross-rank merges both
+        # propagate it); the clamped bucket above still counts the event
+        if math.isfinite(value):
+            self.sum += value
+
+    def percentile(self, q: float) -> float:
+        return percentile_from_buckets(self.buckets, self.count, q)
+
+
 class Counter:
     """Monotone accumulator. ``inc`` must never be fed negative deltas."""
 
@@ -102,20 +214,23 @@ class Gauge:
 
 
 class SpanStats:
-    """Aggregated wall-time statistics for one span path."""
+    """Aggregated wall-time statistics for one span path, plus the log2
+    latency buckets behind the snapshot's p50/p95/p99."""
 
-    __slots__ = ("count", "total_seconds", "max_seconds")
+    __slots__ = ("count", "total_seconds", "max_seconds", "buckets")
 
     def __init__(self) -> None:
         self.count = 0
         self.total_seconds = 0.0
         self.max_seconds = 0.0
+        self.buckets: List[int] = [0] * HISTOGRAM_BUCKETS
 
     def record(self, seconds: float) -> None:
         self.count += 1
         self.total_seconds += seconds
         if seconds > self.max_seconds:
             self.max_seconds = seconds
+        self.buckets[bucket_index(seconds)] += 1
 
 
 class _Span:
@@ -147,7 +262,9 @@ class _Span:
             stack.pop()
         if stack:
             stack.pop()
-        self._registry._record_span(self._path, self._labels, seconds)
+        self._registry._record_span(
+            self._path, self._labels, seconds, t0=self._t0
+        )
 
 
 class Registry:
@@ -157,6 +274,7 @@ class Registry:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, _LabelKey], Counter] = {}
         self._gauges: Dict[Tuple[str, _LabelKey], Gauge] = {}
+        self._histos: Dict[Tuple[str, _LabelKey], Histogram] = {}
         self._spans: Dict[Tuple[str, _LabelKey], SpanStats] = {}
         self._local = threading.local()
 
@@ -179,6 +297,15 @@ class Registry:
                 g = self._gauges[key] = Gauge()
             g.set(value)
 
+    def histo(self, name: str, value: float, **labels: Any) -> None:
+        """Record ``value`` into histogram ``name`` (created on first use)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histos.get(key)
+            if h is None:
+                h = self._histos[key] = Histogram()
+            h.record(value)
+
     def span(self, name: str, **labels: Any) -> _Span:
         """Context manager timing a host-side span.
 
@@ -188,6 +315,17 @@ class Registry:
         a profiler trace would)."""
         return _Span(self, name, _label_key(labels))
 
+    def observe_span(self, path: str, seconds: float, **labels: Any) -> None:
+        """Record an already-measured duration under span ``path`` (no
+        nesting — the caller measured around something that already ran,
+        e.g. the compile time detected inside a watched_jit dispatch)."""
+        self._record_span(
+            path,
+            _label_key(labels),
+            seconds,
+            t0=time.perf_counter() - seconds,
+        )
+
     # --------------------------------------------------------------- plumbing
     def _span_stack(self) -> list:
         stack = getattr(self._local, "stack", None)
@@ -196,7 +334,11 @@ class Registry:
         return stack
 
     def _record_span(
-        self, path: str, labels: _LabelKey, seconds: float
+        self,
+        path: str,
+        labels: _LabelKey,
+        seconds: float,
+        t0: Optional[float] = None,
     ) -> None:
         key = (path, labels)
         with self._lock:
@@ -204,21 +346,27 @@ class Registry:
             if s is None:
                 s = self._spans[key] = SpanStats()
             s.record(seconds)
+        # default-registry spans mirror into the event timeline ring
+        # (obs/trace.py): the sink call sits OUTSIDE the registry lock
+        if _span_sink is not None and self is default_registry:
+            _span_sink(
+                path,
+                labels,
+                t0 if t0 is not None else time.perf_counter() - seconds,
+                seconds,
+            )
 
     # ----------------------------------------------------------------- export
     def snapshot(self) -> Dict[str, Any]:
         """Point-in-time copy as plain JSON-serialisable data:
-        ``{"counters": {...}, "gauges": {...}, "spans": {...}}``.
+        ``{"counters": {...}, "gauges": {...}, "histograms": {...},
+        "spans": {...}}``.
 
         Keys are ``name`` or ``name{k=v,...}`` when labelled (the Prometheus
-        spelling, so snapshot keys and exposition lines correlate 1:1)."""
-
-        def fmt(name: str, labels: _LabelKey) -> str:
-            if not labels:
-                return name
-            inner = ",".join(f"{k}={v}" for k, v in labels)
-            return f"{name}{{{inner}}}"
-
+        spelling, so snapshot keys and exposition lines correlate 1:1).
+        Span entries and histograms carry p50/p95/p99 estimated from the
+        log2 buckets — latency distributions, not only min/max/sum."""
+        fmt = format_key
         with self._lock:
             return {
                 "counters": {
@@ -227,11 +375,30 @@ class Registry:
                 "gauges": {
                     fmt(n, lb): g.value for (n, lb), g in self._gauges.items()
                 },
+                "histograms": {
+                    fmt(n, lb): {
+                        "count": h.count,
+                        "sum": h.sum,
+                        "p50": h.percentile(0.50),
+                        "p95": h.percentile(0.95),
+                        "p99": h.percentile(0.99),
+                    }
+                    for (n, lb), h in self._histos.items()
+                },
                 "spans": {
                     fmt(n, lb): {
                         "count": s.count,
                         "total_seconds": s.total_seconds,
                         "max_seconds": s.max_seconds,
+                        "p50": percentile_from_buckets(
+                            s.buckets, s.count, 0.50
+                        ),
+                        "p95": percentile_from_buckets(
+                            s.buckets, s.count, 0.95
+                        ),
+                        "p99": percentile_from_buckets(
+                            s.buckets, s.count, 0.99
+                        ),
                     }
                     for (n, lb), s in self._spans.items()
                 },
@@ -242,7 +409,10 @@ class Registry:
         is MATERIALISED under the lock and returned: a generator yielding
         under the lock would hold it across the consumer's formatting work
         (stalling every instrumented thread for a whole export) and leak it
-        outright if the consumer abandoned iteration."""
+        outright if the consumer abandoned iteration. Span values are
+        ``(count, total_seconds, max_seconds, buckets)``; histogram values
+        ``(buckets, count, sum)`` — buckets copied as tuples so the consumer
+        never aliases live mutable state."""
         with self._lock:
             out: list = [
                 ("counter", n, lb, c.value)
@@ -253,7 +423,16 @@ class Registry:
                 for (n, lb), g in self._gauges.items()
             )
             out.extend(
-                ("span", n, lb, (s.count, s.total_seconds, s.max_seconds))
+                ("histo", n, lb, (tuple(h.buckets), h.count, h.sum))
+                for (n, lb), h in self._histos.items()
+            )
+            out.extend(
+                (
+                    "span",
+                    n,
+                    lb,
+                    (s.count, s.total_seconds, s.max_seconds, tuple(s.buckets)),
+                )
                 for (n, lb), s in self._spans.items()
             )
             return out
@@ -264,6 +443,7 @@ class Registry:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
+            self._histos.clear()
             self._spans.clear()
 
 
@@ -296,6 +476,19 @@ def gauge(
     if not _enabled:
         return
     (registry or default_registry).gauge(name, value, **labels)
+
+
+def histo(
+    name: str,
+    value: float,
+    *,
+    registry: Optional[Registry] = None,
+    **labels: Any,
+) -> None:
+    """Record into a histogram on the default registry IF obs is enabled."""
+    if not _enabled:
+        return
+    (registry or default_registry).histo(name, value, **labels)
 
 
 class _NullSpan:

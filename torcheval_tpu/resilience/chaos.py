@@ -43,6 +43,9 @@ import threading
 import time
 from typing import Optional
 
+from torcheval_tpu.obs import registry as _obs_registry
+from torcheval_tpu.obs import trace as _obs_trace
+
 _logger = logging.getLogger(__name__)
 
 _ENV_ARM = "TORCHEVAL_TPU_CHAOS"
@@ -118,6 +121,19 @@ def on_sync_round() -> None:
 
     if jax.process_index() != cfg.rank or seen != cfg.round:
         return
+    if _obs_registry._enabled:
+        # the injection is a flight-recorder moment: a per-rank trace (or
+        # the pre-kill obs dump the fault tests write) shows exactly which
+        # round the fault hit — a kill's event survives only if the rank's
+        # snapshot was exported before os._exit, which is the delay/test
+        # pattern; the delay action records and lives on
+        _obs_trace.instant(
+            "resilience.chaos",
+            kind="chaos",
+            action=cfg.action,
+            rank=cfg.rank,
+            round=seen,
+        )
     if cfg.action == "kill":
         _logger.warning(
             "chaos: killing rank %d at sync round %d (exit %d)",

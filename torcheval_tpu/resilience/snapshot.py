@@ -63,6 +63,7 @@ from zipfile import BadZipFile
 import numpy as np
 
 from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _obs_trace
 
 _logger = logging.getLogger(__name__)
 
@@ -354,6 +355,14 @@ def save(
     )
     _obs.counter("resilience.checkpoint.saves")
     _obs.counter("resilience.checkpoint.bytes", float(nbytes))
+    # timeline instant AT the durable publish (the save span covers the
+    # whole write; this marks the os.replace moment a restore can rely on)
+    _obs_trace.instant(
+        "resilience.checkpoint.published",
+        kind="checkpoint",
+        step=step,
+        bytes=nbytes,
+    )
     if keep_last is not None:
         for old in list_checkpoints(directory)[:-keep_last]:
             shutil.rmtree(old, ignore_errors=True)
@@ -474,4 +483,9 @@ def restore(obj: Any, path: str) -> Any:
         for mkey, tree in trees.items():
             metrics[mkey].load_state_dict(tree)
     _obs.counter("resilience.checkpoint.restores")
+    _obs_trace.instant(
+        "resilience.checkpoint.restored",
+        kind="checkpoint",
+        step=manifest.get("step", -1),
+    )
     return obj
