@@ -31,6 +31,7 @@ MODULES = [
     "torcheval_tpu.obs",
     "torcheval_tpu.parallel",
     "torcheval_tpu.resilience",
+    "torcheval_tpu.serve",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
     "torcheval_tpu.utils.test_utils",

@@ -83,5 +83,112 @@ class TestChaosHooks(unittest.TestCase):
             chaos.on_sync_round()  # the configured round: still no action
 
 
+class TestIngestHooks(unittest.TestCase):
+    """The queue-boundary actions (ISSUE 8 satellite): poison and
+    ingestion delay. End-to-end through a daemon in
+    tests/serve/test_fault_containment.py; here the targeting and
+    corruption semantics in isolation."""
+
+    def tearDown(self):
+        chaos.reset_for_tests()
+
+    def _arm(self, **extra):
+        env = {
+            "TORCHEVAL_TPU_CHAOS": "1",
+            "TORCHEVAL_TPU_CHAOS_ACTION": "poison",
+            "TORCHEVAL_TPU_CHAOS_TENANT": "t",
+            "TORCHEVAL_TPU_CHAOS_STEP": "2",
+            "TORCHEVAL_TPU_CHAOS_POISON": "nan",
+        }
+        env.update(extra)
+        return mock.patch.dict(os.environ, env)
+
+    def _batch(self):
+        import numpy as np
+
+        return (
+            np.ones((4, 3), dtype=np.float32),
+            np.zeros(4, dtype=np.int64),
+        )
+
+    def test_nan_poison_targets_tenant_and_step_only(self):
+        import numpy as np
+
+        with self._arm():
+            chaos.reset_for_tests()
+            clean = chaos.on_ingest("t", 1, self._batch())
+            self.assertFalse(np.isnan(clean[0]).any())
+            other = chaos.on_ingest("someone-else", 2, self._batch())
+            self.assertFalse(np.isnan(other[0]).any())
+            hit = chaos.on_ingest("t", 2, self._batch())
+            # the first FLOAT argument is all-NaN; the int labels untouched
+            self.assertTrue(np.isnan(hit[0]).all())
+            self.assertEqual(hit[1].dtype.kind, "i")
+
+    def test_shape_poison_drops_a_leading_row(self):
+        with self._arm(TORCHEVAL_TPU_CHAOS_POISON="shape"):
+            chaos.reset_for_tests()
+            s, l = self._batch()
+            hit = chaos.on_ingest("t", 2, (s, l))
+            self.assertEqual(hit[0].shape, (3, 3))
+            self.assertEqual(hit[1].shape, (4,))
+
+    def test_ingest_delay_sleeps_at_the_boundary(self):
+        with self._arm(
+            TORCHEVAL_TPU_CHAOS_ACTION="ingest_delay",
+            TORCHEVAL_TPU_CHAOS_DELAY_S="0.3",
+        ):
+            chaos.reset_for_tests()
+            t0 = time.monotonic()
+            chaos.on_ingest("t", 1, self._batch())
+            self.assertLess(time.monotonic() - t0, 0.2)
+            t0 = time.monotonic()
+            out = chaos.on_ingest("t", 2, self._batch())
+            self.assertGreaterEqual(time.monotonic() - t0, 0.3)
+            # a delay never corrupts
+            self.assertEqual(out[0].shape, (4, 3))
+
+    def test_wildcard_tenant_and_fires_once(self):
+        import numpy as np
+
+        with self._arm(TORCHEVAL_TPU_CHAOS_TENANT="*"):
+            chaos.reset_for_tests()
+            hit = chaos.on_ingest("anybody", 2, self._batch())
+            self.assertTrue(np.isnan(hit[0]).all())
+            again = chaos.on_ingest("anybody", 2, self._batch())
+            self.assertFalse(np.isnan(again[0]).any())
+
+    def test_sync_armed_process_passes_ingest_untouched_and_vice_versa(self):
+        import numpy as np
+
+        with mock.patch.dict(
+            os.environ,
+            {
+                "TORCHEVAL_TPU_CHAOS": "1",
+                "TORCHEVAL_TPU_CHAOS_ACTION": "delay",
+                "TORCHEVAL_TPU_CHAOS_RANK": "0",
+                "TORCHEVAL_TPU_CHAOS_ROUND": "1",
+                "TORCHEVAL_TPU_CHAOS_DELAY_S": "0.0",
+            },
+        ):
+            chaos.reset_for_tests()
+            out = chaos.on_ingest("t", 1, self._batch())
+            self.assertFalse(np.isnan(out[0]).any())
+        with self._arm():
+            chaos.reset_for_tests()
+            t0 = time.monotonic()
+            chaos.on_sync_round()
+            self.assertLess(time.monotonic() - t0, 0.2)
+
+    def test_missing_ingest_vars_disarm(self):
+        with self._arm():
+            os.environ.pop("TORCHEVAL_TPU_CHAOS_TENANT")
+            chaos.reset_for_tests()
+            out = chaos.on_ingest("t", 2, self._batch())
+            import numpy as np
+
+            self.assertFalse(np.isnan(out[0]).any())
+
+
 if __name__ == "__main__":
     unittest.main()

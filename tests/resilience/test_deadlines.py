@@ -246,6 +246,51 @@ class TestArgumentValidation(_SimulatedWorld):
             with self.assertRaisesRegex(ValueError, "timeout_s"):
                 sync_and_compute(self._metric(), timeout_s=0.0)
 
+    def test_degenerate_timeouts_rejected_at_every_entry_point(self):
+        """ISSUE 8 satellite: non-positive AND non-finite timeouts raise
+        ``ValueError`` at the API boundary of all four sync entry points —
+        BEFORE any collective or state mutation. ``nan`` is the sneaky
+        one: it slips past a plain ``<= 0`` comparison and arms a watchdog
+        whose every remaining-time computation is ``nan`` (neither fires
+        nor guards); ``inf`` arms one that can never fire."""
+        calls = {"n": 0}
+
+        def counting_impl(x, group):
+            calls["n"] += 1
+            raise AssertionError("collective must not run")
+
+        entry_points = (
+            lambda t: sync_and_compute(self._metric(), timeout_s=t),
+            lambda t: get_synced_metric(self._metric(), timeout_s=t),
+            lambda t: get_synced_state_dict(self._metric(), timeout_s=t),
+            lambda t: sync_and_compute_collection(
+                {"s": self._metric()}, timeout_s=t
+            ),
+        )
+        with mock.patch.object(
+            toolkit, "_allgather_stacked_impl", counting_impl
+        ):
+            for api in entry_points:
+                for bad in (0, -1.0, float("nan"), float("inf"), "5"):
+                    with self.assertRaisesRegex(ValueError, "timeout_s"):
+                        api(bad)
+        self.assertEqual(calls["n"], 0)
+
+    def test_valid_timeouts_still_accepted(self):
+        # the boundary check must not over-reject: positive finite floats
+        # and ints pass through, None means no deadline
+        with mock.patch.object(
+            toolkit,
+            "_allgather_stacked_impl",
+            lambda x, group: np.stack([np.asarray(x)] * 2),
+        ):
+            for ok in (5, 0.5, None):
+                self.assertIsNotNone(
+                    sync_and_compute(
+                        self._metric(), recipient_rank="all", timeout_s=ok
+                    )
+                )
+
     def test_watchdog_thread_is_daemonic(self):
         # a timed-out collective leaves its watchdog thread blocked inside
         # the native call; it must be daemonic so process exit never hangs

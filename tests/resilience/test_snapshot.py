@@ -12,6 +12,7 @@ import json
 import os
 import shutil
 import tempfile
+import time
 import unittest
 from unittest import mock
 
@@ -373,6 +374,199 @@ class TestAtomicityAndRotation(_TmpDirTest):
         save(m, self.dir, step=3)
         with self.assertRaises(CheckpointError):
             save(m, self.dir, step=3)
+
+
+class TestStaleTmpGC(_TmpDirTest):
+    """ISSUE 8 satellite: a crash mid-``save()`` leaves a ``.tmp-*``
+    directory behind (the cleanup handler cannot run through a hard
+    death); the NEXT successful save in the same directory reclaims it —
+    while tmp dirs belonging to a live concurrent writer are left alone."""
+
+    def _crashed_writer_tmp(self) -> str:
+        """Run a LITERAL crash between temp write and rename in a child
+        process: ``os.replace`` is swapped for ``os._exit``, so no python
+        cleanup (not even ``save``'s own except-handler) runs. Returns the
+        orphaned tmp path; the child's pid is provably dead."""
+        import subprocess
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+        script = (
+            "import os, sys\n"
+            "sys.path.insert(0, %r)\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "from torcheval_tpu.metrics import Sum\n"
+            "from torcheval_tpu.resilience import snapshot as snap\n"
+            "m = Sum(); m.update(jnp.asarray([1.0]))\n"
+            "snap.os.replace = lambda s, d: os._exit(7)\n"
+            "snap.save(m, sys.argv[1])\n"
+        ) % repo
+        proc = subprocess.run(
+            [_sys.executable, "-c", script, self.dir],
+            capture_output=True,
+            timeout=120,
+        )
+        self.assertEqual(proc.returncode, 7, proc.stderr.decode()[-2000:])
+        tmps = [n for n in os.listdir(self.dir) if n.startswith(".tmp-")]
+        self.assertEqual(len(tmps), 1, "the crash should orphan ONE tmp dir")
+        # the tmp name embeds the writer's pid, and that writer is dead
+        pid = snapshot_mod._tmp_writer_pid(tmps[0])
+        self.assertIsNotNone(pid)
+        with self.assertRaises(ProcessLookupError):
+            os.kill(pid, 0)
+        return os.path.join(self.dir, tmps[0])
+
+    def test_next_save_reclaims_crash_orphaned_tmp(self):
+        orphan = self._crashed_writer_tmp()
+        m = Sum()
+        m.update(jnp.asarray([2.0]))
+        save(m, self.dir)
+        self.assertFalse(
+            os.path.exists(orphan),
+            "the next successful save must GC the dead writer's tmp dir",
+        )
+        # the published checkpoint is untouched by the GC
+        fresh = Sum()
+        restore(fresh, self.dir)
+        self.assertEqual(float(fresh.compute()), 2.0)
+
+    def test_live_writers_tmp_dirs_left_alone(self):
+        import subprocess
+        import sys as _sys
+
+        # a concurrent writer that is still alive (fresh mtime, live pid):
+        # its in-progress tmp must never be reclaimed out from under it
+        sleeper = subprocess.Popen(
+            [_sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        try:
+            live_tmp = os.path.join(
+                self.dir, f".tmp-ckpt-00000009-{sleeper.pid}"
+            )
+            os.makedirs(live_tmp)
+            # our own pid is skipped outright (save() is re-entrant-safe)
+            own_tmp = os.path.join(
+                self.dir, f".tmp-ckpt-00000010-{os.getpid()}"
+            )
+            os.makedirs(own_tmp)
+            m = Sum()
+            m.update(jnp.asarray([1.0]))
+            save(m, self.dir)
+            self.assertTrue(os.path.exists(live_tmp))
+            self.assertTrue(os.path.exists(own_tmp))
+        finally:
+            sleeper.kill()
+            sleeper.wait()
+
+    def test_unparseable_pid_falls_back_to_mtime_age(self):
+        # names that do not match the FULL .tmp-ckpt-<step>-<pid> shape
+        # must use the age fallback — including a foreign tool's numeric
+        # suffix (.tmp-upload-123: pid 123 being dead must NOT delete a
+        # concurrent tool's fresh data) and a truncated checkpoint name
+        fresh_tmp = os.path.join(self.dir, ".tmp-upload-123")
+        old_tmp = os.path.join(self.dir, ".tmp-ckpt-older-garbage")
+        self.assertIsNone(snapshot_mod._tmp_writer_pid(".tmp-upload-123"))
+        self.assertIsNone(snapshot_mod._tmp_writer_pid(".tmp-ckpt-00000010"))
+        os.makedirs(fresh_tmp)
+        os.makedirs(old_tmp)
+        stale = time.time() - 2 * snapshot_mod._TMP_GC_MIN_AGE_S
+        os.utime(old_tmp, (stale, stale))
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        save(m, self.dir)
+        # fresh, concurrent-looking dir survives; the hour-old one goes
+        self.assertTrue(os.path.exists(fresh_tmp))
+        self.assertFalse(os.path.exists(old_tmp))
+
+
+class TestRotationUnderChurn(_TmpDirTest):
+    """ISSUE 8 satellite: ``keep_last=N`` under rapid save/evict cycles
+    never deletes the newest checkpoint, and ``latest_checkpoint`` stays
+    consistent for a reader listing mid-rotation."""
+
+    def test_concurrent_reader_never_observes_zero_checkpoints(self):
+        import threading
+
+        errors = []
+        stop = threading.Event()
+        first_saved = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                path = latest_checkpoint(self.dir)
+                if path is None:
+                    if first_saved.is_set():
+                        errors.append("latest_checkpoint returned None")
+                    continue
+                try:
+                    with open(os.path.join(path, "manifest.json")) as f:
+                        json.load(f)
+                except (FileNotFoundError, json.JSONDecodeError):
+                    # the picked dir was rotated away between list and
+                    # open: consistency demands a NEWER latest now exists
+                    newer = latest_checkpoint(self.dir)
+                    if newer is None or newer <= path:
+                        errors.append(
+                            f"latest regressed: {path} -> {newer}"
+                        )
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            m = Sum()
+            for i in range(30):
+                m.update(jnp.asarray([1.0]))
+                path = save(m, self.dir, keep_last=2)
+                first_saved.set()
+                # the just-published newest is never rotation's victim
+                self.assertTrue(os.path.isdir(path))
+                ckpts = list_checkpoints(self.dir)
+                self.assertLessEqual(len(ckpts), 2)
+                self.assertEqual(ckpts[-1], path)
+        finally:
+            stop.set()
+            t.join(30)
+        self.assertEqual(errors, [])
+        fresh = Sum()
+        restore(fresh, self.dir)
+        self.assertEqual(float(fresh.compute()), 30.0)
+
+    def test_serve_eviction_churn_rotates_and_resumes(self):
+        """The caller this satellite exists for: the serve daemon's
+        evict→reattach cycle, rapidly, against one tenant directory with
+        ``keep_last=2`` — the newest eviction checkpoint must always
+        restore, and the rotation bound must hold."""
+        from torcheval_tpu.serve import EvalDaemon
+
+        rng = np.random.default_rng(21)
+        batches = [
+            (
+                rng.random((16, 5)).astype(np.float32),
+                rng.integers(0, 5, 16),
+            )
+            for _ in range(6)
+        ]
+        oracle = MulticlassAccuracy(num_classes=5)
+        with EvalDaemon(evict_dir=self.dir, evict_keep_last=2) as daemon:
+            h = daemon.attach("churn", MulticlassAccuracy(num_classes=5))
+            for s, l in batches:
+                h.submit(s, l)
+                oracle.update(s, l)
+                oracle.compute()  # mirror the per-cycle fold grouping
+                daemon.evict("churn", timeout=60)
+                h = daemon.attach(
+                    "churn",
+                    MulticlassAccuracy(num_classes=5),
+                    resume="require",
+                )
+            got = float(np.asarray(h.compute(timeout=60)))
+        self.assertEqual(got, float(np.asarray(oracle.compute())))
+        tenant_dir = os.path.join(self.dir, "churn")
+        self.assertLessEqual(len(list_checkpoints(tenant_dir)), 2)
 
 
 class TestObsCounters(_TmpDirTest):

@@ -1,0 +1,185 @@
+"""Worker for the 4-process serve fault-containment test (ISSUE 8
+acceptance).
+
+Each process joins a real ``jax.distributed`` CPU world and runs ONE
+EvalDaemon serving three tenants:
+
+* ``alice`` — the healthy tenant whose results must come through every
+  fault bit-identical, locally and over the sync legs;
+* ``bob`` — the poison victim: on the POISON rank (per-rank env from the
+  launcher) chaos corrupts bob's 2nd batch to all-NaN at the queue
+  boundary, and bob's ``nan_policy="reject"`` quarantines him there;
+* ``carol`` — the eviction leg: explicitly evicted mid-stream
+  (checkpoint via ``resilience.save``), re-attached with
+  ``resume="require"``, and streamed to completion — her final value must
+  be bit-identical to a fault-free oracle.
+
+Then two sync legs through the daemon worker thread: sync A with every
+rank alive (global value), and sync B during which chaos kills or delays
+the FAULT rank mid-collective — survivors must degrade to LOCAL results
+within the deadline (the PR 5 contract, exercised through the serve
+front end).
+
+Run:  python mp_serve_worker.py <rank> <world> <port> <outdir>
+Writes <outdir>/rank<r>.json, rank<r>.obs.json (per-tenant serve counters)
+and rank<r>.health.json (daemon health snapshot) — uploaded as CI
+artifacts. A killed rank writes nothing: it is dead.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 5
+BATCH = 48
+PHASE0_BATCHES = 3
+PHASE1_BATCHES = 2
+TIMEOUT_S = 8.0
+CHAOS_EXIT_CODE = 43
+POISON_RANK = 1  # chaos poisons bob's batch 2 here (per-rank env)
+FAULT_RANK = 2  # chaos kills/delays this rank at sync round 3
+TENANTS = ("alice", "bob", "carol")
+
+
+def make_shard(rank: int, tenant: str, phase: int, batch: int):
+    seed = 10_000 * (TENANTS.index(tenant) + 1) + 100 * phase + 10 * batch + rank
+    rng = np.random.default_rng(seed)
+    scores = rng.random((BATCH, NUM_CLASSES)).astype(np.float32)
+    labels = rng.integers(0, NUM_CLASSES, BATCH)
+    return scores, labels
+
+
+def tenant_stream(rank: int, tenant: str, phases=(0,)):
+    out = []
+    for phase in phases:
+        n = PHASE0_BATCHES if phase == 0 else PHASE1_BATCHES
+        out.extend(make_shard(rank, tenant, phase, b) for b in range(n))
+    return out
+
+
+def main() -> None:
+    rank, world, port, outdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["MASTER_ADDR"] = "localhost"
+    os.environ["MASTER_PORT"] = port
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["RANK"] = str(rank)
+    from torcheval_tpu.parallel import init_from_env
+
+    got_rank, got_world = init_from_env()
+    assert (got_rank, got_world) == (rank, world)
+
+    from torcheval_tpu import obs
+    from torcheval_tpu.metrics import MulticlassAccuracy
+    from torcheval_tpu.serve import EvalDaemon, TenantQuarantinedError
+
+    obs.enable()
+    results = {"rank": rank}
+
+    daemon = EvalDaemon(
+        evict_dir=os.path.join(outdir, f"evict_rank{rank}")
+    ).start()
+    handles = {
+        t: daemon.attach(
+            t,
+            {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)},
+            nan_policy="reject" if t == "bob" else "propagate",
+        )
+        for t in TENANTS
+    }
+
+    # --- phase 0: interleaved streams; on POISON_RANK chaos corrupts
+    # bob's 2nd batch to NaN at the queue boundary
+    for b in range(PHASE0_BATCHES):
+        for t in TENANTS:
+            try:
+                handles[t].submit(*make_shard(rank, t, 0, b))
+            except TenantQuarantinedError as e:
+                results[f"{t}_submit_error"] = e.reason
+
+    # --- local computes: alice/carol must be fault-free everywhere; bob is
+    # quarantined exactly on the poison rank
+    results["alice_phase0"] = float(
+        np.asarray(handles["alice"].compute(timeout=120)["acc"])
+    )
+    try:
+        results["bob_phase0"] = float(
+            np.asarray(handles["bob"].compute(timeout=120)["acc"])
+        )
+    except TenantQuarantinedError as e:
+        results["bob_quarantined"] = {
+            "reason": e.reason,
+            "tenant": e.tenant,
+            "cause": type(e.__cause__).__name__ if e.__cause__ else None,
+        }
+
+    # --- carol: evict mid-stream (atomic checkpoint), reattach, resume
+    ckpt = daemon.evict("carol", timeout=120)
+    results["carol_ckpt_exists"] = os.path.isdir(ckpt)
+    carol2 = daemon.attach(
+        "carol",
+        {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)},
+        resume="require",
+    )
+    for b in range(PHASE1_BATCHES):
+        carol2.submit(*make_shard(rank, "carol", 1, b))
+    results["carol_resumed"] = float(
+        np.asarray(carol2.compute(timeout=120)["acc"])
+    )
+
+    # --- sync A (rounds 1-2): every rank alive — the global alice value
+    rA = handles["alice"].sync_compute(
+        timeout_s=60.0, on_failure="local", timeout=180
+    )
+    results["alice_syncA"] = float(np.asarray(rA["acc"]))
+
+    # --- phase 1 for alice, then sync B (rounds 3-4): chaos kills/delays
+    # FAULT_RANK entering round 3; survivors must degrade to LOCAL within
+    # the deadline, through the daemon worker thread
+    for b in range(PHASE1_BATCHES):
+        handles["alice"].submit(*make_shard(rank, "alice", 1, b))
+    t0 = time.monotonic()
+    rB = handles["alice"].sync_compute(
+        timeout_s=TIMEOUT_S, on_failure="local", timeout=240
+    )
+    results["alice_syncB"] = float(np.asarray(rB["acc"]))
+    results["syncB_elapsed_s"] = time.monotonic() - t0
+    results["alice_local_post"] = float(
+        np.asarray(handles["alice"].compute(timeout=120)["acc"])
+    )
+
+    snap = obs.snapshot()
+    results["timeouts_local"] = snap["counters"].get(
+        "toolkit.sync.timeouts{policy=local}", 0.0
+    )
+
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, f"rank{rank}.obs.json"), "w") as f:
+        json.dump(snap, f, indent=2)
+    with open(os.path.join(outdir, f"rank{rank}.health.json"), "w") as f:
+        json.dump(daemon.health(), f, indent=2)
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # straggler world: the coordination-service leader (rank 0) must
+    # outlive the delayed rank's sleep or the runtime SIGABRTs it
+    hold_s = float(os.environ.get("TORCHEVAL_TPU_CHAOS_HOLD_S", "0"))
+    if rank == 0 and hold_s > 0:
+        time.sleep(hold_s)
+    # hard exit: peers of a dead rank must not wedge in teardown
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

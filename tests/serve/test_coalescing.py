@@ -1,0 +1,141 @@
+"""Batch coalescing: tenants with identical batch signatures share ONE
+compiled window-step program (ISSUE 8 tentpole, leg 2).
+
+The enabler is the canonical-positional-key refactor in
+``metrics/deferred.py``: member names never reach the jitted program's
+static specs or its states pytree, so N tenants running the same metric
+classes/configs over the same batch shape hit one trace however they named
+their members and however many collections wrap them. The recompile
+watchdog's per-entry signature counts make that an observable; these tests
+pin it, plus the correctness of the name↔canonical mapping and the
+control-first fallback lane.
+"""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import (
+    MeanSquaredError,
+    MetricCollection,
+    MulticlassAccuracy,
+)
+from torcheval_tpu.obs import recompile
+from torcheval_tpu.serve import EvalDaemon
+
+
+def _batches(n_batches, seed, n=16, c=5):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.random((n, c)).astype(np.float32), rng.integers(0, c, n))
+        for _ in range(n_batches)
+    ]
+
+
+class TestProgramSharingAcrossOwners(unittest.TestCase):
+    def setUp(self):
+        obs.enable()
+        obs.reset()
+        recompile.reset()
+        self.addCleanup(obs.disable)
+        self.addCleanup(obs.reset)
+        self.addCleanup(recompile.reset)
+
+    def _window_step_signatures(self):
+        return (
+            recompile.trace_counts()
+            .get("deferred.window_step", {})
+            .get("distinct_signatures", 0)
+        )
+
+    def test_differently_named_collections_share_one_program(self):
+        batches = _batches(4, seed=0)
+        cols = [
+            MetricCollection({name: MulticlassAccuracy(num_classes=5)})
+            for name in ("alpha", "beta", "gamma")
+        ]
+        for col in cols:
+            for s, l in batches:
+                col.update(s, l)
+            col.compute()
+        # one close program for all three owners: the member name is not
+        # part of the compiled program's identity
+        self.assertEqual(self._window_step_signatures(), 1)
+
+    def test_100_tenants_compile_like_one(self):
+        batches = _batches(3, seed=1)
+        with EvalDaemon(max_tenants=128) as daemon:
+            handles = [
+                daemon.attach(
+                    f"tenant-{i}", {f"m{i}": MulticlassAccuracy(num_classes=5)}
+                )
+                for i in range(100)
+            ]
+            for s, l in batches:
+                for h in handles:
+                    h.submit(s, l)
+            values = [
+                float(np.asarray(h.compute(timeout=120)[f"m{i}"]))
+                for i, h in enumerate(handles)
+            ]
+        # every tenant computed the same stream: identical values, and the
+        # whole fleet shares ONE window-step program signature
+        self.assertEqual(len(set(values)), 1)
+        self.assertEqual(self._window_step_signatures(), 1)
+
+    def test_canonical_mapping_lands_results_under_the_right_names(self):
+        # two collections with the same two metric classes under SWAPPED
+        # names: the canonical (positional) program keys must map back to
+        # each owner's own names, never leak across
+        scores = np.float32([[0.9, 0.1], [0.2, 0.8]])
+        labels = np.int64([0, 0])
+        a = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=2), "mse": MeanSquaredError()}
+        )
+        b = MetricCollection(
+            {"mse": MulticlassAccuracy(num_classes=2), "acc": MeanSquaredError()}
+        )
+        preds = np.float32([1.0, 0.0])
+        target = np.float32([1.0, 3.0])  # mse 4.5, distinct from acc 0.5
+        # feed the classification pair to the classification members and
+        # the regression pair to the regression members, via direct member
+        # update (mixed-signature collections route per member)
+        a.metrics["acc"].update(scores, labels)
+        a.metrics["mse"].update(preds, target)
+        b.metrics["mse"].update(scores, labels)
+        b.metrics["acc"].update(preds, target)
+        ra, rb = a.compute(), b.compute()
+        self.assertEqual(
+            float(np.asarray(ra["acc"])), float(np.asarray(rb["mse"]))
+        )
+        self.assertEqual(
+            float(np.asarray(ra["mse"])), float(np.asarray(rb["acc"]))
+        )
+        self.assertNotEqual(
+            float(np.asarray(ra["acc"])), float(np.asarray(ra["mse"]))
+        )
+
+    def test_mixed_signatures_fall_back_per_tenant(self):
+        # two tenants with DIFFERENT batch shapes still both complete (the
+        # scheduler groups by signature; a lone signature is its own group
+        # and never waits) — values match their oracles
+        b16 = _batches(3, seed=2, n=16)
+        b32 = _batches(3, seed=3, n=32)
+        with EvalDaemon() as daemon:
+            h16 = daemon.attach("t16", MulticlassAccuracy(num_classes=5))
+            h32 = daemon.attach("t32", MulticlassAccuracy(num_classes=5))
+            for (s16, l16), (s32, l32) in zip(b16, b32):
+                h16.submit(s16, l16)
+                h32.submit(s32, l32)
+            got16 = float(np.asarray(h16.compute(timeout=60)))
+            got32 = float(np.asarray(h32.compute(timeout=60)))
+        for got, batches in ((got16, b16), (got32, b32)):
+            oracle = MulticlassAccuracy(num_classes=5)
+            for s, l in batches:
+                oracle.update(s, l)
+            self.assertEqual(got, float(np.asarray(oracle.compute())))
+
+
+if __name__ == "__main__":
+    unittest.main()

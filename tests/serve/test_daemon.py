@@ -1,0 +1,281 @@
+"""EvalDaemon basics: admission control, bounded-queue backpressure, the
+tenant lifecycle, and result parity against plain collections.
+
+The contract under test (ISSUE 8 tentpole, legs 1 and 4): the daemon is an
+*async front end over the exact same metric machinery* — a tenant's
+``compute()`` must be bit-identical to driving an identically-configured
+``MetricCollection`` by hand — and every refusal is structured
+(``AdmissionError``/``BackpressureError`` with a machine-readable
+``reason``), never an unbounded queue or a bare crash.
+"""
+
+import threading
+import time
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.serve import (
+    AdmissionError,
+    BackpressureError,
+    EvalDaemon,
+    ServeError,
+    TenantStatus,
+)
+
+RNG = np.random.default_rng(11)
+
+
+def _batch(n=32, c=5, rng=RNG):
+    return (
+        rng.random((n, c)).astype(np.float32),
+        rng.integers(0, c, n),
+    )
+
+
+class GateMetric(Metric):
+    """Eager metric whose update blocks on an event — the deterministic way
+    to wedge the worker so queue-capacity behavior can be asserted."""
+
+    def __init__(self, gate, started, *, device=None):
+        super().__init__(device=device)
+        self.gate = gate
+        self.started = started
+
+    def update(self, *args):
+        self.started.set()
+        self.gate.wait(30)
+        return self
+
+    def compute(self):
+        return 0.0
+
+    def merge_state(self, metrics):
+        return self
+
+
+class TestLifecycleAndParity(unittest.TestCase):
+    def test_compute_matches_plain_collection_bit_identical(self):
+        batches = [_batch(rng=np.random.default_rng(s)) for s in range(12)]
+        oracle = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=5),
+                "f1": MulticlassF1Score(num_classes=5, average="macro"),
+            }
+        )
+        for s, l in batches:
+            oracle.update(s, l)
+        want = {
+            k: np.asarray(v) for k, v in oracle.compute().items()
+        }
+        with EvalDaemon() as daemon:
+            h = daemon.attach(
+                "parity",
+                {
+                    "acc": MulticlassAccuracy(num_classes=5),
+                    "f1": MulticlassF1Score(num_classes=5, average="macro"),
+                },
+            )
+            for s, l in batches:
+                h.submit(s, l)
+            got = h.compute(timeout=60)
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(got[k]), want[k])
+
+    def test_compute_then_more_batches_then_compute(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach("t", MulticlassAccuracy(num_classes=5))
+            oracle = MulticlassAccuracy(num_classes=5)
+            for seed in range(3):
+                s, l = _batch(rng=np.random.default_rng(seed))
+                h.submit(s, l)
+                oracle.update(s, l)
+            first = h.compute(timeout=60)
+            self.assertEqual(
+                float(np.asarray(first)), float(np.asarray(oracle.compute()))
+            )
+            for seed in range(3, 6):
+                s, l = _batch(rng=np.random.default_rng(seed))
+                h.submit(s, l)
+                oracle.update(s, l)
+            second = h.compute(timeout=60)
+            self.assertEqual(
+                float(np.asarray(second)), float(np.asarray(oracle.compute()))
+            )
+
+    def test_detach_frees_slot_and_handle_dies(self):
+        with EvalDaemon(max_tenants=1) as daemon:
+            h = daemon.attach("a", MulticlassAccuracy(num_classes=5))
+            h.submit(*_batch())
+            self.assertIsNone(h.detach(timeout=60))
+            self.assertIs(h.status, TenantStatus.DETACHED)
+            with self.assertRaises(ServeError):
+                h.submit(*_batch())
+            # the slot is free again
+            h2 = daemon.attach("b", MulticlassAccuracy(num_classes=5))
+            self.assertIs(h2.status, TenantStatus.ACTIVE)
+
+    def test_prebuilt_collection_accepted(self):
+        col = MetricCollection({"acc": MulticlassAccuracy(num_classes=5)})
+        with EvalDaemon() as daemon:
+            h = daemon.attach("pre", col)
+            s, l = _batch()
+            h.submit(s, l)
+            got = h.compute(timeout=60)
+            self.assertIn("acc", got)
+
+    def test_health_snapshot_shape(self):
+        with EvalDaemon(max_tenants=3) as daemon:
+            h = daemon.attach("h1", MulticlassAccuracy(num_classes=5))
+            h.submit(*_batch())
+            h.compute(timeout=60)
+            health = daemon.health()
+        self.assertTrue(health["worker_alive"])
+        self.assertEqual(health["capacity"]["max_tenants"], 3)
+        self.assertEqual(health["capacity"]["active_tenants"], 1)
+        t = health["tenants"]["h1"]
+        self.assertEqual(t["status"], "active")
+        self.assertEqual(t["ingested"], 1)
+        self.assertEqual(t["processed"], 1)
+        self.assertEqual(t["queue_depth"], 0)
+        self.assertEqual(health["totals"]["attached"], 1)
+
+
+class TestAdmissionControl(unittest.TestCase):
+    def test_duplicate_tenant_rejected(self):
+        with EvalDaemon() as daemon:
+            daemon.attach("dup", MulticlassAccuracy(num_classes=5))
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach("dup", MulticlassAccuracy(num_classes=5))
+            self.assertEqual(ctx.exception.reason, "duplicate_tenant")
+
+    def test_capacity_rejected_with_reason(self):
+        with EvalDaemon(max_tenants=2) as daemon:
+            daemon.attach("a", MulticlassAccuracy(num_classes=5))
+            daemon.attach("b", MulticlassAccuracy(num_classes=5))
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach("c", MulticlassAccuracy(num_classes=5))
+            self.assertEqual(ctx.exception.reason, "capacity")
+
+    def test_stopped_daemon_rejects(self):
+        daemon = EvalDaemon()
+        with self.assertRaises(AdmissionError) as ctx:
+            daemon.attach("x", MulticlassAccuracy(num_classes=5))
+        self.assertEqual(ctx.exception.reason, "daemon_stopped")
+
+    def test_bad_metrics_rejected(self):
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach("bad", {})
+            self.assertEqual(ctx.exception.reason, "bad_metrics")
+
+    def test_resume_require_without_checkpoint_rejected(self):
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach(
+                    "ghost",
+                    MulticlassAccuracy(num_classes=5),
+                    resume="require",
+                )
+            self.assertEqual(ctx.exception.reason, "no_checkpoint")
+
+    def test_bad_knobs_raise_valueerror(self):
+        with self.assertRaises(ValueError):
+            EvalDaemon(max_tenants=0)
+        with self.assertRaises(ValueError):
+            EvalDaemon(queue_capacity=0)
+        with EvalDaemon() as daemon:
+            with self.assertRaises(ValueError):
+                daemon.attach(
+                    "x", MulticlassAccuracy(num_classes=5), nan_policy="drop"
+                )
+            with self.assertRaises(ValueError):
+                daemon.attach(
+                    "x", MulticlassAccuracy(num_classes=5), resume="maybe"
+                )
+
+    def test_degenerate_tenant_timeouts_rejected_at_attach(self):
+        # a bad deadline must reject ADMISSION — firing later inside the
+        # worker would masquerade as tenant poison ('poisoned_batch'), and
+        # nan would silently disarm the idle watchdog (nan >= never)
+        with EvalDaemon() as daemon:
+            for knob in ("watchdog_timeout_s", "step_timeout_s"):
+                for bad in (0, -1.0, float("nan"), float("inf")):
+                    with self.assertRaisesRegex(ValueError, knob):
+                        daemon.attach(
+                            "x",
+                            MulticlassAccuracy(num_classes=5),
+                            **{knob: bad},
+                        )
+            # a rejected attach leaves no tenant behind
+            daemon.attach("x", MulticlassAccuracy(num_classes=5))
+
+    def test_per_tenant_queue_capacity_validated(self):
+        with EvalDaemon() as daemon:
+            for bad in (0, -1):
+                with self.assertRaisesRegex(ValueError, "queue_capacity"):
+                    daemon.attach(
+                        "x",
+                        MulticlassAccuracy(num_classes=5),
+                        queue_capacity=bad,
+                    )
+            h = daemon.attach(
+                "x", MulticlassAccuracy(num_classes=5), queue_capacity=1
+            )
+            self.assertEqual(h._tenant.capacity, 1)
+
+
+class TestBackpressure(unittest.TestCase):
+    def test_full_queue_sheds_with_reason_and_block_waits(self):
+        gate, started = threading.Event(), threading.Event()
+        try:
+            with EvalDaemon() as daemon:
+                h = daemon.attach(
+                    "bp",
+                    {"gate": GateMetric(gate, started)},
+                    queue_capacity=2,
+                )
+                # batch 1 wedges the worker inside update(); the queue is
+                # then free to fill behind it
+                h.submit(np.float32([1.0]))
+                self.assertTrue(started.wait(10))
+                h.submit(np.float32([2.0]))
+                h.submit(np.float32([3.0]))
+                # queue is now at capacity 2: the shed is immediate and
+                # structured, never an unbounded append
+                with self.assertRaises(BackpressureError) as ctx:
+                    h.submit(np.float32([4.0]))
+                self.assertEqual(ctx.exception.reason, "queue_full")
+                self.assertEqual(ctx.exception.tenant, "bp")
+                # block=True with a timeout sheds only after the wait
+                t0 = time.monotonic()
+                with self.assertRaises(BackpressureError):
+                    h.submit(np.float32([5.0]), block=True, timeout=0.3)
+                self.assertGreaterEqual(time.monotonic() - t0, 0.25)
+                # a blocked submit goes through once the worker drains
+                box = {}
+
+                def _blocked_submit():
+                    h.submit(np.float32([6.0]), block=True, timeout=20)
+                    box["ok"] = True
+
+                t = threading.Thread(target=_blocked_submit)
+                t.start()
+                gate.set()
+                t.join(20)
+                self.assertTrue(box.get("ok"))
+                self.assertGreaterEqual(
+                    daemon.health()["tenants"]["bp"]["sheds"], 2
+                )
+        finally:
+            gate.set()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,408 @@
+"""Single-process fault containment (ISSUE 8 tentpole, leg 3).
+
+The headline property: no tenant can take down the daemon or corrupt
+another tenant's results. Each leg injects one fault family — a poisoned
+batch (wrong shape / NaN under policy), a raising compute, a step that
+outruns its PR 5 deadline, a stalled producer — and asserts (a) the faulty
+tenant surfaces a STRUCTURED error with the right reason, (b) every other
+tenant's results are bit-identical to an oracle fed the identical stream,
+and (c) eviction checkpoints restore bit-identically through reattach.
+Chaos-injected variants (the env-gated queue-boundary hooks) live at the
+bottom; the real multi-process legs are in ``test_serve_faults_mp.py``.
+"""
+
+import os
+import threading
+import time
+import unittest
+from unittest import mock
+
+import numpy as np
+
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.resilience import chaos
+from torcheval_tpu.serve import (
+    EvalDaemon,
+    ServeError,
+    TenantEvictedError,
+    TenantQuarantinedError,
+    TenantStatus,
+)
+
+
+def _batches(n_batches, seed, n=32, c=5):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.random((n, c)).astype(np.float32), rng.integers(0, c, n))
+        for _ in range(n_batches)
+    ]
+
+
+def _oracle_value(batches, c=5):
+    m = MulticlassAccuracy(num_classes=c)
+    for s, l in batches:
+        m.update(s, l)
+    return float(np.asarray(m.compute()))
+
+
+class RaisingComputeMetric(Metric):
+    """Eager metric whose compute raises — the hostile-tenant fixture."""
+
+    def update(self, *args):
+        return self
+
+    def compute(self):
+        raise RuntimeError("tenant compute exploded")
+
+    def merge_state(self, metrics):
+        return self
+
+
+class BlockingMetric(Metric):
+    """Eager metric whose update blocks until released — the stuck-step
+    fixture for the PR 5 per-step watchdog."""
+
+    def __init__(self, gate, *, device=None):
+        super().__init__(device=device)
+        self.gate = gate
+
+    def update(self, *args):
+        self.gate.wait(30)
+        return self
+
+    def compute(self):
+        return 0.0
+
+    def merge_state(self, metrics):
+        return self
+
+
+class TestQuarantineContainment(unittest.TestCase):
+    def test_wrong_shape_batch_quarantines_only_that_tenant(self):
+        healthy_batches = _batches(6, seed=0)
+        with EvalDaemon() as daemon:
+            victim = daemon.attach("victim", MulticlassAccuracy(num_classes=5))
+            healthy = daemon.attach("healthy", MulticlassAccuracy(num_classes=5))
+            for i, (s, l) in enumerate(healthy_batches):
+                healthy.submit(s, l)
+                if i == 2:
+                    # leading-dim mismatch: the poisoned batch
+                    victim.submit(s, l[:-1])
+                else:
+                    victim.submit(s, l)
+            with self.assertRaises(TenantQuarantinedError) as ctx:
+                victim.compute(timeout=60)
+            self.assertEqual(ctx.exception.reason, "poisoned_batch")
+            self.assertEqual(ctx.exception.tenant, "victim")
+            self.assertIsInstance(ctx.exception.__cause__, ValueError)
+            self.assertIs(victim.status, TenantStatus.QUARANTINED)
+            # containment: the healthy tenant's value is bit-identical to a
+            # fault-free oracle, and the daemon keeps serving
+            got = float(np.asarray(healthy.compute(timeout=60)))
+            self.assertEqual(got, _oracle_value(healthy_batches))
+            self.assertTrue(daemon.health()["worker_alive"])
+            # subsequent ops on the quarantined tenant keep raising the
+            # same structured error
+            with self.assertRaises(TenantQuarantinedError):
+                victim.submit(*healthy_batches[0])
+
+    def test_nan_policy_reject_quarantines_and_propagate_contains(self):
+        nan_scores = np.full((32, 5), np.nan, dtype=np.float32)
+        labels = np.zeros(32, dtype=np.int64)
+        clean = _batches(3, seed=1)
+        with EvalDaemon() as daemon:
+            strict = daemon.attach(
+                "strict", MulticlassAccuracy(num_classes=5), nan_policy="reject"
+            )
+            lax_t = daemon.attach("lax", MulticlassAccuracy(num_classes=5))
+            bystander = daemon.attach(
+                "bystander", MulticlassAccuracy(num_classes=5)
+            )
+            for s, l in clean:
+                bystander.submit(s, l)
+            strict.submit(nan_scores, labels)
+            lax_t.submit(nan_scores, labels)
+            with self.assertRaises(TenantQuarantinedError) as ctx:
+                strict.compute(timeout=60)
+            self.assertEqual(ctx.exception.reason, "nan_policy")
+            # propagate: garbage stays inside the submitting tenant
+            lax_val = lax_t.compute(timeout=60)
+            self.assertIs(lax_t.status, TenantStatus.ACTIVE)
+            self.assertTrue(np.isfinite(float(np.asarray(lax_val))))
+            got = float(np.asarray(bystander.compute(timeout=60)))
+            self.assertEqual(got, _oracle_value(clean))
+
+    def test_raising_compute_quarantines_with_cause(self):
+        with EvalDaemon() as daemon:
+            bad = daemon.attach("bad", {"boom": RaisingComputeMetric()})
+            ok = daemon.attach("ok", MulticlassAccuracy(num_classes=5))
+            batches = _batches(2, seed=2)
+            for s, l in batches:
+                ok.submit(s, l)
+            bad.submit(np.float32([1.0]))
+            with self.assertRaises(TenantQuarantinedError) as ctx:
+                bad.compute(timeout=60)
+            self.assertEqual(ctx.exception.reason, "compute_error")
+            self.assertIsInstance(ctx.exception.__cause__, RuntimeError)
+            got = float(np.asarray(ok.compute(timeout=60)))
+            self.assertEqual(got, _oracle_value(batches))
+
+    def test_step_deadline_quarantines_stuck_tenant(self):
+        gate = threading.Event()
+        try:
+            with EvalDaemon() as daemon:
+                stuck = daemon.attach(
+                    "stuck",
+                    {"block": BlockingMetric(gate)},
+                    step_timeout_s=0.5,
+                )
+                ok = daemon.attach("ok", MulticlassAccuracy(num_classes=5))
+                batches = _batches(2, seed=3)
+                t0 = time.monotonic()
+                stuck.submit(np.float32([1.0]))
+                for s, l in batches:
+                    ok.submit(s, l)
+                # the PR 5 watchdog fires at ~step_timeout_s and the worker
+                # moves on; the wedged dispatch is abandoned on its daemon
+                # thread exactly like a hung collective
+                with self.assertRaises(TenantQuarantinedError) as ctx:
+                    stuck.compute(timeout=60)
+                self.assertEqual(ctx.exception.reason, "step_timeout")
+                self.assertLess(time.monotonic() - t0, 20.0)
+                got = float(np.asarray(ok.compute(timeout=60)))
+                self.assertEqual(got, _oracle_value(batches))
+        finally:
+            gate.set()
+
+
+class TestEvictionAndResume(unittest.TestCase):
+    def test_watchdog_evicts_idle_tenant_and_reattach_resumes_bit_identical(
+        self,
+    ):
+        import tempfile
+
+        evict_dir = tempfile.mkdtemp(prefix="serve_evict_")
+        batches = _batches(8, seed=4)
+        want = _oracle_value(batches)
+        with EvalDaemon(
+            evict_dir=evict_dir, watchdog_interval_s=0.05
+        ) as daemon:
+            h = daemon.attach(
+                "w",
+                MulticlassAccuracy(num_classes=5),
+                watchdog_timeout_s=0.3,
+            )
+            for s, l in batches[:4]:
+                h.submit(s, l)
+            deadline = time.monotonic() + 30
+            while (
+                h.status is TenantStatus.ACTIVE
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            self.assertIs(h.status, TenantStatus.EVICTED)
+            err = h.error
+            self.assertIsInstance(err, TenantEvictedError)
+            self.assertEqual(err.reason, "watchdog_idle")
+            self.assertTrue(os.path.isdir(err.checkpoint))
+            with self.assertRaises(TenantEvictedError):
+                h.submit(*batches[4])
+            # reattach under the same id restores the eviction checkpoint
+            # and the stream continues exactly where it stopped
+            h2 = daemon.attach(
+                "w", MulticlassAccuracy(num_classes=5), resume="require"
+            )
+            for s, l in batches[4:]:
+                h2.submit(s, l)
+            got = float(np.asarray(h2.compute(timeout=60)))
+            self.assertEqual(got, want)
+
+    def test_explicit_evict_roundtrip(self):
+        import tempfile
+
+        evict_dir = tempfile.mkdtemp(prefix="serve_evict_")
+        batches = _batches(6, seed=5)
+        want = _oracle_value(batches)
+        with EvalDaemon(evict_dir=evict_dir) as daemon:
+            h = daemon.attach("e", MulticlassAccuracy(num_classes=5))
+            for s, l in batches[:3]:
+                h.submit(s, l)
+            path = daemon.evict("e", timeout=60)
+            self.assertTrue(os.path.isdir(path))
+            self.assertIs(h.status, TenantStatus.EVICTED)
+            self.assertEqual(h.error.checkpoint, path)
+            h2 = daemon.attach("e", MulticlassAccuracy(num_classes=5))
+            for s, l in batches[3:]:
+                h2.submit(s, l)
+            self.assertEqual(
+                float(np.asarray(h2.compute(timeout=60))), want
+            )
+
+    def test_detach_with_checkpoint_is_graceful_eviction(self):
+        import tempfile
+
+        evict_dir = tempfile.mkdtemp(prefix="serve_evict_")
+        batches = _batches(4, seed=6)
+        with EvalDaemon(evict_dir=evict_dir) as daemon:
+            h = daemon.attach("g", MulticlassAccuracy(num_classes=5))
+            for s, l in batches[:2]:
+                h.submit(s, l)
+            path = h.detach(checkpoint=True, timeout=60)
+            self.assertTrue(os.path.isdir(path))
+            h2 = daemon.attach(
+                "g", MulticlassAccuracy(num_classes=5), resume="auto"
+            )
+            for s, l in batches[2:]:
+                h2.submit(s, l)
+            self.assertEqual(
+                float(np.asarray(h2.compute(timeout=60))),
+                _oracle_value(batches),
+            )
+
+    def test_resume_never_starts_clean(self):
+        import tempfile
+
+        evict_dir = tempfile.mkdtemp(prefix="serve_evict_")
+        with EvalDaemon(evict_dir=evict_dir) as daemon:
+            h = daemon.attach("c", MulticlassAccuracy(num_classes=5))
+            h.submit(*_batches(1, seed=7)[0])
+            h.detach(checkpoint=True, timeout=60)
+            fresh = _batches(2, seed=8)
+            h2 = daemon.attach(
+                "c", MulticlassAccuracy(num_classes=5), resume="never"
+            )
+            for s, l in fresh:
+                h2.submit(s, l)
+            self.assertEqual(
+                float(np.asarray(h2.compute(timeout=60))),
+                _oracle_value(fresh),
+            )
+
+    def test_quarantined_state_is_never_checkpointed(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach("q", MulticlassAccuracy(num_classes=5))
+            s, l = _batches(1, seed=9)[0]
+            h.submit(s, l[:-1])  # poison
+            with self.assertRaises(TenantQuarantinedError):
+                h.compute(timeout=60)
+            with self.assertRaises(ServeError):
+                daemon.evict("q", timeout=60)
+
+
+class _ChaosEnv:
+    """Arm chaos through the environment for one test, resolving fresh and
+    disarming afterwards (the library caches the parsed config)."""
+
+    def __init__(self, **env):
+        self.env = {k: str(v) for k, v in env.items()}
+
+    def __enter__(self):
+        self._patch = mock.patch.dict(os.environ, self.env)
+        self._patch.__enter__()
+        chaos.reset_for_tests()
+
+    def __exit__(self, *exc):
+        self._patch.__exit__(*exc)
+        chaos.reset_for_tests()
+
+
+class TestChaosAtTheQueueBoundary(unittest.TestCase):
+    """The env-gated ingestion hooks (ISSUE 8 satellite): serve fault tests
+    inject at the queue boundary the same way sync tests inject at the
+    collective funnel."""
+
+    def test_chaos_nan_poison_quarantines_target_tenant_only(self):
+        clean = _batches(4, seed=10)
+        with _ChaosEnv(
+            TORCHEVAL_TPU_CHAOS="1",
+            TORCHEVAL_TPU_CHAOS_ACTION="poison",
+            TORCHEVAL_TPU_CHAOS_TENANT="victim",
+            TORCHEVAL_TPU_CHAOS_STEP="2",
+            TORCHEVAL_TPU_CHAOS_POISON="nan",
+        ):
+            with EvalDaemon() as daemon:
+                victim = daemon.attach(
+                    "victim",
+                    MulticlassAccuracy(num_classes=5),
+                    nan_policy="reject",
+                )
+                other = daemon.attach(
+                    "other", MulticlassAccuracy(num_classes=5)
+                )
+                for s, l in clean:
+                    try:
+                        victim.submit(s, l)
+                    except TenantQuarantinedError:
+                        pass  # later submits observe the quarantine
+                    other.submit(s, l)
+                with self.assertRaises(TenantQuarantinedError) as ctx:
+                    victim.compute(timeout=60)
+                self.assertEqual(ctx.exception.reason, "nan_policy")
+                got = float(np.asarray(other.compute(timeout=60)))
+        self.assertEqual(got, _oracle_value(clean))
+
+    def test_chaos_shape_poison_hits_update_validation(self):
+        clean = _batches(3, seed=11)
+        with _ChaosEnv(
+            TORCHEVAL_TPU_CHAOS="1",
+            TORCHEVAL_TPU_CHAOS_ACTION="poison",
+            TORCHEVAL_TPU_CHAOS_TENANT="victim",
+            TORCHEVAL_TPU_CHAOS_STEP="1",
+            TORCHEVAL_TPU_CHAOS_POISON="shape",
+        ):
+            with EvalDaemon() as daemon:
+                victim = daemon.attach(
+                    "victim", MulticlassAccuracy(num_classes=5)
+                )
+                for s, l in clean:
+                    try:
+                        victim.submit(s, l)
+                    except TenantQuarantinedError:
+                        pass
+                with self.assertRaises(TenantQuarantinedError) as ctx:
+                    victim.compute(timeout=60)
+                self.assertEqual(ctx.exception.reason, "poisoned_batch")
+
+    def test_chaos_ingest_delay_stalls_only_the_producer(self):
+        with _ChaosEnv(
+            TORCHEVAL_TPU_CHAOS="1",
+            TORCHEVAL_TPU_CHAOS_ACTION="ingest_delay",
+            TORCHEVAL_TPU_CHAOS_TENANT="slow",
+            TORCHEVAL_TPU_CHAOS_STEP="1",
+            TORCHEVAL_TPU_CHAOS_DELAY_S="0.5",
+        ):
+            with EvalDaemon() as daemon:
+                slow = daemon.attach("slow", MulticlassAccuracy(num_classes=5))
+                s, l = _batches(1, seed=12)[0]
+                t0 = time.monotonic()
+                slow.submit(s, l)
+                elapsed = time.monotonic() - t0
+                self.assertGreaterEqual(elapsed, 0.45)
+                self.assertIs(slow.status, TenantStatus.ACTIVE)
+
+    def test_malformed_ingest_config_disarms(self):
+        with _ChaosEnv(
+            TORCHEVAL_TPU_CHAOS="1",
+            TORCHEVAL_TPU_CHAOS_ACTION="poison",
+            # TENANT/STEP missing: must disarm with a warning, never raise
+        ):
+            args = chaos.on_ingest("t", 1, (np.float32([1.0]),))
+            self.assertEqual(len(args), 1)
+
+    def test_poison_fires_once_per_process(self):
+        with _ChaosEnv(
+            TORCHEVAL_TPU_CHAOS="1",
+            TORCHEVAL_TPU_CHAOS_ACTION="poison",
+            TORCHEVAL_TPU_CHAOS_TENANT="*",
+            TORCHEVAL_TPU_CHAOS_STEP="1",
+            TORCHEVAL_TPU_CHAOS_POISON="nan",
+        ):
+            a1 = chaos.on_ingest("x", 1, (np.ones(4, np.float32),))
+            self.assertTrue(np.isnan(np.asarray(a1[0])).all())
+            a2 = chaos.on_ingest("y", 1, (np.ones(4, np.float32),))
+            self.assertFalse(np.isnan(np.asarray(a2[0])).any())
+
+
+if __name__ == "__main__":
+    unittest.main()

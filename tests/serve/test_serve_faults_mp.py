@@ -1,0 +1,285 @@
+"""End-to-end serve fault containment in 4 REAL processes (ISSUE 8
+acceptance).
+
+Two worlds, one worker (``mp_serve_worker.py``), the acceptance claims:
+
+* **poison containment** — chaos corrupts one tenant's batch to NaN at the
+  queue boundary on rank 1; that tenant (and only that tenant, and only on
+  that rank) surfaces a structured ``TenantQuarantinedError``; every other
+  tenant's computed results — on EVERY rank, the poisoned one included —
+  are bit-identical to a fault-free oracle, and the daemon never crashes.
+* **eviction resume** — a tenant evicted mid-stream (atomic checkpoint)
+  reattaches with ``resume="require"`` and finishes bit-identically.
+* **sync degradation through the daemon** — with rank 2 killed (kill
+  world) or straggling (delay world) mid-collective, the surviving
+  daemons' ``sync_compute(timeout_s=, on_failure="local")`` returns each
+  rank's LOCAL value within the deadline; the healthy sync before the
+  fault returned the true global value.
+
+Workers write per-tenant obs snapshots and daemon health snapshots next to
+their results; CI uploads the directory on every run.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import unittest
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_WORKER = os.path.join(_HERE, "mp_serve_worker.py")
+WORLD = 4
+
+sys.path.insert(0, _HERE)
+from mp_serve_worker import (  # noqa: E402
+    CHAOS_EXIT_CODE,
+    FAULT_RANK,
+    NUM_CLASSES,
+    POISON_RANK,
+    TIMEOUT_S,
+    tenant_stream,
+)
+
+STRAGGLE_S = 20.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _global_mean(batches) -> float:
+    scores = np.concatenate([s for s, _ in batches])
+    labels = np.concatenate([l for _, l in batches])
+    return float((scores.argmax(1) == labels).mean())
+
+
+def _oracle(rank: int, tenant: str, phases=(0,)) -> float:
+    """Fault-free oracle: the library's own metric, driven with the same
+    per-phase compute cadence the daemon used, so the fold grouping — and
+    therefore the float32 summation order — is identical and the
+    comparison is exact, not approximate."""
+    from torcheval_tpu.metrics import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    val = None
+    for ph in phases:
+        for s, l in tenant_stream(rank, tenant, phases=(ph,)):
+            m.update(s, l)
+        val = float(np.asarray(m.compute()))
+    return val
+
+
+def _artifact_dir(scenario: str) -> str:
+    configured = os.environ.get("TORCHEVAL_TPU_TEST_ARTIFACT_DIR")
+    if configured:
+        out = os.path.join(configured, f"serve_faults_{scenario}")
+        os.makedirs(out, exist_ok=True)
+        return out
+    import tempfile
+
+    return tempfile.mkdtemp(prefix=f"tpu_serve_{scenario}_")
+
+
+def _launch_world(tmpdir: str, action: str):
+    port = _free_port()
+    base = dict(os.environ)
+    base["PYTHONPATH"] = _REPO + os.pathsep + base.get("PYTHONPATH", "")
+    base.pop("XLA_FLAGS", None)
+    for k in list(base):
+        if k.startswith("TORCHEVAL_TPU_CHAOS"):
+            del base[k]
+    procs = []
+    for r in range(WORLD):
+        env = dict(base)
+        if r == POISON_RANK:
+            # the queue-boundary fault: bob's 2nd batch becomes all-NaN
+            env.update(
+                {
+                    "TORCHEVAL_TPU_CHAOS": "1",
+                    "TORCHEVAL_TPU_CHAOS_ACTION": "poison",
+                    "TORCHEVAL_TPU_CHAOS_TENANT": "bob",
+                    "TORCHEVAL_TPU_CHAOS_STEP": "2",
+                    "TORCHEVAL_TPU_CHAOS_POISON": "nan",
+                }
+            )
+        elif r == FAULT_RANK:
+            # the collective-funnel fault: die/straggle entering sync B
+            env.update(
+                {
+                    "TORCHEVAL_TPU_CHAOS": "1",
+                    "TORCHEVAL_TPU_CHAOS_ACTION": action,
+                    "TORCHEVAL_TPU_CHAOS_RANK": str(FAULT_RANK),
+                    "TORCHEVAL_TPU_CHAOS_ROUND": "3",
+                    "TORCHEVAL_TPU_CHAOS_DELAY_S": str(STRAGGLE_S),
+                    "TORCHEVAL_TPU_CHAOS_EXIT_CODE": str(CHAOS_EXIT_CODE),
+                }
+            )
+        if action == "delay":
+            env["TORCHEVAL_TPU_CHAOS_HOLD_S"] = str(
+                STRAGGLE_S - TIMEOUT_S + 8.0
+            )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER, str(r), str(WORLD), str(port), tmpdir],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode(errors="replace"))
+    return procs, outs
+
+
+class _ServeWorldMixin:
+    ACTION = "kill"
+
+    @classmethod
+    def setUpClass(cls):
+        cls.tmpdir = _artifact_dir(cls.ACTION)
+        procs, outs = _launch_world(cls.tmpdir, cls.ACTION)
+        cls.returncodes = [p.returncode for p in procs]
+        cls.outs = outs
+        cls.results = {}
+        for r in range(WORLD):
+            path = os.path.join(cls.tmpdir, f"rank{r}.json")
+            if os.path.exists(path):
+                with open(path) as f:
+                    cls.results[r] = json.load(f)
+
+    def _survivors(self):
+        if self.ACTION == "kill":
+            return [r for r in range(WORLD) if r != FAULT_RANK]
+        return list(range(WORLD))
+
+    # ------------------------------------------------- poison containment
+    def test_poisoned_tenant_quarantined_with_structured_error(self):
+        res = self.results[POISON_RANK]
+        self.assertIn("bob_quarantined", res, f"rank {POISON_RANK}: {res}")
+        self.assertEqual(res["bob_quarantined"]["reason"], "nan_policy")
+        self.assertEqual(res["bob_quarantined"]["tenant"], "bob")
+
+    def test_other_ranks_bob_unaffected(self):
+        for r in self._survivors():
+            if r == POISON_RANK:
+                continue
+            want = _oracle(r, "bob")
+            self.assertEqual(self.results[r].get("bob_phase0"), want)
+
+    def test_other_tenants_bit_identical_to_fault_free_oracle(self):
+        # the poisoned rank INCLUDED: quarantining bob must not perturb
+        # alice or carol anywhere
+        for r in self._survivors():
+            res = self.results[r]
+            self.assertEqual(res["alice_phase0"], _oracle(r, "alice"))
+            self.assertEqual(
+                res["carol_resumed"], _oracle(r, "carol", phases=(0, 1))
+            )
+
+    # --------------------------------------------------- eviction resume
+    def test_evicted_tenant_resumed_from_checkpoint(self):
+        for r in self._survivors():
+            self.assertTrue(self.results[r]["carol_ckpt_exists"])
+
+    # ------------------------------------------------------- sync legs
+    def test_healthy_sync_returned_global_value(self):
+        all_batches = []
+        for r in range(WORLD):
+            all_batches.extend(tenant_stream(r, "alice"))
+        want = _global_mean(all_batches)
+        for r in self._survivors():
+            self.assertAlmostEqual(
+                self.results[r]["alice_syncA"], want, places=6
+            )
+
+    def test_faulted_sync_degraded_to_local_within_deadline(self):
+        for r in self._survivors():
+            if r == FAULT_RANK:
+                continue
+            res = self.results[r]
+            self.assertEqual(res["alice_syncB"], res["alice_local_post"])
+            self.assertEqual(
+                res["alice_syncB"], _oracle(r, "alice", phases=(0, 1))
+            )
+            self.assertLess(res["syncB_elapsed_s"], TIMEOUT_S + 30.0)
+            self.assertEqual(res["timeouts_local"], 1.0)
+
+    # ------------------------------------------------------- plumbing
+    def test_survivors_exited_cleanly(self):
+        for r in self._survivors():
+            self.assertEqual(
+                self.returncodes[r],
+                0,
+                f"rank {r} exited {self.returncodes[r]}:\n{self.outs[r][-4000:]}",
+            )
+
+    def test_per_tenant_obs_and_health_snapshots_written(self):
+        for r in self._survivors():
+            with open(os.path.join(self.tmpdir, f"rank{r}.obs.json")) as f:
+                snap = json.load(f)
+            ingest = [
+                k
+                for k in snap["counters"]
+                if k.startswith("serve.ingest.batches{")
+            ]
+            self.assertTrue(ingest, f"rank {r}: no per-tenant ingest counters")
+            if r == POISON_RANK:
+                quarantines = [
+                    k
+                    for k in snap["counters"]
+                    if k.startswith("serve.quarantines{")
+                ]
+                self.assertTrue(quarantines)
+            with open(
+                os.path.join(self.tmpdir, f"rank{r}.health.json")
+            ) as f:
+                health = json.load(f)
+            self.assertIn("tenants", health)
+            self.assertIn("alice", health["tenants"])
+
+
+class TestServeKillWorld(_ServeWorldMixin, unittest.TestCase):
+    """Rank 2 hard-dies (os._exit) inside the daemon worker's collective."""
+
+    ACTION = "kill"
+
+    def test_killed_rank_died_with_injected_exit_code(self):
+        self.assertEqual(self.returncodes[FAULT_RANK], CHAOS_EXIT_CODE)
+        self.assertNotIn(FAULT_RANK, self.results)
+
+
+class TestServeStragglerWorld(_ServeWorldMixin, unittest.TestCase):
+    """Rank 2 sleeps past the whole sync budget: its peers' collective
+    genuinely hangs, and the survivors' return IS the watchdog firing at
+    ``timeout_s`` — through the serve front end."""
+
+    ACTION = "delay"
+
+    def test_straggler_also_degraded_and_survived(self):
+        res = self.results[FAULT_RANK]
+        self.assertEqual(res["alice_syncB"], res["alice_local_post"])
+        self.assertGreaterEqual(res["syncB_elapsed_s"], STRAGGLE_S - 0.5)
+
+    def test_survivors_waited_out_the_full_deadline(self):
+        for r in self._survivors():
+            if r == FAULT_RANK:
+                continue
+            elapsed = self.results[r]["syncB_elapsed_s"]
+            self.assertGreaterEqual(elapsed, TIMEOUT_S - 0.5)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -75,6 +75,18 @@ class TestBenchHygiene(unittest.TestCase):
                 f"{row} left the --smoke completeness set: the window-step "
                 "perf targets lose their regression pin",
             )
+        for row in (
+            "config7_serve_tenants_single",
+            "config7_serve_tenants_interleaved",
+            "config7_serve_tenants_throughput_ratio",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the multi-tenant "
+                "serving throughput contract (ROADMAP item 3) loses its "
+                "regression pin",
+            )
 
 
 if __name__ == "__main__":

@@ -37,6 +37,13 @@ scalar weights that become extra chunk columns) keep the pre-window lane:
 member updates run per batch and the members' own pending lists group-fold
 in one program per window, exactly the ISSUE-2 behavior.
 
+Program sharing across collections (ISSUE 8): the window/group programs
+key on canonical POSITIONAL member keys (``metrics/deferred.py``), so two
+collections holding the same metric classes/configs in the same order
+share one compiled program whatever their members are named — the
+property that lets ``torcheval_tpu.serve`` run hundreds of tenants (one
+collection each) off a handful of compiled programs.
+
 Donation caveat (unchanged semantics, window trigger): after a window step,
 previously captured references to a member's state arrays are invalid on
 donating backends (their buffers were donated). Read state through the

@@ -683,10 +683,14 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
             m._fold_own()
         return
     chunks = head
-    specs = tuple(_member_spec(key, m) for key, m in members.items())
+    # canonical POSITIONAL keys inside the program (see window_step): the
+    # member names never reach the static specs or the states pytree, so
+    # owners that differ only in member naming share one compiled fold
+    canon = [(str(i), m) for i, m in enumerate(members.values())]
+    specs = tuple(_member_spec(ck, m) for ck, m in canon)
     states = {
-        key: {n: getattr(m, n) for n in m._state_name_to_default}
-        for key, m in members.items()
+        ck: {n: getattr(m, n) for n in m._state_name_to_default}
+        for ck, m in canon
     }
     from torcheval_tpu.utils.platform import donation_pipelines
 
@@ -701,8 +705,8 @@ def group_fold(members: Dict[str, "DeferredFoldMixin"]) -> None:
     for m in pending:
         m._pending = []
         m._pending_bytes = 0
-    for key, m in members.items():
-        for n, v in new_states[key].items():
+    for ck, m in canon:
+        for n, v in new_states[ck].items():
             setattr(m, n, v)
 
 
@@ -724,24 +728,34 @@ def window_step(
     donated: its next read would hit a deleted array). New states are bound
     onto the members before returning; the returned dict maps each computed
     member key to its result. Callers own pending-list clearing (only after
-    this returns, so a failed dispatch never discards valid batches)."""
+    this returns, so a failed dispatch never discards valid batches).
+
+    Program sharing across owners (ISSUE 8): the member NAMES never enter
+    the program — specs, compute specs and the states pytree all use
+    canonical positional keys (``"0"``, ``"1"``, …, enumeration order).
+    Two owners driving the same metric classes/configs over the same batch
+    signature therefore hit ONE compiled window-step program whatever they
+    named their members — the property that lets a multi-tenant daemon
+    (``torcheval_tpu.serve``) serve hundreds of tenants from a handful of
+    compiled programs instead of one per tenant."""
     compute_keys = set(compute_keys)
+    canon = [(str(i), name, m) for i, (name, m) in enumerate(members.items())]
     compute_specs = tuple(
         (
-            key,
+            ck,
             type(m)._compute_fn,
             tuple(m._compute_params),
             tuple(m._state_name_to_default),
         )
-        for key, m in members.items()
-        if key in compute_keys and type(m)._compute_fn is not None
+        for ck, name, m in canon
+        if name in compute_keys and type(m)._compute_fn is not None
     )
     if not chunks and not compute_specs:
         return {}
-    specs = tuple(_member_spec(key, m) for key, m in members.items())
+    specs = tuple(_member_spec(ck, m) for ck, _name, m in canon)
     states = {
-        key: {n: getattr(m, n) for n in m._state_name_to_default}
-        for key, m in members.items()
+        ck: {n: getattr(m, n) for n in m._state_name_to_default}
+        for ck, _name, m in canon
     }
     from torcheval_tpu.utils.platform import donation_pipelines
 
@@ -785,10 +799,13 @@ def window_step(
             computes=len(compute_specs),
             donated=bool(donate),
         )
-    for key, m in members.items():
-        for n, v in new_states[key].items():
+    for ck, _name, m in canon:
+        for n, v in new_states[ck].items():
             setattr(m, n, v)
-    return results
+    # results come back under the canonical keys; hand them to the caller
+    # under the member names it asked with
+    by_canon = {ck: name for ck, name, _m in canon}
+    return {by_canon[ck]: v for ck, v in results.items()}
 
 
 class EvalWindow:

@@ -44,6 +44,7 @@ import contextlib
 import copy
 import functools
 import logging
+import math
 import threading
 import time
 from collections import defaultdict, deque
@@ -117,6 +118,28 @@ def _check_failure_policy(on_failure: str) -> None:
         )
 
 
+def _check_timeout_s(timeout_s: Optional[float]) -> None:
+    """Validate ``timeout_s`` at the API boundary (ISSUE 8 satellite):
+    ``None`` means no deadline; anything else must be a positive FINITE
+    number of seconds. Non-positive values would expire instantly,
+    ``inf`` would arm a watchdog that never fires, and ``nan`` slips past
+    a plain ``<= 0`` comparison into a deadline whose every remaining-time
+    computation is ``nan`` — a degenerate watchdog that neither fires nor
+    guards. All three are caller bugs, rejected before any collective
+    (or any state mutation) happens."""
+    if timeout_s is None:
+        return
+    try:
+        ok = math.isfinite(timeout_s) and timeout_s > 0
+    except TypeError:
+        ok = False
+    if not ok:
+        raise ValueError(
+            "timeout_s must be None or a positive finite number of "
+            f"seconds, got {timeout_s!r}."
+        )
+
+
 class _Deadline:
     __slots__ = ("expires_at", "timeout_s")
 
@@ -138,8 +161,7 @@ def _sync_deadline(timeout_s: Optional[float]):
     if timeout_s is None:
         yield
         return
-    if timeout_s <= 0:
-        raise ValueError(f"timeout_s must be positive, got {timeout_s}.")
+    _check_timeout_s(timeout_s)  # backstop; entry points validate earlier
     prev = getattr(_deadline_local, "deadline", None)
     _deadline_local.deadline = _Deadline(
         time.monotonic() + timeout_s, timeout_s
@@ -653,6 +675,7 @@ def get_synced_metric(
             f"got {recipient_rank} instead."
         )
     _check_failure_policy(on_failure)
+    _check_timeout_s(timeout_s)
     group = _resolve_group(processes)
     _check_group_recipient(group, recipient_rank)
     world = len(group) if group is not None else _world_size()
@@ -716,6 +739,7 @@ def get_synced_state_dict(
     (reference ``toolkit.py:81-118``; ``processes`` = subgroup sync;
     ``timeout_s``/``on_failure`` as in :func:`get_synced_metric` — a
     degraded ``"local"`` call returns the LOCAL state dict)."""
+    _check_timeout_s(timeout_s)
     synced = get_synced_metric(
         metric,
         recipient_rank,
@@ -748,6 +772,7 @@ def sync_and_compute(
     returns its LOCAL compute within the deadline instead of wedging
     (see :func:`get_synced_metric` for the exact degradation contract).
     """
+    _check_timeout_s(timeout_s)
     synced = get_synced_metric(
         metric,
         recipient_rank,
@@ -1059,6 +1084,7 @@ def sync_and_compute_collection(
             f"got {recipient_rank} instead."
         )
     _check_failure_policy(on_failure)
+    _check_timeout_s(timeout_s)
     group = _resolve_group(processes)
     _check_group_recipient(group, recipient_rank)
     world = len(group) if group is not None else _world_size()

@@ -29,6 +29,13 @@ The recompile watchdog (``recompile.py``) and profiler annotation
 (``annotate.py``) ride along unchanged in contract: always-on trace-time
 bookkeeping, one-global-read disabled paths everywhere.
 
+The serving daemon (``torcheval_tpu.serve``, ISSUE 8) feeds the same four
+legs: per-tenant ``serve.*`` counters/histograms (inventory in
+docs/observability.md), ``serve.tenant.step{tenant=}`` spans that land as
+rank-tagged tenant bars in the Chrome trace, and a daemon
+``health(sync=True)`` view built on :func:`sync_snapshot`'s one-collective
+cross-rank merge.
+
 Usage::
 
     from torcheval_tpu import obs

@@ -1,38 +1,70 @@
-"""Env-gated fault injection for the explicit sync path (test-only).
+"""Env-gated fault injection for the sync path and the serve queue (test-only).
 
 A preemption on a real TPU slice looks, from the surviving processes' point
 of view, like one rank silently vanishing (or stalling) between two
 collective rounds — the healthy ranks then block forever inside the next
-collective. The multiprocess fault-injection tests
-(``tests/resilience/test_fault_injection.py``) reproduce exactly that by
-arming this module through the environment before launching a world:
+collective. A misbehaving eval *client* looks different: a corrupted batch
+(wrong shape, NaN payload) entering a serving daemon's queue. Both fault
+families inject here, armed through the environment before launch:
 
 ``TORCHEVAL_TPU_CHAOS``
     ``"1"`` arms the hooks; anything else (or unset) leaves them disabled.
-    Disabled cost is one cached-config check per *collective round* — host
-    code on a path that is about to block on the network, so it is free.
+    Disabled cost is one cached-config check per hook call — host code on
+    paths that are about to block on the network or a queue, so it is free.
+``TORCHEVAL_TPU_CHAOS_ACTION``
+    Which fault. **Sync-funnel actions** (fire in ``on_sync_round``, at the
+    ``toolkit._allgather_stacked`` choke point):
+
+    * ``"kill"`` (default) — ``os._exit(TORCHEVAL_TPU_CHAOS_EXIT_CODE)``,
+      modelling a hard preemption: no Python cleanup, no atexit, no goodbye
+      to the coordinator.
+    * ``"delay"`` — sleep ``TORCHEVAL_TPU_CHAOS_DELAY_S`` seconds before
+      entering the round, modelling a straggler.
+
+    **Ingestion actions** (fire in ``on_ingest``, at the serve queue
+    boundary — the exact point a real client's bad batch would enter):
+
+    * ``"poison"`` — corrupt the chosen batch's payload before it is
+      queued: ``TORCHEVAL_TPU_CHAOS_POISON="nan"`` (default) replaces the
+      first float array with all-NaN; ``"shape"`` drops the first array's
+      last row, so the batch arrives with mismatched leading dims.
+    * ``"ingest_delay"`` — sleep ``TORCHEVAL_TPU_CHAOS_DELAY_S`` before
+      queuing the chosen batch, modelling a stalled producer (the fault
+      the serve watchdog's idle eviction exists for).
 ``TORCHEVAL_TPU_CHAOS_RANK``
-    Global process index the fault targets; other ranks never act.
+    Global process index the fault targets. Required for sync-funnel
+    actions (other ranks never act); optional for ingestion actions (when
+    set, only that rank injects — a multi-process serve test usually arms
+    different per-rank environments instead).
 ``TORCHEVAL_TPU_CHAOS_ROUND``
     1-based index of the explicit collective round (every
     ``toolkit._allgather_stacked`` call counts one round, process-wide) at
-    which the fault fires. A ``sync_and_compute`` is two rounds, so round 3
-    is "entering the descriptor exchange of the second sync".
-``TORCHEVAL_TPU_CHAOS_ACTION``
-    ``"kill"`` (default) — ``os._exit(TORCHEVAL_TPU_CHAOS_EXIT_CODE)``,
-    modelling a hard preemption: no Python cleanup, no atexit, no goodbye
-    to the coordinator. ``"delay"`` — sleep ``TORCHEVAL_TPU_CHAOS_DELAY_S``
-    seconds before entering the round, modelling a straggler.
+    which a sync-funnel fault fires. A ``sync_and_compute`` is two rounds,
+    so round 3 is "entering the descriptor exchange of the second sync".
+    Required for sync-funnel actions.
+``TORCHEVAL_TPU_CHAOS_TENANT``
+    Tenant id an ingestion fault targets (``"*"`` = any tenant). Required
+    for ingestion actions.
+``TORCHEVAL_TPU_CHAOS_STEP``
+    1-based per-tenant batch index at which the ingestion fault fires
+    (each tenant's submissions count separately). Required for ingestion
+    actions. The fault fires ONCE per process — one corrupted batch, like
+    one preemption.
+``TORCHEVAL_TPU_CHAOS_POISON``
+    ``"nan"`` (default) or ``"shape"`` — see ``"poison"`` above.
 ``TORCHEVAL_TPU_CHAOS_DELAY_S``
-    Straggler sleep, seconds (default 30).
+    Straggler/producer-stall sleep, seconds (default 30).
 ``TORCHEVAL_TPU_CHAOS_EXIT_CODE``
     Exit code for ``kill`` (default 43), so a launcher can tell an injected
     death from a genuine crash.
 
-The hook lives at the one funnel every explicit cross-process collective
-round already passes through (``toolkit._allgather_stacked``), so the
-injection point is the real preemption surface, not a mock: the surviving
-ranks execute the genuine Gloo collective and the genuine watchdog path.
+The hooks live at the two funnels the corresponding real faults pass
+through — every explicit cross-process collective round
+(``toolkit._allgather_stacked``) and every serve queue admission
+(``serve.daemon._submit``) — so the injection points are the real fault
+surfaces, not mocks: surviving ranks execute the genuine Gloo collective
+and the genuine watchdog path, and a poisoned batch flows through the
+genuine validation/quarantine machinery.
 """
 
 from __future__ import annotations
@@ -41,7 +73,9 @@ import logging
 import os
 import threading
 import time
-from typing import Optional
+from typing import Optional, Tuple
+
+import numpy as np
 
 from torcheval_tpu.obs import registry as _obs_registry
 from torcheval_tpu.obs import trace as _obs_trace
@@ -54,22 +88,53 @@ _ENV_ROUND = "TORCHEVAL_TPU_CHAOS_ROUND"
 _ENV_ACTION = "TORCHEVAL_TPU_CHAOS_ACTION"
 _ENV_DELAY = "TORCHEVAL_TPU_CHAOS_DELAY_S"
 _ENV_EXIT = "TORCHEVAL_TPU_CHAOS_EXIT_CODE"
+_ENV_TENANT = "TORCHEVAL_TPU_CHAOS_TENANT"
+_ENV_STEP = "TORCHEVAL_TPU_CHAOS_STEP"
+_ENV_POISON = "TORCHEVAL_TPU_CHAOS_POISON"
+
+_SYNC_ACTIONS = ("kill", "delay")
+_INGEST_ACTIONS = ("poison", "ingest_delay")
+_POISON_KINDS = ("nan", "shape")
 
 
 class _ChaosConfig:
-    __slots__ = ("rank", "round", "action", "delay_s", "exit_code")
+    __slots__ = (
+        "rank",
+        "round",
+        "action",
+        "delay_s",
+        "exit_code",
+        "tenant",
+        "step",
+        "poison",
+    )
 
-    def __init__(self, rank: int, rnd: int, action: str, delay_s: float, exit_code: int):
+    def __init__(
+        self,
+        action: str,
+        *,
+        rank: Optional[int] = None,
+        rnd: Optional[int] = None,
+        delay_s: float = 30.0,
+        exit_code: int = 43,
+        tenant: Optional[str] = None,
+        step: Optional[int] = None,
+        poison: str = "nan",
+    ):
+        self.action = action
         self.rank = rank
         self.round = rnd
-        self.action = action
         self.delay_s = delay_s
         self.exit_code = exit_code
+        self.tenant = tenant
+        self.step = step
+        self.poison = poison
 
 
-# resolved lazily on first round; False = disarmed, None = not yet resolved
+# resolved lazily on first hook; False = disarmed, None = not yet resolved
 _config: Optional[object] = None
 _rounds_seen = 0
+_ingest_fired = False
 _lock = threading.Lock()
 
 
@@ -82,27 +147,46 @@ def _resolve() -> object:
         _config = False
         return _config
     try:
-        rank = int(os.environ[_ENV_RANK])
-        rnd = int(os.environ[_ENV_ROUND])
         action = os.environ.get(_ENV_ACTION, "kill")
-        if action not in ("kill", "delay"):
-            raise ValueError(f"unknown chaos action {action!r}")
         delay_s = float(os.environ.get(_ENV_DELAY, "30"))
         exit_code = int(os.environ.get(_ENV_EXIT, "43"))
+        if action in _SYNC_ACTIONS:
+            _config = _ChaosConfig(
+                action,
+                rank=int(os.environ[_ENV_RANK]),
+                rnd=int(os.environ[_ENV_ROUND]),
+                delay_s=delay_s,
+                exit_code=exit_code,
+            )
+        elif action in _INGEST_ACTIONS:
+            poison = os.environ.get(_ENV_POISON, "nan")
+            if poison not in _POISON_KINDS:
+                raise ValueError(f"unknown poison kind {poison!r}")
+            rank_env = os.environ.get(_ENV_RANK)
+            _config = _ChaosConfig(
+                action,
+                rank=int(rank_env) if rank_env is not None else None,
+                delay_s=delay_s,
+                tenant=os.environ[_ENV_TENANT],
+                step=int(os.environ[_ENV_STEP]),
+                poison=poison,
+            )
+        else:
+            raise ValueError(f"unknown chaos action {action!r}")
     except (KeyError, ValueError) as e:
         _logger.warning("chaos hooks armed but misconfigured (%s); disarming.", e)
         _config = False
-        return _config
-    _config = _ChaosConfig(rank, rnd, action, delay_s, exit_code)
     return _config
 
 
 def reset_for_tests() -> None:
-    """Re-read the environment and restart the round counter (test hook)."""
-    global _config, _rounds_seen
+    """Re-read the environment and restart the round/step bookkeeping
+    (test hook)."""
+    global _config, _rounds_seen, _ingest_fired
     with _lock:
         _config = None
         _rounds_seen = 0
+        _ingest_fired = False
 
 
 def on_sync_round() -> None:
@@ -111,7 +195,7 @@ def on_sync_round() -> None:
     cfg = _config
     if cfg is None:
         cfg = _resolve()
-    if cfg is False:
+    if cfg is False or cfg.action not in _SYNC_ACTIONS:
         return
     global _rounds_seen
     with _lock:
@@ -150,3 +234,101 @@ def on_sync_round() -> None:
         cfg.delay_s,
     )
     time.sleep(cfg.delay_s)
+
+
+def _poison_args(args: Tuple, kind: str) -> Tuple:
+    """Corrupt one batch's payload the way a broken client would.
+
+    ``"nan"``: the first float-dtype array argument is replaced with
+    all-NaN of the same shape/dtype (a NaN-policy violation the daemon's
+    ``nan_policy="reject"`` scan catches; under ``"propagate"`` it flows
+    into that tenant's results and nobody else's). ``"shape"``: the first
+    array argument loses its last leading-axis row, so the batch arrives
+    with mismatched leading dims and update validation raises. If no
+    argument qualifies, the batch passes through unchanged (and a warning
+    says so — a chaos test that poisons nothing should fail loudly, not
+    silently pass)."""
+    out = list(args)
+    if kind == "nan":
+        for i, a in enumerate(out):
+            arr = np.asarray(a) if hasattr(a, "__array__") else None
+            if arr is not None and arr.dtype.kind == "f":
+                out[i] = np.full_like(arr, np.nan)
+                return tuple(out)
+    else:  # shape
+        for i, a in enumerate(out):
+            arr = np.asarray(a) if hasattr(a, "__array__") else None
+            if arr is not None and arr.ndim >= 1 and arr.shape[0] > 1:
+                out[i] = arr[:-1]
+                return tuple(out)
+    _logger.warning(
+        "chaos: poison (%s) found no eligible argument; batch unchanged.",
+        kind,
+    )
+    return tuple(out)
+
+
+def ingest_armed() -> bool:
+    """True when an ingestion action is armed for this process — the serve
+    daemon's cheap gate for its chaos slow path (when False, ``submit``
+    never calls :func:`on_ingest` at all)."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    return cfg is not False and cfg.action in _INGEST_ACTIONS
+
+
+def on_ingest(tenant_id: str, step: int, args: Tuple) -> Tuple:
+    """Called by the serve daemon at the queue boundary for a batch that
+    PASSED admission (capacity and liveness checks) — a shed batch must
+    never consume the one-shot fault. ``step`` is the 1-based index of the
+    batch among the tenant's admitted batches, read under the daemon lock
+    so concurrent producers cannot double-present one step. Returns the
+    (possibly corrupted) args; may sleep first. No-op unless armed for an
+    ingestion action matching this tenant and step. The fault fires once
+    per process."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    if cfg is False or cfg.action not in _INGEST_ACTIONS:
+        return args
+    global _ingest_fired
+    if (
+        _ingest_fired
+        or step != cfg.step
+        or cfg.tenant not in ("*", tenant_id)
+    ):
+        return args
+    if cfg.rank is not None:
+        import jax
+
+        if jax.process_index() != cfg.rank:
+            return args
+    with _lock:
+        if _ingest_fired:
+            return args
+        _ingest_fired = True
+    if _obs_registry._enabled:
+        _obs_trace.instant(
+            "resilience.chaos",
+            kind="chaos",
+            action=cfg.action,
+            tenant=tenant_id,
+            step=step,
+        )
+    if cfg.action == "ingest_delay":
+        _logger.warning(
+            "chaos: delaying ingestion of tenant %r batch %d by %.1fs",
+            tenant_id,
+            step,
+            cfg.delay_s,
+        )
+        time.sleep(cfg.delay_s)
+        return args
+    _logger.warning(
+        "chaos: poisoning tenant %r batch %d (%s)",
+        tenant_id,
+        step,
+        cfg.poison,
+    )
+    return _poison_args(args, cfg.poison)

@@ -55,7 +55,9 @@ import hashlib
 import json
 import logging
 import os
+import re
 import shutil
+import time
 from collections import defaultdict, deque
 from typing import Any, Dict, List, Optional, Tuple
 from zipfile import BadZipFile
@@ -254,6 +256,78 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return ckpts[-1] if ckpts else None
 
 
+_TMP_GC_MIN_AGE_S = 3600.0  # mtime fallback when the writer pid is unknowable
+
+
+_TMP_NAME = re.compile(
+    re.escape(_TMP_PREFIX) + re.escape(_CKPT_PREFIX) + r"\d+-(\d+)$"
+)
+
+
+def _tmp_writer_pid(name: str) -> Optional[int]:
+    """The pid a ``.tmp-ckpt-<step>-<pid>`` name embeds, or ``None``.
+
+    The FULL shape must match — a lax ``rsplit("-")`` would read a pid out
+    of any foreign ``.tmp-*`` name whose last segment happens to be
+    numeric (``.tmp-upload-123``) and, if that unrelated pid is not
+    running, the GC would delete a concurrent tool's fresh data instead of
+    applying the mtime-age fallback."""
+    m = _TMP_NAME.match(name)
+    return int(m.group(1)) if m else None
+
+
+def _gc_stale_tmps(directory: str) -> int:
+    """Remove ``.tmp-*`` directories orphaned by a writer that crashed
+    between write and rename (they otherwise accumulate forever).
+
+    Called after every durable publish. A tmp dir is stale when its
+    embedded writer pid is provably dead (``os.kill(pid, 0)`` raises
+    ``ProcessLookupError``) — a *live* writer's in-progress tmp, whatever
+    its age, is never touched (its pid answers the probe; so does a
+    same-pid process after pid reuse, which errs on the safe side). When
+    the pid cannot be parsed (foreign tooling, truncated name), fall back
+    to mtime: only dirs older than an hour are reclaimed, so a
+    concurrent-looking fresh tmp survives. Returns the number removed."""
+    removed = 0
+    now = time.time()
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return 0
+    for name in names:
+        if not name.startswith(_TMP_PREFIX):
+            continue
+        path = os.path.join(directory, name)
+        pid = _tmp_writer_pid(name)
+        if pid == os.getpid():
+            continue  # our own in-flight write (save() is re-entrant-safe)
+        if pid is not None:
+            try:
+                os.kill(pid, 0)
+                continue  # writer (or a pid-reuse doppelganger) is alive
+            except ProcessLookupError:
+                pass  # provably dead: the crash this GC exists for
+            except OSError:
+                continue  # EPERM etc.: a live process we cannot signal
+        else:
+            try:
+                if now - os.path.getmtime(path) < _TMP_GC_MIN_AGE_S:
+                    continue
+            except OSError:
+                continue
+        shutil.rmtree(path, ignore_errors=True)
+        removed += 1
+    if removed:
+        _logger.warning(
+            "checkpoint: reclaimed %d stale .tmp-* dir(s) under %s "
+            "(left by a writer that crashed mid-save).",
+            removed,
+            directory,
+        )
+        _obs.counter("resilience.checkpoint.tmp_gc", float(removed))
+    return removed
+
+
 def _fsync_file(path: str) -> None:
     with open(path, "rb") as f:
         os.fsync(f.fileno())
@@ -366,6 +440,10 @@ def save(
     if keep_last is not None:
         for old in list_checkpoints(directory)[:-keep_last]:
             shutil.rmtree(old, ignore_errors=True)
+    # reclaim tmp dirs orphaned by a crashed writer — AFTER the durable
+    # publish, so a directory that only ever sees failing saves is never
+    # mutated by the failures themselves
+    _gc_stale_tmps(directory)
     return final
 
 

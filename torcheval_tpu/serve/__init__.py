@@ -1,0 +1,52 @@
+"""``torcheval_tpu.serve``: a fault-contained multi-tenant eval service.
+
+The library's serving front end (ISSUE 8, ROADMAP item 3): one persistent
+:class:`EvalDaemon` owns the device mesh and serves many concurrent eval
+streams (*tenants*), each backed by a
+:class:`~torcheval_tpu.metrics.MetricCollection` —
+
+* **async ingestion** over bounded per-tenant queues with admission
+  control and explicit backpressure (:class:`AdmissionError` /
+  :class:`BackpressureError`: reject-with-reason, never unbounded growth);
+* **batch coalescing** — tenants with identical batch signatures share
+  ONE compiled window-step program (the deferred window programs key on
+  canonical positional member keys, never tenant names), with a
+  control-first fallback lane so coalescing never delays a result;
+* **fault containment** — a poisoned batch or a raising compute
+  quarantines exactly that tenant (:class:`TenantQuarantinedError`, the
+  cause attached) while every other tenant proceeds; an idle tenant's
+  watchdog deadline evicts it through an atomic ``resilience.save``
+  checkpoint (:class:`TenantEvictedError` carries the path) and a
+  re-``attach`` resumes bit-identically;
+* **per-tenant observability** — ingest/shed/quarantine/eviction
+  counters, queue-depth histograms and per-tenant spans in the standard
+  obs registry and Chrome trace, plus ``EvalDaemon.health()`` (local) /
+  ``health(sync=True)`` (all ranks, one collective round).
+
+See docs/robustness.md ("Serving") for the tenant lifecycle and the
+failure-semantics table, and ``bench.py``'s ``config7_serve_tenants_*``
+rows for the multi-tenant throughput contract.
+"""
+
+from torcheval_tpu.serve.daemon import EvalDaemon
+from torcheval_tpu.serve.errors import (
+    AdmissionError,
+    BackpressureError,
+    ServeError,
+    TenantError,
+    TenantEvictedError,
+    TenantQuarantinedError,
+)
+from torcheval_tpu.serve.tenant import TenantHandle, TenantStatus
+
+__all__ = [
+    "AdmissionError",
+    "BackpressureError",
+    "EvalDaemon",
+    "ServeError",
+    "TenantError",
+    "TenantEvictedError",
+    "TenantHandle",
+    "TenantQuarantinedError",
+    "TenantStatus",
+]

@@ -1,0 +1,871 @@
+"""`EvalDaemon`: a fault-contained multi-tenant eval front end.
+
+One long-running daemon owns the device mesh and serves many concurrent
+eval streams (*tenants*), each backed by its own
+:class:`~torcheval_tpu.metrics.MetricCollection`. The topology is the
+decoupled many-producers / few-TPU-consumers shape of Podracer
+(arXiv:2104.06272): any number of client threads enqueue host batches into
+bounded per-tenant queues; ONE worker thread drains them and drives the
+collections, so every device dispatch is serialized through a single
+owner and a tenant can never corrupt another tenant's device work.
+
+**Robustness is the headline property** — no tenant can take down the
+daemon or another tenant:
+
+* **Admission control** (``attach``): a daemon at ``max_tenants`` rejects
+  with a structured :class:`AdmissionError` instead of growing without
+  bound; duplicate ids and stopped daemons reject the same way.
+* **Backpressure** (``submit``): per-tenant queues are bounded; a full
+  queue sheds with :class:`BackpressureError` (reason ``"queue_full"``) —
+  reject-with-reason, never unbounded growth. ``block=True`` opts into
+  bounded waiting instead.
+* **Fault containment**: a poisoned batch (bad shape/dtype surfacing in
+  update validation, or a NaN under ``nan_policy="reject"``) or a compute
+  that raises quarantines THAT tenant with a structured
+  :class:`TenantQuarantinedError`; the worker moves on and every other
+  tenant's results are untouched (proven bit-identical against fault-free
+  oracles in ``tests/serve/``). A quarantined tenant's state is suspect
+  and is never checkpointed.
+* **Watchdog eviction**: a tenant idle past its ``watchdog_timeout_s`` is
+  *evicted* — its state folds and checkpoints atomically via
+  ``resilience.save`` into ``<evict_dir>/<tenant_id>`` and its slot frees;
+  re-``attach`` with ``resume="auto"`` restores the checkpoint and the
+  stream continues bit-identically. ``step_timeout_s`` additionally arms
+  the PR 5 watchdog (``toolkit._sync_deadline`` + ``_run_guarded``) around
+  each tenant's device step; a step that outruns it quarantines the tenant
+  (the abandoned dispatch may still write its states later, so that state
+  must never be checkpointed as truth — eviction is reserved for cleanly
+  folded state).
+
+**Batch coalescing.** Tenants whose batches share one ``(shape, dtype)``
+signature share ONE compiled window-step program by construction: the
+deferred window programs key on canonical positional member keys (ISSUE 8,
+``metrics/deferred.py``), never on tenant or member names, and the
+≤2-signatures-per-shape property (PR 2/6) bounds the program count per
+batch shape. The scheduler serves same-signature tenants back-to-back so
+the shared program stays hot, and runs control work (compute/detach)
+FIRST — the per-tenant fallback lane: coalescing is opportunistic and
+never delays a tenant's result to wait for a group.
+
+Per-tenant observability: ``serve.ingest.batches{tenant=}`` /
+``serve.ingest.sheds{tenant=,reason=}`` / ``serve.quarantines`` /
+``serve.evictions`` counters, a ``serve.queue_depth{tenant=}`` occupancy
+histogram, and a ``serve.tenant.step{tenant=}`` span per worker pass (the
+rank-tagged tenant bars in the Chrome trace). ``health()`` returns a
+structured daemon snapshot; ``health(sync=True)`` merges every rank's view
+over ``obs.sync_snapshot()``'s one-collective exchange.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _trace
+from torcheval_tpu.resilience import chaos as _chaos
+from torcheval_tpu.serve.errors import (
+    AdmissionError,
+    BackpressureError,
+    ServeError,
+    TenantEvictedError,
+    TenantQuarantinedError,
+)
+from torcheval_tpu.serve.tenant import (
+    TenantHandle,
+    TenantStatus,
+    _Promise,
+    _Tenant,
+)
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["EvalDaemon"]
+
+_NAN_POLICIES = ("propagate", "reject")
+_RESUME_POLICIES = ("auto", "never", "require")
+
+
+class _NaNPolicyViolation(ValueError):
+    """Internal: a float batch carried NaN under ``nan_policy="reject"``."""
+
+
+def _batch_signature(args) -> tuple:
+    """Host-side batch signature for coalesced scheduling: shapes + dtypes
+    of the queued (host) arrays. Cheap — attribute reads only."""
+    return tuple(
+        (
+            tuple(getattr(a, "shape", ()) or ()),
+            str(getattr(a, "dtype", type(a).__name__)),
+        )
+        for a in args
+    )
+
+
+class EvalDaemon:
+    """The persistent multi-tenant eval service (see module doc).
+
+    Example::
+
+        from torcheval_tpu.serve import EvalDaemon
+        from torcheval_tpu.metrics import MulticlassAccuracy
+
+        with EvalDaemon(max_tenants=128) as daemon:
+            h = daemon.attach("user-42", {"acc": MulticlassAccuracy(num_classes=10)})
+            for scores, labels in stream:
+                h.submit(scores, labels)       # async, bounded, shed-with-reason
+            results = h.compute()              # {"acc": ...}
+            h.detach()
+
+    ``start()``/``stop()`` (or the context manager) bound the worker
+    thread's lifetime. All client methods are thread-safe.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_tenants: int = 64,
+        queue_capacity: int = 32,
+        evict_dir: Optional[str] = None,
+        evict_keep_last: int = 2,
+        watchdog_interval_s: float = 0.25,
+    ) -> None:
+        if max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1, got {max_tenants}.")
+        if queue_capacity < 1:
+            raise ValueError(
+                f"queue_capacity must be >= 1, got {queue_capacity}."
+            )
+        self._max_tenants = max_tenants
+        self._queue_capacity = queue_capacity
+        self._evict_dir_arg = evict_dir
+        self._evict_dir: Optional[str] = evict_dir
+        self._evict_keep_last = evict_keep_last
+        self._watchdog_interval_s = watchdog_interval_s
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._tenants: Dict[str, _Tenant] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+        self._started_at: Optional[float] = None
+        self._totals = {"attached": 0, "quarantined": 0, "evicted": 0}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "EvalDaemon":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+            self._started_at = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._worker_loop,
+                name="torcheval-tpu-serve-worker",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, *, timeout: Optional[float] = 10.0) -> None:
+        """Stop the worker. Outstanding compute/detach promises are failed
+        with a structured ``daemon_stopped`` error; tenant tables stay
+        readable (``health()``) but every handle op raises afterwards."""
+        with self._cond:
+            if not self._running:
+                return
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "EvalDaemon":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ admission
+    def attach(
+        self,
+        tenant_id: str,
+        metrics: Any,
+        *,
+        nan_policy: str = "propagate",
+        watchdog_timeout_s: Optional[float] = None,
+        step_timeout_s: Optional[float] = None,
+        queue_capacity: Optional[int] = None,
+        resume: str = "auto",
+    ) -> TenantHandle:
+        """Admit one tenant and return its handle.
+
+        ``metrics`` is a ``Metric``, a ``{name: Metric}`` dict, or a
+        prebuilt ``MetricCollection`` — the tenant's whole eval stream
+        folds through it. ``nan_policy="reject"`` quarantines the tenant
+        on the first float batch carrying NaN (an O(batch) host scan per
+        submit-side batch, priced in docs). ``watchdog_timeout_s`` arms
+        idle eviction; ``step_timeout_s`` arms the per-step PR 5 watchdog.
+        ``resume`` controls eviction-checkpoint restore for this tenant id:
+        ``"auto"`` restores iff a checkpoint exists, ``"require"`` raises
+        ``AdmissionError(reason="no_checkpoint")`` without one, ``"never"``
+        starts clean. Raises :class:`AdmissionError` (``"capacity"`` /
+        ``"duplicate_tenant"`` / ``"daemon_stopped"`` / ``"bad_metrics"``)
+        instead of ever over-admitting.
+        """
+        if nan_policy not in _NAN_POLICIES:
+            raise ValueError(
+                f"nan_policy must be one of {_NAN_POLICIES}, got {nan_policy!r}."
+            )
+        if resume not in _RESUME_POLICIES:
+            raise ValueError(
+                f"resume must be one of {_RESUME_POLICIES}, got {resume!r}."
+            )
+        # the same boundary validation the sync APIs perform: a degenerate
+        # deadline must reject ADMISSION, not fire later inside the worker
+        # (where a ValueError from the deadline machinery would be
+        # misclassified as tenant poison) or silently disarm the watchdog
+        # (nan never compares >= the idle age)
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        for knob, value in (
+            ("watchdog_timeout_s", watchdog_timeout_s),
+            ("step_timeout_s", step_timeout_s),
+        ):
+            try:
+                _check_timeout_s(value)
+            except ValueError as e:
+                raise ValueError(f"{knob}: {e}") from None
+        if queue_capacity is not None and queue_capacity < 1:
+            raise ValueError(
+                f"queue_capacity must be >= 1, got {queue_capacity}."
+            )
+        from torcheval_tpu.metrics.collection import MetricCollection
+
+        with self._cond:
+            if not self._running:
+                self._count_admission("rejected", "daemon_stopped")
+                raise AdmissionError(
+                    "daemon_stopped",
+                    f"cannot attach {tenant_id!r}: the daemon is not running.",
+                )
+            if tenant_id in self._tenants:
+                self._count_admission("rejected", "duplicate_tenant")
+                raise AdmissionError(
+                    "duplicate_tenant",
+                    f"tenant {tenant_id!r} is already attached "
+                    f"({self._tenants[tenant_id].status.value}); detach it "
+                    "first.",
+                )
+            if len(self._tenants) >= self._max_tenants:
+                self._count_admission("rejected", "capacity")
+                raise AdmissionError(
+                    "capacity",
+                    f"daemon is at max_tenants={self._max_tenants}; "
+                    f"rejecting {tenant_id!r} (load shedding at the front "
+                    "door — retry after a detach/eviction).",
+                )
+            try:
+                collection = (
+                    metrics
+                    if isinstance(metrics, MetricCollection)
+                    else MetricCollection(metrics)
+                )
+            except (TypeError, ValueError) as e:
+                self._count_admission("rejected", "bad_metrics")
+                raise AdmissionError(
+                    "bad_metrics",
+                    f"tenant {tenant_id!r} metrics are not servable: {e}",
+                ) from e
+            ckpt_dir = self._tenant_ckpt_dir(tenant_id, create=False)
+            do_resume = False
+            if resume != "never":
+                from torcheval_tpu.resilience.snapshot import latest_checkpoint
+
+                has_ckpt = (
+                    ckpt_dir is not None
+                    and latest_checkpoint(ckpt_dir) is not None
+                )
+                if resume == "require" and not has_ckpt:
+                    self._count_admission("rejected", "no_checkpoint")
+                    raise AdmissionError(
+                        "no_checkpoint",
+                        f"resume='require' but no eviction checkpoint exists "
+                        f"for tenant {tenant_id!r} under {ckpt_dir!r}.",
+                    )
+                do_resume = has_ckpt
+            if do_resume:
+                # restore BEFORE the tenant is visible: a failed restore
+                # (schema drift, corrupt payload) must reject admission,
+                # not quarantine a half-born tenant
+                from torcheval_tpu.resilience.snapshot import restore
+
+                restore(collection, ckpt_dir)
+            self._seq += 1
+            tenant = _Tenant(
+                tenant_id,
+                collection,
+                capacity=(
+                    queue_capacity
+                    if queue_capacity is not None
+                    else self._queue_capacity
+                ),
+                nan_policy=nan_policy,
+                watchdog_timeout_s=watchdog_timeout_s,
+                step_timeout_s=step_timeout_s,
+                seq=self._seq,
+            )
+            self._tenants[tenant_id] = tenant
+            self._totals["attached"] += 1
+            self._count_admission("accepted", "resumed" if do_resume else "new")
+            if _obs._enabled:
+                _obs.gauge("serve.tenants.active", float(len(self._tenants)))
+        return TenantHandle(self, tenant)
+
+    def _count_admission(self, result: str, reason: str) -> None:
+        if _obs._enabled:
+            _obs.counter("serve.admissions", result=result, reason=reason)
+
+    def _tenant_ckpt_dir(
+        self, tenant_id: str, *, create: bool
+    ) -> Optional[str]:
+        if self._evict_dir is None:
+            if not create and self._evict_dir_arg is None:
+                # no directory configured and none materialized yet: there
+                # can be no checkpoint to resume from
+                return None
+            self._evict_dir = self._evict_dir_arg or tempfile.mkdtemp(
+                prefix="torcheval_tpu_serve_evict_"
+            )
+        # tenant ids become directory names; keep them filesystem-safe
+        safe = "".join(
+            c if (c.isalnum() or c in "-_.") else "_" for c in tenant_id
+        )
+        return os.path.join(self._evict_dir, safe)
+
+    # ------------------------------------------------------------ ingestion
+    def _submit(
+        self,
+        tenant: _Tenant,
+        args: tuple,
+        *,
+        block: bool,
+        timeout: Optional[float],
+    ) -> None:
+        deadline = (
+            time.monotonic() + timeout
+            if (block and timeout is not None)
+            else None
+        )
+        with self._cond:
+            self._check_live(tenant)
+            while len(tenant.queue) >= tenant.capacity:
+                if not block:
+                    self._shed(tenant, "queue_full")
+                remaining = (
+                    None
+                    if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    self._shed(tenant, "queue_full")
+                if not self._cond.wait(timeout=remaining):
+                    self._shed(tenant, "queue_full")
+                self._check_live(tenant)
+            tenant.ingested += 1
+            step = tenant.ingested
+            if not _chaos.ingest_armed():
+                tenant.queue.append(("batch", args, None))
+                tenant.last_activity = time.monotonic()
+                depth = len(tenant.queue)
+                self._cond.notify_all()
+                args = None
+        if args is not None:
+            # chaos slow path (test-only): the fault fires at the queue
+            # boundary for a batch that PASSED admission — only admitted
+            # batches advance ``step``, so a shed can never consume the
+            # one-shot fault — and OUTSIDE the lock, so an ingestion delay
+            # stalls only this producer. The re-acquire below may
+            # transiently exceed the queue bound by the number of
+            # concurrent producers mid-hook; chaos is disarmed in
+            # production, where the bound is exact.
+            args = _chaos.on_ingest(tenant.id, step, args)
+            with self._cond:
+                self._check_live(tenant)
+                tenant.queue.append(("batch", args, None))
+                tenant.last_activity = time.monotonic()
+                depth = len(tenant.queue)
+                self._cond.notify_all()
+        if _obs._enabled:
+            _obs.counter("serve.ingest.batches", tenant=tenant.id)
+            _obs.histo("serve.queue_depth", float(depth), tenant=tenant.id)
+
+    def _shed(self, tenant: _Tenant, reason: str) -> None:
+        tenant.sheds += 1
+        if _obs._enabled:
+            _obs.counter("serve.ingest.sheds", tenant=tenant.id, reason=reason)
+        raise BackpressureError(
+            reason,
+            f"tenant {tenant.id!r} queue is full "
+            f"({tenant.capacity} batches pending); batch shed — back off, "
+            "block=True, or raise queue_capacity.",
+            tenant=tenant.id,
+        )
+
+    def _check_live(self, tenant: _Tenant) -> None:
+        """Raise the tenant's terminal error (or a daemon error) if this
+        tenant can no longer accept work. Caller holds the lock."""
+        if not self._running:
+            raise ServeError(
+                "daemon_stopped", "the daemon has been stopped."
+            )
+        if tenant.status is not TenantStatus.ACTIVE:
+            if tenant.error is not None:
+                raise tenant.error
+            raise ServeError(
+                "tenant_detached",
+                f"tenant {tenant.id!r} is {tenant.status.value}.",
+            )
+
+    def _request(
+        self,
+        tenant: _Tenant,
+        kind: str,
+        *,
+        timeout: Optional[float],
+        payload: Any = None,
+    ) -> Any:
+        promise = _Promise()
+        with self._cond:
+            self._check_live(tenant)
+            tenant.queue.append((kind, payload, promise))
+            tenant.last_activity = time.monotonic()
+            self._cond.notify_all()
+        return promise.result(timeout)
+
+    def _detach(
+        self,
+        tenant: _Tenant,
+        *,
+        checkpoint: bool,
+        timeout: Optional[float],
+    ) -> Optional[str]:
+        with self._cond:
+            if tenant.status is not TenantStatus.ACTIVE or not self._running:
+                # terminal tenants (and stopped daemons) detach directly:
+                # there is no worker round trip to make, only a slot to
+                # clear — the checkpoint, if the tenant was evicted, already
+                # exists and its path is on the error
+                self._tenants.pop(tenant.id, None)
+                prev = tenant.status
+                if tenant.status is TenantStatus.ACTIVE:
+                    tenant.status = TenantStatus.DETACHED
+                if _obs._enabled:
+                    _obs.gauge(
+                        "serve.tenants.active", float(len(self._tenants))
+                    )
+                return (
+                    tenant.error.checkpoint
+                    if (
+                        prev is TenantStatus.EVICTED
+                        and isinstance(tenant.error, TenantEvictedError)
+                    )
+                    else None
+                )
+        return self._request(
+            tenant,
+            "detach",
+            timeout=timeout,
+            payload={"checkpoint": checkpoint, "evict": False},
+        )
+
+    def evict(
+        self, tenant_id: str, *, timeout: Optional[float] = None
+    ) -> str:
+        """Explicitly evict an active tenant: drain its queue, fold and
+        checkpoint its state, free its slot. Returns the checkpoint path;
+        the handle's next op raises :class:`TenantEvictedError` carrying
+        the same path. (The watchdog calls the same machinery for tenants
+        idle past ``watchdog_timeout_s``.)"""
+        with self._cond:
+            tenant = self._tenants.get(tenant_id)
+            if tenant is None or tenant.status is not TenantStatus.ACTIVE:
+                raise ServeError(
+                    "unknown_tenant",
+                    f"no active tenant {tenant_id!r} to evict.",
+                )
+        return self._request(
+            tenant,
+            "detach",
+            timeout=timeout,
+            payload={"checkpoint": True, "evict": True},
+        )
+
+    # ---------------------------------------------------------- worker side
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    self._fail_pending_locked()
+                    return
+                if not self._has_work_locked():
+                    self._cond.wait(timeout=self._watchdog_interval_s)
+                if not self._running:
+                    self._fail_pending_locked()
+                    return
+                plans = self._plan_pass_locked()
+            for tenant, items in plans:
+                self._serve_tenant(tenant, items)
+            self._check_watchdogs()
+
+    def _has_work_locked(self) -> bool:
+        return any(
+            t.queue and t.status is TenantStatus.ACTIVE
+            for t in self._tenants.values()
+        )
+
+    def _plan_pass_locked(self):
+        """Pop every active tenant's queued items and order the pass:
+        control-first (the per-tenant fallback lane — a compute/detach is
+        served immediately, never parked behind a signature group), then
+        batch tenants grouped by head-batch signature so same-signature
+        tenants run back-to-back against the one compiled program they
+        share. Popping frees queue capacity, so blocked submitters wake."""
+        plans = []
+        for t in self._tenants.values():
+            if t.queue and t.status is TenantStatus.ACTIVE:
+                items = list(t.queue)
+                t.queue.clear()
+                plans.append((t, items))
+        if not plans:
+            return plans
+        self._cond.notify_all()
+        control, groups = [], {}
+        for entry in plans:
+            head = entry[1][0]
+            if head[0] != "batch":
+                control.append(entry)
+            else:
+                groups.setdefault(_batch_signature(head[1]), []).append(entry)
+        return control + [e for sig in groups for e in groups[sig]]
+
+    def _serve_tenant(self, tenant: _Tenant, items) -> None:
+        with _obs.span("serve.tenant.step", tenant=tenant.id):
+            for idx, (kind, payload, promise) in enumerate(items):
+                try:
+                    if kind == "batch":
+                        self._process_batch(tenant, payload)
+                    elif kind == "compute":
+                        promise.resolve(
+                            self._guarded(tenant, tenant.collection.compute)
+                        )
+                    elif kind == "sync_compute":
+                        self._do_sync_compute(tenant, payload, promise)
+                    elif kind == "detach":
+                        self._do_detach(tenant, payload, promise)
+                except Exception as exc:  # noqa: BLE001 - containment wall
+                    err = self._classify_and_quarantine(tenant, kind, exc)
+                    # the rest of this tenant's popped items die with it:
+                    # batches drop, promises learn the structured reason
+                    for _k, _p, pr in items[idx:]:
+                        if pr is not None and not pr.event.is_set():
+                            pr.reject(err)
+                    return
+        with self._cond:
+            tenant.last_activity = time.monotonic()
+
+    def _process_batch(self, tenant: _Tenant, args: tuple) -> None:
+        if tenant.nan_policy == "reject":
+            self._nan_check(tenant, args)
+        self._guarded(tenant, lambda: tenant.collection.update(*args))
+        tenant.processed += 1
+
+    @staticmethod
+    def _nan_check(tenant: _Tenant, args: tuple) -> None:
+        for a in args:
+            try:
+                arr = np.asarray(a)
+            except Exception:
+                continue
+            if arr.dtype.kind == "f" and bool(np.isnan(arr).any()):
+                raise _NaNPolicyViolation(
+                    f"tenant {tenant.id!r} submitted a float batch "
+                    "containing NaN under nan_policy='reject'."
+                )
+
+    def _guarded(self, tenant: _Tenant, fn):
+        """Run one tenant device step under its PR 5 watchdog deadline
+        (``toolkit._sync_deadline`` + ``_run_guarded`` — the exact
+        machinery the sync APIs use). ``None`` = unguarded (the default;
+        guarding costs one thread per step)."""
+        if tenant.step_timeout_s is None:
+            return fn()
+        from torcheval_tpu.metrics import toolkit as tk
+
+        with tk._sync_deadline(tenant.step_timeout_s):
+            return tk._run_guarded(fn, "serve.step", "serve")
+
+    def _do_sync_compute(
+        self, tenant: _Tenant, payload: dict, promise: _Promise
+    ) -> None:
+        """Cross-rank sync of one tenant's metrics on the worker thread.
+        A SyncError here is the CLIENT's to handle (it chose timeout_s /
+        on_failure) and the tenant's local state is untouched by a failed
+        exchange — so sync failures reject the promise without
+        quarantining."""
+        from torcheval_tpu.metrics import toolkit as tk
+
+        try:
+            promise.resolve(
+                tk.sync_and_compute_collection(
+                    dict(tenant.collection.metrics),
+                    recipient_rank="all",
+                    timeout_s=payload["timeout_s"],
+                    on_failure=payload["on_failure"],
+                )
+            )
+        except tk.SyncError as exc:
+            promise.reject(exc)
+
+    def _do_detach(
+        self, tenant: _Tenant, payload: dict, promise: _Promise
+    ) -> None:
+        """Graceful detach / explicit eviction, on the worker: optionally
+        fold+checkpoint, then free the slot. A checkpoint failure (disk
+        full, schema surprise) rejects the promise and leaves the tenant
+        ACTIVE — environmental errors are not tenant poison."""
+        path = None
+        try:
+            if payload["checkpoint"]:
+                path = self._checkpoint_tenant(tenant)
+        except Exception as exc:  # noqa: BLE001 - relayed to the caller
+            promise.reject(exc)
+            return
+        evict = payload["evict"]
+        with self._cond:
+            if evict:
+                tenant.status = TenantStatus.EVICTED
+                tenant.error = TenantEvictedError(
+                    "evicted",
+                    f"tenant {tenant.id!r} was evicted; resume from "
+                    f"{path!r}.",
+                    tenant=tenant.id,
+                    checkpoint=path,
+                )
+                self._totals["evicted"] += 1
+            else:
+                tenant.status = TenantStatus.DETACHED
+            self._tenants.pop(tenant.id, None)
+            if _obs._enabled:
+                _obs.gauge("serve.tenants.active", float(len(self._tenants)))
+        if evict and _obs._enabled:
+            _obs.counter(
+                "serve.evictions", tenant=tenant.id, reason="explicit"
+            )
+        promise.resolve(path)
+
+    def _checkpoint_tenant(self, tenant: _Tenant) -> str:
+        from torcheval_tpu.resilience.snapshot import save
+
+        ckpt_dir = self._tenant_ckpt_dir(tenant.id, create=True)
+        with _obs.span("serve.tenant.evict", tenant=tenant.id):
+            return save(
+                tenant.collection, ckpt_dir, keep_last=self._evict_keep_last
+            )
+
+    def _classify_and_quarantine(
+        self, tenant: _Tenant, kind: str, exc: Exception
+    ) -> TenantQuarantinedError:
+        from torcheval_tpu.metrics import toolkit as tk
+
+        if isinstance(exc, _NaNPolicyViolation):
+            reason = "nan_policy"
+        elif isinstance(exc, tk.SyncTimeoutError):
+            reason = "step_timeout"
+        elif kind == "batch":
+            reason = "poisoned_batch"
+        else:
+            reason = "compute_error"
+        err = TenantQuarantinedError(
+            reason,
+            f"tenant {tenant.id!r} quarantined: {exc!r}. Other tenants are "
+            "unaffected; detach and re-attach to start clean.",
+            tenant=tenant.id,
+        )
+        err.__cause__ = exc
+        with self._cond:
+            tenant.status = TenantStatus.QUARANTINED
+            tenant.error = err
+            # anything still queued dies with the tenant: batches drop,
+            # waiting promises learn the reason
+            for _k, _p, pr in tenant.queue:
+                if pr is not None and not pr.event.is_set():
+                    pr.reject(err)
+            tenant.queue.clear()
+            self._totals["quarantined"] += 1
+            self._cond.notify_all()
+        _logger.warning(
+            "serve: quarantined tenant %r (%s): %r", tenant.id, reason, exc
+        )
+        if _obs._enabled:
+            _obs.counter("serve.quarantines", tenant=tenant.id, reason=reason)
+            _trace.instant(
+                "serve.tenant.quarantined",
+                kind="serve",
+                tenant=tenant.id,
+                reason=reason,
+            )
+        return err
+
+    def _check_watchdogs(self) -> None:
+        now = time.monotonic()
+        victims = []
+        with self._cond:
+            for t in self._tenants.values():
+                if (
+                    t.status is TenantStatus.ACTIVE
+                    and t.watchdog_timeout_s is not None
+                    and not t.queue
+                    and now - t.last_activity >= t.watchdog_timeout_s
+                ):
+                    victims.append(t)
+        for t in victims:
+            self._evict_idle(t)
+
+    def _evict_idle(self, tenant: _Tenant) -> None:
+        """Watchdog eviction of an idle (stuck-producer) tenant: fold +
+        checkpoint, then free the slot. The save runs on the worker thread
+        OUTSIDE the daemon lock (holding it across a fold + fsync would
+        stall every tenant's submit for the save's duration); it is safe
+        unlocked because only this thread ever touches the collection. The
+        eviction then commits under the lock ONLY if the tenant is still
+        idle — a submit that raced in during the save means the tenant is
+        live (and the checkpoint stale), so the eviction aborts and the
+        just-published checkpoint is discarded (a mid-stream snapshot left
+        behind would become a wrong resume source for a later
+        ``resume="auto"`` attach)."""
+        with self._cond:
+            if (
+                tenant.status is not TenantStatus.ACTIVE
+                or tenant.queue
+                or self._tenants.get(tenant.id) is not tenant
+            ):
+                return  # a submit raced the watchdog: the tenant is live
+        try:
+            path = self._checkpoint_tenant(tenant)
+        except Exception as exc:  # noqa: BLE001 - never kill the worker
+            _logger.warning(
+                "serve: idle eviction of %r failed to checkpoint (%r); "
+                "leaving the tenant attached.",
+                tenant.id,
+                exc,
+            )
+            return
+        with self._cond:
+            if (
+                tenant.status is not TenantStatus.ACTIVE
+                or tenant.queue
+                or self._tenants.get(tenant.id) is not tenant
+            ):
+                # activity landed during the save: abort and discard the
+                # now-stale checkpoint (only this thread consumes queues,
+                # so ANY new work is visible here as a non-empty queue)
+                shutil.rmtree(path, ignore_errors=True)
+                return
+            tenant.status = TenantStatus.EVICTED
+            tenant.error = TenantEvictedError(
+                "watchdog_idle",
+                f"tenant {tenant.id!r} idle past its watchdog deadline "
+                f"({tenant.watchdog_timeout_s}s) was evicted; resume from "
+                f"{path!r}.",
+                tenant=tenant.id,
+                checkpoint=path,
+            )
+            self._tenants.pop(tenant.id, None)
+            self._totals["evicted"] += 1
+            if _obs._enabled:
+                _obs.gauge("serve.tenants.active", float(len(self._tenants)))
+        _logger.warning(
+            "serve: evicted idle tenant %r (checkpoint %s)", tenant.id, path
+        )
+        if _obs._enabled:
+            _obs.counter(
+                "serve.evictions", tenant=tenant.id, reason="watchdog_idle"
+            )
+            _trace.instant(
+                "serve.tenant.evicted",
+                kind="serve",
+                tenant=tenant.id,
+                reason="watchdog_idle",
+            )
+
+    def _fail_pending_locked(self) -> None:
+        err = ServeError("daemon_stopped", "the daemon has been stopped.")
+        for t in self._tenants.values():
+            for _k, _p, pr in t.queue:
+                if pr is not None and not pr.event.is_set():
+                    pr.reject(err)
+            t.queue.clear()
+
+    # --------------------------------------------------------------- health
+    def health(
+        self,
+        *,
+        sync: bool = False,
+        timeout_s: Optional[float] = None,
+        on_failure: str = "raise",
+    ) -> Dict[str, Any]:
+        """Structured daemon health snapshot: per-tenant status, queue
+        depth, ingest/shed totals and idle age, plus daemon capacity and
+        lifetime counts. With ``sync=True`` the snapshot also carries
+        ``"cluster"`` — every rank's obs registry/timeline merged over
+        ``obs.sync_snapshot()``'s single collective round, under the PR 5
+        ``timeout_s``/``on_failure`` contract (a monitoring loop keeps
+        reporting through a preemption with ``on_failure="local"``)."""
+        now = time.monotonic()
+        with self._cond:
+            tenants = {
+                t.id: {
+                    "status": t.status.value,
+                    "queue_depth": len(t.queue),
+                    "queue_capacity": t.capacity,
+                    "ingested": t.ingested,
+                    "processed": t.processed,
+                    "sheds": t.sheds,
+                    "idle_s": now - t.last_activity,
+                }
+                for t in self._tenants.values()
+            }
+            out: Dict[str, Any] = {
+                "running": self._running,
+                "worker_alive": (
+                    self._thread.is_alive() if self._thread else False
+                ),
+                "uptime_s": (
+                    now - self._started_at if self._started_at else 0.0
+                ),
+                "capacity": {
+                    "max_tenants": self._max_tenants,
+                    "active_tenants": len(self._tenants),
+                },
+                "totals": dict(self._totals),
+                "tenants": tenants,
+            }
+        if sync:
+            from torcheval_tpu import obs
+
+            out["cluster"] = obs.sync_snapshot(
+                timeout_s=timeout_s, on_failure=on_failure
+            )
+        return out
+
+    def __repr__(self) -> str:
+        with self._lock:
+            n = len(self._tenants)
+        state = "running" if self._running else "stopped"
+        return f"EvalDaemon({state}, tenants={n}/{self._max_tenants})"

@@ -1,0 +1,106 @@
+"""Structured failure surface of the eval daemon.
+
+Every serve-side failure is an exception with a machine-readable
+``.reason`` (the :class:`~torcheval_tpu.resilience.CheckpointError`
+pattern): a client can branch on the reason without parsing prose, and the
+daemon's obs counters label by the same strings, so a dashboard and an
+except-clause speak one vocabulary.
+
+The hierarchy mirrors the tenant lifecycle:
+
+* :class:`AdmissionError` — ``attach`` refused (``"capacity"``,
+  ``"duplicate_tenant"``, ``"daemon_stopped"``, ``"bad_metrics"``,
+  ``"no_checkpoint"``). Admission control is the front door of load
+  shedding: a daemon at capacity rejects with a reason instead of growing
+  an unbounded tenant table.
+* :class:`BackpressureError` — a ``submit`` shed (``"queue_full"``): the
+  tenant's bounded queue is full and the policy is reject-with-reason,
+  never unbounded growth. Retry later, or submit with ``block=True``.
+* :class:`TenantQuarantinedError` — the tenant was isolated after a fault
+  its own stream caused (``"poisoned_batch"``, ``"nan_policy"``,
+  ``"compute_error"``, ``"step_timeout"``); every other tenant proceeded.
+  The original exception (if any) is ``__cause__``.
+* :class:`TenantEvictedError` — the watchdog (or an explicit
+  ``evict``/``detach(checkpoint=True)``) checkpointed the tenant's state
+  and released its slot; ``.checkpoint`` is the directory to resume from
+  (``attach(..., resume=...)`` restores it bit-identically).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "ServeError",
+    "AdmissionError",
+    "BackpressureError",
+    "TenantError",
+    "TenantQuarantinedError",
+    "TenantEvictedError",
+]
+
+
+class ServeError(RuntimeError):
+    """Base class: every serve failure carries a machine-readable
+    ``reason`` alongside the human message."""
+
+    def __init__(self, reason: str, message: str) -> None:
+        super().__init__(f"[{reason}] {message}")
+        self.reason = reason
+
+
+class AdmissionError(ServeError):
+    """``attach`` refused at the front door (see module doc for reasons)."""
+
+
+class BackpressureError(ServeError):
+    """A ``submit`` was shed: the tenant's bounded queue is full.
+
+    ``tenant`` names the shedding tenant. The queue bound is the
+    load-shedding contract — ingestion never grows without bound, the
+    producer is told *why* (``reason="queue_full"``) and can back off,
+    block (``submit(..., block=True)``) or drop.
+    """
+
+    def __init__(self, reason: str, message: str, *, tenant: str) -> None:
+        super().__init__(reason, message)
+        self.tenant = tenant
+
+
+class TenantError(ServeError):
+    """Base for per-tenant terminal states; ``tenant`` names the tenant."""
+
+    def __init__(self, reason: str, message: str, *, tenant: str) -> None:
+        super().__init__(reason, message)
+        self.tenant = tenant
+
+
+class TenantQuarantinedError(TenantError):
+    """The tenant was quarantined: a fault its own stream caused (poisoned
+    batch, NaN-policy violation, a compute that raised, or a step that
+    outran its deadline) isolated it with this error while every other
+    tenant proceeded. Its accumulated state is considered suspect and is
+    NOT checkpointed; ``detach`` the handle and re-``attach`` to start
+    clean. The triggering exception, when there was one, is ``__cause__``.
+    """
+
+
+class TenantEvictedError(TenantError):
+    """The tenant's slot was reclaimed after its state was checkpointed.
+
+    ``checkpoint`` is the checkpoint directory
+    (``<evict_dir>/<tenant_id>``); ``attach`` the same tenant id with
+    identically-configured metrics and ``resume="auto"``/``"require"`` to
+    restore and continue bit-identically.
+    """
+
+    def __init__(
+        self,
+        reason: str,
+        message: str,
+        *,
+        tenant: str,
+        checkpoint: Optional[str] = None,
+    ) -> None:
+        super().__init__(reason, message, tenant=tenant)
+        self.checkpoint = checkpoint

@@ -1,0 +1,211 @@
+"""Tenant state machine + the client-side handle.
+
+A *tenant* is one eval stream served by the daemon: a
+:class:`~torcheval_tpu.metrics.MetricCollection` it owns, a bounded
+ingestion queue, and a lifecycle status. All device work happens on the
+daemon's worker thread; the :class:`TenantHandle` a client holds only
+enqueues work and waits on promises, so any number of producer threads can
+feed one daemon — the many-producers / one-TPU-consumer topology
+(Podracer, arXiv:2104.06272).
+
+Lifecycle::
+
+    ACTIVE --(poisoned batch / NaN policy / compute raise / step
+              deadline)--> QUARANTINED     (structured error; slot held
+                                            until detach; state suspect,
+                                            never checkpointed)
+    ACTIVE --(watchdog idle deadline / evict() / detach(checkpoint=True))
+           --> EVICTED                     (state folded + checkpointed
+                                            via resilience.save; slot
+                                            freed; reattach resumes
+                                            bit-identically)
+    ACTIVE --(detach())--> DETACHED        (slot freed, state dropped)
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from torcheval_tpu.serve.errors import ServeError
+
+__all__ = ["TenantStatus", "TenantHandle"]
+
+
+class TenantStatus(enum.Enum):
+    ACTIVE = "active"
+    QUARANTINED = "quarantined"
+    EVICTED = "evicted"
+    DETACHED = "detached"
+
+
+class _Promise:
+    """One worker-fulfilled result slot (compute/detach round trips)."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+
+    def resolve(self, value: Any) -> None:
+        self.value = value
+        self.event.set()
+
+    def reject(self, error: BaseException) -> None:
+        self.error = error
+        self.event.set()
+
+    def result(self, timeout: Optional[float]) -> Any:
+        if not self.event.wait(timeout):
+            raise ServeError(
+                "result_timeout",
+                f"daemon did not produce a result within {timeout}s "
+                "(worker busy or stalled; see daemon.health()).",
+            )
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class _Tenant:
+    """Daemon-internal per-tenant record. Mutated only under the daemon
+    lock (status, queue, stats) or on the worker thread (collection)."""
+
+    __slots__ = (
+        "id",
+        "collection",
+        "queue",
+        "capacity",
+        "status",
+        "error",
+        "nan_policy",
+        "watchdog_timeout_s",
+        "step_timeout_s",
+        "last_activity",
+        "ingested",
+        "processed",
+        "sheds",
+        "seq",
+    )
+
+    def __init__(
+        self,
+        tenant_id: str,
+        collection: Any,
+        *,
+        capacity: int,
+        nan_policy: str,
+        watchdog_timeout_s: Optional[float],
+        step_timeout_s: Optional[float],
+        seq: int,
+    ) -> None:
+        self.id = tenant_id
+        self.collection = collection
+        self.queue: deque = deque()
+        self.capacity = capacity
+        self.status = TenantStatus.ACTIVE
+        self.error: Optional[BaseException] = None
+        self.nan_policy = nan_policy
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.step_timeout_s = step_timeout_s
+        self.last_activity = time.monotonic()
+        self.ingested = 0
+        self.processed = 0
+        self.sheds = 0
+        self.seq = seq
+
+
+class TenantHandle:
+    """Client-side handle to one attached tenant.
+
+    Thread-safe: every method takes the daemon lock for its bookkeeping
+    and never touches the device — ``submit`` enqueues, ``compute`` /
+    ``detach`` enqueue a promise and block on the worker's answer. After a
+    quarantine or eviction, every method raises the tenant's structured
+    terminal error (:class:`~torcheval_tpu.serve.TenantQuarantinedError` /
+    :class:`~torcheval_tpu.serve.TenantEvictedError`), so a producer loop
+    finds out on its next call, with the reason attached.
+    """
+
+    __slots__ = ("_daemon", "_tenant")
+
+    def __init__(self, daemon: Any, tenant: _Tenant) -> None:
+        self._daemon = daemon
+        self._tenant = tenant
+
+    # ------------------------------------------------------------- queries
+    @property
+    def tenant_id(self) -> str:
+        return self._tenant.id
+
+    @property
+    def status(self) -> TenantStatus:
+        return self._tenant.status
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The structured terminal error (quarantine/eviction), if any."""
+        return self._tenant.error
+
+    # ---------------------------------------------------------------- ops
+    def submit(
+        self, *args: Any, block: bool = False, timeout: Optional[float] = None
+    ) -> "TenantHandle":
+        """Enqueue one update batch (the metric ``update`` positional
+        args). Returns immediately once queued; the device work happens on
+        the daemon worker. On a full queue: ``block=False`` sheds with
+        :class:`~torcheval_tpu.serve.BackpressureError` (reason
+        ``"queue_full"``), ``block=True`` waits up to ``timeout`` seconds
+        for space (then sheds)."""
+        self._daemon._submit(self._tenant, args, block=block, timeout=timeout)
+        return self
+
+    def compute(self, *, timeout: Optional[float] = None) -> Any:
+        """Drain this tenant's queued batches, close its eval window and
+        return the metric results (the collection's ``compute()`` shape).
+        Blocks up to ``timeout`` seconds for the worker's answer."""
+        return self._daemon._request(self._tenant, "compute", timeout=timeout)
+
+    def sync_compute(
+        self,
+        *,
+        timeout_s: Optional[float] = None,
+        on_failure: str = "raise",
+        timeout: Optional[float] = None,
+    ) -> Any:
+        """Cross-process ``sync_and_compute_collection`` of this tenant's
+        metrics, run on the worker thread under the PR 5 deadline contract
+        (``timeout_s`` bounds the collective rounds; ``on_failure="local"``
+        degrades to this rank's local results). The client blocks until the
+        worker answers, which keeps multi-rank call order in lockstep —
+        call it for the same tenants in the same order on every rank."""
+        return self._daemon._request(
+            self._tenant,
+            "sync_compute",
+            timeout=timeout,
+            payload={"timeout_s": timeout_s, "on_failure": on_failure},
+        )
+
+    def detach(
+        self, *, checkpoint: bool = False, timeout: Optional[float] = None
+    ) -> Optional[str]:
+        """Release this tenant's slot after the worker drains its queue.
+        With ``checkpoint=True`` the state is folded and saved first
+        (returns the checkpoint path — the graceful spelling of eviction);
+        otherwise the state is dropped and ``None`` returns. Detaching an
+        already-quarantined/evicted tenant just clears the slot."""
+        return self._daemon._detach(
+            self._tenant, checkpoint=checkpoint, timeout=timeout
+        )
+
+    def __repr__(self) -> str:
+        t = self._tenant
+        return (
+            f"TenantHandle({t.id!r}, {t.status.value}, "
+            f"queued={len(t.queue)})"
+        )
