@@ -1048,6 +1048,120 @@ def config7_serve_tenants():
     )
 
 
+def config8_cluster():
+    """ISSUE 10: the network front end's price and the migration blackout.
+
+    Three legs over ONE workload (N batches of the config1 shape into a
+    single tenant): (a) ``local_direct`` — the in-process TenantHandle
+    path (PR 8's fast path, the baseline); (b) ``wire_1host`` — the same
+    stream through EvalServer/EvalClient over loopback TCP with
+    idempotent-seq bookkeeping, plus the wire/in-process throughput
+    ratio; (c) ``wire_2host_migration`` — two hosts sharing a checkpoint
+    root, the tenant's host killed mid-stream, measuring the *blackout*:
+    wall time from the first failed submit until that batch is durable on
+    the survivor (failure detection + checkpoint restore + replay)."""
+    import tempfile
+
+    from torcheval_tpu.metrics import MulticlassAccuracy
+    from torcheval_tpu.serve import (
+        EvalClient,
+        EvalDaemon,
+        EvalRouter,
+        EvalServer,
+    )
+
+    n_batches = 8 if _SMOKE else 64
+    batch = 256 if _SMOKE else 8192
+    rng = np.random.default_rng(8)
+    scores = rng.random((batch, NUM_CLASSES)).astype(np.float32)
+    labels = rng.integers(0, NUM_CLASSES, batch)
+    preds = n_batches * batch
+
+    def metrics():
+        return {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)}
+
+    # (a) in-process baseline
+    with EvalDaemon() as daemon:
+        handle = daemon.attach("warm", metrics())
+        handle.submit(scores, labels)
+        handle.compute(timeout=300)
+        handle.detach(timeout=300)
+        handle = daemon.attach("bench", metrics())
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            handle.submit(scores, labels, block=True, timeout=300)
+        handle.compute(timeout=300)
+        local_s = time.perf_counter() - t0
+    _emit_row("config8_cluster_local_direct", preds / local_s, "preds/s")
+
+    # (b) the same stream over loopback TCP
+    with EvalDaemon() as daemon:
+        server = EvalServer(daemon)
+        client = EvalClient(server.endpoint, request_timeout_s=300.0)
+        spec = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+        client.attach("warm", spec)
+        client.submit("warm", scores, labels)
+        client.compute("warm")
+        client.detach("warm")
+        client.attach("bench", spec)
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            client.submit("bench", scores, labels)
+        client.compute("bench")
+        wire_s = time.perf_counter() - t0
+        client.close()
+        server.close()
+    wire_rate = preds / wire_s
+    _emit_row("config8_cluster_wire_1host", wire_rate, "preds/s")
+    _emit_row(
+        "config8_cluster_wire_1host_ratio",
+        wire_rate / (preds / local_s),
+        "x of in-process",
+    )
+
+    # (c) two hosts, victim killed mid-stream: migration blackout
+    root = tempfile.mkdtemp(prefix="torcheval_tpu_bench_cluster_")
+    daemons = [EvalDaemon(evict_dir=root).start() for _ in range(2)]
+    servers = [EvalServer(d) for d in daemons]
+    router = EvalRouter(
+        [s.endpoint for s in servers],
+        request_timeout_s=300.0,
+        connect_timeout_s=5.0,
+        max_attempts=2,
+        backoff_base_s=0.02,
+        backoff_cap_s=0.1,
+    )
+    spec = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+    router.attach("bench", spec)
+    half = n_batches // 2
+    for _ in range(half):
+        router.submit("bench", scores, labels)
+    router.flush("bench")  # durable up to the kill point
+    victim = router.placement()["bench"]
+    idx = [s.endpoint for s in servers].index(victim)
+    servers[idx].close()
+    daemons[idx].stop()
+    t0 = time.perf_counter()
+    # first post-kill submit pays the whole blackout: detection (failed
+    # attempts), checkpoint restore on the survivor, replay of the
+    # booked batch
+    router.submit("bench", scores, labels)
+    blackout_s = time.perf_counter() - t0
+    for _ in range(n_batches - half - 1):
+        router.submit("bench", scores, labels)
+    router.compute("bench")
+    _emit_row(
+        "config8_cluster_wire_2host_migration",
+        blackout_s * 1e3,
+        "ms blackout",
+    )
+    router.close()
+    for s, d in zip(servers, daemons):
+        s.close()
+        if d._running:
+            d.stop()
+
+
 def _measure_dispatch_floor():
     """The tunnel's per-dispatch execution cost, in seconds (see
     :func:`env_dispatch_floor` for why and how). Shared by the end-of-bench
@@ -1132,6 +1246,9 @@ _EXPECTED_ROW_PREFIXES = (
     "config7_serve_tenants_single",
     "config7_serve_tenants_interleaved",
     "config7_serve_tenants_throughput_ratio",
+    "config8_cluster_local_direct",
+    "config8_cluster_wire_1host",
+    "config8_cluster_wire_2host_migration",
     "env_dispatch_floor",
 )
 
@@ -1170,6 +1287,7 @@ def main() -> None:
         config5_explicit_sync_4proc,
         checkpoint_overhead,
         config7_serve_tenants,
+        config8_cluster,
         env_dispatch_floor,
     ):
         try:

@@ -1,0 +1,137 @@
+"""Worker for the cross-host checkpoint portability tests (ISSUE 10
+satellite): each invocation is a FRESH process with its own forced CPU
+device count, so a save and its restore genuinely cross an ``XLA_FLAGS``
+/ mesh boundary the way a migration between differently-sized hosts does.
+
+Modes (argv: <mode> <dir> <device_count> <out_json>):
+
+* ``save_serve``   — run a serve eviction checkpoint (daemon evict) for a
+  replicated-state tenant.
+* ``resume_serve`` — re-attach with ``resume="require"`` on a different
+  device count, stream phase 2, compute.
+* ``save_sharded`` / ``restore_sharded`` — a metric whose vector state is
+  explicitly SHARDED over a mesh axis sized to the device count; restore
+  must succeed on an equal mesh and raise the structured
+  ``CheckpointError("unsupported")`` on an unequal one.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+NUM_CLASSES = 5
+BATCH = 32
+PHASE1, PHASE2 = 3, 2
+VEC = 8  # divisible by every device count the tests use
+
+
+def make_batch(i: int):
+    rng = np.random.default_rng(1234 + i)
+    return (
+        rng.random((BATCH, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, BATCH),
+    )
+
+
+def _sharded_metric(n_devices: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torcheval_tpu.metrics.metric import Metric
+    from torcheval_tpu.metrics.state import zeros_state
+
+    class VecState(Metric):
+        """Minimal metric with one VECTOR state, shardable over 'x'."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self._add_state("v", zeros_state((VEC,), jnp.float32))
+
+        def update(self, x):
+            self.v = self.v + self._input(x)
+            return self
+
+        def compute(self):
+            return jnp.sum(self.v)
+
+        def merge_state(self, metrics):
+            for other in metrics:
+                self.v = self.v + other.v
+            return self
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    return VecState().to(NamedSharding(mesh, P("x")))
+
+
+def main() -> None:
+    mode, directory, n_devices, out_json = (
+        sys.argv[1],
+        sys.argv[2],
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from torcheval_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(n_devices)
+    result = {"devices": len(jax.devices())}
+
+    if mode in ("save_serve", "resume_serve"):
+        from torcheval_tpu.metrics import MulticlassAccuracy
+        from torcheval_tpu.serve import EvalDaemon
+
+        daemon = EvalDaemon(evict_dir=directory).start()
+        if mode == "save_serve":
+            handle = daemon.attach(
+                "porty", {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)}
+            )
+            for i in range(PHASE1):
+                handle.submit(*make_batch(i))
+            result["checkpoint"] = daemon.evict("porty", timeout=120)
+        else:
+            handle = daemon.attach(
+                "porty",
+                {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)},
+                resume="require",
+            )
+            for i in range(PHASE1, PHASE1 + PHASE2):
+                handle.submit(*make_batch(i))
+            result["value"] = float(
+                np.asarray(handle.compute(timeout=120)["acc"])
+            )
+        daemon.stop()
+    elif mode == "save_sharded":
+        import jax.numpy as jnp
+
+        from torcheval_tpu.resilience import save
+
+        m = _sharded_metric(n_devices)
+        m.update(jnp.arange(float(VEC)))
+        result["sharding_replicated"] = bool(
+            m.v.sharding.is_fully_replicated
+        )
+        result["checkpoint"] = save(m, directory)
+        result["value"] = float(np.asarray(m.compute()))
+    elif mode == "restore_sharded":
+        from torcheval_tpu.resilience import CheckpointError, restore
+
+        m = _sharded_metric(n_devices)
+        try:
+            restore(m, directory)
+            result["value"] = float(np.asarray(m.compute()))
+        except CheckpointError as e:
+            result["error_reason"] = e.reason
+            result["error_message"] = str(e)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    with open(out_json, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -190,5 +190,98 @@ class TestIngestHooks(unittest.TestCase):
             self.assertFalse(np.isnan(out[0]).any())
 
 
+class TestHostActions(unittest.TestCase):
+    """Host-level chaos (ISSUE 10): targeting and one-shot semantics of
+    the eval-wire server hooks. The dying actions (``host_kill`` /
+    ``ack_drop``'s ``os._exit``) can only run in a disposable process —
+    that is ``tests/serve/test_cluster_mp.py``'s drill; here the
+    partition directive, the per-tenant submit counting, and the
+    disarmed edges."""
+
+    def tearDown(self):
+        chaos.reset_for_tests()
+
+    def _arm(self, action="host_partition", tenant="bob", step="2"):
+        return mock.patch.dict(
+            os.environ,
+            {
+                "TORCHEVAL_TPU_CHAOS": "1",
+                "TORCHEVAL_TPU_CHAOS_ACTION": action,
+                "TORCHEVAL_TPU_CHAOS_TENANT": tenant,
+                "TORCHEVAL_TPU_CHAOS_STEP": step,
+            },
+        )
+
+    def test_disarmed_gate_is_false(self):
+        with mock.patch.dict(os.environ):
+            os.environ.pop("TORCHEVAL_TPU_CHAOS", None)
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.host_armed())
+
+    def test_partition_fires_at_tenant_step_only_once(self):
+        with self._arm():
+            chaos.reset_for_tests()
+            self.assertTrue(chaos.host_armed())
+            # other tenants and other ops never trip it
+            self.assertIsNone(chaos.on_host_request("submit", "alice"))
+            self.assertIsNone(chaos.on_host_request("compute", "bob"))
+            self.assertIsNone(chaos.on_host_request("submit", "bob"))  # 1
+            self.assertEqual(
+                chaos.on_host_request("submit", "bob"), "partition"  # 2
+            )
+            # one-shot: the counter never matches again
+            self.assertIsNone(chaos.on_host_request("submit", "bob"))
+
+    def test_ack_drop_directive_returned_for_server_to_honor(self):
+        with self._arm(action="ack_drop", step="1"):
+            chaos.reset_for_tests()
+            self.assertEqual(
+                chaos.on_host_request("submit", "bob"), "ack_drop"
+            )
+
+    def test_wildcard_tenant_counts_per_tenant(self):
+        with self._arm(tenant="*", step="2"):
+            chaos.reset_for_tests()
+            self.assertIsNone(chaos.on_host_request("submit", "a"))  # a:1
+            self.assertIsNone(chaos.on_host_request("submit", "b"))  # b:1
+            self.assertEqual(
+                chaos.on_host_request("submit", "a"), "partition"  # a:2
+            )
+
+    def test_host_actions_do_not_arm_other_hooks(self):
+        import numpy as np
+
+        with self._arm():
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.ingest_armed())
+            rng = np.random.default_rng(0)
+            batch = (rng.random((4, 2)).astype(np.float32),)
+            out = chaos.on_ingest("bob", 2, batch)
+            self.assertFalse(np.isnan(out[0]).any())
+            t0 = time.monotonic()
+            chaos.on_sync_round()
+            self.assertLess(time.monotonic() - t0, 0.2)
+
+    def test_ingest_actions_do_not_arm_host_hooks(self):
+        with mock.patch.dict(
+            os.environ,
+            {
+                "TORCHEVAL_TPU_CHAOS": "1",
+                "TORCHEVAL_TPU_CHAOS_ACTION": "poison",
+                "TORCHEVAL_TPU_CHAOS_TENANT": "bob",
+                "TORCHEVAL_TPU_CHAOS_STEP": "1",
+            },
+        ):
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.host_armed())
+            self.assertIsNone(chaos.on_host_request("submit", "bob"))
+
+    def test_missing_step_disarms_with_warning_not_raise(self):
+        with self._arm():
+            os.environ.pop("TORCHEVAL_TPU_CHAOS_STEP")
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.host_armed())
+
+
 if __name__ == "__main__":
     unittest.main()

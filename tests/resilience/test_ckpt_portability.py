@@ -1,0 +1,122 @@
+"""Cross-host checkpoint portability (ISSUE 10 satellite).
+
+The cluster router's migration contract rests on ``resilience.restore``
+accepting a checkpoint written by a DIFFERENT host — in general a host
+with a different ``XLA_FLAGS`` forced device count or mesh. The supported
+contract (documented in docs/robustness.md, "Checkpoint portability"):
+
+* **replicated state restores anywhere** — the payload stores global host
+  values, so a serve eviction checkpoint from an 8-device host resumes
+  bit-identically on a 2-device host (proven here with real fresh
+  processes on each side);
+* **sharded state requires an equal mesh** — state split across a mesh
+  axis restores onto an equal mesh (axis names and sizes) and raises the
+  structured ``CheckpointError(reason="unsupported")`` on any other,
+  instead of silently re-laying the state out across a topology the
+  saver never validated.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import unittest
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_WORKER = os.path.join(_HERE, "mp_portability_worker.py")
+
+sys.path.insert(0, _HERE)
+from mp_portability_worker import (  # noqa: E402
+    NUM_CLASSES,
+    PHASE1,
+    PHASE2,
+    make_batch,
+)
+
+
+def _run(mode: str, directory: str, devices: int) -> dict:
+    out_json = os.path.join(
+        directory, f"{mode}_{devices}.json"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # each worker forces its OWN device count
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, _WORKER, mode, directory, str(devices), out_json],
+        env=env,
+        capture_output=True,
+        timeout=240,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"{mode} (devices={devices}) failed:\n"
+            f"{proc.stdout.decode(errors='replace')[-4000:]}\n"
+            f"{proc.stderr.decode(errors='replace')[-4000:]}"
+        )
+    with open(out_json) as f:
+        return json.load(f)
+
+
+class TestServeCheckpointPortability(unittest.TestCase):
+    """A serve eviction checkpoint (replicated state) crosses device
+    counts: save on 8 devices, resume on 2, bit-identical to the
+    fault-free oracle."""
+
+    def test_evict_on_8_devices_resume_on_2(self):
+        root = tempfile.mkdtemp(prefix="tpu_port_serve_")
+        saved = _run("save_serve", root, 8)
+        self.assertEqual(saved["devices"], 8)
+        self.assertTrue(os.path.isdir(saved["checkpoint"]))
+        resumed = _run("resume_serve", root, 2)
+        self.assertEqual(resumed["devices"], 2)
+        from torcheval_tpu.metrics import MulticlassAccuracy
+
+        oracle = MulticlassAccuracy(num_classes=NUM_CLASSES)
+        for i in range(PHASE1 + PHASE2):
+            oracle.update(*make_batch(i))
+        self.assertEqual(
+            resumed["value"], float(np.asarray(oracle.compute()))
+        )
+
+    def test_resume_on_1_device_also_exact(self):
+        root = tempfile.mkdtemp(prefix="tpu_port_serve1_")
+        _run("save_serve", root, 4)
+        resumed = _run("resume_serve", root, 1)
+        self.assertEqual(resumed["devices"], 1)
+        from torcheval_tpu.metrics import MulticlassAccuracy
+
+        oracle = MulticlassAccuracy(num_classes=NUM_CLASSES)
+        for i in range(PHASE1 + PHASE2):
+            oracle.update(*make_batch(i))
+        self.assertEqual(
+            resumed["value"], float(np.asarray(oracle.compute()))
+        )
+
+
+class TestShardedStatePortability(unittest.TestCase):
+    """Sharded state: equal mesh restores; unequal mesh raises the
+    structured ``unsupported`` reason BEFORE any state write."""
+
+    def test_equal_mesh_restores(self):
+        root = tempfile.mkdtemp(prefix="tpu_port_shard_eq_")
+        saved = _run("save_sharded", root, 8)
+        self.assertFalse(saved["sharding_replicated"])  # genuinely sharded
+        restored = _run("restore_sharded", root, 8)
+        self.assertNotIn("error_reason", restored)
+        self.assertEqual(restored["value"], saved["value"])
+
+    def test_unequal_mesh_axis_raises_structured_unsupported(self):
+        root = tempfile.mkdtemp(prefix="tpu_port_shard_ne_")
+        _run("save_sharded", root, 8)
+        restored = _run("restore_sharded", root, 4)
+        self.assertEqual(restored.get("error_reason"), "unsupported")
+        self.assertIn("mesh", restored["error_message"])
+
+
+if __name__ == "__main__":
+    unittest.main()

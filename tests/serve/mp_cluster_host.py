@@ -1,0 +1,54 @@
+"""One eval-service host process for the cluster host-kill drill
+(ISSUE 10 acceptance).
+
+Runs an ``EvalDaemon`` (evict_dir = the SHARED checkpoint root every host
+in the drill mounts) behind an ``EvalServer`` bound to port 0, publishes
+the OS-assigned port atomically (``<tag>.port.tmp`` -> ``<tag>.port``),
+then parks. Chaos is armed per-host through the environment the launcher
+sets (``host_kill`` / ``ack_drop`` / ``host_partition`` fire inside the
+server's request dispatch); a killed host leaves nothing behind — its
+tenants' only survivors are the shared-root checkpoints and the router's
+client-side replay buffers, which is the point of the drill.
+
+Run:  python mp_cluster_host.py <outdir> <tag> <ckpt_root>
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    outdir, tag, ckpt_root = sys.argv[1], sys.argv[2], sys.argv[3]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from torcheval_tpu import obs
+    from torcheval_tpu.serve import EvalDaemon, EvalServer
+
+    obs.enable()
+    daemon = EvalDaemon(evict_dir=ckpt_root).start()
+    server = EvalServer(daemon)  # port 0: OS-assigned, CI-lane safe
+
+    os.makedirs(outdir, exist_ok=True)
+    port_path = os.path.join(outdir, f"{tag}.port")
+    tmp = port_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(server.address[1]))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, port_path)  # readers never see a partial port
+
+    # park until the launcher terminates us (or chaos kills us first);
+    # the stop file is the graceful path so CI teardown is deterministic
+    stop_path = os.path.join(outdir, f"{tag}.stop")
+    while not os.path.exists(stop_path):
+        time.sleep(0.05)
+    server.close()
+    daemon.stop()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,506 @@
+"""`EvalClient` reliability-stack tests (ISSUE 10): deadline-knob boundary
+validation (the PR 8 ``_check_timeout_s`` 5-degenerate-values pattern),
+retry/backoff on retryable errors, per-host circuit breaker, bounded
+in-flight, and the replay/migration bookkeeping.
+
+All sockets bind port 0 (OS-assigned).
+"""
+
+import socket
+import threading
+import time
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.serve import (
+    BackpressureError,
+    EvalClient,
+    EvalDaemon,
+    EvalServer,
+    WireError,
+    metric_spec,
+)
+
+NUM_CLASSES = 5
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, n),
+    )
+
+
+def _silent_server():
+    """A TCP listener that accepts and never answers — the half-dead
+    host shape a partition presents. Returns (endpoint, closer)."""
+    sock = socket.create_server(("127.0.0.1", 0))
+    conns = []
+
+    def loop():
+        while True:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            conns.append(conn)  # hold it open, say nothing
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def close():
+        try:
+            sock.close()
+        except OSError:
+            pass
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    host, port = sock.getsockname()[:2]
+    return f"{host}:{port}", close
+
+
+class TestDeadlineKnobValidation(unittest.TestCase):
+    """ISSUE 10 satellite: every new client/router deadline knob goes
+    through the PR 8 ``_check_timeout_s`` boundary check — NaN/inf/<=0
+    raise ``ValueError`` BEFORE any socket or thread wait exists."""
+
+    DEGENERATE = (0, -1.0, float("nan"), float("inf"), "5")
+
+    def test_client_constructor_knobs_rejected(self):
+        for knob in (
+            "request_timeout_s",
+            "connect_timeout_s",
+            "backoff_base_s",
+            "backoff_cap_s",
+            "breaker_reset_s",
+        ):
+            for bad in self.DEGENERATE:
+                with self.assertRaisesRegex(ValueError, knob):
+                    EvalClient("127.0.0.1:1", **{knob: bad})
+
+    def test_per_call_timeout_rejected_before_any_socket(self):
+        # endpoint is unroutable on purpose: validation must fire first
+        client = EvalClient("127.0.0.1:1")
+        for bad in self.DEGENERATE:
+            with self.assertRaisesRegex(ValueError, "timeout_s"):
+                client.health(timeout_s=bad)
+
+    def test_integer_knobs_validated(self):
+        for knob in (
+            "max_attempts",
+            "max_in_flight",
+            "breaker_threshold",
+            "replay_capacity",
+        ):
+            for bad in (0, -1, 1.5):
+                with self.assertRaisesRegex(ValueError, knob):
+                    EvalClient("127.0.0.1:1", **{knob: bad})
+
+    def test_bad_address_rejected(self):
+        with self.assertRaisesRegex(ValueError, "address"):
+            EvalClient("no-port-here")
+
+    def test_valid_knobs_accepted(self):
+        # no over-rejection: positive finite values and None deadlines
+        client = EvalClient(
+            "127.0.0.1:1",
+            request_timeout_s=None,
+            connect_timeout_s=0.5,
+            backoff_base_s=0.01,
+        )
+        client.close()
+
+    def test_daemon_stop_timeout_validated(self):
+        # the same boundary check guards EvalDaemon.stop's join budget
+        daemon = EvalDaemon().start()
+        for bad in self.DEGENERATE:
+            with self.assertRaisesRegex(ValueError, "timeout_s"):
+                daemon.stop(timeout=bad)
+        daemon.stop(timeout=5.0)  # valid value still stops
+
+    def test_daemon_drain_timeout_validated(self):
+        daemon = EvalDaemon().start()
+        self.addCleanup(daemon.stop)
+        for bad in self.DEGENERATE:
+            with self.assertRaisesRegex(ValueError, "timeout_s"):
+                daemon.drain(timeout=bad)
+
+
+class TestTransportFailures(unittest.TestCase):
+    def test_connection_refused_is_retryable_transport_error(self):
+        # bind-then-close: nothing listens on the port afterwards
+        probe = socket.create_server(("127.0.0.1", 0))
+        host, port = probe.getsockname()[:2]
+        probe.close()
+        client = EvalClient(
+            f"{host}:{port}",
+            max_attempts=2,
+            backoff_base_s=0.01,
+            connect_timeout_s=0.5,
+        )
+        self.addCleanup(client.close)
+        t0 = time.monotonic()
+        with self.assertRaises(WireError) as ctx:
+            client.health()
+        self.assertEqual(ctx.exception.reason, "transport")
+        self.assertTrue(ctx.exception.retryable)
+        self.assertIn(str(port), ctx.exception.endpoint)
+        # two attempts with one small backoff between them
+        self.assertLess(time.monotonic() - t0, 5.0)
+
+    def test_silent_server_hits_request_timeout(self):
+        endpoint, close = _silent_server()
+        self.addCleanup(close)
+        client = EvalClient(
+            endpoint,
+            request_timeout_s=0.2,
+            max_attempts=2,
+            backoff_base_s=0.01,
+        )
+        self.addCleanup(client.close)
+        with self.assertRaises(WireError) as ctx:
+            client.health()
+        self.assertEqual(ctx.exception.reason, "request_timeout")
+        self.assertTrue(ctx.exception.retryable)
+
+    def test_circuit_breaker_opens_then_half_opens(self):
+        endpoint, close = _silent_server()
+        self.addCleanup(close)
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.disable)
+        client = EvalClient(
+            endpoint,
+            request_timeout_s=0.1,
+            max_attempts=1,
+            backoff_base_s=0.01,
+            breaker_threshold=2,
+            breaker_reset_s=0.3,
+        )
+        self.addCleanup(client.close)
+        for _ in range(2):  # reach the threshold with real timeouts
+            with self.assertRaises(WireError):
+                client.health()
+        # open: fail fast, no socket wait (far quicker than the 0.1s
+        # request deadline)
+        t0 = time.monotonic()
+        with self.assertRaises(WireError) as ctx:
+            client.health()
+        self.assertEqual(ctx.exception.reason, "circuit_open")
+        self.assertLess(time.monotonic() - t0, 0.05)
+        # after breaker_reset_s a half-open probe goes through to the
+        # socket again (and times out against the silent server)
+        time.sleep(0.35)
+        with self.assertRaises(WireError) as ctx:
+            client.health()
+        self.assertEqual(ctx.exception.reason, "request_timeout")
+        snap = obs.snapshot()
+        open_events = [
+            v
+            for k, v in snap["counters"].items()
+            if k.startswith("serve.client.breaker{")
+            and "event=open" in k
+        ]
+        self.assertTrue(open_events)
+
+    def test_breaker_closes_on_success(self):
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(
+            server.endpoint, breaker_threshold=2, breaker_reset_s=0.1
+        )
+        self.addCleanup(client.close)
+        client._breaker_failure()
+        client._breaker_failure()  # open
+        time.sleep(0.15)
+        client.health()  # half-open probe succeeds -> closed
+        self.assertEqual(client._breaker_failures, 0)
+
+
+class TestRetryOnRetryableServeErrors(unittest.TestCase):
+    def test_backpressure_shed_retries_until_worker_drains(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.disable)
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(
+            server.endpoint,
+            max_attempts=8,
+            backoff_base_s=0.05,
+            backoff_cap_s=0.2,
+        )
+        self.addCleanup(client.close)
+        client.attach(
+            "t",
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+            queue_capacity=1,
+        )
+        scores, labels = _batch()
+        # a burst beyond the queue bound: some submits shed server-side
+        # and the client's retry loop absorbs them (retryable=True)
+        for _ in range(6):
+            self.assertTrue(client.submit("t", scores, labels))
+        got = client.compute("t")
+        oracle = MulticlassAccuracy(num_classes=NUM_CLASSES)
+        for _ in range(6):
+            oracle.update(scores, labels)
+        self.assertEqual(
+            float(np.asarray(got["acc"])),
+            float(np.asarray(oracle.compute())),
+        )
+        # exactly-once even through sheds+retries
+        health = client.health()
+        self.assertEqual(health["tenants"]["t"]["processed"], 6)
+
+    def test_non_retryable_error_surfaces_immediately(self):
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(
+            server.endpoint, max_attempts=1, backoff_base_s=0.01
+        )
+        self.addCleanup(client.close)
+        client.attach(
+            "t",
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+            queue_capacity=1,
+        )
+        scores, labels = _batch()
+        # max_attempts=1: the shed surfaces as the structured error with
+        # its retryable flag for the CALLER to act on
+        daemon._tenants["t"].capacity = 0  # wedge the queue artificially
+        with self.assertRaises(BackpressureError) as ctx:
+            client.submit("t", scores, labels)
+        self.assertTrue(ctx.exception.retryable)
+        # the rejected batch left no ghost in the replay buffer
+        st = client._tenant_state("t")
+        self.assertEqual(len(st.replay), 0)
+        self.assertEqual(st.next_seq, 1)
+
+
+class TestBoundedInFlight(unittest.TestCase):
+    def test_in_flight_bound_holds_under_concurrency(self):
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(server.endpoint, max_in_flight=2)
+        self.addCleanup(client.close)
+        peak = [0]
+        live = [0]
+        lock = threading.Lock()
+        orig = client._checkout
+
+        def tracking_checkout():
+            with lock:
+                live[0] += 1
+                peak[0] = max(peak[0], live[0])
+            return orig()
+
+        orig_in = client._checkin
+
+        def tracking_checkin(sock):
+            with lock:
+                live[0] -= 1
+            orig_in(sock)
+
+        orig_discard = client._discard
+
+        def tracking_discard(sock):
+            with lock:
+                live[0] -= 1
+            orig_discard(sock)
+
+        client._checkout = tracking_checkout
+        client._checkin = tracking_checkin
+        client._discard = tracking_discard
+        threads = [
+            threading.Thread(target=client.health) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.assertLessEqual(peak[0], 2)
+
+
+class TestAmbiguousRejectKeepsBooking(unittest.TestCase):
+    """Review finding (ISSUE 10): a structured reject that arrives AFTER
+    an ambiguous attempt of the same seq must NOT roll the seq back — an
+    earlier send may have been admitted, and reusing the seq would hand
+    it to the next batch, which dedup then silently drops."""
+
+    def _scripted_server(self, script):
+        """One-connection-at-a-time server driven by a list of actions:
+        "drop" (close without answering) or ("error", err_dict)."""
+        from torcheval_tpu.serve.wire import recv_frame, send_frame
+
+        sock = socket.create_server(("127.0.0.1", 0))
+        self.addCleanup(sock.close)
+
+        def loop():
+            while script:
+                try:
+                    conn, _ = sock.accept()
+                except OSError:
+                    return
+                with conn:
+                    while script:
+                        try:
+                            frame = recv_frame(conn)
+                        except Exception:  # noqa: BLE001
+                            break
+                        if frame is None:
+                            break
+                        action = script.pop(0)
+                        if action == "drop":
+                            break  # close mid-request: ambiguous
+                        if action[0] == "ok":
+                            send_frame(conn, {"ok": True, **action[1]})
+                            continue
+                        send_frame(
+                            conn, {"ok": False, "error": action[1]}
+                        )
+
+        threading.Thread(target=loop, daemon=True).start()
+        host, port = sock.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def _client_with_tenant(self, endpoint):
+        client = EvalClient(
+            endpoint,
+            max_attempts=2,
+            backoff_base_s=0.01,
+            request_timeout_s=5.0,
+        )
+        self.addCleanup(client.close)
+        from torcheval_tpu.serve.client import _ClientTenant
+
+        with client._lock:
+            client._tenants["t"] = _ClientTenant(0)
+        return client
+
+    def test_reject_after_ambiguous_attempt_stays_booked(self):
+        quarantine = {
+            "type": "TenantQuarantinedError",
+            "reason": "poisoned_batch",
+            "message": "bad",
+            "tenant": "t",
+            "retryable": False,
+        }
+        endpoint = self._scripted_server(["drop", ("error", quarantine)])
+        client = self._client_with_tenant(endpoint)
+        scores, labels = _batch()
+        from torcheval_tpu.serve import TenantQuarantinedError
+
+        with self.assertRaises(TenantQuarantinedError) as ctx:
+            client.submit("t", scores, labels)
+        self.assertTrue(getattr(ctx.exception, "batch_booked", False))
+        st = client._tenant_state("t")
+        self.assertEqual([s for s, _ in st.replay], [1])  # still booked
+        self.assertEqual(st.next_seq, 2)  # seq 1 is NEVER reused
+
+    def test_booked_transport_failure_resends_before_next_batch(self):
+        """Review finding (ISSUE 10): a direct (router-less) caller that
+        swallows a booked transport failure and keeps submitting must not
+        let a NEW seq advance the daemon watermark past the undelivered
+        one — the next call re-delivers the booked tail first."""
+        script = [
+            "drop",
+            "drop",  # both attempts of seq 1 die: booked, needs_resend
+            ("ok", {"applied": True, "acked_seq": 0}),  # resend of seq 1
+            ("ok", {"applied": True, "acked_seq": 0}),  # fresh seq 2
+        ]
+        endpoint = self._scripted_server(script)
+        client = self._client_with_tenant(endpoint)
+        scores, labels = _batch()
+        with self.assertRaises(WireError) as ctx:
+            client.submit("t", scores, labels)
+        self.assertTrue(getattr(ctx.exception, "batch_booked", False))
+        st = client._tenant_state("t")
+        self.assertTrue(st.needs_resend)
+        # next submit: seq 1 is re-delivered BEFORE seq 2 goes out
+        self.assertTrue(client.submit("t", scores, labels))
+        self.assertFalse(st.needs_resend)
+        self.assertEqual([s for s, _ in st.replay], [1, 2])
+        self.assertEqual(script, [])  # all four scripted exchanges ran
+
+    def test_clean_first_attempt_reject_rolls_back(self):
+        quarantine = {
+            "type": "TenantQuarantinedError",
+            "reason": "poisoned_batch",
+            "message": "bad",
+            "tenant": "t",
+            "retryable": False,
+        }
+        endpoint = self._scripted_server([("error", quarantine)])
+        client = self._client_with_tenant(endpoint)
+        scores, labels = _batch()
+        from torcheval_tpu.serve import TenantQuarantinedError
+
+        with self.assertRaises(TenantQuarantinedError):
+            client.submit("t", scores, labels)
+        st = client._tenant_state("t")
+        self.assertEqual(len(st.replay), 0)  # un-booked: never admitted
+        self.assertEqual(st.next_seq, 1)
+
+
+class TestMigrationBookkeeping(unittest.TestCase):
+    def test_export_adopt_replays_only_undurable_tail(self):
+        root_daemon = EvalDaemon().start()
+        server = EvalServer(root_daemon)
+        self.addCleanup(root_daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(server.endpoint)
+        self.addCleanup(client.close)
+        client.attach(
+            "t",
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+        )
+        scores, labels = _batch()
+        for _ in range(4):
+            client.submit("t", scores, labels)
+        client.flush("t")  # seqs 1-4 durable
+        for _ in range(2):
+            client.submit("t", scores, labels)  # seqs 5-6 un-durable
+        exported = client.export_tenant("t")
+        self.assertEqual(exported["durable_seq"], 4)
+        self.assertEqual([s for s, _ in exported["replay"]], [5, 6])
+        # adopt on a FRESH host (new daemon, fresh tenant) restored at
+        # seq 4: only 5 and 6 replay; entries <= the restored watermark
+        # are pruned without touching the wire
+        daemon2 = EvalDaemon().start()
+        server2 = EvalServer(daemon2)
+        self.addCleanup(daemon2.stop)
+        self.addCleanup(server2.close)
+        client2 = EvalClient(server2.endpoint)
+        self.addCleanup(client2.close)
+        client2.attach(
+            "t",
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+        )
+        replayed = client2.adopt_tenant("t", exported, restored_seq=4)
+        self.assertEqual(replayed, 2)
+        client2.compute("t")  # drain the worker queue before reading stats
+        health = daemon2.health()
+        self.assertEqual(health["tenants"]["t"]["processed"], 2)
+        st = client2._tenant_state("t")
+        self.assertEqual(st.next_seq, 7)  # numbering continues
+
+
+if __name__ == "__main__":
+    unittest.main()

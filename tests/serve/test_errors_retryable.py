@@ -1,0 +1,143 @@
+"""The shared retry-classification source of truth (ISSUE 10 satellite).
+
+Every serve failure carries ``retryable``: the wire client's retry loop,
+the router's failover and any local caller branch on the SAME bit, and
+the wire marshals it with the error so remote callers see exactly what
+local callers would. These tests pin the classification table —
+backpressure and capacity rejects are transient (True); quarantines,
+duplicate ids and bad metric specs need caller action (False) — and that
+``encode_error``/``decode_error`` round-trips class, reason, extras and
+the flag.
+"""
+
+import unittest
+
+from torcheval_tpu.resilience.snapshot import CheckpointError
+from torcheval_tpu.serve import (
+    AdmissionError,
+    BackpressureError,
+    ServeError,
+    TenantError,
+    TenantEvictedError,
+    TenantQuarantinedError,
+    WireError,
+)
+from torcheval_tpu.serve.wire import decode_error, encode_error
+
+
+class TestRetryableClassification(unittest.TestCase):
+    def test_backpressure_always_retryable(self):
+        e = BackpressureError("queue_full", "full", tenant="t")
+        self.assertTrue(e.retryable)
+
+    def test_admission_capacity_retryable(self):
+        self.assertTrue(AdmissionError("capacity", "at max_tenants").retryable)
+
+    def test_admission_non_capacity_not_retryable(self):
+        for reason in (
+            "duplicate_tenant",
+            "bad_metrics",
+            "daemon_stopped",
+            "no_checkpoint",
+            "draining",
+        ):
+            self.assertFalse(
+                AdmissionError(reason, "nope").retryable, reason
+            )
+
+    def test_quarantine_never_retryable(self):
+        for reason in (
+            "poisoned_batch",
+            "nan_policy",
+            "compute_error",
+            "step_timeout",
+        ):
+            self.assertFalse(
+                TenantQuarantinedError(reason, "bad", tenant="t").retryable,
+                reason,
+            )
+
+    def test_eviction_not_retryable(self):
+        # the tenant must be re-attached (a different request), not
+        # the failed op retried verbatim
+        e = TenantEvictedError(
+            "watchdog_idle", "gone", tenant="t", checkpoint="/ckpt"
+        )
+        self.assertFalse(e.retryable)
+
+    def test_generic_serve_error_not_retryable(self):
+        for reason in ("daemon_stopped", "draining", "unknown_tenant"):
+            self.assertFalse(ServeError(reason, "nope").retryable, reason)
+
+    def test_wire_transport_family_retryable_protocol_not(self):
+        for reason in ("transport", "request_timeout", "circuit_open"):
+            self.assertTrue(WireError(reason, "net").retryable, reason)
+        self.assertFalse(WireError("protocol", "skew").retryable)
+
+
+class TestErrorMarshalling(unittest.TestCase):
+    """encode/decode reconstructs class, reason, extras AND retryable."""
+
+    def _roundtrip(self, exc):
+        return decode_error(encode_error(exc))
+
+    def test_backpressure_roundtrip(self):
+        got = self._roundtrip(
+            BackpressureError("queue_full", "queue is full", tenant="bob")
+        )
+        self.assertIsInstance(got, BackpressureError)
+        self.assertEqual(got.reason, "queue_full")
+        self.assertEqual(got.tenant, "bob")
+        self.assertTrue(got.retryable)
+        # the [reason] prefix is composed once, not stacked per hop
+        self.assertEqual(str(got).count("[queue_full]"), 1)
+
+    def test_quarantine_roundtrip(self):
+        got = self._roundtrip(
+            TenantQuarantinedError("nan_policy", "poisoned", tenant="bob")
+        )
+        self.assertIsInstance(got, TenantQuarantinedError)
+        self.assertEqual((got.reason, got.tenant), ("nan_policy", "bob"))
+        self.assertFalse(got.retryable)
+
+    def test_eviction_roundtrip_carries_checkpoint(self):
+        got = self._roundtrip(
+            TenantEvictedError(
+                "watchdog_idle", "gone", tenant="carol", checkpoint="/c/k"
+            )
+        )
+        self.assertIsInstance(got, TenantEvictedError)
+        self.assertEqual(got.checkpoint, "/c/k")
+
+    def test_admission_and_tenant_error_roundtrip(self):
+        got = self._roundtrip(AdmissionError("capacity", "full house"))
+        self.assertIsInstance(got, AdmissionError)
+        self.assertTrue(got.retryable)
+        got = self._roundtrip(TenantError("weird", "odd", tenant="t"))
+        self.assertIsInstance(got, TenantError)
+
+    def test_checkpoint_error_crosses_the_wire(self):
+        # attach(resume=...) restore failures surface remotely with the
+        # structured reason intact
+        got = self._roundtrip(CheckpointError("schema_mismatch", "drift"))
+        self.assertIsInstance(got, CheckpointError)
+        self.assertEqual(got.reason, "schema_mismatch")
+        self.assertFalse(getattr(got, "retryable", False))
+
+    def test_value_error_crosses_as_value_error(self):
+        got = self._roundtrip(ValueError("timeout_s must be positive"))
+        self.assertIsInstance(got, ValueError)
+        self.assertIn("timeout_s", str(got))
+
+    def test_unknown_type_decodes_as_generic_serve_error(self):
+        got = decode_error(
+            {"type": "SomethingNew", "reason": "later", "message": "m",
+             "retryable": True}
+        )
+        self.assertIsInstance(got, ServeError)
+        self.assertEqual(got.reason, "later")
+        self.assertTrue(got.retryable)  # the wire flag is the truth
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,295 @@
+"""`EvalRouter` tests (ISSUE 10): placement, probe-driven failure
+detection, host-death migration with checkpoint+replay exactness, and
+graceful drain. The real multi-process host-kill drill lives in
+``test_cluster_mp.py``; here the "dead host" is a closed server socket,
+which exercises the identical client/router recovery machinery in one
+process. All sockets bind port 0.
+"""
+
+import tempfile
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.serve import (
+    EvalDaemon,
+    EvalRouter,
+    EvalServer,
+    ServeError,
+)
+
+NUM_CLASSES = 5
+SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, n),
+    )
+
+
+def _oracle(batches):
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for s, l in batches:
+        m.update(s, l)
+    return float(np.asarray(m.compute()))
+
+
+class _ClusterMixin:
+    N_HOSTS = 2
+
+    def setUp(self):
+        obs.reset()
+        self.root = tempfile.mkdtemp(prefix="tpu_router_test_")
+        self.daemons, self.servers = [], []
+        for _ in range(self.N_HOSTS):
+            daemon = EvalDaemon(evict_dir=self.root).start()
+            server = EvalServer(daemon)
+            self.daemons.append(daemon)
+            self.servers.append(server)
+            self.addCleanup(daemon.stop)
+            self.addCleanup(server.close)
+        self.router = EvalRouter(
+            [s.endpoint for s in self.servers],
+            request_timeout_s=10.0,
+            connect_timeout_s=1.0,
+            max_attempts=2,
+            backoff_base_s=0.01,
+        )
+        self.addCleanup(self.router.close)
+
+    def _spread_tenants(self, per_host=3, prefix="t"):
+        """Attach tenants chosen so EVERY host holds ``per_host`` of them.
+        Rendezvous placement is deterministic but endpoint strings carry
+        ephemeral ports, so fixed names could all land on one host —
+        instead we consult the router's own placement function and pick
+        ids until both hosts are covered."""
+        counts = {ep: 0 for ep in self.router.endpoints}
+        ids = []
+        for i in range(256):
+            if min(counts.values()) >= per_host:
+                break
+            tid = f"{prefix}{i}"
+            ep = self.router._place(tid)
+            if counts[ep] >= per_host:
+                continue
+            self.router.attach(tid, SPEC)
+            counts[ep] += 1
+            ids.append(tid)
+        placement = self.router.placement()
+        self.assertEqual(
+            len(set(placement.values())),
+            self.N_HOSTS,
+            f"tenants all landed on one host: {placement}",
+        )
+        return ids
+
+    def _kill_host(self, endpoint):
+        idx = [s.endpoint for s in self.servers].index(endpoint)
+        self.servers[idx].close()
+        self.daemons[idx].stop()
+
+
+class TestPlacement(_ClusterMixin, unittest.TestCase):
+    def test_placement_is_deterministic(self):
+        self._spread_tenants()
+        p1 = self.router.placement()
+        router2 = EvalRouter([s.endpoint for s in self.servers])
+        self.addCleanup(router2.close)
+        for tid, ep in p1.items():
+            self.assertEqual(router2._place(tid), ep)
+
+    def test_survivor_placement_unchanged_by_host_death(self):
+        # minimal movement: killing host X never reshuffles tenants
+        # already placed on host Y
+        ids = self._spread_tenants()
+        placement = self.router.placement()
+        victim = placement[ids[0]]
+        survivors_before = {
+            t: ep for t, ep in placement.items() if ep != victim
+        }
+        self._kill_host(victim)
+        self.router.health()  # probe detects, migrates
+        after = self.router.placement()
+        for t, ep in survivors_before.items():
+            self.assertEqual(after[t], ep)
+
+    def test_router_deadline_knobs_validated_at_construction(self):
+        # the client kwargs a router fans out are validated by the same
+        # _check_timeout_s boundary before any socket exists
+        for bad in (0, -1.0, float("nan"), float("inf"), "5"):
+            with self.assertRaisesRegex(ValueError, "request_timeout_s"):
+                EvalRouter(["127.0.0.1:1"], request_timeout_s=bad)
+
+    def test_duplicate_attach_rejected(self):
+        self.router.attach("a", SPEC)
+        with self.assertRaises(ServeError) as ctx:
+            self.router.attach("a", SPEC)
+        self.assertEqual(ctx.exception.reason, "duplicate_tenant")
+
+
+class TestFailureMigration(_ClusterMixin, unittest.TestCase):
+    def test_host_death_mid_stream_migrates_and_matches_oracle(self):
+        """The core ISSUE 10 claim, in-process: host dies mid-window;
+        its tenants finish on the survivor; every tenant's compute is
+        bit-identical to a fault-free oracle — checkpointed batches come
+        back through the shared root, un-durable ones through replay."""
+        obs.enable()
+        self.addCleanup(obs.disable)
+        ids = self._spread_tenants()
+        streams = {tid: [_batch(i), _batch(i + 100), _batch(i + 200)]
+                   for i, tid in enumerate(ids)}
+        for tid in ids:
+            self.router.submit(tid, *streams[tid][0])
+            self.router.flush(tid)  # batch 1 durable in the shared root
+            self.router.submit(tid, *streams[tid][1])  # un-durable tail
+        placement = self.router.placement()
+        victim = placement[ids[0]]
+        victims = [t for t, ep in placement.items() if ep == victim]
+        self._kill_host(victim)
+        # next submit hits the dead host -> transport failure -> the
+        # router migrates ALL its tenants and replays the tail
+        for tid in ids:
+            self.router.submit(tid, *streams[tid][2])
+        for tid in ids:
+            got = float(np.asarray(self.router.compute(tid)["acc"]))
+            self.assertEqual(got, _oracle(streams[tid]), tid)
+        after = self.router.placement()
+        for tid in victims:
+            self.assertNotEqual(after[tid], victim)
+        # zero duplicate application on the survivor: its per-tenant
+        # processed counts equal the batches it actually owns (replayed
+        # tail + post-migration) — the checkpointed batch is NOT re-run
+        survivor = next(ep for ep in self.router.endpoints if ep != victim)
+        sd = self.daemons[
+            [s.endpoint for s in self.servers].index(survivor)
+        ]
+        health = sd.health()
+        for tid in victims:
+            self.assertEqual(health["tenants"][tid]["processed"], 2)
+            self.assertEqual(health["tenants"][tid]["dupes"], 0)
+        snap = obs.snapshot()
+        migrations = [
+            v
+            for k, v in snap["counters"].items()
+            if k.startswith("serve.router.migrations{")
+        ]
+        self.assertEqual(sum(migrations), float(len(victims)))
+        # every victim replays its un-durable batch 2; the tenant whose
+        # submit DETECTED the death additionally replays the in-flight
+        # batch 3 it had booked (the router must not also resubmit it
+        # fresh — that would double-apply)
+        replays = [
+            v
+            for k, v in snap["counters"].items()
+            if k.startswith("serve.router.replays{")
+        ]
+        self.assertEqual(sum(replays), float(len(victims) + 1))
+
+    def test_probe_failure_detects_and_migrates(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        ids = self._spread_tenants()
+        victim = self.router.placement()[ids[0]]
+        self._kill_host(victim)
+        report = self.router.health()
+        self.assertIsNone(report["hosts"][victim])
+        self.assertNotIn(victim, report["alive"])
+        for tid, ep in self.router.placement().items():
+            self.assertNotEqual(ep, victim)
+        snap = obs.snapshot()
+        self.assertTrue(
+            any(
+                k.startswith("serve.router.probe_failures{")
+                for k in snap["counters"]
+            )
+        )
+
+    def test_health_probe_fails_fast_on_silent_host(self):
+        """A partitioned host (answers TCP, never replies) must not
+        blind the failure detector for the full retry ladder: probes run
+        single-attempt under probe_timeout_s."""
+        import socket as _socket
+        import time as _time
+
+        silent = _socket.create_server(("127.0.0.1", 0))
+        self.addCleanup(silent.close)
+        silent_ep = f"127.0.0.1:{silent.getsockname()[1]}"
+        router = EvalRouter(
+            [self.servers[0].endpoint, silent_ep],
+            probe_timeout_s=0.3,
+            request_timeout_s=30.0,  # the probe must NOT use this
+            connect_timeout_s=1.0,
+        )
+        self.addCleanup(router.close)
+        t0 = _time.monotonic()
+        report = router.health()
+        elapsed = _time.monotonic() - t0
+        self.assertIsNone(report["hosts"][silent_ep])
+        self.assertIsNotNone(report["hosts"][self.servers[0].endpoint])
+        self.assertLess(elapsed, 5.0)
+
+    def test_all_hosts_dead_raises_no_hosts(self):
+        self.router.attach("a", SPEC)
+        for server in self.servers:
+            self._kill_host(server.endpoint)
+        self.router.health()
+        with self.assertRaises(ServeError) as ctx:
+            self.router.attach("b", SPEC)
+        self.assertEqual(ctx.exception.reason, "no_hosts")
+
+
+class TestDrain(_ClusterMixin, unittest.TestCase):
+    def test_drain_migrates_with_empty_tail(self):
+        """Graceful drain: the host checkpoints everything, so migration
+        replays nothing and results stay oracle-exact."""
+        obs.enable()
+        self.addCleanup(obs.disable)
+        ids = self._spread_tenants()
+        streams = {tid: [_batch(i), _batch(i + 50)]
+                   for i, tid in enumerate(ids)}
+        for tid in ids:
+            self.router.submit(tid, *streams[tid][0])
+        placement = self.router.placement()
+        victim = placement[ids[0]]
+        victims = [t for t, ep in placement.items() if ep == victim]
+        out = self.router.drain(victim)
+        self.assertEqual(sorted(out["migrated"]), sorted(victims))
+        self.assertEqual(sorted(out["drained"]), sorted(victims))
+        self.assertNotIn(victim, self.router.alive)
+        for tid in ids:
+            self.router.submit(tid, *streams[tid][1])
+            got = float(np.asarray(self.router.compute(tid)["acc"]))
+            self.assertEqual(got, _oracle(streams[tid]), tid)
+        snap = obs.snapshot()
+        drain_migrations = snap["counters"].get(
+            "serve.router.migrations{reason=drain}", 0.0
+        )
+        self.assertEqual(drain_migrations, float(len(victims)))
+        # nothing was un-durable after a drain: zero replays
+        self.assertFalse(
+            any(
+                k.startswith("serve.router.replays{")
+                for k in snap["counters"]
+            )
+        )
+
+    def test_migration_span_lands_in_timeline(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        ids = self._spread_tenants()
+        victim = self.router.placement()[ids[0]]
+        self.router.drain(victim)
+        import json
+
+        trace = json.loads(obs.chrome_trace())
+        names = [e["name"] for e in trace["traceEvents"]]
+        self.assertIn("serve.router.migrate", names)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -87,6 +87,18 @@ class TestBenchHygiene(unittest.TestCase):
                 "serving throughput contract (ROADMAP item 3) loses its "
                 "regression pin",
             )
+        for row in (
+            "config8_cluster_local_direct",
+            "config8_cluster_wire_1host",
+            "config8_cluster_wire_2host_migration",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the cluster "
+                "wire-overhead / migration-blackout contract (ISSUE 10) "
+                "loses its regression pin",
+            )
 
 
 if __name__ == "__main__":

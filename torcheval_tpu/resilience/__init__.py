@@ -25,8 +25,10 @@ Obs counters: ``resilience.checkpoint.{saves,restores,bytes}``,
 
 from torcheval_tpu.resilience.snapshot import (
     CheckpointError,
+    discover_checkpoints,
     latest_checkpoint,
     list_checkpoints,
+    read_extra,
     restore,
     save,
 )
@@ -36,8 +38,10 @@ __all__ = [
     "SyncError",
     "SyncRoundError",
     "SyncTimeoutError",
+    "discover_checkpoints",
     "latest_checkpoint",
     "list_checkpoints",
+    "read_extra",
     "restore",
     "save",
 ]

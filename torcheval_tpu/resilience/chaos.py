@@ -31,6 +31,30 @@ families inject here, armed through the environment before launch:
     * ``"ingest_delay"`` — sleep ``TORCHEVAL_TPU_CHAOS_DELAY_S`` before
       queuing the chosen batch, modelling a stalled producer (the fault
       the serve watchdog's idle eviction exists for).
+
+    **Host actions** (fire in ``on_host_request``, at the eval wire
+    server's request dispatch — the surfaces a whole-host loss presents
+    to remote clients; ISSUE 10):
+
+    * ``"host_kill"`` — ``os._exit`` BEFORE processing the chosen
+      request: the host vanishes mid-window, the un-acked batch was
+      never applied, clients see dead connections from then on.
+    * ``"host_partition"`` — from the chosen request on, the server
+      reads requests and never answers (nor processes them): TCP is up,
+      the service is gone — clients must discover it by deadline, not by
+      connection error.
+    * ``"ack_drop"`` — process the chosen request fully, then
+      ``os._exit`` BEFORE the ack leaves: the exactly-once hard case —
+      the client cannot know whether its batch landed, must resend, and
+      only server-side sequence dedup (or the batch dying with the
+      host's un-checkpointed state) keeps the metric exactly-once.
+
+    Host actions select their request with ``TORCHEVAL_TPU_CHAOS_TENANT``
+    and ``TORCHEVAL_TPU_CHAOS_STEP`` (the 1-based index among *submit*
+    requests for the matching tenant, counted process-wide at the
+    server), fire once per process, and ignore
+    ``TORCHEVAL_TPU_CHAOS_RANK`` (the drill arms each host process with
+    its own environment).
 ``TORCHEVAL_TPU_CHAOS_RANK``
     Global process index the fault targets. Required for sync-funnel
     actions (other ranks never act); optional for ingestion actions (when
@@ -94,6 +118,7 @@ _ENV_POISON = "TORCHEVAL_TPU_CHAOS_POISON"
 
 _SYNC_ACTIONS = ("kill", "delay")
 _INGEST_ACTIONS = ("poison", "ingest_delay")
+_HOST_ACTIONS = ("host_kill", "host_partition", "ack_drop")
 _POISON_KINDS = ("nan", "shape")
 
 
@@ -135,6 +160,8 @@ class _ChaosConfig:
 _config: Optional[object] = None
 _rounds_seen = 0
 _ingest_fired = False
+_host_fired = False
+_host_submits_seen: dict = {}  # tenant_id -> submit requests observed
 _lock = threading.Lock()
 
 
@@ -171,6 +198,13 @@ def _resolve() -> object:
                 step=int(os.environ[_ENV_STEP]),
                 poison=poison,
             )
+        elif action in _HOST_ACTIONS:
+            _config = _ChaosConfig(
+                action,
+                exit_code=exit_code,
+                tenant=os.environ[_ENV_TENANT],
+                step=int(os.environ[_ENV_STEP]),
+            )
         else:
             raise ValueError(f"unknown chaos action {action!r}")
     except (KeyError, ValueError) as e:
@@ -182,11 +216,13 @@ def _resolve() -> object:
 def reset_for_tests() -> None:
     """Re-read the environment and restart the round/step bookkeeping
     (test hook)."""
-    global _config, _rounds_seen, _ingest_fired
+    global _config, _rounds_seen, _ingest_fired, _host_fired
     with _lock:
         _config = None
         _rounds_seen = 0
         _ingest_fired = False
+        _host_fired = False
+        _host_submits_seen.clear()
 
 
 def on_sync_round() -> None:
@@ -276,6 +312,73 @@ def ingest_armed() -> bool:
     if cfg is None:
         cfg = _resolve()
     return cfg is not False and cfg.action in _INGEST_ACTIONS
+
+
+def host_armed() -> bool:
+    """True when a host action is armed for this process — the eval wire
+    server's cheap gate (when False, request dispatch never calls
+    :func:`on_host_request`)."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    return cfg is not False and cfg.action in _HOST_ACTIONS
+
+
+def on_host_request(op: str, tenant_id: Optional[str]) -> Optional[str]:
+    """Called by the eval wire server before dispatching each request.
+
+    Counts *submit* requests per tenant (process-wide, under the lock so
+    concurrent connections cannot double-count one step). At the armed
+    tenant's armed step: ``host_kill`` exits HERE (request never
+    processed); ``"partition"`` tells the server to go silent from this
+    request on; ``"ack_drop"`` tells it to process the request and call
+    :func:`host_die` before acking. Fires once per process."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    if cfg is False or cfg.action not in _HOST_ACTIONS:
+        return None
+    global _host_fired
+    if _host_fired or op != "submit" or tenant_id is None:
+        return None
+    with _lock:
+        if _host_fired:
+            return None
+        seen = _host_submits_seen.get(tenant_id, 0) + 1
+        _host_submits_seen[tenant_id] = seen
+        if seen != cfg.step or cfg.tenant not in ("*", tenant_id):
+            return None
+        _host_fired = True
+    if _obs_registry._enabled:
+        _obs_trace.instant(
+            "resilience.chaos",
+            kind="chaos",
+            action=cfg.action,
+            tenant=tenant_id,
+            step=seen,
+        )
+    if cfg.action == "host_kill":
+        host_die("host_kill")
+    if cfg.action == "host_partition":
+        _logger.warning(
+            "chaos: host partitioned at tenant %r submit %d (TCP up, "
+            "service silent).",
+            tenant_id,
+            seen,
+        )
+        return "partition"
+    return "ack_drop"
+
+
+def host_die(action: str) -> None:
+    """The host-loss moment itself: no Python cleanup, no atexit, no
+    flush of the daemon's state — exactly what a preempted VM leaves."""
+    cfg = _config
+    exit_code = cfg.exit_code if isinstance(cfg, _ChaosConfig) else 43
+    _logger.warning(
+        "chaos: killing host (%s, exit %d)", action, exit_code
+    )
+    os._exit(exit_code)
 
 
 def on_ingest(tenant_id: str, step: int, args: Tuple) -> Tuple:

@@ -81,6 +81,8 @@ __all__ = [
     "restore",
     "latest_checkpoint",
     "list_checkpoints",
+    "read_extra",
+    "discover_checkpoints",
 ]
 
 
@@ -158,11 +160,31 @@ def _flatten_states(
         arrays[key] = np.asarray(value)
         return key
 
+    def _sharded_mesh_shape(value) -> Optional[dict]:
+        """``{axis: size}`` of the mesh a genuinely *sharded* jax array
+        lives on, else ``None`` (host arrays, single-device arrays, and
+        mesh-replicated arrays all restore anywhere — only state that is
+        actually split across a mesh axis pins the checkpoint to an
+        equal axis, see :func:`restore`)."""
+        sharding = getattr(value, "sharding", None)
+        if sharding is None or getattr(
+            sharding, "is_fully_replicated", True
+        ):
+            return None
+        mesh = getattr(sharding, "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if not shape:
+            return None
+        return {str(k): int(v) for k, v in dict(shape).items()}
+
     for mkey, metric in metrics.items():
         sd = metric.state_dict()
         for name in metric._state_name_to_reduction:
             value = sd[name]
             entry: dict = {"metric": mkey, "state": name}
+            mesh_shape = _sharded_mesh_shape(value)
+            if mesh_shape is not None:
+                entry["sharded_mesh"] = mesh_shape
             if isinstance(value, deque):
                 entry["kind"] = "deque"
                 entry["maxlen"] = value.maxlen
@@ -254,6 +276,46 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     """Newest published checkpoint path, or ``None``."""
     ckpts = list_checkpoints(directory)
     return ckpts[-1] if ckpts else None
+
+
+def rotate_checkpoints(directory: str, keep_last: int) -> None:
+    """Remove published checkpoints beyond the newest ``keep_last``.
+    ``save(keep_last=)`` calls this after its durable publish; callers
+    that must defer rotation past their own commit point (the serve
+    daemon's abortable idle eviction) call it directly afterwards."""
+    for old in list_checkpoints(directory)[:-keep_last]:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def discover_checkpoints(root: str) -> Dict[str, str]:
+    """Map each immediate subdirectory of ``root`` that holds published
+    checkpoints to its newest one: ``{name: ckpt_path}``.
+
+    Checkpoint-root discovery for cluster operators (ISSUE 10): serve
+    hosts evict and flush tenants into ``<root>/<tenant_id>``, so after a
+    host (or a whole router) is lost, this enumerates every recoverable
+    tenant — and its resume point — from shared storage alone, with no
+    word from any dead process; re-``attach`` each id with
+    ``resume="require"`` to resurrect it. (The router's automatic
+    migration path doesn't need the scan: it already knows its tenant
+    ids and lets ``attach(resume="auto")`` resolve each directory.)
+    Names are the subdirectory names (the daemon's filesystem-safe
+    tenant ids). Subdirectories without a published ``ckpt-*`` (e.g.
+    only ``.tmp-*`` left by a crash mid-save) are omitted.
+    """
+    out: Dict[str, str] = {}
+    try:
+        names = os.listdir(root)
+    except (FileNotFoundError, NotADirectoryError):
+        return out
+    for name in sorted(names):
+        sub = os.path.join(root, name)
+        if not os.path.isdir(sub):
+            continue
+        newest = latest_checkpoint(sub)
+        if newest is not None:
+            out[name] = newest
+    return out
 
 
 _TMP_GC_MIN_AGE_S = 3600.0  # mtime fallback when the writer pid is unknowable
@@ -351,14 +413,20 @@ def save(
     *,
     step: Optional[int] = None,
     keep_last: Optional[int] = None,
+    extra: Optional[dict] = None,
 ) -> str:
     """Write one atomic checkpoint of ``obj`` under ``directory``.
 
     ``step`` defaults to one past the newest existing checkpoint. With
     ``keep_last=N``, older checkpoints beyond the newest ``N`` are removed
     after the new one is durably published (rotation can therefore never
-    leave fewer than one complete checkpoint behind). Returns the published
-    checkpoint path.
+    leave fewer than one complete checkpoint behind). ``extra`` is an
+    optional JSON-serialisable dict stored in the manifest (readable back
+    via :func:`read_extra`) — it rides the same temp-then-rename publish,
+    so metadata like the serve wire's acked-sequence watermark is
+    atomically consistent with the state it describes. It does not enter
+    the schema digest: restore targets never need to know it. Returns the
+    published checkpoint path.
     """
     if keep_last is not None and keep_last < 1:
         # validate BEFORE any side effect: rejecting the argument after the
@@ -410,6 +478,8 @@ def save(
                 },
                 "entries": entries,
             }
+            if extra is not None:
+                manifest["extra"] = extra
             manifest_path = os.path.join(tmp, _MANIFEST)
             with open(manifest_path, "w") as f:
                 json.dump(manifest, f)
@@ -438,8 +508,7 @@ def save(
         bytes=nbytes,
     )
     if keep_last is not None:
-        for old in list_checkpoints(directory)[:-keep_last]:
-            shutil.rmtree(old, ignore_errors=True)
+        rotate_checkpoints(directory, keep_last)
     # reclaim tmp dirs orphaned by a crashed writer — AFTER the durable
     # publish, so a directory that only ever sees failing saves is never
     # mutated by the failures themselves
@@ -476,6 +545,65 @@ def _read_manifest(ckpt: str) -> dict:
     return manifest
 
 
+def _resolve_ckpt(path: str) -> str:
+    """``path`` itself if it is a checkpoint directory, else the newest
+    published ``ckpt-*`` under it. Raises ``not_found`` when neither."""
+    if os.path.exists(os.path.join(path, _MANIFEST)):
+        return path
+    nested = latest_checkpoint(path)
+    if nested is None:
+        raise CheckpointError(
+            "not_found", f"no checkpoint found under {path!r}."
+        )
+    return nested
+
+
+def read_extra(path: str) -> dict:
+    """The ``extra`` metadata dict :func:`save` stored in the manifest at
+    ``path`` (a checkpoint directory, or a parent whose newest ``ckpt-*``
+    is used); ``{}`` when none was stored. Validates the manifest shape
+    (same :class:`CheckpointError` reasons as :func:`restore`) but not the
+    payload checksum — reading a watermark must not cost a full payload
+    scan."""
+    manifest = _read_manifest(_resolve_ckpt(path))
+    extra = manifest.get("extra", {})
+    if not isinstance(extra, dict):
+        raise CheckpointError(
+            "corrupt_manifest",
+            f"manifest 'extra' at {path!r} is {type(extra).__name__}, "
+            "expected a dict.",
+        )
+    return extra
+
+
+def _check_mesh_portability(entry: dict, metric, mkey: str) -> None:
+    """Enforce the cross-host portability contract (ISSUE 10 satellite):
+    replicated state restores anywhere; state that was *sharded* across a
+    mesh axis at save time requires the restore target to place it on an
+    equal mesh (axis names and sizes), because the global value would
+    otherwise be silently re-laid-out across a topology the saver never
+    validated — a different device count must be an explicit, structured
+    failure, not a quiet resharding."""
+    saved_mesh = entry.get("sharded_mesh")
+    if saved_mesh is None:
+        return
+    device = getattr(metric, "_device", None)
+    mesh = getattr(device, "mesh", None)
+    shape = getattr(mesh, "shape", None)
+    current = (
+        {str(k): int(v) for k, v in dict(shape).items()} if shape else None
+    )
+    if current != dict(saved_mesh):
+        raise CheckpointError(
+            "unsupported",
+            f"state {entry['state']!r} of metric {mkey!r} was sharded "
+            f"across mesh {dict(saved_mesh)!r} at save time but the "
+            f"restore target's placement mesh is {current!r} — sharded "
+            "state requires an equal mesh axis (replicated state restores "
+            "anywhere; see docs/robustness.md, 'Checkpoint portability').",
+        )
+
+
 def restore(obj: Any, path: str) -> Any:
     """Restore ``obj``'s metric states from ``path`` — a checkpoint
     directory, or a parent directory whose newest ``ckpt-*`` is used.
@@ -486,14 +614,7 @@ def restore(obj: Any, path: str) -> Any:
     half-loaded. Returns ``obj``.
     """
     metrics = _as_metrics(obj)
-    ckpt = path
-    if not os.path.exists(os.path.join(ckpt, _MANIFEST)):
-        nested = latest_checkpoint(path)
-        if nested is None:
-            raise CheckpointError(
-                "not_found", f"no checkpoint found under {path!r}."
-            )
-        ckpt = nested
+    ckpt = _resolve_ckpt(path)
     with _obs.span("resilience.checkpoint.restore"):
         manifest = _read_manifest(ckpt)
         payload_path = os.path.join(ckpt, manifest.get("payload", _PAYLOAD))
@@ -533,6 +654,7 @@ def restore(obj: Any, path: str) -> Any:
                             "schema_mismatch",
                             f"manifest names unknown metric {mkey!r}.",
                         )
+                    _check_mesh_portability(entry, metrics[mkey], mkey)
                     default = metrics[mkey]._state_name_to_default.get(sname)
                     value = _rebuild_state(entry, payload, default)
                     if (

@@ -23,11 +23,29 @@ streams (*tenants*), each backed by a
   obs registry and Chrome trace, plus ``EvalDaemon.health()`` (local) /
   ``health(sync=True)`` (all ranks, one collective round).
 
-See docs/robustness.md ("Serving") for the tenant lifecycle and the
-failure-semantics table, and ``bench.py``'s ``config7_serve_tenants_*``
-rows for the multi-tenant throughput contract.
+Since ISSUE 10 the service also crosses machines — a stdlib-only network
+layer on top of the same daemon:
+
+* **wire** (``wire.py``) — length-prefixed JSON + npz framing, an
+  :class:`EvalServer` TCP front end per daemon, structured errors
+  crossing with their ``retryable`` classification intact;
+* **client** (``client.py``) — :class:`EvalClient` with per-request
+  deadlines, exponential backoff + jitter, a per-host circuit breaker,
+  bounded in-flight, and idempotent submits (per-tenant monotonic
+  sequence numbers + a bounded replay buffer: at-least-once on the wire,
+  exactly-once into the metric state);
+* **router** (``router.py``) — :class:`EvalRouter` places tenants across
+  hosts (rendezvous hashing), health-probes them, and on host failure or
+  explicit ``drain`` migrates tenants by restoring their shared-root
+  checkpoints on a survivor and replaying the un-durable tail.
+
+See docs/robustness.md ("Serving", "Cluster") for the tenant lifecycle,
+the failure-semantics table and the migration contract, and ``bench.py``'s
+``config7_serve_tenants_*`` / ``config8_cluster_*`` rows for the
+throughput contracts.
 """
 
+from torcheval_tpu.serve.client import EvalClient, metric_spec
 from torcheval_tpu.serve.daemon import EvalDaemon
 from torcheval_tpu.serve.errors import (
     AdmissionError,
@@ -36,17 +54,25 @@ from torcheval_tpu.serve.errors import (
     TenantError,
     TenantEvictedError,
     TenantQuarantinedError,
+    WireError,
 )
+from torcheval_tpu.serve.router import EvalRouter
 from torcheval_tpu.serve.tenant import TenantHandle, TenantStatus
+from torcheval_tpu.serve.wire import EvalServer
 
 __all__ = [
     "AdmissionError",
     "BackpressureError",
+    "EvalClient",
     "EvalDaemon",
+    "EvalRouter",
+    "EvalServer",
     "ServeError",
     "TenantError",
     "TenantEvictedError",
     "TenantHandle",
     "TenantQuarantinedError",
     "TenantStatus",
+    "WireError",
+    "metric_spec",
 ]

@@ -1,0 +1,787 @@
+"""`EvalClient`: the producer-side endpoint of the eval wire.
+
+One client speaks to ONE host (an :class:`~torcheval_tpu.serve.EvalServer`
+in front of an :class:`~torcheval_tpu.serve.EvalDaemon`); the cluster
+router (``serve/router.py``) composes one client per endpoint. The client
+owns every *unreliable-network* concern so callers see the same
+structured-error surface a local :class:`TenantHandle` gives:
+
+* **per-request deadlines** — every request runs under a socket timeout
+  (``request_timeout_s`` default, overridable per call), validated at the
+  boundary by the same ``_check_timeout_s`` every serve/sync deadline
+  knob uses;
+* **retry with exponential backoff + jitter** — transport failures and
+  *retryable* structured errors (a shed, a capacity reject: the shared
+  ``retryable`` classification from ``serve/errors.py``) retry up to
+  ``max_attempts`` with the ``init_from_env`` backoff shape (×2 growth,
+  cap, 0.5–1.5× jitter); non-retryable errors surface immediately;
+* **a per-host circuit breaker** — ``breaker_threshold`` consecutive
+  transport failures open the circuit and further calls fail fast with
+  ``WireError("circuit_open")`` (no socket touched) until
+  ``breaker_reset_s`` elapses and a half-open probe is allowed through;
+* **bounded in-flight** — at most ``max_in_flight`` requests on the wire
+  at once (a semaphore over the connection pool): client-side
+  backpressure composes with the daemon's queue bounds instead of hiding
+  them;
+* **idempotent submits + a bounded replay buffer** — each submit carries
+  a per-tenant monotonic ``seq`` and is held in a bounded replay buffer
+  until an ack reports it *durable* (covered by a published checkpoint).
+  A resend after an ambiguous failure is deduplicated server-side, so
+  blind retries are safe; when the buffer fills, the client issues a
+  ``flush`` (checkpoint-without-evicting) to advance the durable
+  watermark and prune. The router migrates a dead host's tenants by
+  restoring their checkpoints elsewhere and replaying exactly this
+  buffer's un-durable tail.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.serve.errors import ServeError, WireError
+from torcheval_tpu.serve.wire import (
+    decode_error,
+    pack_tree,
+    recv_frame,
+    send_frame,
+    unpack_tree,
+)
+
+__all__ = ["EvalClient", "metric_spec"]
+
+_UNSET = object()
+
+
+def metric_spec(class_name: str, **kwargs: Any) -> List[Any]:
+    """One wire metric-spec entry: ``metric_spec("MulticlassAccuracy",
+    num_classes=10)``. Class names resolve server-side against
+    ``torcheval_tpu.metrics`` only."""
+    return [class_name, kwargs]
+
+
+class _ClientTenant:
+    """Client-side per-tenant wire state (sequence numbers + replay)."""
+
+    __slots__ = (
+        "lock",
+        "next_seq",
+        "durable_seq",
+        "replay",
+        "migrated",
+        "needs_resend",
+    )
+
+    def __init__(self, last_seq: int) -> None:
+        self.lock = threading.Lock()
+        self.next_seq = last_seq + 1
+        self.durable_seq = last_seq
+        self.replay: deque = deque()  # (seq, np-args tuple), seq ascending
+        # set (under lock) by export_tenant: a concurrent submitter that
+        # grabbed this state object before the export must NOT book a
+        # batch into it — the buffer has already been carried elsewhere
+        self.migrated = False
+        # set when a booked submit escaped with a transport failure: the
+        # next submit/flush must re-deliver the booked tail FIRST (dedup
+        # absorbs any that actually landed) — otherwise a later batch
+        # advances the daemon watermark past the hole and a flush prunes
+        # the never-applied entry as "durable"
+        self.needs_resend = False
+
+
+class EvalClient:
+    """Wire client for one eval-service host. See module doc.
+
+    ``address`` is ``"host:port"`` or a ``(host, port)`` tuple. All
+    deadline knobs are validated eagerly (NaN/inf/non-positive raise
+    ``ValueError`` before any socket exists).
+    """
+
+    def __init__(
+        self,
+        address: Any,
+        *,
+        request_timeout_s: Optional[float] = 30.0,
+        connect_timeout_s: Optional[float] = 5.0,
+        max_attempts: int = 5,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        max_in_flight: int = 8,
+        breaker_threshold: int = 3,
+        breaker_reset_s: float = 1.0,
+        replay_capacity: int = 64,
+    ) -> None:
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        for knob, value in (
+            ("request_timeout_s", request_timeout_s),
+            ("connect_timeout_s", connect_timeout_s),
+            ("backoff_base_s", backoff_base_s),
+            ("backoff_cap_s", backoff_cap_s),
+            ("breaker_reset_s", breaker_reset_s),
+        ):
+            try:
+                _check_timeout_s(value)
+            except ValueError as e:
+                raise ValueError(f"{knob}: {e}") from None
+        for knob, value, floor in (
+            ("max_attempts", max_attempts, 1),
+            ("max_in_flight", max_in_flight, 1),
+            ("breaker_threshold", breaker_threshold, 1),
+            ("replay_capacity", replay_capacity, 1),
+        ):
+            if not isinstance(value, int) or value < floor:
+                raise ValueError(
+                    f"{knob} must be an int >= {floor}, got {value!r}."
+                )
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            try:
+                self._addr: Tuple[str, int] = (host, int(port))
+            except ValueError:
+                raise ValueError(
+                    f"address must be 'host:port' or (host, port), "
+                    f"got {address!r}."
+                ) from None
+        else:
+            host, port = address
+            self._addr = (str(host), int(port))
+        self.endpoint = f"{self._addr[0]}:{self._addr[1]}"
+        self._request_timeout_s = request_timeout_s
+        self._connect_timeout_s = connect_timeout_s
+        self._max_attempts = max_attempts
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self.replay_capacity = replay_capacity
+        self._inflight = threading.BoundedSemaphore(max_in_flight)
+        self._lock = threading.Lock()
+        self._pool: List[socket.socket] = []
+        self._closed = False
+        self._breaker_failures = 0
+        self._breaker_opened_at = 0.0
+        self._breaker_probing = False
+        self._tenants: Dict[str, _ClientTenant] = {}
+
+    # ------------------------------------------------------------ transport
+    def _checkout(self) -> socket.socket:
+        with self._lock:
+            if self._closed:
+                raise ServeError("client_closed", "EvalClient is closed.")
+            if self._pool:
+                return self._pool.pop()
+        sock = socket.create_connection(
+            self._addr, timeout=self._connect_timeout_s
+        )
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        return sock
+
+    def _checkin(self, sock: socket.socket) -> None:
+        with self._lock:
+            if not self._closed:
+                self._pool.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            pool, self._pool = self._pool, []
+        for sock in pool:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "EvalClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------------- breaker
+    def _breaker_gate(self) -> None:
+        with self._lock:
+            if self._breaker_failures < self._breaker_threshold:
+                return
+            if (
+                time.monotonic() - self._breaker_opened_at
+                >= self._breaker_reset_s
+            ) and not self._breaker_probing:
+                # half-open: exactly ONE probe goes to the socket; every
+                # other caller keeps failing fast until it reports back
+                self._breaker_probing = True
+                return
+        if _obs._enabled:
+            _obs.counter(
+                "serve.client.breaker", event="fastfail", endpoint=self.endpoint
+            )
+        raise WireError(
+            "circuit_open",
+            f"circuit to {self.endpoint} is open after "
+            f"{self._breaker_threshold} consecutive transport failures; "
+            f"failing fast for {self._breaker_reset_s}s.",
+            endpoint=self.endpoint,
+        )
+
+    def _breaker_failure(self) -> None:
+        with self._lock:
+            self._breaker_probing = False
+            self._breaker_failures += 1
+            opened = self._breaker_failures == self._breaker_threshold
+            if opened or (
+                self._breaker_failures > self._breaker_threshold
+            ):
+                self._breaker_opened_at = time.monotonic()
+        if opened and _obs._enabled:
+            _obs.counter(
+                "serve.client.breaker", event="open", endpoint=self.endpoint
+            )
+
+    def _breaker_success(self) -> None:
+        with self._lock:
+            self._breaker_probing = False
+            self._breaker_failures = 0
+
+    # ---------------------------------------------------------------- calls
+    def _call(
+        self,
+        op: str,
+        header: Dict[str, Any],
+        payload: bytes = b"",
+        *,
+        timeout_s: Any = _UNSET,
+        attempts: Optional[int] = None,
+        ambiguity_box: Optional[dict] = None,
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """One wire request with the full reliability stack (deadline,
+        breaker, bounded in-flight, backoff retries). Safe to blind-retry
+        by construction: submits are deduplicated by seq, attach/detach
+        are idempotent (nonce / already-gone-counts-as-done), and every
+        other op is a read. ``attempts`` overrides ``max_attempts`` for
+        this call (health probes want to fail fast). ``ambiguity_box``,
+        when given, has its ``"sent"`` entry incremented for every
+        attempt that may have REACHED the server without an answer — a
+        caller that must know whether an earlier try could have landed
+        (submit's rollback logic) reads it."""
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        if timeout_s is _UNSET:
+            timeout_s = self._request_timeout_s
+        else:
+            _check_timeout_s(timeout_s)
+        max_attempts = self._max_attempts if attempts is None else attempts
+        header = {"op": op, **header}
+        delay_s = self._backoff_base_s
+        for attempt in range(1, max_attempts + 1):
+            self._breaker_gate()
+            try:
+                response = self._roundtrip(header, payload, timeout_s)
+            except WireError as e:
+                if ambiguity_box is not None and getattr(
+                    e, "request_sent", False
+                ):
+                    # the request went out before the failure: the server
+                    # may have processed it even though we got no answer
+                    ambiguity_box["sent"] = ambiguity_box.get("sent", 0) + 1
+                if e.reason == "protocol":
+                    # the peer speaks something else; retrying cannot fix it
+                    self._breaker_failure()
+                    raise
+                self._breaker_failure()
+                if attempt == max_attempts:
+                    raise
+                delay_s = self._sleep_backoff(delay_s, e.reason)
+                continue
+            self._breaker_success()
+            resp_header, resp_payload = response
+            if resp_header.get("ok"):
+                return resp_header, resp_payload
+            err = decode_error(resp_header.get("error", {}))
+            if (
+                getattr(err, "retryable", False)
+                and attempt < max_attempts
+            ):
+                delay_s = self._sleep_backoff(
+                    delay_s, getattr(err, "reason", "remote")
+                )
+                continue
+            raise err
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _roundtrip(
+        self,
+        header: Dict[str, Any],
+        payload: bytes,
+        timeout_s: Optional[float],
+    ) -> Tuple[Dict[str, Any], bytes]:
+        with self._inflight:
+            try:
+                sock = self._checkout()
+            except OSError as e:
+                err = WireError(
+                    "transport",
+                    f"cannot connect to {self.endpoint}: {e}",
+                    endpoint=self.endpoint,
+                )
+                err.request_sent = False  # never left this process
+                raise err from e
+            try:
+                sock.settimeout(timeout_s)
+                send_frame(sock, header, payload)
+                frame = recv_frame(sock)
+            except socket.timeout:
+                self._discard(sock)
+                err = WireError(
+                    "request_timeout",
+                    f"{header.get('op')} to {self.endpoint} produced no "
+                    f"response within {timeout_s}s.",
+                    endpoint=self.endpoint,
+                )
+                err.request_sent = True
+                raise err from None
+            except OSError as e:
+                self._discard(sock)
+                err = WireError(
+                    "transport",
+                    f"{header.get('op')} to {self.endpoint} failed: {e}",
+                    endpoint=self.endpoint,
+                )
+                # a failed send MAY still have delivered bytes the server
+                # acted on; only a connect failure is unambiguous
+                err.request_sent = True
+                raise err from e
+            except WireError as e:
+                self._discard(sock)
+                e.request_sent = True
+                raise
+            if frame is None:
+                self._discard(sock)
+                err = WireError(
+                    "transport",
+                    f"{self.endpoint} closed the connection before "
+                    "answering.",
+                    endpoint=self.endpoint,
+                )
+                err.request_sent = True
+                raise err
+            self._checkin(sock)
+            return frame
+
+    @staticmethod
+    def _discard(sock: socket.socket) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _sleep_backoff(self, delay_s: float, reason: str) -> float:
+        if _obs._enabled:
+            _obs.counter("serve.client.retries", reason=reason)
+        time.sleep(min(delay_s, self._backoff_cap_s) * (0.5 + random.random()))
+        return delay_s * 2
+
+    # ----------------------------------------------------------- tenant api
+    def attach(
+        self,
+        tenant_id: str,
+        spec: Dict[str, Any],
+        *,
+        nan_policy: Optional[str] = None,
+        watchdog_timeout_s: Optional[float] = None,
+        step_timeout_s: Optional[float] = None,
+        queue_capacity: Optional[int] = None,
+        resume: Optional[str] = None,
+        timeout_s: Any = _UNSET,
+    ) -> Dict[str, Any]:
+        """Attach ``tenant_id`` with a wire metric spec (see
+        :func:`metric_spec`). Returns ``{"last_seq": durable_watermark}``
+        — 0 for a fresh tenant, the checkpoint's acked watermark for a
+        resumed one. Admission failures raise the same structured
+        :class:`AdmissionError` a local ``attach`` would. The request
+        carries a one-shot nonce so a blind retry after an ambiguous
+        failure (our attach landed, the ack did not) is recognized
+        server-side and answered with the ORIGINAL success instead of
+        ``duplicate_tenant`` — attach is idempotent per call, like
+        submit."""
+        header, _ = self._call(
+            "attach",
+            {
+                "tenant": tenant_id,
+                "spec": spec,
+                "nonce": uuid.uuid4().hex,
+                "nan_policy": nan_policy,
+                "watchdog_timeout_s": watchdog_timeout_s,
+                "step_timeout_s": step_timeout_s,
+                "queue_capacity": queue_capacity,
+                "resume": resume,
+            },
+            timeout_s=timeout_s,
+        )
+        last_seq = int(header.get("last_seq", 0))
+        with self._lock:
+            self._tenants[tenant_id] = _ClientTenant(last_seq)
+        return {"last_seq": last_seq}
+
+    def _tenant_state(self, tenant_id: str) -> _ClientTenant:
+        with self._lock:
+            state = self._tenants.get(tenant_id)
+        if state is None:
+            raise ServeError(
+                "unknown_tenant",
+                f"tenant {tenant_id!r} is not attached through this client.",
+            )
+        return state
+
+    def submit(
+        self, tenant_id: str, *args: Any, timeout_s: Any = _UNSET
+    ) -> bool:
+        """Submit one update batch. Assigns the next sequence number,
+        holds the batch in the bounded replay buffer until it is durable,
+        and retries transparently (dedup makes resends exactly-once).
+        Returns ``True`` if this call's send was applied, ``False`` if
+        the server had it already (a prior ambiguous attempt landed)."""
+        state = self._tenant_state(tenant_id)
+        np_args = tuple(np.asarray(a) for a in args)
+        with state.lock:
+            if state.migrated:
+                raise ServeError(
+                    "tenant_migrated",
+                    f"tenant {tenant_id!r} was migrated off this host "
+                    "mid-call; re-route and resubmit (the batch was not "
+                    "booked).",
+                )
+            if state.needs_resend:
+                self._resend_locked(tenant_id, state, timeout_s)
+            if len(state.replay) >= self.replay_capacity:
+                # replay valve: checkpoint server-side to advance the
+                # durable watermark, then prune — the buffer stays
+                # bounded without ever dropping a non-durable batch
+                self._flush_locked(tenant_id, state, timeout_s)
+            # marshal BEFORE booking: an unmarshalable or over-limit
+            # argument must fail this call cleanly, not leave a poison
+            # entry in the replay buffer that every future resend and
+            # migration chokes on (the server would drop an oversize
+            # frame without answering, which reads as host death)
+            spec, blob = pack_tree(list(np_args))
+            from torcheval_tpu.serve.wire import _MAX_PAYLOAD_BYTES
+
+            if len(blob) > _MAX_PAYLOAD_BYTES:
+                raise WireError(
+                    "protocol",
+                    f"batch payload is {len(blob)} bytes, over the "
+                    f"{_MAX_PAYLOAD_BYTES}-byte wire limit; split the "
+                    "batch.",
+                    endpoint=self.endpoint,
+                )
+            seq = state.next_seq
+            state.next_seq += 1
+            state.replay.append((seq, np_args))
+            ambiguity: dict = {}
+            try:
+                header, _ = self._call(
+                    "submit",
+                    {"tenant": tenant_id, "seq": seq, "args": spec},
+                    blob,
+                    timeout_s=timeout_s,
+                    ambiguity_box=ambiguity,
+                )
+            except WireError as e:
+                # ambiguous: the batch may or may not have landed. It
+                # STAYS booked in the replay buffer under its seq — a
+                # migration replays it, dedup absorbs the overlap. Mark
+                # the error so the router knows delivery is now the
+                # replay buffer's job and must NOT resubmit the batch
+                # under a fresh seq (that would double-apply it). A
+                # direct (router-less) caller that keeps submitting is
+                # covered by needs_resend: the next call re-delivers this
+                # booked tail before any new seq can advance the daemon
+                # watermark past the hole.
+                state.needs_resend = True
+                e.batch_booked = True
+                raise
+            except ServeError as e:
+                if not ambiguity.get("sent"):
+                    # a STRUCTURED reject with NO earlier ambiguous send:
+                    # the daemon saw this seq exactly once and did not
+                    # admit it (shed after retries, quarantine,
+                    # draining) — un-book it so the replay buffer never
+                    # re-applies a rejected batch
+                    state.replay.pop()
+                    state.next_seq = seq
+                else:
+                    # an earlier attempt of this seq MAY have been
+                    # admitted before its ack was lost; rolling the seq
+                    # back would hand it to the NEXT batch, which the
+                    # daemon would then dedup away (silent loss). Keep
+                    # the booking: replay/dedup settle it exactly-once —
+                    # and flag the resend catch-up exactly like the
+                    # transport branch, or a later seq could advance the
+                    # daemon watermark past this possibly-unapplied hole.
+                    state.needs_resend = True
+                    e.batch_booked = True
+                raise
+            state.durable_seq = max(
+                state.durable_seq, int(header.get("acked_seq", 0))
+            )
+            self._prune_locked(state)
+            return bool(header.get("applied", True))
+
+    def flush(self, tenant_id: str, *, timeout_s: Any = _UNSET) -> dict:
+        """Checkpoint the tenant server-side (no eviction), advance the
+        durable watermark, prune the replay buffer. Returns
+        ``{"path": ..., "acked_seq": ...}``."""
+        state = self._tenant_state(tenant_id)
+        with state.lock:
+            if state.migrated:
+                raise ServeError(
+                    "tenant_migrated",
+                    f"tenant {tenant_id!r} was migrated off this host "
+                    "mid-call; re-route.",
+                )
+            if state.needs_resend:
+                self._resend_locked(tenant_id, state, timeout_s)
+            return self._flush_locked(tenant_id, state, timeout_s)
+
+    def _send_replay_entries(
+        self, tenant_id: str, state: _ClientTenant, timeout_s: Any
+    ) -> int:
+        """Deliver every current replay entry in seq order under the
+        caller-held ``state.lock`` (the daemon dedups any that already
+        landed), folding acked durable watermarks in and pruning. The
+        ONE loop behind resend catch-up and migration replay — fixes to
+        its semantics cannot diverge between the two. Returns the number
+        of entries sent."""
+        sent = 0
+        for seq, np_args in list(state.replay):
+            spec, blob = pack_tree(list(np_args))
+            header, _ = self._call(
+                "submit",
+                {"tenant": tenant_id, "seq": seq, "args": spec},
+                blob,
+                timeout_s=timeout_s,
+            )
+            sent += 1
+            state.durable_seq = max(
+                state.durable_seq, int(header.get("acked_seq", 0))
+            )
+        self._prune_locked(state)
+        return sent
+
+    def _resend_locked(
+        self, tenant_id: str, state: _ClientTenant, timeout_s: Any
+    ) -> None:
+        """Re-deliver the booked tail a failed submit left behind,
+        clearing the hole. Raises (flag intact) if the host is still
+        unreachable — nothing new may be sequenced past the hole until
+        it closes."""
+        self._send_replay_entries(tenant_id, state, timeout_s)
+        state.needs_resend = False
+
+    def _flush_locked(
+        self, tenant_id: str, state: _ClientTenant, timeout_s: Any
+    ) -> dict:
+        header, _ = self._call(
+            "flush",
+            {
+                "tenant": tenant_id,
+                "timeout": self._effective_timeout(timeout_s),
+            },
+            timeout_s=timeout_s,
+        )
+        state.durable_seq = max(
+            state.durable_seq, int(header.get("acked_seq", 0))
+        )
+        self._prune_locked(state)
+        return {"path": header.get("path"), "acked_seq": state.durable_seq}
+
+    @staticmethod
+    def _prune_locked(state: _ClientTenant) -> None:
+        while state.replay and state.replay[0][0] <= state.durable_seq:
+            state.replay.popleft()
+
+    def _effective_timeout(self, timeout_s: Any) -> Optional[float]:
+        """The deadline a request actually runs under — forwarded to the
+        daemon side so its promise wait is bounded by the same budget the
+        socket is (otherwise each client retry would park one more
+        handler thread on an unbounded wait)."""
+        return (
+            self._request_timeout_s if timeout_s is _UNSET else timeout_s
+        )
+
+    def compute(self, tenant_id: str, *, timeout_s: Any = _UNSET) -> Any:
+        header, payload = self._call(
+            "compute",
+            {
+                "tenant": tenant_id,
+                "timeout": self._effective_timeout(timeout_s),
+            },
+            timeout_s=timeout_s,
+        )
+        return unpack_tree(header["result"], payload)
+
+    def sync_compute(
+        self,
+        tenant_id: str,
+        *,
+        sync_timeout_s: Optional[float] = None,
+        on_failure: str = "raise",
+        timeout_s: Any = _UNSET,
+    ) -> Any:
+        """``TenantHandle.sync_compute`` over the wire: ``sync_timeout_s``
+        bounds the daemon-side collective rounds (the PR 5 contract);
+        ``timeout_s`` bounds this wire request."""
+        header, payload = self._call(
+            "sync_compute",
+            {
+                "tenant": tenant_id,
+                "timeout_s": sync_timeout_s,
+                "on_failure": on_failure,
+                "timeout": self._effective_timeout(timeout_s),
+            },
+            timeout_s=timeout_s,
+        )
+        return unpack_tree(header["result"], payload)
+
+    def detach(
+        self,
+        tenant_id: str,
+        *,
+        checkpoint: bool = False,
+        timeout_s: Any = _UNSET,
+    ) -> Optional[str]:
+        """Detach over the wire. Idempotent: a retry of a detach whose
+        ack was lost finds the tenant already gone (``unknown_tenant``)
+        and counts that as success — the caller asked for the tenant to
+        be detached, and it is (a checkpoint path from the first landing
+        is lost with the ack in that corner; ``resilience.
+        latest_checkpoint(<root>/<tenant>)`` recovers it)."""
+        try:
+            header, _ = self._call(
+                "detach",
+                {
+                    "tenant": tenant_id,
+                    "checkpoint": checkpoint,
+                    "timeout": self._effective_timeout(timeout_s),
+                },
+                timeout_s=timeout_s,
+            )
+        except ServeError as e:
+            if isinstance(e, WireError) or e.reason != "unknown_tenant":
+                raise
+            header = {}
+        with self._lock:
+            self._tenants.pop(tenant_id, None)
+        return header.get("checkpoint")
+
+    # ---------------------------------------------------------- cluster api
+    def health(
+        self, *, timeout_s: Any = _UNSET, attempts: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """The host's ``daemon.health()`` snapshot. ``attempts`` caps the
+        retry budget for this probe (a failure DETECTOR wants to fail
+        fast, not ride the full backoff ladder)."""
+        header, _ = self._call(
+            "health", {}, timeout_s=timeout_s, attempts=attempts
+        )
+        return header["health"]
+
+    def snapshot(self, *, timeout_s: Any = _UNSET) -> Dict[str, Any]:
+        """The host's obs registry snapshot + Chrome trace (flight-record
+        collection for drills and dashboards)."""
+        header, payload = self._call("snapshot", {}, timeout_s=timeout_s)
+        return unpack_tree(header["result"], payload)
+
+    def drain(self, *, timeout_s: Any = _UNSET) -> Dict[str, Optional[str]]:
+        """Ask the host to drain (evict-and-checkpoint every tenant).
+        Returns ``{tenant_id: checkpoint_path}``."""
+        header, _ = self._call(
+            "drain",
+            {"timeout": self._effective_timeout(timeout_s)},
+            timeout_s=timeout_s,
+        )
+        return dict(header.get("tenants", {}))
+
+    # ------------------------------------------------- migration bookkeeping
+    def export_tenant(self, tenant_id: str) -> Dict[str, Any]:
+        """Detach this client's local wire state for ``tenant_id`` (seqs +
+        replay buffer) so the router can carry it to another host. Purely
+        local: works when the host is dead."""
+        with self._lock:
+            state = self._tenants.pop(tenant_id, None)
+        if state is None:
+            raise ServeError(
+                "unknown_tenant",
+                f"tenant {tenant_id!r} is not attached through this client.",
+            )
+        with state.lock:
+            state.migrated = True
+            return {
+                "next_seq": state.next_seq,
+                "durable_seq": state.durable_seq,
+                "replay": list(state.replay),
+            }
+
+    def adopt_tenant(
+        self,
+        tenant_id: str,
+        exported: Dict[str, Any],
+        *,
+        restored_seq: int,
+        timeout_s: Any = _UNSET,
+    ) -> int:
+        """Install an exported tenant state after an ``attach`` on this
+        host restored its checkpoint at ``restored_seq``, then replay the
+        un-durable tail of the replay buffer (everything above the
+        restored watermark) in order. Batches at or below the watermark
+        came back through the checkpoint; the server dedups any overlap.
+        Returns the number of batches replayed. Raises a structured
+        ``checkpoint_behind`` error when the restored watermark is BELOW
+        the exported durable one: entries the old host acked durable were
+        already pruned from the replay buffer, so a restore that does not
+        carry them (a non-shared checkpoint root, a lost directory) can
+        only produce silently wrong results — refuse instead."""
+        exported_durable = int(exported["durable_seq"])
+        if restored_seq < exported_durable:
+            raise ServeError(
+                "checkpoint_behind",
+                f"tenant {tenant_id!r}: restored checkpoint watermark "
+                f"{restored_seq} < acked durable watermark "
+                f"{exported_durable}; batches in between exist in neither "
+                "the checkpoint nor the replay buffer (are the hosts "
+                "sharing one checkpoint root?).",
+            )
+        state = _ClientTenant(0)
+        state.next_seq = int(exported["next_seq"])
+        state.durable_seq = max(exported_durable, restored_seq)
+        state.replay = deque(
+            (int(seq), tuple(args))
+            for seq, args in exported["replay"]
+            if int(seq) > state.durable_seq
+        )
+        with self._lock:
+            self._tenants[tenant_id] = state
+        with state.lock:
+            replayed = self._send_replay_entries(
+                tenant_id, state, timeout_s
+            )
+        if replayed and _obs._enabled:
+            _obs.counter(
+                "serve.router.replays", float(replayed), tenant=tenant_id
+            )
+        return replayed

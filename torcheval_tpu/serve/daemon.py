@@ -152,7 +152,9 @@ class EvalDaemon:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._tenants: Dict[str, _Tenant] = {}
+        self._attaching: set = set()  # reserved ids mid-admission
         self._running = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         self._seq = 0
         self._started_at: Optional[float] = None
@@ -176,7 +178,14 @@ class EvalDaemon:
     def stop(self, *, timeout: Optional[float] = 10.0) -> None:
         """Stop the worker. Outstanding compute/detach promises are failed
         with a structured ``daemon_stopped`` error; tenant tables stay
-        readable (``health()``) but every handle op raises afterwards."""
+        readable (``health()``) but every handle op raises afterwards.
+        ``timeout`` bounds the worker join (``None`` = wait forever) and
+        is validated at this boundary like every other deadline knob — a
+        NaN/inf/non-positive join budget must raise here, not silently
+        turn the join into a no-op or a hang."""
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        _check_timeout_s(timeout)
         with self._cond:
             if not self._running:
                 return
@@ -254,15 +263,24 @@ class EvalDaemon:
                     "daemon_stopped",
                     f"cannot attach {tenant_id!r}: the daemon is not running.",
                 )
-            if tenant_id in self._tenants:
+            if self._draining:
+                self._count_admission("rejected", "draining")
+                raise AdmissionError(
+                    "draining",
+                    f"cannot attach {tenant_id!r}: this daemon is draining "
+                    "(its tenants are being migrated off-host).",
+                )
+            if tenant_id in self._tenants or tenant_id in self._attaching:
                 self._count_admission("rejected", "duplicate_tenant")
                 raise AdmissionError(
                     "duplicate_tenant",
-                    f"tenant {tenant_id!r} is already attached "
-                    f"({self._tenants[tenant_id].status.value}); detach it "
+                    f"tenant {tenant_id!r} is already attached; detach it "
                     "first.",
                 )
-            if len(self._tenants) >= self._max_tenants:
+            if (
+                len(self._tenants) + len(self._attaching)
+                >= self._max_tenants
+            ):
                 self._count_admission("rejected", "capacity")
                 raise AdmissionError(
                     "capacity",
@@ -283,7 +301,14 @@ class EvalDaemon:
                     f"tenant {tenant_id!r} metrics are not servable: {e}",
                 ) from e
             ckpt_dir = self._tenant_ckpt_dir(tenant_id, create=False)
-            do_resume = False
+            # reserve the id + a capacity slot, then RELEASE the lock for
+            # the checkpoint I/O below: a migration restore can take long
+            # enough that holding the daemon-wide lock across it would
+            # stall every live tenant's submit on this host
+            self._attaching.add(tenant_id)
+        do_resume = False
+        resumed_seq = 0
+        try:
             if resume != "never":
                 from torcheval_tpu.resilience.snapshot import latest_checkpoint
 
@@ -303,9 +328,53 @@ class EvalDaemon:
                 # restore BEFORE the tenant is visible: a failed restore
                 # (schema drift, corrupt payload) must reject admission,
                 # not quarantine a half-born tenant
-                from torcheval_tpu.resilience.snapshot import restore
+                from torcheval_tpu.resilience.snapshot import (
+                    _resolve_ckpt,
+                    read_extra,
+                    restore,
+                )
 
-                restore(collection, ckpt_dir)
+                # resolve the checkpoint ONCE and use the same directory
+                # for both the state and the watermark — resolving twice
+                # would let a concurrent publish (e.g. a partitioned old
+                # host still flushing into the shared root) slip a newer
+                # manifest between the two reads, arming the dedup window
+                # ahead of the restored state and silently dropping
+                # replayed batches. For seq-tracked tenants prefer the
+                # HIGHEST acked watermark over the newest step: a
+                # partitioned-but-alive old host can publish a stale
+                # checkpoint into the shared root AFTER the tenant
+                # migrated, and "newest step" would resurrect it.
+                ckpt = self._best_serve_ckpt(ckpt_dir) or _resolve_ckpt(
+                    ckpt_dir
+                )
+                restore(collection, ckpt)
+                # the wire-sequence watermark rides the manifest (written
+                # atomically with the state it describes): every batch
+                # with seq <= resumed_seq is IN the restored state, so the
+                # dedup window re-arms exactly where the checkpoint left
+                # it and a client replaying its un-acked window after a
+                # migration can never double-apply a checkpointed batch
+                resumed_seq = int(
+                    read_extra(ckpt).get("serve", {}).get("acked_seq", 0)
+                )
+        except BaseException:
+            with self._cond:
+                self._attaching.discard(tenant_id)
+            raise
+        with self._cond:
+            self._attaching.discard(tenant_id)
+            if not self._running or self._draining:
+                # the daemon stopped/drained while we restored: reject —
+                # committing now would strand a tenant the drain's
+                # eviction sweep already missed
+                reason = "daemon_stopped" if not self._running else "draining"
+                self._count_admission("rejected", reason)
+                raise AdmissionError(
+                    reason,
+                    f"cannot attach {tenant_id!r}: the daemon began "
+                    f"{reason.replace('_', ' ')} during admission.",
+                )
             self._seq += 1
             tenant = _Tenant(
                 tenant_id,
@@ -320,12 +389,42 @@ class EvalDaemon:
                 step_timeout_s=step_timeout_s,
                 seq=self._seq,
             )
+            tenant.last_seq = tenant.applied_seq = tenant.durable_seq = (
+                resumed_seq
+            )
             self._tenants[tenant_id] = tenant
             self._totals["attached"] += 1
             self._count_admission("accepted", "resumed" if do_resume else "new")
             if _obs._enabled:
                 _obs.gauge("serve.tenants.active", float(len(self._tenants)))
         return TenantHandle(self, tenant)
+
+    @staticmethod
+    def _best_serve_ckpt(ckpt_dir: Optional[str]) -> Optional[str]:
+        """The published checkpoint with the highest serve acked-seq
+        watermark (ties -> newest step; zero-padded names sort by step).
+        For tenants never driven over the wire every watermark is 0 and
+        this degenerates to newest-step, exactly the old behavior."""
+        from torcheval_tpu.resilience.snapshot import (
+            CheckpointError,
+            list_checkpoints,
+            read_extra,
+        )
+
+        if ckpt_dir is None:
+            return None
+        best, best_key = None, None
+        for ckpt in list_checkpoints(ckpt_dir):
+            try:
+                acked = int(
+                    read_extra(ckpt).get("serve", {}).get("acked_seq", 0)
+                )
+            except (CheckpointError, TypeError, ValueError):
+                continue  # unreadable manifest: restore would reject it
+            key = (acked, ckpt)
+            if best_key is None or key > best_key:
+                best, best_key = ckpt, key
+        return best
 
     def _count_admission(self, result: str, reason: str) -> None:
         if _obs._enabled:
@@ -356,15 +455,44 @@ class EvalDaemon:
         *,
         block: bool,
         timeout: Optional[float],
-    ) -> None:
+        seq: Optional[int] = None,
+    ) -> bool:
+        """Admit one batch. ``seq`` is the wire client's per-tenant
+        monotonic sequence number: a submit at or below the tenant's
+        admitted watermark is a replay of a batch this daemon already
+        holds (an ambiguous-failure retry — at-least-once on the wire)
+        and is acknowledged WITHOUT re-applying (exactly-once into the
+        metric state). Returns ``True`` when the batch was admitted,
+        ``False`` when it was deduplicated. The dedup check re-runs
+        after every capacity wait: two retries of one seq can block in
+        the wait side by side, and only the first may append."""
         deadline = (
             time.monotonic() + timeout
             if (block and timeout is not None)
             else None
         )
         with self._cond:
-            self._check_live(tenant)
-            while len(tenant.queue) >= tenant.capacity:
+            while True:
+                self._check_live(tenant)
+                if seq is not None and seq <= tenant.last_seq:
+                    # dedup BEFORE the draining check: a replay of an
+                    # already-admitted seq must get its duplicate ack
+                    # even mid-drain — a "draining" reject here would
+                    # make the client think the batch was never admitted
+                    # and resubmit it under a fresh seq elsewhere while
+                    # the drain checkpoint also carries it (double-apply)
+                    tenant.dupes += 1
+                    if _obs._enabled:
+                        _obs.counter("serve.ingest.dupes", tenant=tenant.id)
+                    return False
+                if self._draining:
+                    raise ServeError(
+                        "draining",
+                        f"tenant {tenant.id!r}: this daemon is draining; "
+                        "resubmit after the router migrates the tenant.",
+                    )
+                if len(tenant.queue) < tenant.capacity:
+                    break
                 if not block:
                     self._shed(tenant, "queue_full")
                 remaining = (
@@ -376,11 +504,12 @@ class EvalDaemon:
                     self._shed(tenant, "queue_full")
                 if not self._cond.wait(timeout=remaining):
                     self._shed(tenant, "queue_full")
-                self._check_live(tenant)
             tenant.ingested += 1
             step = tenant.ingested
+            if seq is not None:
+                tenant.last_seq = seq
             if not _chaos.ingest_armed():
-                tenant.queue.append(("batch", args, None))
+                tenant.queue.append(("batch", (seq, args), None))
                 tenant.last_activity = time.monotonic()
                 depth = len(tenant.queue)
                 self._cond.notify_all()
@@ -397,13 +526,14 @@ class EvalDaemon:
             args = _chaos.on_ingest(tenant.id, step, args)
             with self._cond:
                 self._check_live(tenant)
-                tenant.queue.append(("batch", args, None))
+                tenant.queue.append(("batch", (seq, args), None))
                 tenant.last_activity = time.monotonic()
                 depth = len(tenant.queue)
                 self._cond.notify_all()
         if _obs._enabled:
             _obs.counter("serve.ingest.batches", tenant=tenant.id)
             _obs.histo("serve.queue_depth", float(depth), tenant=tenant.id)
+        return True
 
     def _shed(self, tenant: _Tenant, reason: str) -> None:
         tenant.sheds += 1
@@ -506,6 +636,49 @@ class EvalDaemon:
             payload={"checkpoint": True, "evict": True},
         )
 
+    def drain(
+        self, *, timeout: Optional[float] = None
+    ) -> Dict[str, Optional[str]]:
+        """Gracefully hand every tenant off this host (ISSUE 10): stop
+        admitting work (new ``attach``/``submit`` reject with a structured
+        ``"draining"`` reason), then evict each ACTIVE tenant — drain its
+        queue, fold + checkpoint atomically, free the slot — and return
+        ``{tenant_id: checkpoint_path}``. A cluster router calls this
+        before taking a host down, then re-attaches the tenants elsewhere
+        from the returned checkpoints; quarantined tenants have no
+        trustworthy state to hand off and are omitted. The daemon stays
+        up (``health()`` keeps answering) so the router can verify the
+        drain; ``stop()`` it afterwards. ``timeout`` bounds each tenant's
+        eviction round trip."""
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        _check_timeout_s(timeout)
+        with self._cond:
+            if not self._running:
+                raise ServeError(
+                    "daemon_stopped", "cannot drain a stopped daemon."
+                )
+            self._draining = True
+            victims = [
+                t.id
+                for t in self._tenants.values()
+                if t.status is TenantStatus.ACTIVE
+            ]
+        out: Dict[str, Optional[str]] = {}
+        for tid in victims:
+            try:
+                out[tid] = self.evict(tid, timeout=timeout)
+            except ServeError:
+                # quarantined mid-drain, or detached by a racing client:
+                # either way there is no state to hand off
+                continue
+        if _obs._enabled:
+            _obs.counter("serve.drains")
+            _trace.instant(
+                "serve.drained", kind="serve", tenants=len(out)
+            )
+        return out
+
     # ---------------------------------------------------------- worker side
     def _worker_loop(self) -> None:
         while True:
@@ -551,7 +724,10 @@ class EvalDaemon:
             if head[0] != "batch":
                 control.append(entry)
             else:
-                groups.setdefault(_batch_signature(head[1]), []).append(entry)
+                # batch payload is (seq, args); group on the args signature
+                groups.setdefault(
+                    _batch_signature(head[1][1]), []
+                ).append(entry)
         return control + [e for sig in groups for e in groups[sig]]
 
     def _serve_tenant(self, tenant: _Tenant, items) -> None:
@@ -566,6 +742,8 @@ class EvalDaemon:
                         )
                     elif kind == "sync_compute":
                         self._do_sync_compute(tenant, payload, promise)
+                    elif kind == "flush":
+                        self._do_flush(tenant, promise)
                     elif kind == "detach":
                         self._do_detach(tenant, payload, promise)
                 except Exception as exc:  # noqa: BLE001 - containment wall
@@ -579,11 +757,19 @@ class EvalDaemon:
         with self._cond:
             tenant.last_activity = time.monotonic()
 
-    def _process_batch(self, tenant: _Tenant, args: tuple) -> None:
+    def _process_batch(self, tenant: _Tenant, payload: tuple) -> None:
+        seq, args = payload
         if tenant.nan_policy == "reject":
             self._nan_check(tenant, args)
         self._guarded(tenant, lambda: tenant.collection.update(*args))
         tenant.processed += 1
+        if seq is not None:
+            # worker-thread-only write: the applied watermark is what a
+            # checkpoint taken on this thread can truthfully claim. The
+            # per-tenant queue is FIFO so seqs arrive ascending; max() is
+            # armor against any future scheduler reordering quietly
+            # regressing the watermark below an applied seq
+            tenant.applied_seq = max(tenant.applied_seq, seq)
 
     @staticmethod
     def _nan_check(tenant: _Tenant, args: tuple) -> None:
@@ -643,6 +829,7 @@ class EvalDaemon:
         try:
             if payload["checkpoint"]:
                 path = self._checkpoint_tenant(tenant)
+                tenant.durable_seq = tenant.applied_seq
         except Exception as exc:  # noqa: BLE001 - relayed to the caller
             promise.reject(exc)
             return
@@ -669,14 +856,57 @@ class EvalDaemon:
             )
         promise.resolve(path)
 
-    def _checkpoint_tenant(self, tenant: _Tenant) -> str:
+    def _do_flush(self, tenant: _Tenant, promise: _Promise) -> None:
+        """Checkpoint the tenant's current folded state WITHOUT evicting
+        it — the wire client's replay-buffer valve: a flush advances the
+        durable watermark so the client can prune acked-and-now-durable
+        batches from its bounded replay buffer. An environmental
+        checkpoint failure rejects the promise and leaves the tenant
+        ACTIVE (same contract as detach — disk trouble is not tenant
+        poison)."""
+        try:
+            path = self._checkpoint_tenant(tenant)
+        except Exception as exc:  # noqa: BLE001 - relayed to the caller
+            promise.reject(exc)
+            return
+        tenant.durable_seq = tenant.applied_seq
+        promise.resolve({"path": path, "acked_seq": tenant.durable_seq})
+
+    def _checkpoint_tenant(self, tenant: _Tenant, *, rotate: bool = True) -> str:
         from torcheval_tpu.resilience.snapshot import save
 
         ckpt_dir = self._tenant_ckpt_dir(tenant.id, create=True)
+        # worker thread: every queued batch ahead of this request has been
+        # applied, so applied_seq is exactly the set of batches the folded
+        # state (and therefore this checkpoint) contains. The watermark
+        # rides the manifest's ``extra`` through the same atomic publish.
+        # NOTE: callers commit ``tenant.durable_seq`` themselves AFTER the
+        # checkpoint is known to stick — the idle-eviction path can still
+        # DISCARD this checkpoint if a submit raced in, and a watermark
+        # advanced for a discarded checkpoint would let a client prune
+        # replay entries whose only durable copy was just deleted.
+        # ``rotate=False`` defers keep_last rotation for the same reason:
+        # rotating at save time and then discarding the new checkpoint
+        # could leave ZERO checkpoints behind (with keep_last=1 the save
+        # deletes the old durable one and the abort deletes the new one)
+        # — the idle path rotates only after its eviction commits.
         with _obs.span("serve.tenant.evict", tenant=tenant.id):
             return save(
-                tenant.collection, ckpt_dir, keep_last=self._evict_keep_last
+                tenant.collection,
+                ckpt_dir,
+                keep_last=self._evict_keep_last if rotate else None,
+                extra={"serve": {"acked_seq": tenant.applied_seq}},
             )
+
+    def _rotate_tenant_ckpts(self, tenant_id: str) -> None:
+        """Apply ``evict_keep_last`` rotation after a deferred-rotation
+        checkpoint COMMITTED (see ``_checkpoint_tenant(rotate=False)``)."""
+        from torcheval_tpu.resilience.snapshot import rotate_checkpoints
+
+        ckpt_dir = self._tenant_ckpt_dir(tenant_id, create=False)
+        if ckpt_dir is None or self._evict_keep_last is None:
+            return
+        rotate_checkpoints(ckpt_dir, self._evict_keep_last)
 
     def _classify_and_quarantine(
         self, tenant: _Tenant, kind: str, exc: Exception
@@ -757,7 +987,11 @@ class EvalDaemon:
             ):
                 return  # a submit raced the watchdog: the tenant is live
         try:
-            path = self._checkpoint_tenant(tenant)
+            # rotation deferred to the commit below: if the eviction
+            # aborts, the discarded checkpoint must not have rotated away
+            # the previous durable one (clients pruned replay buffers
+            # against its watermark)
+            path = self._checkpoint_tenant(tenant, rotate=False)
         except Exception as exc:  # noqa: BLE001 - never kill the worker
             _logger.warning(
                 "serve: idle eviction of %r failed to checkpoint (%r); "
@@ -774,9 +1008,12 @@ class EvalDaemon:
             ):
                 # activity landed during the save: abort and discard the
                 # now-stale checkpoint (only this thread consumes queues,
-                # so ANY new work is visible here as a non-empty queue)
+                # so ANY new work is visible here as a non-empty queue;
+                # durable_seq was never advanced for it, so no client has
+                # pruned replay entries against the discarded copy)
                 shutil.rmtree(path, ignore_errors=True)
                 return
+            tenant.durable_seq = tenant.applied_seq
             tenant.status = TenantStatus.EVICTED
             tenant.error = TenantEvictedError(
                 "watchdog_idle",
@@ -790,6 +1027,7 @@ class EvalDaemon:
             self._totals["evicted"] += 1
             if _obs._enabled:
                 _obs.gauge("serve.tenants.active", float(len(self._tenants)))
+        self._rotate_tenant_ckpts(tenant.id)
         _logger.warning(
             "serve: evicted idle tenant %r (checkpoint %s)", tenant.id, path
         )
@@ -837,12 +1075,17 @@ class EvalDaemon:
                     "ingested": t.ingested,
                     "processed": t.processed,
                     "sheds": t.sheds,
+                    "dupes": t.dupes,
+                    "last_seq": t.last_seq,
+                    "applied_seq": t.applied_seq,
+                    "durable_seq": t.durable_seq,
                     "idle_s": now - t.last_activity,
                 }
                 for t in self._tenants.values()
             }
             out: Dict[str, Any] = {
                 "running": self._running,
+                "draining": self._draining,
                 "worker_alive": (
                     self._thread.is_alive() if self._thread else False
                 ),

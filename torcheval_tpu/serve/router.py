@@ -1,0 +1,542 @@
+"""`EvalRouter`: tenant placement, health probing, and cross-host migration.
+
+The cluster layer of ISSUE 10. A router fronts N eval-service hosts (each
+an :class:`EvalServer` + :class:`EvalDaemon` pair sharing one checkpoint
+root) with one :class:`~torcheval_tpu.serve.EvalClient` per endpoint, and
+makes the death of any single host a routine event (the TPU-serving
+stance: host loss and draining are absorbed, not outages):
+
+* **placement** — tenants place by rendezvous (highest-random-weight)
+  hashing of ``tenant_id`` over the *alive* endpoint set: deterministic,
+  coordination-free, and minimal-movement (a host's death moves only its
+  own tenants, never reshuffles survivors);
+* **health probing** — ``health()`` probes every alive host's
+  ``daemon.health()`` over the wire; a probe failure (or any transport
+  failure on a tenant op) marks the host dead and triggers migration;
+* **failure migration** — a dead host's tenants re-``attach`` on a
+  surviving host with ``resume="auto"``: the daemon restores each
+  tenant's latest checkpoint from the shared root (``resilience.save``'s
+  contract is location-independent — evict-on-idle and flushes already
+  write there) and re-arms its dedup watermark from the checkpoint
+  manifest; the router then replays the client-side replay buffer's
+  un-durable tail. Acked-and-checkpointed batches come back through the
+  checkpoint, un-acked ones through replay, and seq dedup absorbs the
+  overlap — post-migration computes match a fault-free oracle
+  bit-identically;
+* **graceful drain** — ``drain(endpoint)`` asks the host to
+  checkpoint-and-evict every tenant (it stops admitting immediately),
+  then migrates them the same way; use it before planned maintenance so
+  the "un-acked tail" is empty and the blackout is one restore long.
+
+Observability: ``serve.router.migrations{reason=}``,
+``serve.router.replays{tenant=}`` (counted at the replaying client),
+``serve.router.probe_failures{endpoint=}``, plus a
+``serve.router.migrate`` span per migrated host (a migration-blackout
+bar in the Chrome trace).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _trace
+from torcheval_tpu.serve.client import EvalClient
+from torcheval_tpu.serve.errors import AdmissionError, ServeError, WireError
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["EvalRouter"]
+
+
+class _RoutedTenant:
+    __slots__ = ("spec", "knobs", "endpoint")
+
+    def __init__(self, spec: Any, knobs: Dict[str, Any], endpoint: str):
+        self.spec = spec
+        self.knobs = knobs
+        self.endpoint = endpoint
+
+
+class EvalRouter:
+    """Route tenants across eval-service hosts; survive any one of them.
+
+    ``endpoints`` are ``"host:port"`` strings (or ``(host, port)``
+    tuples); ``client_kwargs`` configure every per-host
+    :class:`EvalClient` (deadlines, breaker, replay capacity — all
+    validated there). The hosts must share one checkpoint root (each
+    daemon's ``evict_dir``) for migration to have a resume source.
+
+    Thread-safe for the many-producers shape: submits for different
+    tenants proceed concurrently (per-tenant client locks); migration
+    holds the router lock so a failing host is migrated exactly once.
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[Any],
+        *,
+        client_factory: Any = EvalClient,
+        reroute_grace_s: float = 60.0,
+        probe_timeout_s: Optional[float] = 5.0,
+        **client_kwargs: Any,
+    ) -> None:
+        if not endpoints:
+            raise ValueError("EvalRouter needs at least one endpoint.")
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        for knob, value in (
+            ("reroute_grace_s", reroute_grace_s),
+            ("probe_timeout_s", probe_timeout_s),
+        ):
+            try:
+                _check_timeout_s(value)
+            except ValueError as e:
+                raise ValueError(f"{knob}: {e}") from None
+        if reroute_grace_s is None:
+            raise ValueError("reroute_grace_s must be a positive number.")
+        self._reroute_grace_s = float(reroute_grace_s)
+        self._probe_timeout_s = probe_timeout_s
+        self._clients: Dict[str, EvalClient] = {}
+        for ep in endpoints:
+            client = client_factory(ep, **client_kwargs)
+            self._clients[client.endpoint] = client
+        if len(self._clients) != len(endpoints):
+            raise ValueError(f"duplicate endpoints in {endpoints!r}.")
+        self._alive = set(self._clients)
+        self._tenants: Dict[str, _RoutedTenant] = {}
+        self._lock = threading.RLock()
+        # endpoints whose migration is in flight: the lock guards only
+        # the routing tables; migration's network work (attach + restore
+        # + replay per tenant) runs OUTSIDE it so one dying host never
+        # stalls traffic to healthy hosts. _cv wakes threads waiting for
+        # an in-flight migration to finish.
+        self._cv = threading.Condition(self._lock)
+        self._migrating: set = set()
+
+    # ------------------------------------------------------------ placement
+    def _place(self, tenant_id: str) -> str:
+        """Rendezvous placement over the alive set (deterministic for a
+        given alive set; no state to rebalance when hosts die)."""
+        with self._lock:
+            alive = sorted(self._alive)
+        if not alive:
+            raise ServeError(
+                "no_hosts", "every endpoint is dead or drained."
+            )
+        return max(
+            alive,
+            key=lambda ep: hashlib.sha256(
+                f"{tenant_id}@{ep}".encode()
+            ).digest(),
+        )
+
+    @property
+    def endpoints(self) -> List[str]:
+        return sorted(self._clients)
+
+    @property
+    def alive(self) -> List[str]:
+        with self._lock:
+            return sorted(self._alive)
+
+    def placement(self) -> Dict[str, str]:
+        """Current ``{tenant_id: endpoint}`` map."""
+        with self._lock:
+            return {t: rec.endpoint for t, rec in self._tenants.items()}
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
+
+    def __enter__(self) -> "EvalRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ tenant api
+    def attach(
+        self, tenant_id: str, spec: Dict[str, Any], **knobs: Any
+    ) -> str:
+        """Place and attach one tenant; returns the chosen endpoint.
+        ``spec``/``knobs`` are recorded so a migration can re-attach the
+        tenant identically elsewhere."""
+        with self._lock:
+            if tenant_id in self._tenants:
+                raise ServeError(
+                    "duplicate_tenant",
+                    f"tenant {tenant_id!r} is already routed.",
+                )
+        while True:
+            ep = self._place(tenant_id)
+            try:
+                self._clients[ep].attach(tenant_id, spec, **knobs)
+            except WireError as e:
+                if not e.retryable:
+                    raise
+                self._host_failed(ep, cause=e)
+                continue
+            except AdmissionError as e:
+                if e.reason != "draining":
+                    raise
+                # the rendezvous pick is mid-decommission: treat it like
+                # a failed host (same single-flight migration machinery;
+                # if the router's own drain() already owns the move this
+                # just waits for it) and re-place among the survivors
+                self._host_failed(ep, cause=e)
+                continue
+            with self._lock:
+                self._tenants[tenant_id] = _RoutedTenant(spec, dict(knobs), ep)
+            return ep
+
+    def _routed(self, tenant_id: str) -> _RoutedTenant:
+        with self._lock:
+            rec = self._tenants.get(tenant_id)
+        if rec is None:
+            raise ServeError(
+                "unknown_tenant",
+                f"tenant {tenant_id!r} is not routed; attach it first.",
+            )
+        return rec
+
+    def _with_failover(self, tenant_id: str, op) -> Any:
+        """Run one tenant op against its current host; on a transport
+        failure, migrate the host's tenants and run the op once more on
+        the new placement (compute/flush/detach are idempotent). A second
+        transport failure surfaces. The in-flight-migration window
+        (``tenant_migrated`` / client-side ``unknown_tenant`` for a
+        still-routed tenant) re-routes within ``reroute_grace_s``, like
+        ``submit``."""
+        wire_failures = 0
+        deadline = time.monotonic() + self._reroute_grace_s
+        sleep_s = 0.02
+        while True:
+            rec = self._routed(tenant_id)
+            client = self._clients[rec.endpoint]
+            try:
+                return op(client)
+            except WireError as e:
+                wire_failures += 1
+                if wire_failures >= 2 or not e.retryable:
+                    # a protocol error (version skew) is not evidence the
+                    # HOST is dead — don't let it trigger a migration
+                    raise
+                self._host_failed(rec.endpoint, cause=e)
+            except ServeError as e:
+                if e.reason == "tenant_migrated" or (
+                    e.reason == "unknown_tenant"
+                    and tenant_id in self._tenants
+                ):
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(sleep_s)
+                    sleep_s = min(sleep_s * 2, 0.5)
+                    continue
+                raise
+
+    def submit(self, tenant_id: str, *args: Any, **kw: Any) -> bool:
+        """Deliver one batch, surviving a host death or drain mid-submit.
+
+        A transport-failed submit whose batch was already booked in the
+        client replay buffer is delivered BY the migration's replay —
+        resubmitting it here under a fresh seq would apply it twice, so
+        failover only resubmits when the failure struck before booking.
+        Three structured rejects mean "the placement is changing, the
+        batch was NOT booked; wait and re-route" and are absorbed up to
+        ``reroute_grace_s``: ``tenant_migrated`` (a concurrent migration
+        exported the client state first), client-side ``unknown_tenant``
+        for a tenant the ROUTER still routes (the export-to-adopt window
+        of an in-flight migration), and ``draining`` (planned
+        decommission; the drain's own migration moves the tenant — a
+        drain issued behind the router's back never migrates, so the
+        grace period bounds that misuse with a structured error)."""
+        wire_failures = 0
+        deadline = time.monotonic() + self._reroute_grace_s
+        sleep_s = 0.02
+        while True:
+            rec = self._routed(tenant_id)
+            client = self._clients[rec.endpoint]
+            try:
+                return client.submit(tenant_id, *args, **kw)
+            except WireError as e:
+                wire_failures += 1
+                if wire_failures >= 2 or not e.retryable:
+                    raise
+                self._host_failed(rec.endpoint, cause=e)
+                if getattr(e, "batch_booked", False):
+                    # delivery is the migration replay's job — but only a
+                    # migration that SUCCEEDED for this tenant (it is
+                    # still routed) actually replayed it; a dropped
+                    # tenant's batch is gone and saying True would lie
+                    with self._lock:
+                        still_routed = tenant_id in self._tenants
+                    if still_routed:
+                        return True
+                    raise ServeError(
+                        "migration_failed",
+                        f"tenant {tenant_id!r} could not be migrated off "
+                        f"{rec.endpoint}; the in-flight batch was lost "
+                        "with it.",
+                    ) from e
+            except ServeError as e:
+                if getattr(e, "batch_booked", False):
+                    # the batch sits in the replay buffer under its seq
+                    # (an earlier ambiguous attempt may have been
+                    # admitted): it must be delivered by a MIGRATION'S
+                    # replay, never resubmitted fresh. Wait for the
+                    # tenant to move off this endpoint within the grace
+                    # budget; if nothing moves it, surface the error
+                    # (the booking stays, a later migration still
+                    # delivers exactly once).
+                    old_ep = rec.endpoint
+                    while time.monotonic() < deadline:
+                        self._wait_not_migrating(old_ep, timeout_s=1.0)
+                        with self._lock:
+                            cur = self._tenants.get(tenant_id)
+                        if cur is None:
+                            raise ServeError(
+                                "migration_failed",
+                                f"tenant {tenant_id!r} was dropped while "
+                                "its in-flight batch awaited migration.",
+                            ) from e
+                        if cur.endpoint != old_ep:
+                            return True  # migrated: the replay carried it
+                        time.sleep(sleep_s)
+                        sleep_s = min(sleep_s * 2, 0.5)
+                    raise
+                if e.reason == "tenant_migrated" or (
+                    e.reason == "unknown_tenant"
+                    and tenant_id in self._tenants
+                ):
+                    pass  # re-route (possibly after the wait below)
+                elif e.reason == "draining":
+                    self._wait_not_migrating(rec.endpoint, timeout_s=5.0)
+                else:
+                    raise
+                if time.monotonic() >= deadline:
+                    raise ServeError(
+                        "reroute_storm",
+                        f"tenant {tenant_id!r}: submit could not settle "
+                        f"on a host within {self._reroute_grace_s}s of "
+                        "migrations/drains.",
+                    ) from e
+                time.sleep(sleep_s)
+                sleep_s = min(sleep_s * 2, 0.5)
+
+    def compute(self, tenant_id: str, **kw: Any) -> Any:
+        return self._with_failover(
+            tenant_id, lambda c: c.compute(tenant_id, **kw)
+        )
+
+    def sync_compute(self, tenant_id: str, **kw: Any) -> Any:
+        return self._with_failover(
+            tenant_id, lambda c: c.sync_compute(tenant_id, **kw)
+        )
+
+    def flush(self, tenant_id: str, **kw: Any) -> dict:
+        return self._with_failover(
+            tenant_id, lambda c: c.flush(tenant_id, **kw)
+        )
+
+    def detach(self, tenant_id: str, **kw: Any) -> Optional[str]:
+        try:
+            return self._with_failover(
+                tenant_id, lambda c: c.detach(tenant_id, **kw)
+            )
+        finally:
+            with self._lock:
+                self._tenants.pop(tenant_id, None)
+
+    # --------------------------------------------------------------- health
+    def health(
+        self, *, migrate: bool = True, timeout_s: Any = None
+    ) -> Dict[str, Any]:
+        """Probe every alive host's ``daemon.health()``. A failed probe
+        counts ``serve.router.probe_failures{endpoint=}`` and (with
+        ``migrate=True``) marks the host dead and migrates its tenants
+        right away — a monitoring loop doubles as the failure detector.
+        Probes run single-attempt under ``probe_timeout_s`` (overridable
+        via ``timeout_s``): one partitioned host must not blind the
+        detector to the others for a whole retry ladder. Returns per-host
+        health (``None`` for failed probes), the alive set, and the
+        tenant placement."""
+        probe_timeout = (
+            timeout_s if timeout_s is not None else self._probe_timeout_s
+        )
+        hosts: Dict[str, Any] = {}
+        for ep in self.alive:
+            try:
+                hosts[ep] = self._clients[ep].health(
+                    timeout_s=probe_timeout, attempts=1
+                )
+            except (WireError, ServeError) as e:
+                hosts[ep] = None
+                if _obs._enabled:
+                    _obs.counter(
+                        "serve.router.probe_failures", endpoint=ep
+                    )
+                _logger.warning(
+                    "router: health probe of %s failed: %s", ep, e
+                )
+                if migrate:
+                    self._host_failed(ep, cause=e)
+        return {
+            "hosts": hosts,
+            "alive": self.alive,
+            "tenants": self.placement(),
+        }
+
+    # ------------------------------------------------------------ migration
+    def _wait_not_migrating(
+        self, endpoint: str, *, timeout_s: float = 300.0
+    ) -> None:
+        """Block until no migration is in flight for ``endpoint`` (or the
+        bound expires), so a caller that returns afterwards observes
+        post-migration routing."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: endpoint not in self._migrating, timeout=timeout_s
+            )
+
+    def _host_failed(self, endpoint: str, *, cause: BaseException) -> None:
+        """Mark ``endpoint`` dead and migrate every tenant it held.
+        Single-flight per endpoint: exactly one thread runs the
+        migration; concurrent reporters of the same failure WAIT for it
+        (their booked batches are delivered by the migration's replay,
+        so returning before it finishes would lie to them). The network
+        work runs OUTSIDE the router lock — healthy hosts keep serving
+        while a dead one is migrated."""
+        with self._cv:
+            if endpoint in self._alive:
+                self._alive.discard(endpoint)
+                self._migrating.add(endpoint)
+            elif endpoint in self._migrating:
+                self._cv.wait_for(
+                    lambda: endpoint not in self._migrating, timeout=300.0
+                )
+                return
+            else:
+                return  # already dead and fully migrated
+        _logger.warning(
+            "router: endpoint %s marked dead (%s); migrating its tenants.",
+            endpoint,
+            cause,
+        )
+        try:
+            self._migrate_host(endpoint, reason="host_failure")
+        finally:
+            with self._cv:
+                self._migrating.discard(endpoint)
+                self._cv.notify_all()
+
+    def drain(
+        self, endpoint: str, *, timeout_s: Any = None
+    ) -> Dict[str, Any]:
+        """Gracefully move every tenant off ``endpoint``: the host
+        checkpoints-and-evicts them all (admissions stop immediately),
+        the endpoint leaves the alive set, and the tenants re-attach
+        elsewhere from their fresh checkpoints. Returns
+        ``{"drained": {tenant: ckpt_path}, "migrated": [tenant, ...]}``."""
+        if endpoint not in self._clients:
+            raise ValueError(f"unknown endpoint {endpoint!r}.")
+        kw = {} if timeout_s is None else {"timeout_s": timeout_s}
+        drained = self._clients[endpoint].drain(**kw)
+        with self._cv:
+            if endpoint in self._migrating:
+                # a concurrent failure migration beat us to the move;
+                # wait it out — the drain still checkpointed everything
+                self._cv.wait_for(
+                    lambda: endpoint not in self._migrating, timeout=300.0
+                )
+                return {"drained": drained, "migrated": []}
+            self._alive.discard(endpoint)
+            self._migrating.add(endpoint)
+        try:
+            migrated = self._migrate_host(endpoint, reason="drain")
+        finally:
+            with self._cv:
+                self._migrating.discard(endpoint)
+                self._cv.notify_all()
+        return {"drained": drained, "migrated": migrated}
+
+    def _migrate_host(self, endpoint: str, *, reason: str) -> List[str]:
+        """Move every tenant routed to ``endpoint`` onto survivors.
+        Caller holds the endpoint's ``_migrating`` slot (single-flight),
+        NOT the router lock — the per-tenant network work must not stall
+        ops against healthy hosts."""
+        with self._lock:
+            victims = [
+                t
+                for t, rec in self._tenants.items()
+                if rec.endpoint == endpoint
+            ]
+        migrated: List[str] = []
+        with _obs.span(
+            "serve.router.migrate", endpoint=endpoint, reason=reason
+        ):
+            for tenant_id in victims:
+                try:
+                    self._migrate_tenant(tenant_id, endpoint, reason)
+                    migrated.append(tenant_id)
+                except Exception as e:  # noqa: BLE001 - containment wall
+                    # a tenant that cannot migrate (no usable checkpoint —
+                    # incl. a remote CheckpointError — no survivors, a
+                    # checkpoint_behind refusal) is dropped from the
+                    # routing table with a loud log, and the REST of the
+                    # host's tenants still migrate: one tenant's bad
+                    # checkpoint must never strand its neighbors on a
+                    # dead endpoint. The caller's next op on the dropped
+                    # tenant raises unknown_tenant, never a silent ghost.
+                    _logger.error(
+                        "router: tenant %r failed to migrate off %s: %s",
+                        tenant_id,
+                        endpoint,
+                        e,
+                    )
+                    with self._lock:
+                        self._tenants.pop(tenant_id, None)
+        if _obs._enabled and victims:
+            _trace.instant(
+                "serve.router.migrated",
+                kind="serve",
+                endpoint=endpoint,
+                reason=reason,
+                tenants=len(migrated),
+            )
+        return migrated
+
+    def _migrate_tenant(
+        self, tenant_id: str, from_ep: str, reason: str
+    ) -> None:
+        with self._lock:
+            rec = self._tenants.get(tenant_id)
+        if rec is None:
+            return  # detached while the migration was queued
+        exported = self._clients[from_ep].export_tenant(tenant_id)
+        new_ep = self._place(tenant_id)
+        client = self._clients[new_ep]
+        knobs = dict(rec.knobs)
+        knobs["resume"] = "auto"  # restore the shared-root checkpoint
+        attach_resp = client.attach(tenant_id, rec.spec, **knobs)
+        replayed = client.adopt_tenant(
+            tenant_id, exported, restored_seq=int(attach_resp["last_seq"])
+        )
+        with self._lock:
+            rec.endpoint = new_ep
+        if _obs._enabled:
+            _obs.counter("serve.router.migrations", reason=reason)
+        _logger.warning(
+            "router: migrated tenant %r %s -> %s (%s; checkpoint seq %d, "
+            "replayed %d)",
+            tenant_id,
+            from_ep,
+            new_ep,
+            reason,
+            int(attach_resp["last_seq"]),
+            replayed,
+        )

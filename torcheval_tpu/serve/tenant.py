@@ -91,6 +91,10 @@ class _Tenant:
         "processed",
         "sheds",
         "seq",
+        "last_seq",
+        "applied_seq",
+        "durable_seq",
+        "dupes",
     )
 
     def __init__(
@@ -118,6 +122,18 @@ class _Tenant:
         self.processed = 0
         self.sheds = 0
         self.seq = seq
+        # wire-sequence bookkeeping (ISSUE 10): highest client sequence
+        # number ADMITTED to the queue (the dedup watermark — a replayed
+        # submit at or below it is acknowledged without re-applying),
+        # highest APPLIED into the collection (worker thread only), and
+        # highest covered by a published checkpoint (the durable
+        # watermark an ack reports so clients can prune replay buffers).
+        # All 0 for tenants never driven over the wire (seq=None submits
+        # leave them untouched).
+        self.last_seq = 0
+        self.applied_seq = 0
+        self.durable_seq = 0
+        self.dupes = 0
 
 
 class TenantHandle:
@@ -154,16 +170,34 @@ class TenantHandle:
 
     # ---------------------------------------------------------------- ops
     def submit(
-        self, *args: Any, block: bool = False, timeout: Optional[float] = None
-    ) -> "TenantHandle":
+        self,
+        *args: Any,
+        block: bool = False,
+        timeout: Optional[float] = None,
+        seq: Optional[int] = None,
+    ) -> bool:
         """Enqueue one update batch (the metric ``update`` positional
-        args). Returns immediately once queued; the device work happens on
-        the daemon worker. On a full queue: ``block=False`` sheds with
+        args). Returns once queued; the device work happens on the daemon
+        worker. On a full queue: ``block=False`` sheds with
         :class:`~torcheval_tpu.serve.BackpressureError` (reason
         ``"queue_full"``), ``block=True`` waits up to ``timeout`` seconds
-        for space (then sheds)."""
-        self._daemon._submit(self._tenant, args, block=block, timeout=timeout)
-        return self
+        for space (then sheds). ``seq`` is the wire layer's per-tenant
+        monotonic sequence number: a resubmit at or below the admitted
+        watermark is acknowledged without re-applying (returns ``False``)
+        — exactly-once into the metric state under at-least-once
+        delivery. Returns ``True`` when the batch was admitted."""
+        return self._daemon._submit(
+            self._tenant, args, block=block, timeout=timeout, seq=seq
+        )
+
+    def flush(self, *, timeout: Optional[float] = None) -> dict:
+        """Fold and checkpoint this tenant's current state WITHOUT
+        evicting it: ``{"path": ckpt_dir, "acked_seq": durable_watermark}``.
+        The wire client calls this to advance the durable watermark when
+        its bounded replay buffer fills; local callers get a midstream
+        resume point for free. The tenant stays ACTIVE and continues
+        bit-identically."""
+        return self._daemon._request(self._tenant, "flush", timeout=timeout)
 
     def compute(self, *, timeout: Optional[float] = None) -> Any:
         """Drain this tenant's queued batches, close its eval window and

@@ -1,0 +1,619 @@
+"""Network wire for the eval service: framing, marshalling, `EvalServer`.
+
+ISSUE 10's ingestion layer. The single-host :class:`EvalDaemon` (PR 8)
+already decouples many producer *threads* from one device-owning worker;
+this module pushes the producer side across a network boundary — the
+Podracer split of many remote actors feeding a small number of
+device-owning learners (arXiv:2104.06272) — with **no new runtime
+dependency**: plain TCP sockets, a length-prefixed JSON header, and an
+optional ``npz`` binary payload for arrays.
+
+Frame layout (all integers big-endian)::
+
+    magic   4 bytes  b"TEW1"   (protocol + version; a stray speaker on
+                                the port fails fast as "protocol")
+    hlen    4 bytes  uint32    header length
+    plen    8 bytes  uint64    payload length
+    header  hlen bytes         UTF-8 JSON object
+    payload plen bytes         npz archive (absent when plen == 0)
+
+Request headers carry ``op`` (``attach`` / ``submit`` / ``compute`` /
+``sync_compute`` / ``flush`` / ``detach`` / ``drain`` / ``health`` /
+``snapshot``) plus op-specific fields; responses carry ``ok`` and either
+the result or a structured ``error`` object that reconstructs the
+serve-side exception CLASS, ``reason``, and ``retryable`` flag on the
+client (:func:`encode_error` / :func:`decode_error`) — a remote caller
+branches on exactly the bits a local caller would.
+
+Array trees (submit args, compute results) cross as
+:func:`pack_tree`/:func:`unpack_tree`: a JSON spec mirroring the
+container structure with array leaves swapped for indices into one npz
+payload — exact dtype/shape round trip, no pickling, ``allow_pickle``
+stays off.
+
+**Exactly-once submits.** Each wire submit carries the client's
+per-tenant monotonic ``seq``; the daemon deduplicates at admission
+(``seq <= last admitted`` is acknowledged without re-applying). The wire
+is therefore at-least-once — a client MAY blindly resend after an
+ambiguous failure (connection died after send, before the ack) — while
+the metric state is exactly-once. Acks return the tenant's *durable*
+watermark (highest seq covered by a published checkpoint) so clients can
+prune their bounded replay buffers.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.resilience import chaos as _chaos
+from torcheval_tpu.serve.errors import (
+    AdmissionError,
+    ServeError,
+    WireError,
+)
+
+_logger = logging.getLogger(__name__)
+
+__all__ = [
+    "EvalServer",
+    "pack_tree",
+    "unpack_tree",
+    "encode_error",
+    "decode_error",
+    "send_frame",
+    "recv_frame",
+]
+
+_MAGIC = b"TEW1"
+_HEAD = struct.Struct(">4sIQ")
+_MAX_HEADER_BYTES = 16 << 20
+_MAX_PAYLOAD_BYTES = 1 << 31
+
+
+# ------------------------------------------------------------------ framing
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; ``None`` on clean EOF at a frame
+    boundary (``n`` asked, zero read); ``protocol`` error mid-frame."""
+    if n == 0:
+        return b""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            if not buf:
+                return None
+            raise WireError(
+                "protocol",
+                f"connection closed mid-frame ({len(buf)}/{n} bytes).",
+            )
+        buf += chunk
+    return bytes(buf)
+
+
+def send_frame(
+    sock: socket.socket, header: Dict[str, Any], payload: bytes = b""
+) -> None:
+    """Serialize and send one frame (header dict + binary payload)."""
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    sock.sendall(
+        _HEAD.pack(_MAGIC, len(hbytes), len(payload)) + hbytes + payload
+    )
+
+
+def recv_frame(
+    sock: socket.socket,
+) -> Optional[Tuple[Dict[str, Any], bytes]]:
+    """Receive one frame; ``None`` on clean EOF. Raises
+    :class:`WireError(reason="protocol")` on garbage — wrong magic,
+    absurd lengths, unparseable header — so a client never retries
+    against a peer that speaks something else."""
+    head = _recv_exact(sock, _HEAD.size)
+    if head is None:
+        return None
+    magic, hlen, plen = _HEAD.unpack(head)
+    if magic != _MAGIC:
+        raise WireError(
+            "protocol",
+            f"bad frame magic {magic!r} (expected {_MAGIC!r}) — not a "
+            "torcheval-tpu eval-wire peer, or a protocol version skew.",
+        )
+    if hlen > _MAX_HEADER_BYTES or plen > _MAX_PAYLOAD_BYTES:
+        raise WireError(
+            "protocol", f"frame sizes out of range (hlen={hlen}, plen={plen})."
+        )
+    hbytes = _recv_exact(sock, hlen)
+    if hbytes is None:
+        raise WireError("protocol", "connection closed before header.")
+    try:
+        header = json.loads(hbytes)
+    except json.JSONDecodeError as e:
+        raise WireError("protocol", f"unparseable frame header: {e}") from None
+    payload = _recv_exact(sock, plen)
+    if payload is None and plen:
+        raise WireError("protocol", "connection closed before payload.")
+    return header, payload or b""
+
+
+# -------------------------------------------------------------- tree coding
+def pack_tree(obj: Any) -> Tuple[Any, bytes]:
+    """Encode a result/args tree (dicts, lists/tuples, scalars, arrays)
+    into a JSON-safe spec plus ONE npz payload holding every array leaf.
+    Anything with ``__array__`` (numpy, jax arrays, torch tensors)
+    becomes an array leaf; exact dtype/shape survive the round trip."""
+    arrays: Dict[str, np.ndarray] = {}
+
+    def enc(x: Any) -> Any:
+        if x is None or isinstance(x, (bool, int, float, str)):
+            return {"t": "py", "v": x}
+        if isinstance(x, dict):
+            return {
+                "t": "dict",
+                "k": [enc(k) for k in x.keys()],
+                "v": [enc(v) for v in x.values()],
+            }
+        if isinstance(x, (list, tuple)):
+            return {
+                "t": "list" if isinstance(x, list) else "tuple",
+                "v": [enc(v) for v in x],
+            }
+        try:
+            arr = np.asarray(x)
+        except Exception:
+            arr = None
+        if arr is None or arr.dtype == object:
+            # np.asarray swallows almost anything into an object array;
+            # an object leaf would need pickling, which the wire refuses
+            raise WireError(
+                "protocol",
+                f"cannot marshal {type(x).__name__} over the eval wire "
+                "(dicts, lists, scalars and numeric array-likes only).",
+            )
+        key = f"a{len(arrays)}"
+        arrays[key] = arr
+        return {"t": "arr", "i": key}
+
+    spec = enc(obj)
+    if not arrays:
+        return spec, b""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return spec, buf.getvalue()
+
+
+def unpack_tree(spec: Any, payload: bytes) -> Any:
+    """Inverse of :func:`pack_tree`."""
+    arrays: Dict[str, np.ndarray] = {}
+    if payload:
+        try:
+            with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+                arrays = {k: z[k] for k in z.files}
+        except Exception as e:
+            raise WireError(
+                "protocol", f"undecodable array payload: {e}"
+            ) from None
+
+    def dec(s: Any) -> Any:
+        try:
+            t = s["t"]
+            if t == "py":
+                return s["v"]
+            if t == "dict":
+                return {
+                    dec(k): dec(v) for k, v in zip(s["k"], s["v"])
+                }
+            if t == "list":
+                return [dec(v) for v in s["v"]]
+            if t == "tuple":
+                return tuple(dec(v) for v in s["v"])
+            if t == "arr":
+                return arrays[s["i"]]
+        except (KeyError, TypeError, IndexError):
+            pass
+        raise WireError("protocol", f"malformed tree spec node: {s!r}.")
+
+    return dec(spec)
+
+
+# ------------------------------------------------------------------- errors
+def _bare_message(exc: BaseException) -> str:
+    """Strip the ``[reason]`` prefix ``ServeError.__init__`` composes, so
+    a decode does not stack a second one."""
+    msg = str(exc)
+    reason = getattr(exc, "reason", None)
+    prefix = f"[{reason}] "
+    return msg[len(prefix):] if reason and msg.startswith(prefix) else msg
+
+
+def encode_error(exc: BaseException) -> Dict[str, Any]:
+    """Structured wire form of a serve-side failure: class name, reason,
+    retryable flag, and the per-class extras (tenant/checkpoint)."""
+    out: Dict[str, Any] = {
+        "type": type(exc).__name__,
+        "reason": getattr(exc, "reason", "internal"),
+        "message": _bare_message(exc),
+        "retryable": bool(getattr(exc, "retryable", False)),
+    }
+    for field in ("tenant", "checkpoint", "endpoint"):
+        value = getattr(exc, field, None)
+        if value is not None:
+            out[field] = value
+    return out
+
+
+def decode_error(err: Dict[str, Any]) -> BaseException:
+    """Reconstruct the exception :func:`encode_error` marshalled: the
+    matching serve class when the type is known (so an except-clause
+    written against local daemon calls works unchanged against the
+    wire), a generic :class:`ServeError` otherwise. ``retryable`` is
+    copied from the wire — the shared classification crosses intact."""
+    from torcheval_tpu.resilience.snapshot import CheckpointError
+    from torcheval_tpu.serve import errors as _errs
+
+    name = err.get("type", "ServeError")
+    reason = err.get("reason", "internal")
+    message = err.get("message", "(no message)")
+    tenant = err.get("tenant", "?")
+    exc: BaseException
+    if name == "BackpressureError":
+        exc = _errs.BackpressureError(reason, message, tenant=tenant)
+    elif name == "TenantQuarantinedError":
+        exc = _errs.TenantQuarantinedError(reason, message, tenant=tenant)
+    elif name == "TenantEvictedError":
+        exc = _errs.TenantEvictedError(
+            reason, message, tenant=tenant, checkpoint=err.get("checkpoint")
+        )
+    elif name == "TenantError":
+        exc = _errs.TenantError(reason, message, tenant=tenant)
+    elif name == "AdmissionError":
+        exc = _errs.AdmissionError(reason, message)
+    elif name == "WireError":
+        exc = _errs.WireError(reason, message, endpoint=err.get("endpoint"))
+    elif name == "CheckpointError":
+        exc = CheckpointError(reason, message)
+    elif name == "ValueError":
+        exc = ValueError(message)
+    else:
+        exc = _errs.ServeError(reason, message)
+    if hasattr(exc, "retryable") or "retryable" in err:
+        exc.retryable = bool(err.get("retryable", False))
+    return exc
+
+
+# ------------------------------------------------------------- metric specs
+def build_metrics(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Instantiate ``{name: Metric}`` from a wire metric spec
+    ``{name: [class_name, kwargs]}`` — class names resolve against the
+    public ``torcheval_tpu.metrics`` namespace only (no dotted paths, no
+    pickles: a metric spec can never execute caller-chosen code). An
+    unknown class or bad constructor args reject as
+    ``AdmissionError("bad_metrics")``."""
+    from torcheval_tpu import metrics as _metrics_ns
+    from torcheval_tpu.metrics.metric import Metric
+
+    if not isinstance(spec, dict) or not spec:
+        raise AdmissionError(
+            "bad_metrics", f"metric spec must be a non-empty dict, got {spec!r}."
+        )
+    out: Dict[str, Any] = {}
+    for name, entry in spec.items():
+        try:
+            cls_name, kwargs = entry[0], (entry[1] if len(entry) > 1 else {})
+        except (TypeError, IndexError, KeyError):
+            raise AdmissionError(
+                "bad_metrics",
+                f"metric spec entry {name!r} must be [class_name, kwargs], "
+                f"got {entry!r}.",
+            ) from None
+        cls = getattr(_metrics_ns, str(cls_name), None)
+        if not (isinstance(cls, type) and issubclass(cls, Metric)):
+            raise AdmissionError(
+                "bad_metrics",
+                f"metric spec entry {name!r} names {cls_name!r}, which is "
+                "not a torcheval_tpu.metrics Metric class.",
+            )
+        try:
+            out[name] = cls(**dict(kwargs or {}))
+        except (TypeError, ValueError) as e:
+            raise AdmissionError(
+                "bad_metrics",
+                f"constructing {cls_name}({kwargs!r}) for {name!r} failed: {e}",
+            ) from e
+    return out
+
+
+# ------------------------------------------------------------------- server
+class EvalServer:
+    """TCP front end for one :class:`EvalDaemon`.
+
+    Binds on construction (``port=0`` = OS-assigned, read it back from
+    ``.address``) and serves immediately: an accept-loop thread plus one
+    handler thread per connection — connection counts at eval-service
+    scale are small (routers and producer fleets multiplex many tenants
+    per connection), and a blocked tenant op never stalls another
+    connection. All device work still happens on the daemon's single
+    worker thread; handler threads only enqueue and wait on promises,
+    exactly like local producer threads.
+
+    Structured failures cross the wire via :func:`encode_error`; an
+    unexpected handler exception is contained per-request (``ok=False``
+    with reason ``"internal"``), never tearing the server down.
+    """
+
+    def __init__(
+        self,
+        daemon: Any,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        backlog: int = 32,
+    ) -> None:
+        self._daemon = daemon
+        self._sock = socket.create_server((host, port), backlog=backlog)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._handles: Dict[str, Any] = {}
+        self._attach_nonces: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._conns: set = set()
+        self._running = True
+        # chaos host_partition: once tripped the server stops ACKing —
+        # requests are read and dropped, modelling a half-dead host whose
+        # TCP stack answers but whose service never does
+        self._partitioned = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name="torcheval-tpu-eval-server-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    def close(self) -> None:
+        """Stop accepting AND sever live connections — a closed server is
+        fully gone from the network's point of view (clients see dead
+        sockets, not a listener that answers on old connections)."""
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "EvalServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ transport
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._serve_connection,
+                args=(conn,),
+                name="torcheval-tpu-eval-server-conn",
+                daemon=True,
+            ).start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        with self._lock:
+            self._conns.add(conn)
+        try:
+            while self._running:
+                try:
+                    frame = recv_frame(conn)
+                except WireError as e:
+                    _logger.warning("eval-wire: dropping connection: %s", e)
+                    return
+                if frame is None:
+                    return
+                header, payload = frame
+                if self._partitioned:
+                    continue  # read and never answer (see class doc)
+                response = self._dispatch(header, payload)
+                if response is None:
+                    continue  # partition tripped ON this request
+                try:
+                    send_frame(conn, *response)
+                except OSError:
+                    return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(
+        self, header: Dict[str, Any], payload: bytes
+    ) -> Optional[Tuple[Dict[str, Any], bytes]]:
+        op = str(header.get("op", "?"))
+        tenant = header.get("tenant")
+        if _obs._enabled:
+            _obs.counter("serve.wire.requests", op=op)
+        if _chaos.host_armed():
+            directive = _chaos.on_host_request(op, tenant)
+            if directive == "partition":
+                self._partitioned = True
+                return None
+            # "ack_drop" processes below and dies before the ack
+        else:
+            directive = None
+        try:
+            out_header, out_payload = self._handle(op, header, payload)
+            response = ({"ok": True, **out_header}, out_payload)
+        except BaseException as exc:  # noqa: BLE001 - containment wall
+            if not isinstance(exc, (ServeError, ValueError)) and not type(
+                exc
+            ).__name__.endswith("CheckpointError"):
+                _logger.exception("eval-wire: %s request failed", op)
+            response = ({"ok": False, "error": encode_error(exc)}, b"")
+        if directive == "ack_drop":
+            # process-then-die-before-ack: the host dies before ANY
+            # answer leaves — including an error one; a request that
+            # happened to reject must not quietly consume the one-shot
+            # fault and let the drill pass without a fault
+            _chaos.host_die("ack_drop")
+        return response
+
+    def _handle(
+        self, op: str, header: Dict[str, Any], payload: bytes
+    ) -> Tuple[Dict[str, Any], bytes]:
+        if op == "health":
+            return {"health": self._daemon.health()}, b""
+        if op == "snapshot":
+            from torcheval_tpu import obs
+
+            spec, blob = pack_tree(
+                {"snapshot": obs.snapshot(), "trace": obs.chrome_trace()}
+            )
+            return {"result": spec}, blob
+        if op == "drain":
+            drained = self._daemon.drain(timeout=header.get("timeout"))
+            with self._lock:
+                for tid in drained:
+                    self._handles.pop(tid, None)
+                    self._attach_nonces.pop(tid, None)
+            return {"tenants": drained}, b""
+        if op == "attach":
+            return self._handle_attach(header)
+        if op not in ("submit", "compute", "sync_compute", "flush", "detach"):
+            raise WireError("protocol", f"unknown wire op {op!r}.")
+        # every remaining op targets one attached tenant
+        handle = self._tenant_handle(str(header.get("tenant")))
+        if op == "submit":
+            seq = int(header["seq"])
+            args = unpack_tree(header["args"], payload)
+            applied = handle.submit(*args, seq=seq)
+            return {
+                "applied": applied,
+                "acked_seq": handle._tenant.durable_seq,
+            }, b""
+        if op == "compute":
+            result = handle.compute(timeout=header.get("timeout"))
+            spec, blob = pack_tree(result)
+            return {"result": spec}, blob
+        if op == "sync_compute":
+            result = handle.sync_compute(
+                timeout_s=header.get("timeout_s"),
+                on_failure=header.get("on_failure", "raise"),
+                timeout=header.get("timeout"),
+            )
+            spec, blob = pack_tree(result)
+            return {"result": spec}, blob
+        if op == "flush":
+            out = handle.flush(timeout=header.get("timeout"))
+            return {"path": out["path"], "acked_seq": out["acked_seq"]}, b""
+        if op == "detach":
+            path = handle.detach(
+                checkpoint=bool(header.get("checkpoint", False)),
+                timeout=header.get("timeout"),
+            )
+            with self._lock:
+                self._handles.pop(handle.tenant_id, None)
+                self._attach_nonces.pop(handle.tenant_id, None)
+            return {"checkpoint": path}, b""
+        raise AssertionError(op)  # pragma: no cover - gated above
+
+    def _handle_attach(
+        self, header: Dict[str, Any]
+    ) -> Tuple[Dict[str, Any], bytes]:
+        tenant_id = str(header.get("tenant"))
+        nonce = header.get("nonce")
+        metrics = build_metrics(header.get("spec"))
+        kwargs: Dict[str, Any] = {}
+        for knob in (
+            "nan_policy",
+            "watchdog_timeout_s",
+            "step_timeout_s",
+            "queue_capacity",
+            "resume",
+        ):
+            if header.get(knob) is not None:
+                kwargs[knob] = header[knob]
+        try:
+            handle = self._daemon.attach(tenant_id, metrics, **kwargs)
+        except AdmissionError as e:
+            if e.reason == "duplicate_tenant" and nonce is not None:
+                # possibly a blind retry of OUR OWN attach whose ack was
+                # lost (or whose original request is STILL mid-restore —
+                # the daemon reserves the id before its checkpoint I/O):
+                # attach is idempotent per nonce; wait for the original
+                # to commit and re-ack its success. No submits can have
+                # landed in between — the retrying client serializes
+                # attach before them.
+                deadline = time.monotonic() + 30.0
+                while True:
+                    with self._lock:
+                        prior_nonce = self._attach_nonces.get(tenant_id)
+                        prior_handle = self._handles.get(tenant_id)
+                    if prior_handle is not None:
+                        if prior_nonce == nonce:
+                            return {
+                                "last_seq": prior_handle._tenant.durable_seq
+                            }, b""
+                        break  # a different caller's committed tenant
+                    if (
+                        not self._attach_pending(tenant_id)
+                        or time.monotonic() >= deadline
+                    ):
+                        break  # no in-flight attach that could be ours
+                    time.sleep(0.05)
+            raise
+        with self._lock:
+            self._handles[tenant_id] = handle
+            self._attach_nonces[tenant_id] = nonce
+        return {"last_seq": handle._tenant.durable_seq}, b""
+
+    def _attach_pending(self, tenant_id: str) -> bool:
+        """True while the daemon holds ``tenant_id`` reserved for an
+        in-flight admission (the restore-outside-the-lock window)."""
+        daemon_lock = getattr(self._daemon, "_lock", None)
+        attaching = getattr(self._daemon, "_attaching", None)
+        if daemon_lock is None or attaching is None:
+            return False
+        with daemon_lock:
+            return tenant_id in attaching
+
+    def _tenant_handle(self, tenant_id: str):
+        with self._lock:
+            handle = self._handles.get(tenant_id)
+        if handle is None:
+            raise ServeError(
+                "unknown_tenant",
+                f"no tenant {tenant_id!r} attached over this wire; "
+                "attach first.",
+            )
+        return handle
