@@ -1049,17 +1049,28 @@ def config7_serve_tenants():
 
 
 def config8_cluster():
-    """ISSUE 10: the network front end's price and the migration blackout.
+    """ISSUE 10/11: the network front end's price and the migration
+    blackout.
 
-    Three legs over ONE workload (N batches of the config1 shape into a
-    single tenant): (a) ``local_direct`` — the in-process TenantHandle
+    Legs over ONE workload (N distinct batches of the config1 shape into
+    a single tenant): (a) ``local_direct`` — the in-process TenantHandle
     path (PR 8's fast path, the baseline); (b) ``wire_1host`` — the same
     stream through EvalServer/EvalClient over loopback TCP with
     idempotent-seq bookkeeping, plus the wire/in-process throughput
-    ratio; (c) ``wire_2host_migration`` — two hosts sharing a checkpoint
+    ratio; (b2) ``ingest_overlap`` — concurrent producers at a tight
+    window cadence, measuring how much of each window's fill ran while
+    the previous window's step executed (the ISSUE 11 double-buffering
+    proof); (c) ``wire_2host_migration`` — two hosts sharing a checkpoint
     root, the tenant's host killed mid-stream, measuring the *blackout*:
     wall time from the first failed submit until that batch is durable on
-    the survivor (failure detection + checkpoint restore + replay)."""
+    the survivor (failure detection + checkpoint restore + replay).
+
+    Since ISSUE 11 the timed legs submit DISTINCT batch buffers (a real
+    stream never re-submits one array object; identical objects would
+    let the coalesced-H2D dedup skip transfers the workload should pay)
+    and pin the window cadence with ``window_chunks`` so every window
+    program both legs dispatch is warmed ahead of the timers — the ratio
+    compares steady-state serving, never a one-off XLA compile."""
     import tempfile
 
     from torcheval_tpu.metrics import MulticlassAccuracy
@@ -1072,51 +1083,152 @@ def config8_cluster():
 
     n_batches = 8 if _SMOKE else 64
     batch = 256 if _SMOKE else 8192
+    window_chunks = 4 if _SMOKE else 8  # n_batches % window_chunks == 0
     rng = np.random.default_rng(8)
-    scores = rng.random((batch, NUM_CLASSES)).astype(np.float32)
-    labels = rng.integers(0, NUM_CLASSES, batch)
+    batches = [
+        (
+            rng.random((batch, NUM_CLASSES)).astype(np.float32),
+            rng.integers(0, NUM_CLASSES, batch),
+        )
+        for _ in range(n_batches)
+    ]
+    scores, labels = batches[0]
     preds = n_batches * batch
 
     def metrics():
         return {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)}
 
-    # (a) in-process baseline
+    # (a) in-process baseline. The warm tenant drives one full window
+    # cycle at the leg's exact cadence (window_chunks valve fold + the
+    # compute-only close), so the timed stream dispatches only cached
+    # programs — same warm-up shape for the wire leg below.
     with EvalDaemon() as daemon:
-        handle = daemon.attach("warm", metrics())
-        handle.submit(scores, labels)
+        handle = daemon.attach(
+            "warm", metrics(), window_chunks=window_chunks
+        )
+        for s, l in batches[:window_chunks]:
+            handle.submit(s, l, block=True, timeout=300)
         handle.compute(timeout=300)
         handle.detach(timeout=300)
-        handle = daemon.attach("bench", metrics())
+        handle = daemon.attach(
+            "bench", metrics(), window_chunks=window_chunks
+        )
         t0 = time.perf_counter()
-        for _ in range(n_batches):
-            handle.submit(scores, labels, block=True, timeout=300)
+        for s, l in batches:
+            handle.submit(s, l, block=True, timeout=300)
         handle.compute(timeout=300)
         local_s = time.perf_counter() - t0
     _emit_row("config8_cluster_local_direct", preds / local_s, "preds/s")
 
-    # (b) the same stream over loopback TCP
-    with EvalDaemon() as daemon:
+    # (b) the same stream over loopback TCP. submit_buffer engages the
+    # coalesced submit_many frames + scatter-gather packer (ISSUE 11) —
+    # per-frame costs amortize over the group the same way the daemon's
+    # coalesced H2D amortizes transfers.
+    with EvalDaemon(queue_capacity=64) as daemon:
         server = EvalServer(daemon)
-        client = EvalClient(server.endpoint, request_timeout_s=300.0)
+        client = EvalClient(
+            server.endpoint,
+            request_timeout_s=300.0,
+            submit_buffer=window_chunks,
+        )
         spec = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
-        client.attach("warm", spec)
-        client.submit("warm", scores, labels)
+        client.attach("warm", spec, window_chunks=window_chunks)
+        for s, l in batches[:window_chunks]:
+            client.submit("warm", s, l)
         client.compute("warm")
         client.detach("warm")
-        client.attach("bench", spec)
+        client.attach("bench", spec, window_chunks=window_chunks)
         t0 = time.perf_counter()
-        for _ in range(n_batches):
-            client.submit("bench", scores, labels)
+        for s, l in batches:
+            client.submit("bench", s, l)
         client.compute("bench")
         wire_s = time.perf_counter() - t0
         client.close()
         server.close()
     wire_rate = preds / wire_s
     _emit_row("config8_cluster_wire_1host", wire_rate, "preds/s")
+    # ISSUE 11 before/after on this box: the PRE-pipeline legs recorded
+    # 0.95x — an artifact (both legs were dominated by one identical XLA
+    # compile; with warmed programs the old wire path measured ~0.15x).
+    # The pipeline (zero-copy pooled decode, scatter-gather submit_many
+    # coalescing, coalesced H2D, double-buffered windows) brings the
+    # honest steady-state ratio to ~0.6x on the 1-core sandbox, where
+    # client+server+worker share one core; the >=0.8 target applies to
+    # hosts whose device executes off-CPU and whose cores let ingest
+    # genuinely overlap compute (docs/performance.md, "Ingest pipeline").
     _emit_row(
         "config8_cluster_wire_1host_ratio",
         wire_rate / (preds / local_s),
-        "x of in-process",
+        "x of in-process (target >= 0.8 with ingest/compute overlap)",
+    )
+
+    # (b2) ingest overlap: concurrent producers keep the daemon queue
+    # non-empty, so after a mid-pass valve dispatch the very next append
+    # (window N+1's first fill) happens while window N's donated step is
+    # still executing; deferred.window.overlap_ms pins the realized
+    # overlap (a 0 here would mean fully serial ingest — the exact
+    # failure mode ISSUE 11 removes). Untimed, so obs can be on.
+    import threading
+
+    from torcheval_tpu import obs as _obs_api
+    from torcheval_tpu.obs import registry as _obs_reg
+
+    was_enabled = _obs_reg._enabled
+    if not was_enabled:
+        _obs_api.enable()
+
+    def _overlap_stats():
+        h = _obs_reg.snapshot()["histograms"].get(
+            "deferred.window.overlap_ms"
+        )
+        return (h["count"], h["sum"]) if h else (0, 0.0)
+
+    c0, s0 = _overlap_stats()
+    try:
+        with EvalDaemon(queue_capacity=max(64, n_batches)) as daemon:
+            server = EvalServer(daemon)
+            client = EvalClient(server.endpoint, request_timeout_s=300.0)
+            n_producers = 4
+            for k in range(n_producers):
+                client.attach(
+                    f"overlap-{k}", spec, window_chunks=window_chunks
+                )
+            producer_errors = []
+
+            def produce(k):
+                # one tenant per producer: per-tenant client locks don't
+                # contend, so frames interleave and every worker pass
+                # serves several same-signature tenants (one coalesced
+                # transfer)
+                try:
+                    for s, l in batches:
+                        client.submit(f"overlap-{k}", s, l)
+                except Exception as exc:  # noqa: BLE001 - surfaced below
+                    producer_errors.append(exc)
+
+            threads = [
+                threading.Thread(target=produce, args=(k,))
+                for k in range(n_producers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if producer_errors:
+                raise producer_errors[0]
+            for k in range(n_producers):
+                client.compute(f"overlap-{k}")
+            client.close()
+            server.close()
+    finally:
+        # obs state must not leak into later TIMED legs whatever happens
+        c1, s1 = _overlap_stats()
+        if not was_enabled:
+            _obs_api.disable()
+    _emit_row(
+        "config8_ingest_overlap_ms",
+        ((s1 - s0) / (c1 - c0)) if c1 > c0 else 0.0,
+        "ms/window fill overlapped with the previous window's execution",
     )
 
     # (c) two hosts, victim killed mid-stream: migration blackout
@@ -1248,7 +1360,9 @@ _EXPECTED_ROW_PREFIXES = (
     "config7_serve_tenants_throughput_ratio",
     "config8_cluster_local_direct",
     "config8_cluster_wire_1host",
+    "config8_cluster_wire_1host_ratio",
     "config8_cluster_wire_2host_migration",
+    "config8_ingest_overlap_ms",
     "env_dispatch_floor",
 )
 

@@ -32,6 +32,7 @@ MODULES = [
     "torcheval_tpu.parallel",
     "torcheval_tpu.resilience",
     "torcheval_tpu.serve",
+    "torcheval_tpu.serve.ingest",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
     "torcheval_tpu.utils.test_utils",

@@ -99,6 +99,17 @@ class TestBenchHygiene(unittest.TestCase):
                 "wire-overhead / migration-blackout contract (ISSUE 10) "
                 "loses its regression pin",
             )
+        for row in (
+            "config8_cluster_wire_1host_ratio",
+            "config8_ingest_overlap_ms",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the ingest "
+                "pipeline's wire-vs-in-process ratio / overlap contract "
+                "(ISSUE 11) loses its regression pin",
+            )
 
 
 if __name__ == "__main__":

@@ -178,6 +178,26 @@ class MetricCollection:
 
     @_traced("collection.update")
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
+        return self._update_impl(args, kwargs, False)
+
+    @_traced("collection.update")
+    def update_placed(
+        self, args: tuple, *, owned: bool = False
+    ) -> "MetricCollection":
+        """``update`` for batches ALREADY placed on device by a trusted
+        ingest pipeline (the serve daemon's coalesced H2D stage, ISSUE
+        11). ``owned=True`` is the caller's vouch that every device
+        buffer in ``args`` was created by its own transfer and is
+        referenced by no one else — which re-arms chunk donation that a
+        plain ``update`` must refuse for caller-passed device arrays (it
+        cannot know who else holds them). Never pass ``owned=True`` for a
+        buffer any other window/caller can still read: a donated chunk's
+        next read is a deleted-array error."""
+        return self._update_impl(args, None, owned)
+
+    def _update_impl(
+        self, args: tuple, kwargs: Any, placed_owned: bool
+    ) -> "MetricCollection":
         # convert + place each batch argument ONCE for the whole collection:
         # torch/numpy batches must land on the metrics' device before any
         # fold anyway, and eager/deferred members then hit _input's already-
@@ -192,7 +212,7 @@ class MetricCollection:
         for a in args:
             if _needs_placement(type(a)):
                 p = place(a)
-                if p is a or _is_torch_tensor(a):
+                if (p is a and not placed_owned) or _is_torch_tensor(a):
                     # the caller may still hold this buffer (jax passthrough)
                     # or alias it (torch via zero-copy dlpack): never donate
                     owned = False
@@ -201,6 +221,7 @@ class MetricCollection:
                 placed.append(a)
                 direct = False  # python scalars etc.: member updates convert
         args = tuple(placed)
+        kwargs = kwargs or {}
         if kwargs:
             kwargs = {
                 k: place(v) if _needs_placement(type(v)) else v

@@ -502,6 +502,34 @@ _window_step_dispatch_donated_all = partial(
 # have retired (``anchor.is_ready()`` — non-blocking).
 _inflight_donated: List[Tuple[Any, Tuple[Any, ...]]] = []
 
+# newest window-step output anchor, donated or not — the overlap probe the
+# double-buffered EvalWindow (and the serve ingest pool) ride: window N+1's
+# fill measures itself against this anchor's is_ready(), and a pooled host
+# buffer released "after the current execution" cools against it. Held
+# WEAKLY: the owning metric's state binding keeps the array alive exactly
+# while it is the current output; a strong global ref would pin one stale
+# state buffer forever after the last window step of a quiesced process.
+_last_window_anchor: Any = None
+
+
+def _deref_anchor(ref: Any) -> Any:
+    """A live anchor from ``ref`` — a weakref (the normal case), a direct
+    anchor object (tests), or ``None``."""
+    if isinstance(ref, weakref.ref):
+        return ref()
+    return ref
+
+
+def inflight_anchor() -> Any:
+    """The newest anchor that upper-bounds every in-flight execution:
+    the youngest donated-hold anchor when one exists (same-device programs
+    retire in submission order, so it is ready only after everything
+    before it), else the last window-step output. ``None`` when nothing
+    is known to be in flight."""
+    if _inflight_donated:
+        return _inflight_donated[-1][0]
+    return _deref_anchor(_last_window_anchor)
+
 
 def _hold_donated_inputs(outputs: Any, *refs: Any) -> None:
     """Pin ``refs`` (the just-donated dispatch inputs) until ``outputs``'
@@ -779,6 +807,18 @@ def window_step(
         compute_specs=compute_specs,
         stack_ok=stack_ok,
     )
+    global _last_window_anchor
+    _anchor_leaf = next(
+        (
+            a
+            for a in jax.tree_util.tree_leaves(new_states)
+            if hasattr(a, "is_ready")
+        ),
+        None,
+    )
+    _last_window_anchor = (
+        weakref.ref(_anchor_leaf) if _anchor_leaf is not None else None
+    )
     path = ("stacked" if stack_ok else "concat") if chunks else "compute"
     _obs.counter("deferred.window_steps", path=path)
     if chunks:
@@ -832,6 +872,9 @@ class EvalWindow:
         "sig_nbytes",
         "owned",
         "owner",
+        "_fill_t0",
+        "_ov_anchor",
+        "_ov_last",
     )
 
     def __init__(
@@ -843,6 +886,15 @@ class EvalWindow:
         self.sig: Optional[Tuple[Any, ...]] = None
         self.sig_nbytes = 0  # cached per-batch bytes of ``sig``
         self.owned = True
+        # double-buffering telemetry (ISSUE 11): this window's fill start,
+        # the previous window step's output anchor if it was still
+        # executing when the fill began, and the last moment that anchor
+        # was observed in flight — the overlap window the
+        # ``deferred.window.overlap_ms`` histogram records. Obs-gated:
+        # zeroed and untouched while obs is disabled.
+        self._fill_t0 = 0.0
+        self._ov_anchor: Any = None
+        self._ov_last = 0.0
         # ownerless windows (direct construction) count as always-alive
         self.owner = weakref.ref(owner) if owner is not None else (lambda: self)
 
@@ -858,9 +910,61 @@ class EvalWindow:
                 chunks=len(self.chunks) + 1,
                 bytes=nbytes,
             )
+            self._track_overlap(bool(self.chunks))
         self.chunks.append(chunk)
         self.nbytes += nbytes
         self.owned = self.owned and owned
+
+    def _track_overlap(self, filling: bool) -> None:
+        """Advance the fill-vs-previous-execution overlap watermark. On
+        the first append of a window, latch the previous window step's
+        anchor iff it is still executing (the double-buffer moment:
+        window N+1 starts filling while window N runs); on later appends,
+        move the watermark while it stays in flight. A probe that raises
+        means the anchor was donated onward — its retirement time is
+        unknowable, so the watermark freezes where it was (a lower
+        bound, never an overclaim)."""
+        now = time.perf_counter()
+        if not filling:
+            self._fill_t0 = now
+            self._ov_anchor = None
+            self._ov_last = 0.0
+            anchor = _deref_anchor(_last_window_anchor)
+            if anchor is not None:
+                try:
+                    if not anchor.is_ready():
+                        self._ov_anchor = anchor
+                        self._ov_last = now
+                except Exception:
+                    pass
+        elif self._ov_anchor is not None:
+            try:
+                if self._ov_anchor.is_ready():
+                    self._ov_anchor = None
+                else:
+                    self._ov_last = now
+            except Exception:
+                self._ov_anchor = None
+
+    def _record_overlap(self) -> None:
+        """Emit the realized fill/execute overlap for the closing window
+        (obs-enabled paths only; called before this window's own
+        dispatch)."""
+        if not self._ov_last:
+            return
+        if self._ov_anchor is not None:
+            try:
+                if not self._ov_anchor.is_ready():
+                    # still executing as the next window closes: the
+                    # whole fill overlapped
+                    self._ov_last = time.perf_counter()
+            except Exception:
+                pass
+        overlap_s = self._ov_last - self._fill_t0
+        if overlap_s > 0.0:
+            _obs.histo("deferred.window.overlap_ms", overlap_s * 1e3)
+        self._ov_anchor = None
+        self._ov_last = 0.0
 
     def clear(self) -> None:
         self.chunks = []
@@ -907,6 +1011,8 @@ class EvalWindow:
                     w.close()
         if any(getattr(m, "_pending", None) for m in self.members.values()):
             group_fold(self.members)
+        if _obs._enabled and self.chunks:
+            self._record_overlap()
         chunks = tuple(self.chunks)
         results = window_step(
             self.members,

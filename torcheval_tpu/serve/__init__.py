@@ -23,6 +23,17 @@ streams (*tenants*), each backed by a
   obs registry and Chrome trace, plus ``EvalDaemon.health()`` (local) /
   ``health(sync=True)`` (all ranks, one collective round).
 
+Since ISSUE 11 ingest is a zero-copy, overlapped pipeline
+(``ingest.py``): frame payloads land in a pooled, size-classed host
+staging buffer and decode as zero-copy views; each serving pass moves a
+whole coalesced signature group to the device in ONE transfer (identical
+broadcast batches transfer once); and eval windows double-buffer —
+window N+1 fills and transfers while window N's donated step executes.
+The client side coalesces too: ``EvalClient(submit_buffer=K)`` ships K
+booked batches per ``submit_many`` frame through a scatter-gather packer.
+See docs/performance.md ("Ingest pipeline") for the stage diagram and
+the buffer aliasing/recycling contract.
+
 Since ISSUE 10 the service also crosses machines — a stdlib-only network
 layer on top of the same daemon:
 

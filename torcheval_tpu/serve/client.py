@@ -51,8 +51,10 @@ from torcheval_tpu.serve.errors import ServeError, WireError
 from torcheval_tpu.serve.wire import (
     decode_error,
     pack_tree,
+    pack_tree_parts,
     recv_frame,
     send_frame,
+    send_frame_parts,
     unpack_tree,
 )
 
@@ -76,6 +78,7 @@ class _ClientTenant:
         "next_seq",
         "durable_seq",
         "replay",
+        "sendbuf",
         "migrated",
         "needs_resend",
     )
@@ -85,6 +88,11 @@ class _ClientTenant:
         self.next_seq = last_seq + 1
         self.durable_seq = last_seq
         self.replay: deque = deque()  # (seq, np-args tuple), seq ascending
+        # booked-but-unsent tail under submit_buffer coalescing: every
+        # entry here is ALSO in replay (booked at submit time), so a
+        # crash/migration between booking and the coalesced send loses
+        # nothing — the replay path delivers it
+        self.sendbuf: list = []
         # set (under lock) by export_tenant: a concurrent submitter that
         # grabbed this state object before the export must NOT book a
         # batch into it — the buffer has already been carried elsewhere
@@ -118,6 +126,7 @@ class EvalClient:
         breaker_threshold: int = 3,
         breaker_reset_s: float = 1.0,
         replay_capacity: int = 64,
+        submit_buffer: int = 1,
     ) -> None:
         from torcheval_tpu.metrics.toolkit import _check_timeout_s
 
@@ -137,6 +146,7 @@ class EvalClient:
             ("max_in_flight", max_in_flight, 1),
             ("breaker_threshold", breaker_threshold, 1),
             ("replay_capacity", replay_capacity, 1),
+            ("submit_buffer", submit_buffer, 1),
         ):
             if not isinstance(value, int) or value < floor:
                 raise ValueError(
@@ -163,6 +173,14 @@ class EvalClient:
         self._breaker_threshold = breaker_threshold
         self._breaker_reset_s = breaker_reset_s
         self.replay_capacity = replay_capacity
+        # submit coalescing (ISSUE 11): >1 buffers this many booked
+        # batches per tenant and ships them as ONE submit_many frame —
+        # frame overhead (round trip, headers, archive directory)
+        # amortizes over the group exactly like the daemon's coalesced
+        # H2D amortizes transfers. Batches are booked into the replay
+        # buffer at submit() time, so the reliability story is unchanged:
+        # anything unsent or unacked is redelivered by replay + dedup.
+        self.submit_buffer = min(submit_buffer, replay_capacity)
         self._inflight = threading.BoundedSemaphore(max_in_flight)
         self._lock = threading.Lock()
         self._pool: List[socket.socket] = []
@@ -199,6 +217,26 @@ class EvalClient:
             pass
 
     def close(self) -> None:
+        # best-effort: ship any coalesced unsent tails first — a buffered
+        # submit() returned True for these batches, so dropping them
+        # silently on close would break the delivered-on-True contract.
+        # A drain failure is swallowed (we are closing; the batches stay
+        # booked in the replay buffer for a future migration/adopt).
+        with self._lock:
+            tenants = list(self._tenants.items())
+        for tenant_id, state in tenants:
+            try:
+                with state.lock:
+                    if (
+                        state.sendbuf
+                        and not state.migrated
+                        and not state.needs_resend
+                    ):
+                        self._drain_sendbuf_locked(
+                            tenant_id, state, _UNSET
+                        )
+            except (ServeError, WireError, OSError):
+                pass
         with self._lock:
             self._closed = True
             pool, self._pool = self._pool, []
@@ -343,7 +381,12 @@ class EvalClient:
                 raise err from e
             try:
                 sock.settimeout(timeout_s)
-                send_frame(sock, header, payload)
+                if isinstance(payload, tuple):
+                    # scatter-gather payload (parts, total): array data
+                    # goes straight from its owning buffers to the kernel
+                    send_frame_parts(sock, header, *payload)
+                else:
+                    send_frame(sock, header, payload)
                 frame = recv_frame(sock)
             except socket.timeout:
                 self._discard(sock)
@@ -407,6 +450,7 @@ class EvalClient:
         step_timeout_s: Optional[float] = None,
         queue_capacity: Optional[int] = None,
         resume: Optional[str] = None,
+        window_chunks: Optional[int] = None,
         timeout_s: Any = _UNSET,
     ) -> Dict[str, Any]:
         """Attach ``tenant_id`` with a wire metric spec (see
@@ -430,6 +474,7 @@ class EvalClient:
                 "step_timeout_s": step_timeout_s,
                 "queue_capacity": queue_capacity,
                 "resume": resume,
+                "window_chunks": window_chunks,
             },
             timeout_s=timeout_s,
         )
@@ -455,7 +500,12 @@ class EvalClient:
         holds the batch in the bounded replay buffer until it is durable,
         and retries transparently (dedup makes resends exactly-once).
         Returns ``True`` if this call's send was applied, ``False`` if
-        the server had it already (a prior ambiguous attempt landed)."""
+        the server had it already (a prior ambiguous attempt landed).
+        Under ``submit_buffer > 1`` the return is always ``True`` (the
+        batch is BOOKED; the server's per-batch dedup verdicts ride the
+        coalesced frame's ack and are not reported per call) — callers
+        that need the per-batch applied signal use an unbuffered
+        client."""
         state = self._tenant_state(tenant_id)
         np_args = tuple(np.asarray(a) for a in args)
         with state.lock:
@@ -466,13 +516,25 @@ class EvalClient:
                     "mid-call; re-route and resubmit (the batch was not "
                     "booked).",
                 )
-            if state.needs_resend:
-                self._resend_locked(tenant_id, state, timeout_s)
-            if len(state.replay) >= self.replay_capacity:
-                # replay valve: checkpoint server-side to advance the
-                # durable watermark, then prune — the buffer stays
-                # bounded without ever dropping a non-durable batch
-                self._flush_locked(tenant_id, state, timeout_s)
+            try:
+                if state.needs_resend:
+                    self._resend_locked(tenant_id, state, timeout_s)
+                if len(state.replay) >= self.replay_capacity:
+                    # replay valve: checkpoint server-side to advance the
+                    # durable watermark, then prune — the buffer stays
+                    # bounded without ever dropping a non-durable batch
+                    self._flush_locked(tenant_id, state, timeout_s)
+            except (WireError, ServeError) as e:
+                # pre-booking failure: earlier BOOKED entries redeliver
+                # through replay, but THIS call's batch was never booked —
+                # a batch_booked=True leaking out of the flush's internal
+                # drain would make the router skip resubmitting it
+                e.batch_booked = False
+                raise
+            if self.submit_buffer > 1:
+                return self._buffered_submit_locked(
+                    tenant_id, state, np_args, timeout_s
+                )
             # marshal BEFORE booking: an unmarshalable or over-limit
             # argument must fail this call cleanly, not leave a poison
             # entry in the replay buffer that every future resend and
@@ -542,6 +604,112 @@ class EvalClient:
             self._prune_locked(state)
             return bool(header.get("applied", True))
 
+    def _buffered_submit_locked(
+        self,
+        tenant_id: str,
+        state: _ClientTenant,
+        np_args: tuple,
+        timeout_s: Any,
+    ) -> bool:
+        """Book one batch into the replay buffer AND the coalesced send
+        tail; ship the tail as one ``submit_many`` frame when it reaches
+        ``submit_buffer`` batches (or would overflow the frame limit).
+        Returns ``True`` — the batch is booked; any dedup of an earlier
+        ambiguous landing happens server-side when the frame ships."""
+        from torcheval_tpu.serve.wire import _MAX_PAYLOAD_BYTES
+
+        for a in np_args:
+            if a.dtype.hasobject:
+                # validate at booking time: a poison entry must fail THIS
+                # call, never lurk in the replay buffer
+                raise WireError(
+                    "protocol",
+                    "cannot marshal object arrays over the eval wire.",
+                    endpoint=self.endpoint,
+                )
+        nbytes = sum(int(a.nbytes) for a in np_args) + 4096
+        if nbytes > _MAX_PAYLOAD_BYTES:
+            raise WireError(
+                "protocol",
+                f"batch payload is ~{nbytes} bytes, over the "
+                f"{_MAX_PAYLOAD_BYTES}-byte wire limit; split the batch.",
+                endpoint=self.endpoint,
+            )
+        pending = sum(
+            sum(int(a.nbytes) for a in args) + 4096
+            for _seq, args in state.sendbuf
+        )
+        if state.sendbuf and pending + nbytes > _MAX_PAYLOAD_BYTES:
+            try:
+                self._drain_sendbuf_locked(tenant_id, state, timeout_s)
+            except (WireError, ServeError) as e:
+                # the drained tail is booked (replay covers it); THIS
+                # batch is not — the caller must resubmit it
+                e.batch_booked = False
+                raise
+        seq = state.next_seq
+        state.next_seq += 1
+        state.replay.append((seq, np_args))
+        state.sendbuf.append((seq, np_args))
+        if len(state.sendbuf) >= self.submit_buffer:
+            self._drain_sendbuf_locked(tenant_id, state, timeout_s)
+        return True
+
+    def _drain_sendbuf_locked(
+        self, tenant_id: str, state: _ClientTenant, timeout_s: Any
+    ) -> None:
+        """Ship the booked-but-unsent tail as ONE ``submit_many`` frame.
+        On any failure the whole group stays booked in the replay buffer
+        (``needs_resend``): redelivery in seq order + server dedup settle
+        whichever prefix actually landed, exactly once."""
+        if not state.sendbuf:
+            return
+        take, state.sendbuf = state.sendbuf, []
+        seqs = [seq for seq, _args in take]
+        spec, parts, total = pack_tree_parts(
+            [list(args) for _seq, args in take]
+        )
+        try:
+            header, _ = self._call(
+                "submit_many",
+                {"tenant": tenant_id, "seqs": seqs, "args": spec},
+                (parts, total),
+                timeout_s=timeout_s,
+            )
+        except (WireError, ServeError) as e:
+            state.needs_resend = True
+            e.batch_booked = True
+            raise
+        state.durable_seq = max(
+            state.durable_seq, int(header.get("acked_seq", 0))
+        )
+        self._prune_locked(state)
+
+    def _drain_for(self, tenant_id: str, timeout_s: Any) -> None:
+        """Deliver any coalesced booked-but-undelivered tail before an op
+        whose result must reflect every prior ``submit``
+        (compute/sync_compute/detach). The needs-resend check comes
+        FIRST: a failed coalesced drain empties the send tail but leaves
+        its batches booked in the replay buffer, and those must redeliver
+        too — a ``submit()`` that returned ``True`` may never silently
+        miss a compute. Buffered clients only (``submit_buffer > 1``):
+        the unbuffered client's long-standing semantics — a FAILED
+        submit's hole redelivers at the next submit/flush, not at
+        compute — stay exactly as they were."""
+        if self.submit_buffer <= 1:
+            return
+        with self._lock:
+            state = self._tenants.get(tenant_id)
+        if state is None:
+            return
+        with state.lock:
+            if state.migrated:
+                return
+            if state.needs_resend:
+                self._resend_locked(tenant_id, state, timeout_s)
+            elif state.sendbuf:
+                self._drain_sendbuf_locked(tenant_id, state, timeout_s)
+
     def flush(self, tenant_id: str, *, timeout_s: Any = _UNSET) -> dict:
         """Checkpoint the tenant server-side (no eviction), advance the
         durable watermark, prune the replay buffer. Returns
@@ -589,13 +757,19 @@ class EvalClient:
         """Re-deliver the booked tail a failed submit left behind,
         clearing the hole. Raises (flag intact) if the host is still
         unreachable — nothing new may be sequenced past the hole until
-        it closes."""
+        it closes. Coalesced unsent entries are already booked in the
+        replay buffer, so dropping the send tail and replaying covers
+        them in seq order."""
+        state.sendbuf.clear()
         self._send_replay_entries(tenant_id, state, timeout_s)
         state.needs_resend = False
 
     def _flush_locked(
         self, tenant_id: str, state: _ClientTenant, timeout_s: Any
     ) -> dict:
+        # the durable watermark a flush advances must cover the booked
+        # tail: ship any coalesced unsent entries first
+        self._drain_sendbuf_locked(tenant_id, state, timeout_s)
         header, _ = self._call(
             "flush",
             {
@@ -625,6 +799,7 @@ class EvalClient:
         )
 
     def compute(self, tenant_id: str, *, timeout_s: Any = _UNSET) -> Any:
+        self._drain_for(tenant_id, timeout_s)
         header, payload = self._call(
             "compute",
             {
@@ -646,6 +821,7 @@ class EvalClient:
         """``TenantHandle.sync_compute`` over the wire: ``sync_timeout_s``
         bounds the daemon-side collective rounds (the PR 5 contract);
         ``timeout_s`` bounds this wire request."""
+        self._drain_for(tenant_id, timeout_s)
         header, payload = self._call(
             "sync_compute",
             {
@@ -671,6 +847,7 @@ class EvalClient:
         be detached, and it is (a checkpoint path from the first landing
         is lost with the ack in that corner; ``resilience.
         latest_checkpoint(<root>/<tenant>)`` recovers it)."""
+        self._drain_for(tenant_id, timeout_s)
         try:
             header, _ = self._call(
                 "detach",
@@ -731,6 +908,9 @@ class EvalClient:
             )
         with state.lock:
             state.migrated = True
+            # coalesced unsent entries are booked in the replay buffer,
+            # so the export carries them; the new host's replay delivers
+            state.sendbuf.clear()
             return {
                 "next_seq": state.next_seq,
                 "durable_seq": state.durable_seq,

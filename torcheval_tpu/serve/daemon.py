@@ -97,6 +97,16 @@ class _NaNPolicyViolation(ValueError):
     """Internal: a float batch carried NaN under ``nan_policy="reject"``."""
 
 
+def _ingest_anchor():
+    """Newest in-flight execution anchor (the PR 6 donated-hold registry,
+    falling back to the last window-step output) — the guard a pooled
+    staging buffer's release rides so its slot is not recycled while a
+    program that read it may still be running."""
+    from torcheval_tpu.metrics import deferred as _deferred
+
+    return _deferred.inflight_anchor()
+
+
 def _batch_signature(args) -> tuple:
     """Host-side batch signature for coalesced scheduling: shapes + dtypes
     of the queued (host) arrays. Cheap — attribute reads only."""
@@ -211,6 +221,7 @@ class EvalDaemon:
         step_timeout_s: Optional[float] = None,
         queue_capacity: Optional[int] = None,
         resume: str = "auto",
+        window_chunks: Optional[int] = None,
     ) -> TenantHandle:
         """Admit one tenant and return its handle.
 
@@ -223,9 +234,14 @@ class EvalDaemon:
         ``resume`` controls eviction-checkpoint restore for this tenant id:
         ``"auto"`` restores iff a checkpoint exists, ``"require"`` raises
         ``AdmissionError(reason="no_checkpoint")`` without one, ``"never"``
-        starts clean. Raises :class:`AdmissionError` (``"capacity"`` /
-        ``"duplicate_tenant"`` / ``"daemon_stopped"`` / ``"bad_metrics"``)
-        instead of ever over-admitting.
+        starts clean. ``window_chunks`` caps this tenant's eval-window
+        occupancy (the deferred chunk-count valve): a lower cap closes
+        windows more often, which bounds per-tenant pending HBM and sets
+        the double-buffering cadence — window N+1 fills and transfers
+        while window N's step executes (ISSUE 11). Raises
+        :class:`AdmissionError` (``"capacity"`` / ``"duplicate_tenant"`` /
+        ``"daemon_stopped"`` / ``"bad_metrics"``) instead of ever
+        over-admitting.
         """
         if nan_policy not in _NAN_POLICIES:
             raise ValueError(
@@ -253,6 +269,12 @@ class EvalDaemon:
         if queue_capacity is not None and queue_capacity < 1:
             raise ValueError(
                 f"queue_capacity must be >= 1, got {queue_capacity}."
+            )
+        if window_chunks is not None and (
+            not isinstance(window_chunks, int) or window_chunks < 1
+        ):
+            raise ValueError(
+                f"window_chunks must be an int >= 1, got {window_chunks!r}."
             )
         from torcheval_tpu.metrics.collection import MetricCollection
 
@@ -300,6 +322,12 @@ class EvalDaemon:
                     "bad_metrics",
                     f"tenant {tenant_id!r} metrics are not servable: {e}",
                 ) from e
+            if window_chunks is not None:
+                # per-instance valve override (the collection's budget
+                # check reads the probe member; each member's own 2x
+                # self-valve scales off the same attribute)
+                for m in getattr(collection, "_deferred", {}).values():
+                    m._DEFER_MAX_CHUNKS = window_chunks
             ckpt_dir = self._tenant_ckpt_dir(tenant_id, create=False)
             # reserve the id + a capacity slot, then RELEASE the lock for
             # the checkpoint I/O below: a migration restore can take long
@@ -456,6 +484,7 @@ class EvalDaemon:
         block: bool,
         timeout: Optional[float],
         seq: Optional[int] = None,
+        stage: Any = None,
     ) -> bool:
         """Admit one batch. ``seq`` is the wire client's per-tenant
         monotonic sequence number: a submit at or below the tenant's
@@ -465,71 +494,90 @@ class EvalDaemon:
         metric state). Returns ``True`` when the batch was admitted,
         ``False`` when it was deduplicated. The dedup check re-runs
         after every capacity wait: two retries of one seq can block in
-        the wait side by side, and only the first may append."""
+        the wait side by side, and only the first may append.
+
+        ``stage`` (the pooled staging buffer backing ``args``, ISSUE 11)
+        is owned by this call from here on: it rides the queue entry and
+        is released after the worker's device placement, or released
+        RIGHT HERE on every path that does not enqueue (dedup, shed,
+        drain reject, dead tenant) — a shed batch must never leak its
+        staging slot."""
         deadline = (
             time.monotonic() + timeout
             if (block and timeout is not None)
             else None
         )
-        with self._cond:
-            while True:
-                self._check_live(tenant)
-                if seq is not None and seq <= tenant.last_seq:
-                    # dedup BEFORE the draining check: a replay of an
-                    # already-admitted seq must get its duplicate ack
-                    # even mid-drain — a "draining" reject here would
-                    # make the client think the batch was never admitted
-                    # and resubmit it under a fresh seq elsewhere while
-                    # the drain checkpoint also carries it (double-apply)
-                    tenant.dupes += 1
-                    if _obs._enabled:
-                        _obs.counter("serve.ingest.dupes", tenant=tenant.id)
-                    return False
-                if self._draining:
-                    raise ServeError(
-                        "draining",
-                        f"tenant {tenant.id!r}: this daemon is draining; "
-                        "resubmit after the router migrates the tenant.",
-                    )
-                if len(tenant.queue) < tenant.capacity:
-                    break
-                if not block:
-                    self._shed(tenant, "queue_full")
-                remaining = (
-                    None
-                    if deadline is None
-                    else deadline - time.monotonic()
-                )
-                if remaining is not None and remaining <= 0:
-                    self._shed(tenant, "queue_full")
-                if not self._cond.wait(timeout=remaining):
-                    self._shed(tenant, "queue_full")
-            tenant.ingested += 1
-            step = tenant.ingested
-            if seq is not None:
-                tenant.last_seq = seq
-            if not _chaos.ingest_armed():
-                tenant.queue.append(("batch", (seq, args), None))
-                tenant.last_activity = time.monotonic()
-                depth = len(tenant.queue)
-                self._cond.notify_all()
-                args = None
-        if args is not None:
-            # chaos slow path (test-only): the fault fires at the queue
-            # boundary for a batch that PASSED admission — only admitted
-            # batches advance ``step``, so a shed can never consume the
-            # one-shot fault — and OUTSIDE the lock, so an ingestion delay
-            # stalls only this producer. The re-acquire below may
-            # transiently exceed the queue bound by the number of
-            # concurrent producers mid-hook; chaos is disarmed in
-            # production, where the bound is exact.
-            args = _chaos.on_ingest(tenant.id, step, args)
+        try:
             with self._cond:
-                self._check_live(tenant)
-                tenant.queue.append(("batch", (seq, args), None))
-                tenant.last_activity = time.monotonic()
-                depth = len(tenant.queue)
-                self._cond.notify_all()
+                while True:
+                    self._check_live(tenant)
+                    if seq is not None and seq <= tenant.last_seq:
+                        # dedup BEFORE the draining check: a replay of an
+                        # already-admitted seq must get its duplicate ack
+                        # even mid-drain — a "draining" reject here would
+                        # make the client think the batch was never admitted
+                        # and resubmit it under a fresh seq elsewhere while
+                        # the drain checkpoint also carries it (double-apply)
+                        tenant.dupes += 1
+                        if _obs._enabled:
+                            _obs.counter(
+                                "serve.ingest.dupes", tenant=tenant.id
+                            )
+                        return False
+                    if self._draining:
+                        raise ServeError(
+                            "draining",
+                            f"tenant {tenant.id!r}: this daemon is draining; "
+                            "resubmit after the router migrates the tenant.",
+                        )
+                    if len(tenant.queue) < tenant.capacity:
+                        break
+                    if not block:
+                        self._shed(tenant, "queue_full")
+                    remaining = (
+                        None
+                        if deadline is None
+                        else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        self._shed(tenant, "queue_full")
+                    if not self._cond.wait(timeout=remaining):
+                        self._shed(tenant, "queue_full")
+                tenant.ingested += 1
+                step = tenant.ingested
+                if seq is not None:
+                    tenant.last_seq = seq
+                if not _chaos.ingest_armed():
+                    tenant.queue.append(
+                        ("batch", (seq, args, stage, None), None)
+                    )
+                    stage = None  # the queue entry owns it now
+                    tenant.last_activity = time.monotonic()
+                    depth = len(tenant.queue)
+                    self._cond.notify_all()
+                    args = None
+            if args is not None:
+                # chaos slow path (test-only): the fault fires at the queue
+                # boundary for a batch that PASSED admission — only admitted
+                # batches advance ``step``, so a shed can never consume the
+                # one-shot fault — and OUTSIDE the lock, so an ingestion
+                # delay stalls only this producer. The re-acquire below may
+                # transiently exceed the queue bound by the number of
+                # concurrent producers mid-hook; chaos is disarmed in
+                # production, where the bound is exact.
+                args = _chaos.on_ingest(tenant.id, step, args)
+                with self._cond:
+                    self._check_live(tenant)
+                    tenant.queue.append(
+                        ("batch", (seq, args, stage, None), None)
+                    )
+                    stage = None
+                    tenant.last_activity = time.monotonic()
+                    depth = len(tenant.queue)
+                    self._cond.notify_all()
+        finally:
+            if stage is not None:
+                stage.release()
         if _obs._enabled:
             _obs.counter("serve.ingest.batches", tenant=tenant.id)
             _obs.histo("serve.queue_depth", float(depth), tenant=tenant.id)
@@ -692,6 +740,7 @@ class EvalDaemon:
                     self._fail_pending_locked()
                     return
                 plans = self._plan_pass_locked()
+            self._stage_pass(plans)
             for tenant, items in plans:
                 self._serve_tenant(tenant, items)
             self._check_watchdogs()
@@ -730,6 +779,77 @@ class EvalDaemon:
                 ).append(entry)
         return control + [e for sig in groups for e in groups[sig]]
 
+    def _stage_pass(self, plans) -> None:
+        """Coalesced H2D for one serving pass (ISSUE 11): every queued
+        host (numpy) batch in ``plans`` transfers in ONE ``device_put``
+        per (device, signature) group — not one per batch per tenant —
+        and its queue entry is rewritten in place with the placed device
+        arrays plus an ``owned`` verdict (exclusively-owned device
+        buffers may be donated by the window step; buffers shared via
+        identical host arrays may not). Pooled staging buffers release
+        here, anchored on a transferred device array, the moment their
+        host bytes have been handed to the transfer engine.
+
+        Excluded and left on the per-batch path: tenants under
+        ``nan_policy="reject"`` (their priced host-side NaN scan must see
+        host memory), non-numpy args (already-placed jax arrays, torch
+        tensors, scalars), and metrics without a plain single-device
+        placement (sharded placements belong to the SPMD partitioner)."""
+        groups: Dict[tuple, list] = {}
+        for tenant, items in plans:
+            if tenant.nan_policy == "reject":
+                continue
+            probe = getattr(tenant.collection, "_defer_probe", None)
+            device = getattr(probe, "_plain_device", None)
+            if device is None:
+                continue
+            for i, (kind, payload, _promise) in enumerate(items):
+                if kind != "batch":
+                    continue
+                args = payload[1]
+                if not args or not all(
+                    type(a) is np.ndarray and a.dtype.kind in "biufc"
+                    for a in args
+                ):
+                    continue
+                sig = tuple((a.shape, a.dtype) for a in args)
+                groups.setdefault((id(device), sig), []).append(
+                    (device, items, i)
+                )
+        from torcheval_tpu.serve import ingest as _ingest
+
+        for members in groups.values():
+            device = members[0][0]
+            batches = [items[i][1][1] for _dev, items, i in members]
+            try:
+                placed, owned = _ingest.coalesce_h2d(batches, device)
+            except Exception:  # noqa: BLE001 - fall back to per-batch path
+                # an unplaceable group (device trouble) keeps the host
+                # arrays; the per-batch update path will surface the real
+                # error inside the owning tenant's containment wall
+                continue
+            for (_dev, items, i), dev_args, own in zip(
+                members, placed, owned
+            ):
+                kind, payload, promise = items[i]
+                stage = payload[2] if len(payload) > 2 else None
+                items[i] = (
+                    kind, (payload[0], dev_args, None, own), promise
+                )
+                if stage is not None:
+                    # host bytes are consumed once THIS batch's transfers
+                    # retire — anchor on all of its own placed arrays
+                    # (transfers within a batched device_put can complete
+                    # independently; anchoring on another batch's array
+                    # could recycle the slot mid-read)
+                    stage.release(
+                        anchor=(
+                            dev_args[0]
+                            if len(dev_args) == 1
+                            else _ingest.group_anchor(dev_args)
+                        )
+                    )
+
     def _serve_tenant(self, tenant: _Tenant, items) -> None:
         with _obs.span("serve.tenant.step", tenant=tenant.id):
             for idx, (kind, payload, promise) in enumerate(items):
@@ -749,19 +869,70 @@ class EvalDaemon:
                 except Exception as exc:  # noqa: BLE001 - containment wall
                     err = self._classify_and_quarantine(tenant, kind, exc)
                     # the rest of this tenant's popped items die with it:
-                    # batches drop, promises learn the structured reason
+                    # batches drop (their staging buffers release — no
+                    # pool leak across a quarantine), promises learn the
+                    # structured reason
                     for _k, _p, pr in items[idx:]:
+                        self._release_stage(_k, _p)
                         if pr is not None and not pr.event.is_set():
                             pr.reject(err)
                     return
         with self._cond:
             tenant.last_activity = time.monotonic()
 
+    @staticmethod
+    def _release_stage(kind: str, payload: Any) -> None:
+        """Free a dropped queue entry's pooled staging buffer (idempotent;
+        entries the staging pass already placed carry ``stage=None``)."""
+        if kind == "batch" and len(payload) > 2 and payload[2] is not None:
+            payload[2].release(anchor=_ingest_anchor())
+
     def _process_batch(self, tenant: _Tenant, payload: tuple) -> None:
-        seq, args = payload
-        if tenant.nan_policy == "reject":
-            self._nan_check(tenant, args)
-        self._guarded(tenant, lambda: tenant.collection.update(*args))
+        # (seq, args) legacy 2-tuples still appear in tests that inject
+        # queue entries directly; the full form is (seq, args, stage,
+        # owned) — ``owned`` non-None means the staging pass already
+        # placed ``args`` on device (and vouches for buffer ownership)
+        seq, args = payload[0], payload[1]
+        stage = payload[2] if len(payload) > 2 else None
+        owned = payload[3] if len(payload) > 3 else None
+        release_anchor = None
+        try:
+            if tenant.nan_policy == "reject":
+                self._nan_check(tenant, args)
+            if owned is None and stage is not None:
+                # stage-backed host views that skipped the staging pass
+                # (nan-reject tenants, fallback): place them HERE so the
+                # stage's release anchors on exactly the transfers that
+                # read the pooled bytes — an unrelated anchor (or none)
+                # could recycle the slot mid-read on async-H2D backends
+                placed = self._place_batch(tenant, args)
+                if placed is not None:
+                    args, release_anchor, owned = placed
+                else:
+                    # no plain device to anchor a transfer on (sharded
+                    # placements, exotic args): materialize the views
+                    # once so the slot can free with zero aliasing risk
+                    args = tuple(
+                        np.array(a) if isinstance(a, np.ndarray) else a
+                        for a in args
+                    )
+            if owned is None:
+                self._guarded(
+                    tenant, lambda: tenant.collection.update(*args)
+                )
+            else:
+                self._guarded(
+                    tenant,
+                    lambda: tenant.collection.update_placed(
+                        args, owned=owned
+                    ),
+                )
+        finally:
+            if stage is not None:
+                # release_anchor covers the staged-placement case; every
+                # other path above either materialized the views (no
+                # aliasing left) or never read the stage (early raise)
+                stage.release(anchor=release_anchor)
         tenant.processed += 1
         if seq is not None:
             # worker-thread-only write: the applied watermark is what a
@@ -770,6 +941,35 @@ class EvalDaemon:
             # armor against any future scheduler reordering quietly
             # regressing the watermark below an applied seq
             tenant.applied_seq = max(tenant.applied_seq, seq)
+
+    @staticmethod
+    def _place_batch(tenant: _Tenant, args: tuple):
+        """Device-place one stage-backed host batch through the ingest
+        transfer machinery; returns ``(placed_args, anchor, owned)`` or
+        ``None`` when the batch is not eligible (mirrors the staging
+        pass's gates)."""
+        probe = getattr(tenant.collection, "_defer_probe", None)
+        device = getattr(probe, "_plain_device", None)
+        if device is None or not args or not all(
+            type(a) is np.ndarray and a.dtype.kind in "biufc" for a in args
+        ):
+            return None
+        from torcheval_tpu.serve import ingest as _ingest
+
+        try:
+            placed, owned = _ingest.coalesce_h2d([args], device)
+        except Exception:  # noqa: BLE001 - keep the host-path fallback
+            return None
+        dev_args = placed[0]
+        anchor = (
+            dev_args[0]
+            if len(dev_args) == 1
+            else _ingest.group_anchor(dev_args)
+        )
+        # owned[0] is False only when one host array appeared twice in
+        # the batch (its device twin is shared — donating it twice would
+        # be a duplicate-donation error)
+        return dev_args, anchor, owned[0]
 
     @staticmethod
     def _nan_check(tenant: _Tenant, args: tuple) -> None:
@@ -931,9 +1131,11 @@ class EvalDaemon:
         with self._cond:
             tenant.status = TenantStatus.QUARANTINED
             tenant.error = err
-            # anything still queued dies with the tenant: batches drop,
-            # waiting promises learn the reason
+            # anything still queued dies with the tenant: batches drop
+            # (and release their staging buffers — a quarantine must not
+            # leak pool slots), waiting promises learn the reason
             for _k, _p, pr in tenant.queue:
+                self._release_stage(_k, _p)
                 if pr is not None and not pr.event.is_set():
                     pr.reject(err)
             tenant.queue.clear()
@@ -1046,6 +1248,7 @@ class EvalDaemon:
         err = ServeError("daemon_stopped", "the daemon has been stopped.")
         for t in self._tenants.values():
             for _k, _p, pr in t.queue:
+                self._release_stage(_k, _p)
                 if pr is not None and not pr.event.is_set():
                     pr.reject(err)
             t.queue.clear()

@@ -1,0 +1,329 @@
+"""Pooled host staging buffers + coalesced H2D for the eval service.
+
+ISSUE 11's ingest pipeline. The cluster path used to decode each frame
+into fresh host numpy (two copies per leaf), device-put each batch on its
+own, and do all of it serially with the window step. This module supplies
+the two host-side stages that turn that into a pipeline:
+
+* :class:`HostBufferPool` — size-classed, reusable host staging buffers.
+  ``recv_frame_into`` reads each frame's payload straight into a pooled
+  slot and ``unpack_tree`` decodes zero-copy views over it
+  (``utils/npz.py``), so the steady ingest path performs no per-batch
+  payload allocation at all. The **aliasing contract**: a released buffer
+  is not recycled while anything that read it may still be in flight —
+  ``release(anchor=...)`` parks the slot in a cooling rack keyed by an
+  execution/transfer anchor (a ``jax.Array`` — the PR 6 donated-hold
+  registry's anchor discipline) and the slot only re-enters the free list
+  once ``anchor.is_ready()``. An anchor whose probe *raises* was donated
+  into a later program; same-device programs retire in submission order
+  and an H2D read always completes before the program consuming it runs,
+  so a deleted anchor proves the host read is over and the slot is safe.
+* :func:`coalesce_h2d` — ONE ``jax.device_put`` call per coalesced
+  signature group per serving pass (the daemon's scheduler builds the
+  groups), instead of one transfer per batch per tenant. Identical host
+  arrays (by object identity) transfer once and share one device buffer —
+  the 100-tenants-one-signature win from PR 8 extended from compile time
+  to transfer count. Shared device buffers are reported back so the
+  caller can demote ``owned`` (a shared chunk must never be donated).
+
+Observability: ``serve.ingest.pool{result=hit|miss|grow}`` counters on
+every acquire, a ``serve.ingest.h2d_bytes`` counter and one
+``serve.ingest.transfer`` timeline bar per coalesced transfer, and a
+``serve.ingest.stage`` bar per pooled payload fill (emitted by the wire).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _trace
+
+__all__ = ["HostBufferPool", "PooledBuffer", "SharedStage", "coalesce_h2d"]
+
+_MIN_CLASS_BITS = 12  # smallest slot: 4 KiB
+
+
+def _size_class(nbytes: int) -> int:
+    bits = max(int(nbytes - 1).bit_length(), _MIN_CLASS_BITS)
+    return 1 << bits
+
+
+class PooledBuffer:
+    """One staging slot handed out by :class:`HostBufferPool`.
+
+    ``view(n)`` exposes the first ``n`` bytes as a writable memoryview
+    (the ``recv_into`` target and the npz-view backing store).
+    ``release(anchor=...)`` hands the slot back; it is idempotent — the
+    first call wins, later calls are no-ops — so the ownership handoff
+    between the wire handler and the daemon worker can be belt-and-braces
+    on error paths without double-freeing."""
+
+    __slots__ = ("pool", "nbytes", "data", "_released", "_split")
+
+    def __init__(self, pool: "HostBufferPool", nbytes: int) -> None:
+        self.pool = pool
+        self.nbytes = nbytes  # size class, not the payload length
+        self.data = np.empty(nbytes, dtype=np.uint8)
+        self._released = False
+        self._split = False
+
+    def view(self, n: int) -> memoryview:
+        return memoryview(self.data)[:n]
+
+    def release(self, *, anchor: Any = None) -> None:
+        if self._released or self._split:
+            # _split: ownership moved to a SharedStage's holders — only
+            # the LAST share may free the slot, via _release_from_split
+            # (a direct release here is the wire's belt-and-braces error
+            # path firing late, and must never bypass the shares'
+            # accumulated anchors)
+            return
+        self._released = True
+        self.pool._release(self, anchor)
+
+    def _release_from_split(self, anchor: Any) -> None:
+        """The SharedStage-only release: frees the slot regardless of the
+        ``_split`` latch (which stays set until the pool recycles the
+        slot, so a racing direct ``release()`` can never free it with the
+        shares' anchors discarded)."""
+        if self._released:
+            return
+        self._released = True
+        self.pool._release(self, anchor)
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+
+class _GroupAnchor:
+    """Composite anchor: retired only when EVERY member anchor is."""
+
+    __slots__ = ("anchors",)
+
+    def __init__(self, anchors: List[Any]) -> None:
+        self.anchors = anchors
+
+    def is_ready(self) -> bool:
+        return all(_anchor_retired(a) for a in self.anchors)
+
+
+def group_anchor(anchors) -> _GroupAnchor:
+    """An anchor that retires only when every anchor in ``anchors`` has."""
+    return _GroupAnchor(list(anchors))
+
+
+class SharedStage:
+    """Reference-shared ownership of one :class:`PooledBuffer` backing
+    SEVERAL queued batches (the coalesced ``submit_many`` frame): each
+    holder's ``release`` drops one share and contributes its anchor; the
+    slot frees when the last share goes, guarded by ALL contributed
+    anchors (one frame's batches can ride different coalesced transfers
+    — the earliest-released group's transfer may still be in flight when
+    the last share drops). Individual releases stay idempotent-per-holder
+    by the daemon's one-release-per-queue-entry discipline."""
+
+    __slots__ = ("_stage", "_lock", "_n", "_anchors")
+
+    def __init__(self, stage: PooledBuffer, n: int) -> None:
+        self._stage = stage
+        self._lock = threading.Lock()
+        self._n = n
+        self._anchors: List[Any] = []
+        stage._split = True
+
+    def release(self, *, anchor: Any = None) -> None:
+        with self._lock:
+            if anchor is not None:
+                self._anchors.append(anchor)
+            self._n -= 1
+            if self._n != 0:
+                return
+            anchors = self._anchors
+        final = (
+            None
+            if not anchors
+            else anchors[0] if len(anchors) == 1 else _GroupAnchor(anchors)
+        )
+        # _split stays latched: a concurrent direct release() between a
+        # cleared latch and this call would free the slot with the
+        # accumulated anchors discarded
+        self._stage._release_from_split(final)
+
+    @property
+    def released(self) -> bool:
+        return self._stage.released
+
+
+def _anchor_retired(anchor: Any) -> bool:
+    """True when ``anchor``'s transfer/program can no longer read host
+    memory. A raised probe means the anchor was donated to a later
+    program — by then its own execution (and therefore every host read
+    feeding it) has been sequenced, so the slot is safe (module doc)."""
+    if anchor is None:
+        return True
+    try:
+        return bool(anchor.is_ready())
+    except Exception:
+        return True
+
+
+class HostBufferPool:
+    """Size-classed reusable host staging buffers (module doc).
+
+    ``max_slots_per_class`` bounds the FREE list per class (in-flight and
+    cooling slots are unbounded — backpressure for those is the daemon's
+    queue bound, not the pool's); ``idle_ttl_s`` drops free slots that
+    have not been reused for that long, so a burst does not pin its peak
+    footprint forever (:meth:`shrink` runs opportunistically on acquire).
+    Thread-safe: wire handler threads acquire, the daemon worker releases.
+    """
+
+    def __init__(
+        self, *, max_slots_per_class: int = 8, idle_ttl_s: float = 30.0
+    ) -> None:
+        self._lock = threading.Lock()
+        # size class -> [(buffer, freed_at)] free slots, LIFO for warmth
+        self._free: Dict[int, List[Tuple[PooledBuffer, float]]] = {}
+        # [(buffer, anchor)] released slots whose reader may be in flight
+        self._cooling: List[Tuple[PooledBuffer, Any]] = []
+        self._max_slots = max_slots_per_class
+        self._idle_ttl_s = idle_ttl_s
+        self._last_shrink = 0.0
+        self.allocated = 0  # lifetime allocations (tests/ops visibility)
+
+    def acquire(self, nbytes: int) -> PooledBuffer:
+        """A staging slot of at least ``nbytes``. Recycles a retired slot
+        when one exists (``result=hit``); otherwise allocates — counted as
+        ``grow`` when slots of the class exist but are all still in
+        flight (the double-buffering case: window N holds the pool's
+        warm slot, window N+1 must come from a fresh one), ``miss`` on
+        first sight of the class."""
+        cls = _size_class(nbytes)
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_cooling_locked()
+            free = self._free.get(cls)
+            if free:
+                buf, _t = free.pop()
+                buf._released = False
+                buf._split = False  # the split latch dies with the cycle
+                result = "hit"
+            else:
+                in_flight = any(
+                    b.nbytes == cls for b, _a in self._cooling
+                )
+                result = "grow" if in_flight else "miss"
+                buf = PooledBuffer(self, cls)
+                self.allocated += 1
+            if now - self._last_shrink >= 1.0:
+                self._last_shrink = now
+                self._shrink_locked(now)
+        if _obs._enabled:
+            _obs.counter("serve.ingest.pool", result=result)
+        return buf
+
+    def _release(self, buf: PooledBuffer, anchor: Any) -> None:
+        with self._lock:
+            if anchor is not None and not _anchor_retired(anchor):
+                self._cooling.append((buf, anchor))
+                return
+            self._free_locked(buf, time.monotonic())
+
+    def _free_locked(self, buf: PooledBuffer, now: float) -> None:
+        free = self._free.setdefault(buf.nbytes, [])
+        if len(free) < self._max_slots:
+            free.append((buf, now))
+        # over the cap: drop the buffer on the floor (plain GC)
+
+    def _sweep_cooling_locked(self) -> None:
+        if not self._cooling:
+            return
+        now = time.monotonic()
+        still = []
+        for buf, anchor in self._cooling:
+            if _anchor_retired(anchor):
+                self._free_locked(buf, now)
+            else:
+                still.append((buf, anchor))
+        self._cooling = still
+
+    def _shrink_locked(self, now: float) -> None:
+        for cls, free in list(self._free.items()):
+            kept = [
+                (b, t) for b, t in free if now - t < self._idle_ttl_s
+            ]
+            if kept:
+                self._free[cls] = kept
+            else:
+                del self._free[cls]
+
+    def shrink(self, *, now: Optional[float] = None) -> None:
+        """Drop free slots idle past ``idle_ttl_s`` (also runs
+        opportunistically on acquire, at most once a second)."""
+        with self._lock:
+            self._sweep_cooling_locked()
+            self._shrink_locked(
+                time.monotonic() if now is None else now
+            )
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "free": sum(len(v) for v in self._free.values()),
+                "cooling": len(self._cooling),
+                "allocated": self.allocated,
+            }
+
+
+def coalesce_h2d(
+    batches: Sequence[Tuple[np.ndarray, ...]],
+    device: Any = None,
+) -> Tuple[List[Tuple[Any, ...]], List[bool]]:
+    """Transfer every host batch in ``batches`` (tuples of numpy arrays,
+    one signature group) in ONE ``jax.device_put`` call. Returns
+    ``(placed_batches, owned_flags)``: per input batch, the device-array
+    tuple and whether every one of its device buffers is exclusively that
+    batch's (identical host arrays transfer once and share one device
+    buffer — such a batch reports ``owned=False`` so its chunks are never
+    donated)."""
+    import jax
+
+    unique: Dict[int, int] = {}
+    uses: Dict[int, int] = {}
+    order: List[np.ndarray] = []
+    for args in batches:
+        for a in args:
+            key = id(a)
+            if key not in unique:
+                unique[key] = len(order)
+                order.append(a)
+            uses[key] = uses.get(key, 0) + 1
+    t0 = time.perf_counter()
+    placed = (
+        jax.device_put(order, device) if device is not None
+        else jax.device_put(order)
+    )
+    nbytes = sum(int(a.nbytes) for a in order)
+    if _obs._enabled:
+        _obs.counter("serve.ingest.h2d_bytes", float(nbytes))
+        _trace.complete(
+            "serve.ingest.transfer",
+            t0,
+            time.perf_counter() - t0,
+            kind="serve",
+            bytes=nbytes,
+            arrays=len(order),
+            batches=len(batches),
+        )
+    out: List[Tuple[Any, ...]] = []
+    owned: List[bool] = []
+    for args in batches:
+        out.append(tuple(placed[unique[id(a)]] for a in args))
+        owned.append(all(uses[id(a)] == 1 for a in args))
+    return out, owned

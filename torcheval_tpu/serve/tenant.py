@@ -175,6 +175,7 @@ class TenantHandle:
         block: bool = False,
         timeout: Optional[float] = None,
         seq: Optional[int] = None,
+        stage: Any = None,
     ) -> bool:
         """Enqueue one update batch (the metric ``update`` positional
         args). Returns once queued; the device work happens on the daemon
@@ -185,9 +186,15 @@ class TenantHandle:
         monotonic sequence number: a resubmit at or below the admitted
         watermark is acknowledged without re-applying (returns ``False``)
         — exactly-once into the metric state under at-least-once
-        delivery. Returns ``True`` when the batch was admitted."""
+        delivery. ``stage`` is the pooled staging buffer backing ``args``
+        (the wire's zero-copy ingest path); ownership transfers to the
+        daemon, which releases it on EVERY path — after the batch's
+        device placement, or immediately when the batch is deduplicated,
+        shed, or dropped with a quarantined tenant. Returns ``True`` when
+        the batch was admitted."""
         return self._daemon._submit(
-            self._tenant, args, block=block, timeout=timeout, seq=seq
+            self._tenant, args, block=block, timeout=timeout, seq=seq,
+            stage=stage,
         )
 
     def flush(self, *, timeout: Optional[float] = None) -> dict:

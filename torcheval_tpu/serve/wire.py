@@ -55,23 +55,28 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs import trace as _trace
 from torcheval_tpu.resilience import chaos as _chaos
 from torcheval_tpu.serve.errors import (
     AdmissionError,
     ServeError,
     WireError,
 )
+from torcheval_tpu.utils.npz import NPZ_FORMAT_ERRORS, npz_views
 
 _logger = logging.getLogger(__name__)
 
 __all__ = [
     "EvalServer",
     "pack_tree",
+    "pack_tree_parts",
     "unpack_tree",
     "encode_error",
     "decode_error",
     "send_frame",
+    "send_frame_parts",
     "recv_frame",
+    "recv_frame_into",
 ]
 
 _MAGIC = b"TEW1"
@@ -103,20 +108,68 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 def send_frame(
     sock: socket.socket, header: Dict[str, Any], payload: bytes = b""
 ) -> None:
-    """Serialize and send one frame (header dict + binary payload)."""
+    """Serialize and send one frame (header dict + binary payload).
+    Scatter-gather (``sendmsg``) where the platform has it: composing
+    ``head + header + payload`` into one bytes object re-copies the whole
+    payload per frame — at config8's 32 MB batches that copy was a
+    measurable slice of the wire gap (ISSUE 11)."""
     hbytes = json.dumps(header, separators=(",", ":")).encode()
-    sock.sendall(
-        _HEAD.pack(_MAGIC, len(hbytes), len(payload)) + hbytes + payload
-    )
+    head = _HEAD.pack(_MAGIC, len(hbytes), len(payload))
+    if payload and hasattr(sock, "sendmsg"):
+        _send_parts(sock, [head, hbytes, payload])
+        return
+    sock.sendall(head + hbytes + payload)
 
 
-def recv_frame(
+# segments per sendmsg call: Linux IOV_MAX is 1024 and sendmsg raises
+# EMSGSIZE above it — chunk conservatively below the limit
+_IOV_CHUNK = 1000
+
+
+def _send_parts(sock: socket.socket, parts: List[Any]) -> None:
+    # flat byte views only: short-write resumption below counts BYTES, and
+    # a shaped (e.g. float32) memoryview's len()/slicing count elements
+    parts = [
+        p
+        if isinstance(p, (bytes, bytearray))
+        else memoryview(p).cast("B")
+        for p in parts
+    ]
+    for start in range(0, len(parts), _IOV_CHUNK):
+        chunk = parts[start : start + _IOV_CHUNK]
+        sent = sock.sendmsg(chunk)
+        for p in chunk:  # finish any short scatter write part by part
+            if sent >= len(p):
+                sent -= len(p)
+                continue
+            sock.sendall(p[sent:] if sent else p)
+            sent = 0
+
+
+def send_frame_parts(
     sock: socket.socket,
-) -> Optional[Tuple[Dict[str, Any], bytes]]:
-    """Receive one frame; ``None`` on clean EOF. Raises
-    :class:`WireError(reason="protocol")` on garbage — wrong magic,
-    absurd lengths, unparseable header — so a client never retries
-    against a peer that speaks something else."""
+    header: Dict[str, Any],
+    parts: List[Any],
+    total: int,
+) -> None:
+    """:func:`send_frame` whose payload is a scatter-gather parts list
+    (:func:`pack_tree_parts`): the payload bytes go from their owning
+    buffers straight into the kernel — never assembled in user space."""
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    head = _HEAD.pack(_MAGIC, len(hbytes), total)
+    if hasattr(sock, "sendmsg"):
+        _send_parts(sock, [head, hbytes, *parts])
+        return
+    sock.sendall(b"".join([head, hbytes, *map(bytes, parts)]))
+
+
+def _recv_prefix(
+    sock: socket.socket,
+) -> Optional[Tuple[Dict[str, Any], int]]:
+    """Read and validate one frame's prefix (magic, sizes, JSON header);
+    returns ``(header, payload_len)``, or ``None`` on clean EOF at a
+    frame boundary. The ONE copy of the frame-prefix protocol shared by
+    :func:`recv_frame` and :func:`recv_frame_into`."""
     head = _recv_exact(sock, _HEAD.size)
     if head is None:
         return None
@@ -138,19 +191,81 @@ def recv_frame(
         header = json.loads(hbytes)
     except json.JSONDecodeError as e:
         raise WireError("protocol", f"unparseable frame header: {e}") from None
+    return header, plen
+
+
+def recv_frame(
+    sock: socket.socket,
+) -> Optional[Tuple[Dict[str, Any], bytes]]:
+    """Receive one frame; ``None`` on clean EOF. Raises
+    :class:`WireError(reason="protocol")` on garbage — wrong magic,
+    absurd lengths, unparseable header — so a client never retries
+    against a peer that speaks something else."""
+    prefix = _recv_prefix(sock)
+    if prefix is None:
+        return None
+    header, plen = prefix
     payload = _recv_exact(sock, plen)
     if payload is None and plen:
         raise WireError("protocol", "connection closed before payload.")
     return header, payload or b""
 
 
+def _recv_exact_into(sock: socket.socket, mv: memoryview) -> None:
+    """Fill ``mv`` completely from the socket; ``protocol`` error on EOF
+    mid-payload (the caller has already read this frame's header)."""
+    want = len(mv)
+    got = 0
+    while got < want:
+        n = sock.recv_into(mv[got:], min(want - got, 1 << 20))
+        if not n:
+            raise WireError(
+                "protocol",
+                f"connection closed mid-frame ({got}/{want} bytes).",
+            )
+        got += n
+
+
+def recv_frame_into(
+    sock: socket.socket, pool: Any
+) -> Optional[Tuple[Dict[str, Any], Any, Any]]:
+    """:func:`recv_frame`, but the payload lands in a pooled staging
+    buffer instead of a fresh ``bytes`` object: returns ``(header,
+    payload_view, stage)`` where ``stage`` is the
+    :class:`~torcheval_tpu.serve.ingest.PooledBuffer` backing
+    ``payload_view`` (``None`` for payloadless frames — then
+    ``payload_view`` is ``b""``). The caller owns releasing the stage.
+    The pooled fill is the timeline's ``serve.ingest.stage`` bar: the
+    window in which this frame's bytes were landing in host memory."""
+    prefix = _recv_prefix(sock)
+    if prefix is None:
+        return None
+    header, plen = prefix
+    if not plen:
+        return header, b"", None
+    t0 = time.perf_counter()
+    stage = pool.acquire(plen)
+    view = stage.view(plen)
+    try:
+        _recv_exact_into(sock, view)
+    except BaseException:
+        stage.release()
+        raise
+    if _obs._enabled:
+        _trace.complete(
+            "serve.ingest.stage",
+            t0,
+            time.perf_counter() - t0,
+            kind="serve",
+            bytes=plen,
+        )
+    return header, view, stage
+
+
 # -------------------------------------------------------------- tree coding
-def pack_tree(obj: Any) -> Tuple[Any, bytes]:
-    """Encode a result/args tree (dicts, lists/tuples, scalars, arrays)
-    into a JSON-safe spec plus ONE npz payload holding every array leaf.
-    Anything with ``__array__`` (numpy, jax arrays, torch tensors)
-    becomes an array leaf; exact dtype/shape survive the round trip."""
-    arrays: Dict[str, np.ndarray] = {}
+def _tree_encoder(arrays: Dict[str, np.ndarray]):
+    """The shared spec encoder behind :func:`pack_tree` and
+    :func:`pack_tree_parts`: array leaves register into ``arrays``."""
 
     def enc(x: Any) -> Any:
         if x is None or isinstance(x, (bool, int, float, str)):
@@ -182,7 +297,16 @@ def pack_tree(obj: Any) -> Tuple[Any, bytes]:
         arrays[key] = arr
         return {"t": "arr", "i": key}
 
-    spec = enc(obj)
+    return enc
+
+
+def pack_tree(obj: Any) -> Tuple[Any, bytes]:
+    """Encode a result/args tree (dicts, lists/tuples, scalars, arrays)
+    into a JSON-safe spec plus ONE npz payload holding every array leaf.
+    Anything with ``__array__`` (numpy, jax arrays, torch tensors)
+    becomes an array leaf; exact dtype/shape survive the round trip."""
+    arrays: Dict[str, np.ndarray] = {}
+    spec = _tree_encoder(arrays)(obj)
     if not arrays:
         return spec, b""
     buf = io.BytesIO()
@@ -190,14 +314,109 @@ def pack_tree(obj: Any) -> Tuple[Any, bytes]:
     return spec, buf.getvalue()
 
 
-def unpack_tree(spec: Any, payload: bytes) -> Any:
-    """Inverse of :func:`pack_tree`."""
+# zip structure constants for the scatter-gather packer
+_ZIP_LOCAL = struct.Struct("<4s5H3I2H")
+_ZIP_CENTRAL = struct.Struct("<4s6H3I5H2I")
+_ZIP_EOCD = struct.Struct("<4s4H2IH")
+
+
+def pack_tree_parts(obj: Any) -> Tuple[Any, List[Any], int]:
+    """:func:`pack_tree` for the ingest hot path: returns ``(spec, parts,
+    total_len)`` where ``parts`` is a scatter-gather list whose array-data
+    members are MEMORYVIEWS of the caller's own buffers — the payload is
+    never assembled, ``send_frame`` hands the parts straight to
+    ``sendmsg``. The archive is a STORED npz whose members' data offsets
+    are 64-byte aligned (so the receiving :func:`unpack_tree` decodes
+    zero-copy views), with one deliberate deviation: **member CRC32
+    fields are zero**. Computing real CRCs costs one full pass over the
+    payload per frame — the exact per-byte work this path exists to
+    remove — and the repo's own decoder (``utils/npz.py``) never reads
+    them. Foreign ``np.load`` consumers must use :func:`pack_tree`
+    (checkpoints do: ``resilience.save`` keeps real npz + sha256).
+
+    The caller must keep the encoded arrays alive until the send
+    completes (the parts alias their buffers)."""
     arrays: Dict[str, np.ndarray] = {}
-    if payload:
+    spec = _tree_encoder(arrays)(obj)
+    if not arrays:
+        return spec, [], 0
+    parts: List[Any] = []
+    central = []
+    offset = 0
+    import zlib
+
+    for key, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        name = f"{key}.npy".encode()
+        dtype_descr = np.lib.format.dtype_to_descr(arr.dtype)
+        header = (
+            "{'descr': %r, 'fortran_order': False, 'shape': %r, }"
+            % (dtype_descr, arr.shape)
+        ).encode("latin1")
+        # absolute 64-byte data alignment: pad the npy header (spaces
+        # before the terminating newline, per the npy spec) so
+        # data_start = offset + 30 + len(name) + 10 + hlen is 0 mod 64
+        base_hlen = len(header) + 1
+        data_start = offset + 30 + len(name) + 10 + base_hlen
+        hlen = base_hlen + (-data_start) % 64
+        npy_head = (
+            b"\x93NUMPY\x01\x00"
+            + struct.pack("<H", hlen)
+            + header
+            + b" " * (hlen - base_hlen)
+            + b"\n"
+        )
+        size = len(npy_head) + arr.nbytes
+        crc = 0
+        if not isinstance(dtype_descr, str):
+            # structured dtypes take the receiver's CHECKED copy fallback
+            # (zipfile verifies member CRCs at EOF there), so they alone
+            # pay the real checksum; plain-descr members ride the
+            # CRC-blind zero-copy path (module doc above)
+            crc = zlib.crc32(
+                arr.data.cast("B"), zlib.crc32(npy_head)
+            )
+        local = _ZIP_LOCAL.pack(
+            b"PK\x03\x04", 20, 0, 0, 0, 0, crc, size, size, len(name), 0
+        )
+        parts.append(local + name + npy_head)
+        if arr.nbytes:
+            # flat byte view: scatter-send bookkeeping counts bytes
+            parts.append(arr.data.cast("B"))
+        central.append((name, offset, size, crc))
+        offset += 30 + len(name) + size
+    cd_start = offset
+    cd = bytearray()
+    for name, off, size, crc in central:
+        cd += _ZIP_CENTRAL.pack(
+            b"PK\x01\x02", 20, 20, 0, 0, 0, 0, crc, size, size,
+            len(name), 0, 0, 0, 0, 0, off,
+        )
+        cd += name
+    cd += _ZIP_EOCD.pack(
+        b"PK\x05\x06", 0, 0, len(central), len(central), len(cd), cd_start, 0
+    )
+    parts.append(bytes(cd))
+    return spec, parts, cd_start + len(cd)
+
+
+def unpack_tree(spec: Any, payload: Any) -> Any:
+    """Inverse of :func:`pack_tree`. ``payload`` may be ``bytes`` or any
+    buffer (a pooled staging view): aligned uncompressed leaves decode as
+    zero-copy ``np.frombuffer`` views over the payload itself — no
+    per-leaf heap allocation on the steady path — with a per-leaf copy
+    fallback for compressed/misaligned/structured members
+    (``utils/npz.py``; object arrays still reject exactly like
+    ``allow_pickle=False``). The views pin the payload buffer (via
+    ``ndarray.base``) for as long as any leaf lives, and are READ-ONLY
+    when the payload is (a ``bytes`` frame) — callers that mutate a
+    decoded result in place must copy it first (``np.load`` used to hand
+    back fresh writable arrays here)."""
+    arrays: Dict[str, np.ndarray] = {}
+    if len(payload):
         try:
-            with np.load(io.BytesIO(payload), allow_pickle=False) as z:
-                arrays = {k: z[k] for k in z.files}
-        except Exception as e:
+            arrays = npz_views(payload)
+        except NPZ_FORMAT_ERRORS as e:
             raise WireError(
                 "protocol", f"undecodable array payload: {e}"
             ) from None
@@ -357,7 +576,13 @@ class EvalServer:
         port: int = 0,
         backlog: int = 32,
     ) -> None:
+        from torcheval_tpu.serve.ingest import HostBufferPool
+
         self._daemon = daemon
+        # shared staging pool: frame payloads land here and decode as
+        # zero-copy views; slots recycle under the ingest aliasing
+        # contract (serve/ingest.py)
+        self._pool = HostBufferPool()
         self._sock = socket.create_server((host, port), backlog=backlog)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
         self._handles: Dict[str, Any] = {}
@@ -431,16 +656,18 @@ class EvalServer:
         try:
             while self._running:
                 try:
-                    frame = recv_frame(conn)
+                    frame = recv_frame_into(conn, self._pool)
                 except WireError as e:
                     _logger.warning("eval-wire: dropping connection: %s", e)
                     return
                 if frame is None:
                     return
-                header, payload = frame
+                header, payload, stage = frame
                 if self._partitioned:
+                    if stage is not None:
+                        stage.release()
                     continue  # read and never answer (see class doc)
-                response = self._dispatch(header, payload)
+                response = self._dispatch(header, payload, stage)
                 if response is None:
                     continue  # partition tripped ON this request
                 try:
@@ -457,7 +684,7 @@ class EvalServer:
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(
-        self, header: Dict[str, Any], payload: bytes
+        self, header: Dict[str, Any], payload: Any, stage: Any = None
     ) -> Optional[Tuple[Dict[str, Any], bytes]]:
         op = str(header.get("op", "?"))
         tenant = header.get("tenant")
@@ -467,14 +694,31 @@ class EvalServer:
             directive = _chaos.on_host_request(op, tenant)
             if directive == "partition":
                 self._partitioned = True
+                if stage is not None:
+                    stage.release()
                 return None
             # "ack_drop" processes below and dies before the ack
         else:
             directive = None
+        # single-owner staging discipline: the box holds the stage until
+        # the submit path TAKES it (just before handing it to the daemon,
+        # which releases on every one of its own paths). The except arm
+        # below frees only a stage still in the box — pre-handoff
+        # failures (unpack errors, unknown tenants) — so a slot can never
+        # be double-released across a pool recycle by two owners.
+        stage_box = [stage]
         try:
-            out_header, out_payload = self._handle(op, header, payload)
+            out_header, out_payload = self._handle(
+                op, header, payload, stage_box
+            )
+            if stage_box[0] is not None:
+                # a payload-bearing non-submit op: nothing took the stage
+                stage_box[0].release()
+                stage_box[0] = None
             response = ({"ok": True, **out_header}, out_payload)
         except BaseException as exc:  # noqa: BLE001 - containment wall
+            if stage_box[0] is not None:
+                stage_box[0].release()
             if not isinstance(exc, (ServeError, ValueError)) and not type(
                 exc
             ).__name__.endswith("CheckpointError"):
@@ -489,8 +733,14 @@ class EvalServer:
         return response
 
     def _handle(
-        self, op: str, header: Dict[str, Any], payload: bytes
+        self,
+        op: str,
+        header: Dict[str, Any],
+        payload: Any,
+        stage_box: Optional[list] = None,
     ) -> Tuple[Dict[str, Any], bytes]:
+        if stage_box is None:
+            stage_box = [None]
         if op == "health":
             return {"health": self._daemon.health()}, b""
         if op == "snapshot":
@@ -509,14 +759,31 @@ class EvalServer:
             return {"tenants": drained}, b""
         if op == "attach":
             return self._handle_attach(header)
-        if op not in ("submit", "compute", "sync_compute", "flush", "detach"):
+        if op not in (
+            "submit",
+            "submit_many",
+            "compute",
+            "sync_compute",
+            "flush",
+            "detach",
+        ):
             raise WireError("protocol", f"unknown wire op {op!r}.")
         # every remaining op targets one attached tenant
         handle = self._tenant_handle(str(header.get("tenant")))
+        if op == "submit_many":
+            return self._handle_submit_many(
+                handle, header, payload, stage_box
+            )
         if op == "submit":
             seq = int(header["seq"])
             args = unpack_tree(header["args"], payload)
-            applied = handle.submit(*args, seq=seq)
+            # the decoded args are zero-copy views over the pooled stage;
+            # TAKE the stage out of the box — from here its lifetime is
+            # the daemon's problem: it releases on every non-enqueue path
+            # (even when submit raises) and, for admitted batches, after
+            # the worker has placed the views on device
+            stage, stage_box[0] = stage_box[0], None
+            applied = handle.submit(*args, seq=seq, stage=stage)
             return {
                 "applied": applied,
                 "acked_seq": handle._tenant.durable_seq,
@@ -547,6 +814,69 @@ class EvalServer:
             return {"checkpoint": path}, b""
         raise AssertionError(op)  # pragma: no cover - gated above
 
+    def _handle_submit_many(
+        self,
+        handle: Any,
+        header: Dict[str, Any],
+        payload: Any,
+        stage_box: list,
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """The client's coalesced submit: ONE frame carrying K seq'd
+        batches (ISSUE 11 — the wire analog of the coalesced H2D group:
+        frame overhead amortizes over the group instead of repeating per
+        batch). Batches apply strictly in seq order; the single pooled
+        stage backing every batch's views is reference-shared so it frees
+        only when the LAST batch's device placement is done. On a
+        mid-group failure the error surfaces with the whole group booked
+        client-side — replay + seq dedup settle the split exactly-once."""
+        from torcheval_tpu.serve.ingest import SharedStage
+
+        seqs = header.get("seqs")
+        batches = unpack_tree(header["args"], payload)
+        if not isinstance(seqs, list) or len(seqs) != len(batches):
+            raise WireError(
+                "protocol",
+                f"submit_many seqs/batches mismatch "
+                f"({seqs!r} vs {len(batches)} batches).",
+            )
+        try:
+            # validate BEFORE taking shares: once the SharedStage exists,
+            # only handle.submit may consume a share per batch — a raise
+            # from anywhere else would break the share accounting below
+            seqs = [int(s) for s in seqs]
+        except (TypeError, ValueError):
+            raise WireError(
+                "protocol", f"submit_many seqs must be ints, got {seqs!r}."
+            ) from None
+        # validations done: take the stage from the box — from here share
+        # accounting (one per batch) owns the slot's lifetime
+        stage, stage_box[0] = stage_box[0], None
+        shared = (
+            SharedStage(stage, len(batches))
+            if stage is not None and batches
+            else None
+        )
+        if shared is None and stage is not None:
+            stage.release()  # a payload-bearing frame with zero batches
+        applied = []
+        try:
+            for seq, args in zip(seqs, batches):
+                applied.append(
+                    handle.submit(*args, seq=seq, stage=shared)
+                )
+        except BaseException:
+            if shared is not None:
+                # the failing submit released its own share on its
+                # no-enqueue path; the never-attempted tail's shares are
+                # still ours
+                for _ in range(len(batches) - len(applied) - 1):
+                    shared.release()
+            raise
+        return {
+            "applied": applied,
+            "acked_seq": handle._tenant.durable_seq,
+        }, b""
+
     def _handle_attach(
         self, header: Dict[str, Any]
     ) -> Tuple[Dict[str, Any], bytes]:
@@ -560,6 +890,7 @@ class EvalServer:
             "step_timeout_s",
             "queue_capacity",
             "resume",
+            "window_chunks",
         ):
             if header.get(knob) is not None:
                 kwargs[knob] = header[knob]
