@@ -1130,6 +1130,10 @@ def config8_cluster():
             server.endpoint,
             request_timeout_s=300.0,
             submit_buffer=window_chunks,
+            # pinned raw: this is the codec leg's baseline — inheriting a
+            # fleet-wide TORCHEVAL_TPU_WIRE_CODEC here would turn the
+            # codec_gain row into a codec-vs-codec comparison (~1.0)
+            codec="raw",
         )
         spec = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
         client.attach("warm", spec, window_chunks=window_chunks)
@@ -1160,6 +1164,55 @@ def config8_cluster():
         "config8_cluster_wire_1host_ratio",
         wire_rate / (preds / local_s),
         "x of in-process (target >= 0.8 with ingest/compute overlap)",
+    )
+
+    # (b-codec) the SAME stream with the negotiated wire codec on
+    # (ISSUE 12): delta-narrowed integer leaves + block-quantized f32
+    # leaves shrink each submit frame ~3-4x, so the wire leg pays fewer
+    # bytes through the loopback kernel. Paired with (b) on the same run,
+    # the codec ratio vs the raw ratio is the acceptance observable
+    # (codec_gain > 1 = the codec helped; on a 1-core sandbox encode CPU
+    # and wire savings fight over the same core, so the honest win here
+    # is a lower bound on what a real NIC-bound deployment sees).
+    # TORCHEVAL_TPU_BENCH_WIRE_CODEC picks the codec (default qblk, the
+    # full compressed wire; "delta" benches the lossless-only variant).
+    bench_codec = os.environ.get("TORCHEVAL_TPU_BENCH_WIRE_CODEC", "qblk")
+    with EvalDaemon(queue_capacity=64) as daemon:
+        server = EvalServer(daemon)
+        client = EvalClient(
+            server.endpoint,
+            request_timeout_s=300.0,
+            submit_buffer=window_chunks,
+            codec=bench_codec,
+        )
+        client.attach("warm", spec, window_chunks=window_chunks)
+        for s, l in batches[:window_chunks]:
+            client.submit("warm", s, l)
+        client.compute("warm")
+        client.detach("warm")
+        client.attach("bench", spec, window_chunks=window_chunks)
+        t0 = time.perf_counter()
+        for s, l in batches:
+            client.submit("bench", s, l)
+        client.compute("bench")
+        codec_s = time.perf_counter() - t0
+        client.close()
+        server.close()
+    codec_rate = preds / codec_s
+    _emit_row(
+        f"config8_cluster_wire_codec_1host[{bench_codec}]",
+        codec_rate,
+        "preds/s",
+    )
+    _emit_row(
+        "config8_cluster_wire_codec_1host_ratio",
+        codec_rate / (preds / local_s),
+        "x of in-process (paired with config8_cluster_wire_1host_ratio)",
+    )
+    _emit_row(
+        "config8_cluster_wire_codec_gain",
+        codec_rate / wire_rate,
+        "x of the raw wire on the same run (>1 = codec helped)",
     )
 
     # (b2) ingest overlap: concurrent producers keep the daemon queue
@@ -1361,6 +1414,9 @@ _EXPECTED_ROW_PREFIXES = (
     "config8_cluster_local_direct",
     "config8_cluster_wire_1host",
     "config8_cluster_wire_1host_ratio",
+    "config8_cluster_wire_codec_1host",
+    "config8_cluster_wire_codec_1host_ratio",
+    "config8_cluster_wire_codec_gain",
     "config8_cluster_wire_2host_migration",
     "config8_ingest_overlap_ms",
     "env_dispatch_floor",

@@ -33,6 +33,7 @@ MODULES = [
     "torcheval_tpu.resilience",
     "torcheval_tpu.serve",
     "torcheval_tpu.serve.ingest",
+    "torcheval_tpu.utils.quant",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
     "torcheval_tpu.utils.test_utils",

@@ -47,6 +47,59 @@ def make_dict_updates(rank: int):
     return [("shared", float(rank + 1)), (f"rank{rank}", 10.0 * (rank + 1))]
 
 
+# quantized-wire scenario (ISSUE 12): an integer-lane-dominant state big
+# enough for the codecs to engage (int64 counts held as host numpy so the
+# 64-bit width survives jax's 32-bit default)
+QUANT_N = 4096
+
+
+def make_quant_counts(rank: int):
+    rng = np.random.default_rng(300 + rank)
+    return rng.integers(0, 200, QUANT_N).astype(np.int64)
+
+
+def make_quant_fsum(rank: int):
+    rng = np.random.default_rng(400 + rank)
+    return (rng.random(QUANT_N) * 10.0).astype(np.float32)
+
+
+def make_quant_metric(rank: int):
+    from torcheval_tpu.metrics.metric import Metric
+    from torcheval_tpu.metrics.state import Reduction
+
+    class QuantSumMetric(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self._add_state(
+                "counts",
+                np.zeros(QUANT_N, np.int64),
+                reduction=Reduction.SUM,
+            )
+            self._add_state(
+                "fsum",
+                np.zeros(QUANT_N, np.float32),
+                reduction=Reduction.SUM,
+            )
+
+        def update(self, c, f):
+            self.counts = np.asarray(self.counts, np.int64) + c
+            self.fsum = np.asarray(self.fsum, np.float32) + f
+            return self
+
+        def compute(self):
+            return float(self.counts.sum()) + float(self.fsum.sum())
+
+        def merge_state(self, metrics):
+            for o in metrics:
+                self.counts = self.counts + np.asarray(o.counts)
+                self.fsum = self.fsum + np.asarray(o.fsum)
+            return self
+
+    return QuantSumMetric().update(
+        make_quant_counts(rank), make_quant_fsum(rank)
+    )
+
+
 def _jsonable(x):
     arr = np.asarray(x)
     return arr.tolist() if arr.ndim else float(arr)
@@ -292,6 +345,16 @@ def main() -> None:
         ]
         results["obs_world_size"] = snap["gauges"]["toolkit.sync.world_size"]
 
+        # lane_bytes accounting-drift guard (ISSUE 12 satellite): when the
+        # codec is raw, the raw and encoded counters must agree EXACTLY —
+        # a silent double-count in either immediately breaks this pair.
+        # (Accuracy's states sit below the quantization floor, so this
+        # holds even when TORCHEVAL_TPU_SYNC_QUANTIZE=1 forces the codec
+        # on for the CI rerun.)
+        results["obs_acc_sum_lane_bytes_encoded_raw"] = snap["counters"][
+            "toolkit.sync.lane_bytes_encoded{codec=raw,lane=SUM}"
+        ]
+
         obs.reset()
         sync_and_compute(auroc, recipient_rank="all")
         snap = obs.snapshot()
@@ -301,6 +364,50 @@ def main() -> None:
         results["obs_auroc_cat_lane_bytes"] = snap["counters"][
             "toolkit.sync.lane_bytes{lane=CAT}"
         ]
+
+        # --- quantized wire over the REAL 4-process transport (ISSUE 12):
+        # an integer-lane-dominant metric syncs with quantize=True — int64
+        # count lanes must come back bit-exact (narrow + widened
+        # accumulation), the f32 sum lane within its documented bound, the
+        # wire still two rounds, and the encoded bytes >= 4x below raw
+        obs.reset()
+        qm = make_quant_metric(rank)
+        q_synced = get_synced_metric(qm, recipient_rank="all", quantize=True)
+        qsnap = obs.snapshot()
+        results["quant_rounds"] = qsnap["counters"]["toolkit.sync.rounds"]
+        want_counts = np.sum(
+            [make_quant_counts(r) for r in range(world)], axis=0
+        )
+        results["quant_int_exact"] = bool(
+            np.array_equal(np.asarray(q_synced.counts), want_counts)
+        )
+        want_fsum = np.sum(
+            [make_quant_fsum(r) for r in range(world)], axis=0,
+            dtype=np.float64,
+        )
+        bound = (
+            sum(
+                float(np.abs(make_quant_fsum(r)).max())
+                for r in range(world)
+            )
+            / 254.0
+            + 1e-3
+        )
+        results["quant_f32_within_bound"] = bool(
+            np.abs(np.asarray(q_synced.fsum) - want_fsum).max() <= bound
+        )
+        raw_b = sum(
+            v
+            for k, v in qsnap["counters"].items()
+            if k.startswith("toolkit.sync.lane_bytes{")
+        )
+        enc_b = sum(
+            v
+            for k, v in qsnap["counters"].items()
+            if k.startswith("toolkit.sync.lane_bytes_encoded{")
+        )
+        results["quant_lane_bytes_raw"] = raw_b
+        results["quant_lane_bytes_encoded"] = enc_b
     finally:
         obs.disable()
         obs.reset()

@@ -205,6 +205,33 @@ class TestMultiprocessSync(unittest.TestCase):
             else:
                 self.assertEqual(res["obs_auroc_cat_lane_bytes"], 0)
 
+    def test_lane_bytes_raw_and_encoded_agree_on_raw_codec(self):
+        # ISSUE 12 satellite: when the codec is raw the lane_bytes /
+        # lane_bytes_encoded pair must agree EXACTLY — the guard against
+        # silent double-count regressions in either counter. (Holds under
+        # the TORCHEVAL_TPU_SYNC_QUANTIZE=1 CI rerun too: accuracy's
+        # states sit below the quantization floor and stay raw.)
+        for res in self.results:
+            self.assertEqual(
+                res["obs_acc_sum_lane_bytes"],
+                res["obs_acc_sum_lane_bytes_encoded_raw"],
+            )
+
+    def test_quantized_sync_over_real_transport(self):
+        # ISSUE 12 acceptance, on the real 4-process Gloo wire: integer
+        # lanes bit-exact, f32 drift within the documented bound, still
+        # two collective rounds, and the encoded payload >= 4x below raw
+        # on the integer-lane-dominant state
+        for res in self.results:
+            self.assertTrue(res["quant_int_exact"])
+            self.assertTrue(res["quant_f32_within_bound"])
+            self.assertEqual(res["quant_rounds"], 2)
+            self.assertGreater(res["quant_lane_bytes_raw"], 0)
+            self.assertLessEqual(
+                res["quant_lane_bytes_encoded"] * 4,
+                res["quant_lane_bytes_raw"],
+            )
+
     def test_window_config_drift_raises_uniformly(self):
         # window_size drift across ranks: the schema digest (which folds in
         # _sync_schema_extra) mismatches and EVERY rank raises — the typed

@@ -1,0 +1,361 @@
+"""Quantized metric-state sync lanes (ISSUE 12).
+
+The wire codecs run inside ``_gather_collection_states``, so these tests
+drive the REAL two-round exchange — encode, descriptor matrix, payload
+concatenation, per-rank decode, fold — over a **simulated wire**: W
+threads, one per rank, rendezvous at a barrier inside a stubbed
+``_allgather_stacked_impl`` and exchange genuinely different per-rank
+buffers. That exercises everything but the transport (which the real
+4-process world in ``test_multiprocess_sync.py`` covers, re-run by CI
+with ``TORCHEVAL_TPU_SYNC_QUANTIZE=1``).
+
+Contracts pinned here, per the ISSUE 12 acceptance:
+
+* integer SUM/MAX/MIN lanes are BIT-EXACT at world sizes 2/4/8
+  (lossless narrowing + widened accumulation);
+* f32 SUM lanes drift within the documented bound — per element, at most
+  ``sum over ranks of max|rank block| / 254`` (each rank contributes at
+  most half a quantization step; docs/distributed.md "Quantized sync");
+* ``quantize=False`` opts out per call and restores exact raw bytes even
+  with the env knob forced on;
+* non-finite f32 entries fall back to the raw lane (error-channel shape)
+  and the synced result is bit-identical to an unquantized sync;
+* the ``lane_bytes`` / ``lane_bytes_encoded`` pair shows >= 4x shrink on
+  an integer-lane-dominant state at world size 8, and agrees exactly
+  when the codec is raw;
+* ranks that DISAGREE on the knob (env drift) still interoperate — the
+  codec travels per entry in the descriptor.
+"""
+
+import os
+import threading
+import unittest
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+
+import torcheval_tpu.metrics.toolkit as tk
+from torcheval_tpu import obs
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction
+
+
+class BigState(Metric):
+    """Integer-lane-dominant metric: two int64 SUM count lanes (the
+    dominant payload — held as host numpy so the 64-bit width survives
+    jax's 32-bit default, exactly like the toolkit's own faithful-numpy
+    decode path), an int32 MAX watermark, and an f32 SUM tail."""
+
+    N = 4096
+    RAW_BYTES = N * (8 + 8 + 4 + 4)  # the four states' raw wire bytes
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._add_state(
+            "counts", np.zeros(self.N, np.int64), reduction=Reduction.SUM
+        )
+        self._add_state(
+            "hits", np.zeros(self.N, np.int64), reduction=Reduction.SUM
+        )
+        self._add_state(
+            "peak", jnp.zeros(self.N, jnp.int32), reduction=Reduction.MAX
+        )
+        self._add_state(
+            "fsum", jnp.zeros(self.N, jnp.float32), reduction=Reduction.SUM
+        )
+
+    def update(self, c, h, p, f):
+        self.counts = np.asarray(self.counts, np.int64) + np.asarray(
+            c, np.int64
+        )
+        self.hits = np.asarray(self.hits, np.int64) + np.asarray(
+            h, np.int64
+        )
+        self.peak = jnp.maximum(self.peak, jnp.asarray(p, jnp.int32))
+        self.fsum = self.fsum + jnp.asarray(f)
+        return self
+
+    def compute(self):
+        return (
+            int(self.counts.sum()),
+            int(self.hits.sum()),
+            int(jnp.max(self.peak)),
+            float(jnp.sum(self.fsum)),
+        )
+
+    def merge_state(self, metrics):
+        for o in metrics:
+            self.counts = self.counts + np.asarray(o.counts, np.int64)
+            self.hits = self.hits + np.asarray(o.hits, np.int64)
+            self.peak = jnp.maximum(self.peak, o.peak)
+            self.fsum = self.fsum + o.fsum
+        return self
+
+
+def make_replica(rank: int, fscale: float = 10.0) -> BigState:
+    rng = np.random.default_rng(100 + rank)
+    return BigState().update(
+        rng.integers(0, 200, BigState.N),
+        rng.integers(0, 50, BigState.N),
+        rng.integers(0, 1000, BigState.N),
+        (rng.random(BigState.N) * fscale).astype(np.float32),
+    )
+
+
+class _SimWire:
+    """Barrier-coordinated allgather stub: each rank thread contributes
+    its own buffer and receives the genuine per-rank stack — the real
+    collective's semantics, minus the network."""
+
+    def __init__(self, world: int):
+        self.world = world
+        self.barrier = threading.Barrier(world)
+        self.slots = [None] * world
+        self.tls = threading.local()
+        self.round_bytes = []
+        self._lock = threading.Lock()
+
+    def allgather(self, x, group):
+        assert group is None
+        rank = self.tls.rank
+        self.slots[rank] = np.array(x, copy=True)
+        self.barrier.wait()
+        out = np.stack(self.slots)
+        with self._lock:
+            self.round_bytes.append(int(np.asarray(x).nbytes))
+        self.barrier.wait()  # all read before the next round overwrites
+        return out
+
+
+def run_world(world, fn):
+    """Run ``fn(rank)`` on W rank threads under the simulated wire;
+    returns per-rank results (exceptions re-raised). The module patches
+    are entered ONCE on the main thread — entering mock.patch per rank
+    thread would race the save/restore and could leak a patched
+    ``_world_size`` into later tests; the per-thread rank rides the sim's
+    thread-local instead."""
+    sim = _SimWire(world)
+    results = [None] * world
+    errors = []
+
+    def runner(rank):
+        sim.tls.rank = rank
+        try:
+            results[rank] = fn(rank)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((rank, e))
+
+    with mock.patch.object(
+        tk, "_allgather_stacked_impl", sim.allgather
+    ), mock.patch.object(
+        tk, "_world_size", lambda: world
+    ), mock.patch.object(
+        tk, "_process_index", lambda: sim.tls.rank
+    ):
+        threads = [
+            threading.Thread(target=runner, args=(r,)) for r in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0][1]
+    return results, sim
+
+
+def exact_merge(world, fscale: float = 10.0) -> BigState:
+    base = make_replica(0, fscale)
+    return base.merge_state(
+        [make_replica(r, fscale) for r in range(1, world)]
+    )
+
+
+class TestQuantizedSync(unittest.TestCase):
+    def _sync_world(self, world, quantize, fscale=10.0):
+        def fn(rank):
+            return tk.get_synced_metric(
+                make_replica(rank, fscale),
+                recipient_rank="all",
+                quantize=quantize,
+            )
+
+        return run_world(world, fn)
+
+    def test_integer_lanes_bit_exact_across_world_sizes(self):
+        for world in (2, 4, 8):
+            results, _ = self._sync_world(world, quantize=True)
+            want = exact_merge(world)
+            for synced in results:
+                np.testing.assert_array_equal(
+                    np.asarray(synced.counts), np.asarray(want.counts)
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(synced.peak), np.asarray(want.peak)
+                )
+
+    def test_f32_sum_drift_within_documented_bound(self):
+        # the documented tolerance: each rank's entry dequantizes within
+        # max|block|/254 per element, and the fold adds one such error
+        # per contributing rank — so the synced sum sits within
+        # sum_r(max|rank_r|)/254 of the exact rank-ordered fold (plus a
+        # whisker for f32 accumulation order)
+        for world in (2, 4, 8):
+            results, _ = self._sync_world(world, quantize=True)
+            want = np.asarray(exact_merge(world).fsum)
+            bound = sum(
+                float(np.abs(np.asarray(make_replica(r).fsum)).max())
+                for r in range(world)
+            ) / 254.0
+            for synced in results:
+                drift = np.abs(np.asarray(synced.fsum) - want)
+                self.assertGreater(drift.max(), 0)  # actually quantized
+                self.assertLessEqual(drift.max(), bound * 1.0001)
+
+    def test_quantize_false_opt_out_restores_exact_bytes(self):
+        env = {"TORCHEVAL_TPU_SYNC_QUANTIZE": "1"}
+        with mock.patch.dict(os.environ, env):
+            results, sim = self._sync_world(4, quantize=False)
+        want = exact_merge(4)
+        for synced in results:
+            np.testing.assert_array_equal(
+                np.asarray(synced.fsum), np.asarray(want.fsum)
+            )
+        # payload round carries the full raw bytes of all four states
+        self.assertEqual(sim.round_bytes[-1], BigState.RAW_BYTES)
+
+    def test_env_default_engages_quantization(self):
+        env = {"TORCHEVAL_TPU_SYNC_QUANTIZE": "1"}
+        with mock.patch.dict(os.environ, env):
+            _, sim = self._sync_world(4, quantize=None)
+        self.assertLess(sim.round_bytes[-1], BigState.RAW_BYTES // 3)
+
+    def test_payload_round_shrinks_at_least_4x_at_world_8(self):
+        _, sim_raw = self._sync_world(8, quantize=False)
+        _, sim_q = self._sync_world(8, quantize=True)
+        self.assertLessEqual(
+            sim_q.round_bytes[-1] * 4, sim_raw.round_bytes[-1]
+        )
+
+    def test_lane_bytes_encoded_counters_and_ratio(self):
+        obs.enable()
+        try:
+            obs.reset()
+            self._sync_world(8, quantize=True)
+            counters = obs.snapshot()["counters"]
+            raw = counters["toolkit.sync.lane_bytes{lane=SUM}"]
+            raw += counters["toolkit.sync.lane_bytes{lane=MAX}"]
+            enc = sum(
+                v
+                for k, v in counters.items()
+                if k.startswith("toolkit.sync.lane_bytes_encoded{")
+            )
+            self.assertGreater(raw, 0)
+            # >= 4x on the integer-dominant state (acceptance criterion)
+            self.assertLessEqual(enc * 4, raw)
+            # raw codec label absent: every lane actually encoded
+            self.assertNotIn(
+                "toolkit.sync.lane_bytes_encoded{codec=raw,lane=SUM}",
+                counters,
+            )
+
+            # and with the codec RAW, the two counters agree exactly
+            # (the lane_bytes accounting-drift guard)
+            obs.reset()
+            self._sync_world(4, quantize=False)
+            counters = obs.snapshot()["counters"]
+            for lane in ("SUM", "MAX"):
+                self.assertEqual(
+                    counters[f"toolkit.sync.lane_bytes{{lane={lane}}}"],
+                    counters[
+                        "toolkit.sync.lane_bytes_encoded"
+                        f"{{codec=raw,lane={lane}}}"
+                    ],
+                )
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_nonfinite_f32_falls_back_to_raw_lane(self):
+        def fn(rank):
+            m = make_replica(rank)
+            bad = np.zeros(BigState.N, np.float32)
+            bad[rank] = np.inf if rank % 2 else np.nan
+            m.update(
+                np.zeros(BigState.N, np.int64),
+                np.zeros(BigState.N, np.int64),
+                np.zeros(BigState.N, np.int64),
+                bad,
+            )
+            return tk.get_synced_metric(
+                m, recipient_rank="all", quantize=True
+            )
+
+        obs.enable()
+        try:
+            obs.reset()
+            results, _ = run_world(4, fn)
+            counters = obs.snapshot()["counters"]
+            self.assertGreaterEqual(
+                counters["toolkit.sync.quantize_fallbacks{reason=nonfinite}"],
+                4,
+            )
+        finally:
+            obs.disable()
+            obs.reset()
+        # the f32 lane shipped raw: results bit-identical to an exact
+        # merge (NaN/inf propagate exactly as an unquantized sync would)
+        fsum = np.asarray(results[0].fsum)
+        self.assertTrue(np.isnan(fsum[0]))
+        self.assertTrue(np.isinf(fsum[1]))
+        # integer lanes still narrowed and exact
+        want = exact_merge(4)
+        np.testing.assert_array_equal(
+            np.asarray(results[0].counts), np.asarray(want.counts)
+        )
+
+    def test_mixed_knob_ranks_interoperate(self):
+        # env drift: rank 0 quantizes, the others do not — the per-entry
+        # codec column makes decode per-rank, so the sync still lands,
+        # ints exact, floats within the single quantizing rank's bound
+        def fn(rank):
+            return tk.get_synced_metric(
+                make_replica(rank),
+                recipient_rank="all",
+                quantize=(rank == 0),
+            )
+
+        results, _ = run_world(4, fn)
+        want = exact_merge(4)
+        bound = float(np.abs(np.asarray(make_replica(0).fsum)).max()) / 254.0
+        for synced in results:
+            np.testing.assert_array_equal(
+                np.asarray(synced.counts), np.asarray(want.counts)
+            )
+            drift = np.abs(np.asarray(synced.fsum) - np.asarray(want.fsum))
+            self.assertLessEqual(drift.max(), bound * 1.0001)
+
+    def test_small_f32_states_stay_bit_exact_under_quantize(self):
+        # scalar/small states never quantize (Q8_MIN_ELEMENTS floor):
+        # a Sum metric synced with quantization forced on is bit-exact
+        from torcheval_tpu.metrics import Sum
+
+        def fn(rank):
+            s = Sum()
+            s.update(jnp.asarray([float(rank + 1), 2.0 * (rank + 1)]))
+            return tk.sync_and_compute(
+                s, recipient_rank="all", quantize=True
+            )
+
+        results, _ = run_world(4, fn)
+        for value in results:
+            self.assertEqual(float(np.asarray(value)), 30.0)
+
+    def test_sync_is_still_two_rounds(self):
+        _, sim = self._sync_world(4, quantize=True)
+        self.assertEqual(len(sim.round_bytes), 2 * 4)  # 2 rounds x 4 ranks
+
+
+if __name__ == "__main__":
+    unittest.main()

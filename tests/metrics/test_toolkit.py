@@ -185,7 +185,7 @@ class TestFoldStates(unittest.TestCase):
             _encode_entry_descriptor(np.zeros((2,) * 6)), np.int32
         )
         self.assertEqual(int(desc[1]), 6)
-        all_desc = np.stack([np.zeros(7, np.int32), desc])
+        all_desc = np.stack([np.zeros_like(desc), desc])
         with self.assertRaisesRegex(NotImplementedError, "rank 6"):
             _check_cat_descriptors("inputs", all_desc)
         # in-range descriptors pass

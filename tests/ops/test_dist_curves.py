@@ -241,6 +241,131 @@ class TestDistCurveKernels(unittest.TestCase):
         self.assertGreater(int(err), 0)
 
 
+class TestQuantizedExchange(unittest.TestCase):
+    """ISSUE 12: the int8/bf16 quantized exchange — bit-identical values
+    (unit counts are exact in int8 and the merge widens before any
+    cumulative sum; bf16 splitters only move load, never results), the
+    same 3-collective structure, and the halved obs-accounted payload."""
+
+    def setUp(self):
+        self.mesh = data_parallel_mesh()
+
+    def _sharded(self, s, t):
+        return (
+            [shard_batch(self.mesh, jnp.asarray(s))],
+            [shard_batch(self.mesh, jnp.asarray(t))],
+        )
+
+    def test_binary_values_bit_identical_to_unquantized(self):
+        for maker in (sharded_binary_auroc, sharded_binary_auprc):
+            s, t = _tied_data(8 * 300)
+            s_list, t_list = self._sharded(s, t)
+            v_raw, e_raw = maker(s_list, t_list, mesh=self.mesh)
+            v_q, e_q = maker(
+                s_list, t_list, mesh=self.mesh, quantize=True
+            )
+            self.assertEqual(int(e_raw), 0)
+            self.assertEqual(int(e_q), 0)
+            self.assertEqual(float(v_raw), float(v_q))
+
+    def test_multiclass_values_bit_identical_to_unquantized(self):
+        C = 5
+        s, t = _mc_tied_data(8 * 200, C)
+        s_list, t_list = self._sharded(s, t)
+        for maker in (sharded_multiclass_auroc, sharded_multiclass_auprc):
+            v_raw, _ = maker(s_list, t_list, mesh=self.mesh)
+            v_q, _ = maker(s_list, t_list, mesh=self.mesh, quantize=True)
+            np.testing.assert_array_equal(np.asarray(v_raw), np.asarray(v_q))
+
+    def test_quantized_multiclass_hlo_still_three_collectives(self):
+        # the acceptance HLO assertion: the quantized shared exchange
+        # keeps the vmapped-batched structure — <= 3 all_to_all defs
+        # independent of C, no all-gather anywhere — with int8 count
+        # operands and a bf16 splitter-histogram all-reduce visible
+        C = 5
+        s, t = _mc_tied_data(8 * 200, C)
+        s_list, t_list = self._sharded(s, t)
+        fn = _program(self.mesh, "data", "mc_auroc", True)
+        hlo = fn.lower(s_list, t_list).compile().as_text()
+        self.assertNotIn("all-gather", hlo)
+        defs = _hlo_all_to_all_defs(hlo)
+        self.assertGreaterEqual(len(defs), 1)
+        self.assertLessEqual(len(defs), 3)
+        self.assertIn(f"[{C},", hlo[hlo.index("all-to-all"):][:4000])
+        self.assertIn("s8[", hlo)  # int8 count columns in the exchange
+        self.assertIn("bf16[", hlo)  # bf16 splitter histogram all-reduce
+
+    def test_quantized_error_channels_still_trip(self):
+        n = 8 * 200
+        s, t = _tied_data(n)
+        s[3] = np.nan
+        s_list, t_list = self._sharded(s, t)
+        _, err = sharded_binary_auroc(
+            s_list, t_list, mesh=self.mesh, quantize=True
+        )
+        self.assertGreaterEqual(int(err), 1)
+        s2 = np.full(n, 0.5, np.float32)
+        s_list, t_list = self._sharded(s2, t)
+        _, ov = sharded_binary_auroc(
+            s_list, t_list, mesh=self.mesh, quantize=True
+        )
+        self.assertGreater(int(ov), 0)
+
+    def test_exchange_bytes_accounted_per_codec(self):
+        from torcheval_tpu import obs
+        from torcheval_tpu.ops.dist_curves import _bucket_capacity
+
+        s, t = _tied_data(8 * 256)
+        s_list, t_list = self._sharded(s, t)
+        obs.enable()
+        try:
+            obs.reset()
+            sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+            sharded_binary_auroc(
+                s_list, t_list, mesh=self.mesh, quantize=True
+            )
+            counters = obs.snapshot()["counters"]
+            cap = _bucket_capacity(256, 8)
+            self.assertEqual(
+                counters[
+                    "dist_curves.exchange_send_bytes"
+                    "{codec=raw,kernel=auroc}"
+                ],
+                12 * 8 * cap,
+            )
+            self.assertEqual(
+                counters[
+                    "dist_curves.exchange_send_bytes"
+                    "{codec=q8,kernel=auroc}"
+                ],
+                6 * 8 * cap,
+            )
+            self.assertEqual(
+                counters["dist_curves.exchanges{codec=q8,kernel=auroc}"], 1
+            )
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_env_knob_engages_quantized_program(self):
+        import os
+        from unittest import mock
+
+        from torcheval_tpu.ops import dist_curves as dc
+
+        s, t = _tied_data(8 * 200)
+        s_list, t_list = self._sharded(s, t)
+        with mock.patch.object(
+            dc, "_program", wraps=dc._program
+        ) as spy, mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_SYNC_QUANTIZE": "1"}
+        ):
+            v_env, _ = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(spy.call_args[0][3], True)
+        v_raw, _ = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(float(v_env), float(v_raw))
+
+
 class TestDistCurveMetricIntegration(unittest.TestCase):
     """BinaryAUROC/AUPRC automatically take the distributed path when their
     cache is uniformly data-sharded (the ShardedEvaluator regime)."""

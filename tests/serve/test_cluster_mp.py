@@ -275,6 +275,24 @@ class _ClusterDrillMixin:
         for t in self.tenants:
             self.assertEqual(self.results[t], _oracle(t), t)
 
+    def test_wire_codec_negotiated_when_forced(self):
+        # ISSUE 12: CI re-runs this drill with TORCHEVAL_TPU_WIRE_CODEC=
+        # delta — the router's clients then OFFER the codec at every
+        # attach and the surviving host's registry must show it
+        # negotiated (raw runs skip: nothing was offered). The
+        # bit-identical-to-oracle and zero-duplicate assertions above run
+        # unchanged either way, which is the point: the compressed wire
+        # is exercised under the same chaos with the same exactness bar.
+        codec = os.environ.get("TORCHEVAL_TPU_WIRE_CODEC", "raw")
+        if codec == "raw":
+            self.skipTest("raw-wire run (TORCHEVAL_TPU_WIRE_CODEC unset)")
+        counters = self.host_a_flight["snapshot"]["counters"]
+        self.assertGreaterEqual(
+            counters.get(f"serve.wire.codec{{codec={codec}}}", 0),
+            1,
+            sorted(k for k in counters if "codec" in k),
+        )
+
     def test_zero_duplicate_application_on_survivor(self):
         """Exactly-once arithmetic on host A: a migrated tenant's batches
         split durable-through-checkpoint (PHASE1, restored, never re-run)

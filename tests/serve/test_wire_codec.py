@@ -1,0 +1,314 @@
+"""Negotiated cluster-wire codecs (ISSUE 12).
+
+Three layers:
+
+* the codec primitives (``utils/quant.py``): delta/narrow integer
+  round-trips are LOSSLESS across dtypes and value shapes; q8 block
+  quantization honors its documented error bound
+  (``|x - dec(x)| <= max|block| / 254`` per element) and refuses
+  non-finite / too-small inputs;
+* ``pack_tree``/``pack_tree_parts``/``unpack_tree`` with a codec: specs
+  stay self-describing (decode needs no codec argument), integer leaves
+  survive bit-identically, f32 leaves within the bound, payloads shrink;
+* the capability exchange: a codec-capable client against a raw-only
+  server (and the reverse) negotiates down to raw with NO protocol error
+  and bit-identical results — the mixed-version interop contract — while
+  two codec-capable peers compress and still match the local oracle
+  bit-for-bit on the lossless codec.
+"""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.utils import quant
+
+
+def _assemble(parts):
+    return b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+
+
+class TestQuantPrimitives(unittest.TestCase):
+    def test_delta_int_lossless_across_dtypes_and_shapes(self):
+        rng = np.random.default_rng(0)
+        cases = [
+            rng.integers(0, 5, 257).astype(np.int64),
+            rng.integers(-3, 3, (16, 33)).astype(np.int32),
+            np.cumsum(rng.integers(0, 9, 1000)).astype(np.int64),  # sorted
+            np.arange(100, dtype=np.uint32) * 7 + 3,
+            rng.integers(0, 100, 64).astype(np.int16),
+        ]
+        for arr in cases:
+            parts = quant.delta_int_parts(arr)
+            self.assertIsNotNone(parts, arr.dtype)
+            offset, data = parts
+            out = quant.delta_int_from_parts(
+                data, offset, arr.dtype, arr.shape
+            )
+            np.testing.assert_array_equal(out, arr)
+            self.assertEqual(out.dtype, arr.dtype)
+            self.assertLess(data.nbytes, arr.nbytes)
+            # the bytes-level wrapper round-trips identically
+            enc = quant.delta_int_encode(arr)
+            np.testing.assert_array_equal(
+                quant.delta_int_decode(enc, arr.dtype, arr.shape), arr
+            )
+
+    def test_narrow_int_lossless_and_fold_exact(self):
+        rng = np.random.default_rng(1)
+        arr = rng.integers(10_000, 10_900, 4096).astype(np.int64)
+        enc = quant.narrow_int_encode(arr)
+        self.assertIsNotNone(enc)
+        # span < 2^16 -> u16 data (+ the fixed 9-byte header)
+        self.assertLess(len(enc), arr.nbytes // 4 + 16)
+        out = quant.narrow_int_decode(enc, arr.dtype, arr.shape)
+        np.testing.assert_array_equal(out, arr)
+        # widened accumulation: summing decoded wide values across 8
+        # simulated ranks is bit-exact vs summing the originals
+        self.assertEqual(int(out.sum() * 8), int(arr.sum() * 8))
+
+    def test_int_encoders_refuse_no_win(self):
+        # already-narrow dtype: nothing to gain
+        self.assertIsNone(
+            quant.narrow_int_encode(np.arange(100, dtype=np.uint8))
+        )
+        # span too wide for a narrower width
+        wide = np.asarray([0, 2**40], dtype=np.int64)
+        self.assertIsNone(quant.narrow_int_encode(wide))
+        self.assertIsNone(quant.delta_int_encode(np.zeros(0, np.int64)))
+        # floats never take the integer codecs
+        self.assertIsNone(quant.delta_int_parts(np.zeros(10, np.float32)))
+
+    def test_q8_error_bound_and_exact_zero_blocks(self):
+        rng = np.random.default_rng(2)
+        arr = (rng.standard_normal(10_000) * 50).astype(np.float32)
+        arr[:256] = 0.0  # a zero block must decode exactly
+        scales, q = quant.q8_parts(arr)
+        out = quant.q8_from_parts(scales, q, arr.shape)
+        np.testing.assert_array_equal(out[:256], 0.0)
+        nblocks = -(-arr.size // quant.Q8_BLOCK)
+        pad = np.zeros(nblocks * quant.Q8_BLOCK - arr.size, np.float32)
+        blocks = np.concatenate([arr, pad]).reshape(nblocks, quant.Q8_BLOCK)
+        bound = np.abs(blocks).max(axis=1, keepdims=True) / 254.0
+        err = np.abs(
+            np.concatenate([out, pad]).reshape(nblocks, -1) - blocks
+        )
+        self.assertTrue((err <= bound * (1 + 1e-6)).all())
+
+    def test_q8_refuses_small_and_nonfinite(self):
+        self.assertIsNone(quant.q8_parts(np.ones(8, np.float32)))
+        bad = np.ones(1024, np.float32)
+        bad[7] = np.inf
+        self.assertIsNone(quant.q8_parts(bad))
+        bad[7] = np.nan
+        self.assertIsNone(quant.q8_parts(bad))
+        self.assertIsNone(quant.q8_parts(np.ones(1024, np.float64)))
+
+    def test_q8_bytes_roundtrip_and_ratio(self):
+        arr = np.linspace(-9.0, 9.0, 4096).astype(np.float32)
+        enc = quant.q8_encode(arr)
+        self.assertLessEqual(len(enc), arr.nbytes // 3)  # ~3.94x
+        out = quant.q8_decode(enc, arr.shape)
+        self.assertLessEqual(np.abs(out - arr).max(), 9.0 / 254 * 1.000001)
+
+
+class TestPackTreeCodecs(unittest.TestCase):
+    def _roundtrip(self, obj, codec):
+        from torcheval_tpu.serve.wire import pack_tree, unpack_tree
+
+        spec, blob = pack_tree(obj, codec=codec)
+        return unpack_tree(spec, blob), len(blob)
+
+    def test_delta_tree_bit_identical_and_smaller(self):
+        from torcheval_tpu.serve.wire import pack_tree
+
+        rng = np.random.default_rng(3)
+        labels = rng.integers(0, 5, 4096).astype(np.int64)
+        scores = rng.random((4096, 5)).astype(np.float32)
+        obj = {"batch": [scores, labels], "meta": (1, "x", None)}
+        out, enc_len = self._roundtrip(obj, "delta")
+        np.testing.assert_array_equal(out["batch"][0], scores)  # floats raw
+        np.testing.assert_array_equal(out["batch"][1], labels)
+        self.assertEqual(out["batch"][1].dtype, labels.dtype)
+        self.assertEqual(out["meta"], (1, "x", None))
+        _, raw_blob = pack_tree(obj)
+        self.assertLess(enc_len, len(raw_blob))
+
+    def test_qblk_tree_bounded_floats_exact_ints(self):
+        rng = np.random.default_rng(4)
+        scores = (rng.random((512, 8)) * 3).astype(np.float32)
+        labels = rng.integers(0, 8, 512)
+        out, _ = self._roundtrip([scores, labels], "qblk")
+        np.testing.assert_array_equal(out[1], labels)
+        self.assertLessEqual(
+            np.abs(out[0] - scores).max(),
+            np.abs(scores).max() / 254 * 1.000001,
+        )
+        self.assertEqual(out[0].dtype, scores.dtype)
+        self.assertEqual(out[0].shape, scores.shape)
+
+    def test_small_and_nonfinite_leaves_stay_raw_and_exact(self):
+        # scalars, tiny arrays and NaN-bearing floats must survive a
+        # qblk-coded tree bit-identically (per-leaf raw fallback)
+        tiny = np.asarray([1.25, -2.5], dtype=np.float32)
+        nan = np.full(1024, np.nan, dtype=np.float32)
+        out, _ = self._roundtrip([tiny, nan], "qblk")
+        np.testing.assert_array_equal(out[0], tiny)
+        np.testing.assert_array_equal(out[1], nan)
+
+    def test_malformed_codec_nodes_classify_as_protocol_error(self):
+        # a codec node whose decode recipe disagrees with the member's
+        # element count must raise the structured WireError("protocol")
+        # every other malformed-node path raises — never a bare
+        # ValueError that loses the retryability classification
+        from torcheval_tpu.serve.wire import WireError, pack_tree, unpack_tree
+
+        spec, blob = pack_tree(
+            [np.arange(100, dtype=np.int64)], codec="delta"
+        )
+        spec["v"][0]["sh"] = [999_999]  # shape vs member size mismatch
+        with self.assertRaises(WireError) as ctx:
+            unpack_tree(spec, blob)
+        self.assertEqual(ctx.exception.reason, "protocol")
+
+    def test_pack_tree_parts_matches_pack_tree(self):
+        from torcheval_tpu.serve.wire import (
+            pack_tree,
+            pack_tree_parts,
+            unpack_tree,
+        )
+
+        rng = np.random.default_rng(5)
+        obj = [
+            (rng.random((128, 5)).astype(np.float32),
+             rng.integers(0, 5, 128)),
+            (rng.random((128, 5)).astype(np.float32),
+             rng.integers(0, 5, 128)),
+        ]
+        for codec in ("delta", "qblk"):
+            spec_p, parts, total = pack_tree_parts(obj, codec=codec)
+            blob = _assemble(parts)
+            self.assertEqual(len(blob), total)
+            via_parts = unpack_tree(spec_p, blob)
+            spec_b, blob_b = pack_tree(obj, codec=codec)
+            via_bytes = unpack_tree(spec_b, blob_b)
+            for (ap, bp) in zip(via_parts, via_bytes):
+                np.testing.assert_array_equal(ap[0], bp[0])
+                np.testing.assert_array_equal(ap[1], bp[1])
+
+
+class TestWireCodecNegotiation(unittest.TestCase):
+    """Live server/client worlds: negotiation, interop, bit-identity."""
+
+    NUM_CLASSES = 5
+    SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": 5}]}
+
+    @classmethod
+    def setUpClass(cls):
+        rng = np.random.default_rng(6)
+        cls.batches = [
+            (
+                rng.random((64, cls.NUM_CLASSES)).astype(np.float32),
+                rng.integers(0, cls.NUM_CLASSES, 64),
+            )
+            for _ in range(6)
+        ]
+
+    def _oracle(self):
+        from torcheval_tpu.metrics import MulticlassAccuracy
+
+        m = MulticlassAccuracy(num_classes=self.NUM_CLASSES)
+        for s, l in self.batches:
+            m.update(s, l)
+        return float(np.asarray(m.compute()))
+
+    def _run(self, server_codecs, client_codec, submit_buffer=1):
+        from torcheval_tpu.serve import EvalClient, EvalDaemon, EvalServer
+
+        with EvalDaemon() as daemon:
+            server = EvalServer(daemon, codecs=server_codecs)
+            client = EvalClient(
+                server.endpoint,
+                codec=client_codec,
+                submit_buffer=submit_buffer,
+            )
+            try:
+                ack = client.attach("t", self.SPEC)
+                for s, l in self.batches:
+                    client.submit("t", s, l)
+                result = client.compute("t")["acc"]
+            finally:
+                client.close()
+                server.close()
+        return ack, float(np.asarray(result))
+
+    def test_codec_client_vs_raw_only_server_negotiates_down(self):
+        # the mixed-version hard case: a new client offering codecs to a
+        # server that knows none — raw wire, zero protocol errors,
+        # bit-identical results
+        ack, value = self._run((), "qblk")
+        self.assertEqual(ack["codec"], "raw")
+        self.assertEqual(value, self._oracle())
+
+    def test_raw_client_vs_codec_server_stays_raw(self):
+        from torcheval_tpu.serve.wire import WIRE_CODECS
+
+        ack, value = self._run(WIRE_CODECS, "raw")
+        self.assertEqual(ack["codec"], "raw")
+        self.assertEqual(value, self._oracle())
+
+    def test_delta_negotiated_and_bit_identical(self):
+        from torcheval_tpu.serve.wire import WIRE_CODECS
+
+        ack, value = self._run(WIRE_CODECS, "delta")
+        self.assertEqual(ack["codec"], "delta")
+        self.assertEqual(value, self._oracle())
+
+    def test_qblk_on_delta_only_server_takes_second_choice(self):
+        # a qblk client implies delta as second offer, so a delta-only
+        # server still gets the lossless compressed wire
+        ack, value = self._run(("delta",), "qblk")
+        self.assertEqual(ack["codec"], "delta")
+        self.assertEqual(value, self._oracle())
+
+    def test_qblk_submit_many_within_documented_drift(self):
+        from torcheval_tpu.serve.wire import WIRE_CODECS
+
+        ack, value = self._run(WIRE_CODECS, "qblk", submit_buffer=3)
+        self.assertEqual(ack["codec"], "qblk")
+        # oracle on DEQUANTIZED batches: the wire's only effect is the
+        # documented per-leaf quantization, nothing else
+        from torcheval_tpu.metrics import MulticlassAccuracy
+
+        m = MulticlassAccuracy(num_classes=self.NUM_CLASSES)
+        for s, l in self.batches:
+            scales, q = quant.q8_parts(s)
+            m.update(quant.q8_from_parts(scales, q, s.shape), l)
+        self.assertEqual(value, float(np.asarray(m.compute())))
+
+    def test_codec_obs_counters(self):
+        from torcheval_tpu import obs
+        from torcheval_tpu.serve.wire import WIRE_CODECS
+
+        obs.enable()
+        try:
+            obs.reset()
+            self._run(WIRE_CODECS, "delta")
+            counters = obs.snapshot()["counters"]
+            self.assertGreaterEqual(
+                counters.get("serve.wire.codec{codec=delta}", 0), 1
+            )
+            raw = counters["serve.client.payload_raw_bytes{codec=delta}"]
+            enc = counters["serve.client.payload_bytes{codec=delta}"]
+            self.assertGreater(raw, 0)
+            self.assertLess(enc, raw + 4096)  # npz overhead bounded
+            self.assertGreaterEqual(
+                counters["serve.wire.rx_bytes{codec=delta}"], enc
+            )
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+if __name__ == "__main__":
+    unittest.main()

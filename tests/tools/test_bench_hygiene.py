@@ -110,6 +110,19 @@ class TestBenchHygiene(unittest.TestCase):
                 "pipeline's wire-vs-in-process ratio / overlap contract "
                 "(ISSUE 11) loses its regression pin",
             )
+        for row in (
+            "config8_cluster_wire_codec_1host",
+            "config8_cluster_wire_codec_1host_ratio",
+            "config8_cluster_wire_codec_gain",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the compressed "
+                "cluster-wire contract (ISSUE 12 — the codec ratio must "
+                "stay paired with the raw-wire ratio on the same run) "
+                "loses its regression pin",
+            )
 
 
 if __name__ == "__main__":

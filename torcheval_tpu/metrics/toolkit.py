@@ -59,6 +59,7 @@ from torcheval_tpu.metrics.state import Reduction, TState
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.annotate import traced as _traced
 from torcheval_tpu.resilience import chaos as _chaos
+from torcheval_tpu.utils import quant as _quant
 from torcheval_tpu.utils.devices import DeviceLike
 from torcheval_tpu.utils.telemetry import log_once as _log_once
 
@@ -643,11 +644,18 @@ def get_synced_metric(
     processes: _ProcessGroup = None,
     timeout_s: Optional[float] = None,
     on_failure: str = "raise",
+    quantize: Optional[bool] = None,
     _gathered: Optional[List[Dict[str, TState]]] = None,
 ) -> Optional[TMetric]:
     """Sync metric states over all JAX processes — or the ``processes``
     subgroup — and return the merged metric on the recipient rank(s);
     ``None`` elsewhere.
+
+    ``quantize`` engages the wire codecs for additive lanes (integer
+    lanes narrow losslessly; f32 SUM lanes block-quantize with a bounded,
+    documented error — docs/distributed.md "Quantized sync"). ``None``
+    defers to ``TORCHEVAL_TPU_SYNC_QUANTIZE``; ``False`` is the per-call
+    opt-out that restores exact raw bytes whatever the environment says.
 
     Reference parity: ``toolkit.py:145-232`` — world size 1 returns the input
     metric with a warning; ``recipient_rank="all"`` returns on every rank;
@@ -703,7 +711,9 @@ def get_synced_metric(
                 gathered = [
                     per_rank["m"]
                     for per_rank in _gather_collection_states(
-                        {"m": metric}, group
+                        {"m": metric},
+                        group,
+                        quantize=_quant.sync_quantize_enabled(quantize),
                     )
                 ]
     except SyncError as err:
@@ -734,11 +744,13 @@ def get_synced_state_dict(
     processes: _ProcessGroup = None,
     timeout_s: Optional[float] = None,
     on_failure: str = "raise",
+    quantize: Optional[bool] = None,
 ) -> Dict[str, TState]:
     """Globally-merged ``state_dict``; ``{}`` on non-recipient ranks
     (reference ``toolkit.py:81-118``; ``processes`` = subgroup sync;
-    ``timeout_s``/``on_failure`` as in :func:`get_synced_metric` — a
-    degraded ``"local"`` call returns the LOCAL state dict)."""
+    ``timeout_s``/``on_failure``/``quantize`` as in
+    :func:`get_synced_metric` — a degraded ``"local"`` call returns the
+    LOCAL state dict)."""
     _check_timeout_s(timeout_s)
     synced = get_synced_metric(
         metric,
@@ -746,6 +758,7 @@ def get_synced_state_dict(
         processes=processes,
         timeout_s=timeout_s,
         on_failure=on_failure,
+        quantize=quantize,
     )
     return synced.state_dict() if synced is not None else {}
 
@@ -758,6 +771,7 @@ def sync_and_compute(
     processes: _ProcessGroup = None,
     timeout_s: Optional[float] = None,
     on_failure: str = "raise",
+    quantize: Optional[bool] = None,
 ) -> Optional[Any]:
     """Sync states across all processes — or the ``processes`` subgroup —
     and compute on the recipient rank(s).
@@ -771,6 +785,7 @@ def sync_and_compute(
     spelling: if a rank died and the collective hangs, every survivor
     returns its LOCAL compute within the deadline instead of wedging
     (see :func:`get_synced_metric` for the exact degradation contract).
+    ``quantize`` is the wire-codec knob (also documented there).
     """
     _check_timeout_s(timeout_s)
     synced = get_synced_metric(
@@ -779,6 +794,7 @@ def sync_and_compute(
         processes=processes,
         timeout_s=timeout_s,
         on_failure=on_failure,
+        quantize=quantize,
     )
     if synced is None:
         return None
@@ -789,16 +805,73 @@ def sync_and_compute(
 # One descriptor exchange + one byte-payload gather for a WHOLE collection,
 # instead of one gather round per state per metric (round-2 verdict Weak #7:
 # on a DCN-attached pod every round is a cross-host latency hit). Wire:
-#   round 1: (n_entries, 7) int32 descriptor matrix
-#            [d0, ndim, dtype_code, d1, d2, d3, d4]  (ndim == -1: empty CAT)
-#   round 2: uint8 payload — every entry's raw C-order bytes concatenated,
-#            padded to the max total length across ranks
+#   round 1: (n_entries, 9) int32 descriptor matrix
+#            [d0, ndim, dtype_code, d1, d2, d3, d4, codec, enc_nbytes]
+#            (ndim == -1: empty CAT; codec 0 = raw, then enc_nbytes is
+#            derived from shape x dtype and the column stays 0)
+#   round 2: uint8 payload — every entry's raw C-order bytes (or its
+#            encoded form, per the codec column) concatenated, padded to
+#            the max total length across ranks
 # Entry order is (metric key, registered state order) — identical on every
 # rank by SPMD lockstep, same assumption the per-metric path already makes.
 # WINDOW entries are truncated between the rounds to the rows that survive
 # the maxlen fold (_window_keep_counts): the gathered descriptors tell every
 # rank every rank's row counts, so the payload round moves <= maxlen window
 # rows total instead of maxlen per rank.
+#
+# ---- quantized lanes (ISSUE 12, EQuARX-shaped). With quantization on
+# (per-call ``quantize=`` or TORCHEVAL_TPU_SYNC_QUANTIZE=1), additive
+# entries encode BEFORE the descriptor round and the codec travels in the
+# descriptor, so every rank decodes every peer's entries from the wire
+# metadata alone — ranks may even disagree on the knob (env drift) and
+# still interoperate, because decode is per-rank-per-entry:
+#   codec 1 (narrow): SUM/MAX/MIN integer lanes, min-offset narrowed —
+#     LOSSLESS; decode widens back to the declared dtype before the fold,
+#     so integer counts accumulate bit-exactly (widened accumulation).
+#   codec 2 (q8): f32 SUM lanes of >= Q8_MIN_ELEMENTS elements,
+#     int8-block-quantized with per-block f32 scales — bounded error
+#     (per element <= max|block|/254 per contributing rank; the tolerance
+#     table lives in docs/distributed.md). Scalars and small states stay
+#     raw and bit-exact even when quantization is forced on; non-finite
+#     entries fall back to raw (counted in
+#     toolkit.sync.quantize_fallbacks{reason=nonfinite} — the dist-curves
+#     error-channel shape: detect, never silently corrupt).
+# An encoder that would not shrink an entry returns None and the entry
+# ships raw — the codec can only reduce wire bytes, never grow them.
+
+_SYNC_CODEC_RAW, _SYNC_CODEC_NARROW, _SYNC_CODEC_Q8 = 0, 1, 2
+_SYNC_CODEC_NAMES = ("raw", "narrow", "q8")
+_DESC_COLS = 9
+_QUANT_LANES = (Reduction.SUM, Reduction.MAX, Reduction.MIN)
+
+
+def _encode_sync_entry(
+    red: Reduction, local: Optional[np.ndarray], quantize: bool
+) -> Tuple[int, Optional[bytes]]:
+    """Pick and run the wire codec for one entry: ``(codec_id, encoded
+    bytes or None)``. Raw (``(0, None)``) whenever quantization is off,
+    the lane is not additive, or encoding would not shrink the entry."""
+    if not quantize or local is None or red not in _QUANT_LANES:
+        return _SYNC_CODEC_RAW, None
+    if local.dtype.kind in "iu":
+        enc = _quant.narrow_int_encode(local)
+        if enc is not None:
+            return _SYNC_CODEC_NARROW, enc
+    elif (
+        red is Reduction.SUM
+        and local.dtype == np.float32
+        and local.size >= _quant.Q8_MIN_ELEMENTS
+    ):
+        if not np.isfinite(local).all():
+            # would have quantized, but the values cannot be represented:
+            # fall back to the raw lane LOUDLY (the error-channel shape)
+            _obs.counter("toolkit.sync.quantize_fallbacks", reason="nonfinite")
+            return _SYNC_CODEC_RAW, None
+        # the ONE finiteness scan above is authoritative — skip q8's own
+        enc = _quant.q8_encode(local, check_finite=False)
+        if enc is not None:
+            return _SYNC_CODEC_Q8, enc
+    return _SYNC_CODEC_RAW, None
 
 
 def _cat_cache_concat(value) -> Optional[jax.Array]:
@@ -835,21 +908,29 @@ def _collection_entries(metrics: Dict[str, Metric]):
     return entries
 
 
-def _encode_entry_descriptor(local: Optional[np.ndarray]) -> list:
+def _encode_entry_descriptor(
+    local: Optional[np.ndarray],
+    codec: int = _SYNC_CODEC_RAW,
+    enc_nbytes: int = 0,
+) -> list:
     if local is None:
-        return [0, -1, 0, 0, 0, 0, 0]  # empty CAT cache
+        return [0, -1, 0, 0, 0, 0, 0, 0, 0]  # empty CAT cache
     if local.ndim > _MAX_CAT_RANK:
         # oversized rank: encode it rather than raising here — a one-sided
         # pre-collective raise would hang the peers; _check_cat_descriptors
         # fails uniformly on every rank after the exchange
-        return [0, local.ndim, 0, 0, 0, 0, 0]
+        return [0, local.ndim, 0, 0, 0, 0, 0, 0, 0]
     codes = [
         i for i, d in enumerate(_CAT_DTYPES) if np.dtype(jnp.dtype(d)) == local.dtype
     ]
     code = codes[0] if codes else -1
     shape = list(local.shape) + [0] * (_MAX_CAT_RANK - local.ndim)
     d0 = shape[0] if local.ndim else 1
-    return [d0, local.ndim, code] + shape[1:_MAX_CAT_RANK]
+    return (
+        [d0, local.ndim, code]
+        + shape[1:_MAX_CAT_RANK]
+        + [codec, enc_nbytes]
+    )
 
 
 def _window_keep_counts(d0: np.ndarray, maxlen: int) -> np.ndarray:
@@ -874,6 +955,8 @@ def _entry_nbytes(desc: np.ndarray) -> int:
     ndim = int(desc[1])
     if ndim < 0:
         return 0
+    if int(desc[7]):  # encoded entry: the wire length IS the descriptor's
+        return int(desc[8])
     dtype = np.dtype(jnp.dtype(_CAT_DTYPES[int(desc[2])]))
     shape = _entry_shape(desc)
     n = 1
@@ -912,12 +995,19 @@ def _schema_digest_row(metrics: Dict[str, Metric]) -> list:
                 (mkey, type(metric).__qualname__, name, red.name) + extra
             )
     digest = hashlib.sha256(repr(schema).encode()).digest()[:24]
-    return [len(schema)] + np.frombuffer(digest, dtype="<i4").tolist()
+    # padded to the descriptor width; the pad stays zero so old and new
+    # header rows compare equal column-for-column
+    return (
+        [len(schema)]
+        + np.frombuffer(digest, dtype="<i4").tolist()
+        + [0] * (_DESC_COLS - 7)
+    )
 
 
 def _gather_collection_states(
     metrics: Dict[str, Metric],
     group: Optional[Tuple[int, ...]] = None,
+    quantize: bool = False,
 ) -> List[Dict[str, Dict[str, TState]]]:
     """All-gather every rank's states for a whole collection in exactly two
     collective rounds (full world, or the ``group`` subgroup); returns
@@ -928,16 +1018,31 @@ def _gather_collection_states(
     built their collections in different orders fail loudly on every rank
     instead of folding bytes into the wrong states. (Ranks with *different
     entry counts* diverge in collective shape and fail inside XLA already;
-    the digest covers the dangerous same-shape case.)"""
+    the digest covers the dangerous same-shape case.)
+
+    ``quantize`` engages the wire codecs for additive lanes (see the
+    lane-codec comment block above); the payload round then carries each
+    entry's encoded form and the descriptor's codec column drives every
+    peer's decode. Still exactly two rounds — encoding is pure local
+    work."""
     world = len(group) if group is not None else _world_size()
     entries = _collection_entries(metrics)
+    encodings = [
+        _encode_sync_entry(red, local, quantize)
+        for _, _, red, local in entries
+    ]
     desc = np.asarray(
         [_schema_digest_row(metrics)]
-        + [_encode_entry_descriptor(local) for _, _, _, local in entries],
+        + [
+            _encode_entry_descriptor(
+                local, codec, len(enc) if enc is not None else 0
+            )
+            for (_, _, _, local), (codec, enc) in zip(entries, encodings)
+        ],
         dtype=np.int32,
-    ).reshape(len(entries) + 1, 7)
+    ).reshape(len(entries) + 1, _DESC_COLS)
     all_desc = _allgather_stacked(desc, group, "descriptor", "typed").reshape(
-        world, len(entries) + 1, 7
+        world, len(entries) + 1, _DESC_COLS
     )
     # uniform validation AFTER the exchange (a one-sided raise would hang the
     # payload collective on the other ranks): first the schema digest, then
@@ -1001,13 +1106,19 @@ def _gather_collection_states(
     if _obs.enabled():
         # per-Reduction-lane payload accounting: how many bytes each lane
         # (SUM/MAX/MIN/CAT/WINDOW/NONE) contributes to the byte-payload
-        # round (AFTER window truncation — actual wire bytes) — the
-        # observable behind "which state is dominating my sync"
-        for _, _, red, local in entries:
+        # round. ``lane_bytes`` keeps the post-truncation RAW bytes
+        # (dashboard continuity across the codec introduction);
+        # ``lane_bytes_encoded`` records what actually crosses the wire,
+        # per codec — the pair is the observable behind the >=4x claim
+        # (and must agree exactly on every raw-codec entry)
+        for (_, _, red, local), (codec, enc) in zip(entries, encodings):
+            raw_bytes = float(local.nbytes) if local is not None else 0.0
+            _obs.counter("toolkit.sync.lane_bytes", raw_bytes, lane=red.name)
             _obs.counter(
-                "toolkit.sync.lane_bytes",
-                float(local.nbytes) if local is not None else 0.0,
+                "toolkit.sync.lane_bytes_encoded",
+                float(len(enc)) if enc is not None else raw_bytes,
                 lane=red.name,
+                codec=_SYNC_CODEC_NAMES[codec],
             )
     totals = [
         sum(_entry_nbytes(all_desc[r, e]) for e in range(len(entries)))
@@ -1016,10 +1127,13 @@ def _gather_collection_states(
     max_total = max(max(totals), 1)
     payload = np.zeros(max_total, dtype=np.uint8)
     offset = 0
-    for _, _, _, local in entries:
-        if local is None:
+    for (_, _, _, local), (_codec, enc) in zip(entries, encodings):
+        if enc is not None:
+            raw = np.frombuffer(enc, dtype=np.uint8)
+        elif local is None:
             continue
-        raw = np.ascontiguousarray(local).view(np.uint8).reshape(-1)
+        else:
+            raw = np.ascontiguousarray(local).view(np.uint8).reshape(-1)
         payload[offset : offset + raw.size] = raw
         offset += raw.size
     all_bytes = _allgather_stacked(
@@ -1037,9 +1151,15 @@ def _gather_collection_states(
                 gathered[r][mkey][name] = []
                 continue
             dtype = np.dtype(jnp.dtype(_CAT_DTYPES[int(d[2])]))
-            value = np.frombuffer(
-                all_bytes[r, offset : offset + nbytes].tobytes(), dtype=dtype
-            ).reshape(_entry_shape(d))
+            shape = _entry_shape(d)
+            wire = all_bytes[r, offset : offset + nbytes].tobytes()
+            codec = int(d[7])
+            if codec == _SYNC_CODEC_NARROW:
+                value = _quant.narrow_int_decode(wire, dtype, shape)
+            elif codec == _SYNC_CODEC_Q8:
+                value = _quant.q8_decode(wire, shape)
+            else:
+                value = np.frombuffer(wire, dtype=dtype).reshape(shape)
             offset += nbytes
             decoded = jnp.asarray(value)
             if decoded.dtype != value.dtype:
@@ -1063,6 +1183,7 @@ def sync_and_compute_collection(
     processes: _ProcessGroup = None,
     timeout_s: Optional[float] = None,
     on_failure: str = "raise",
+    quantize: Optional[bool] = None,
 ) -> Optional[Dict[str, Any]]:
     """Sync and compute a named collection of metrics in ONE gather pass.
 
@@ -1101,7 +1222,13 @@ def sync_and_compute_collection(
     try:
         with _sync_deadline(timeout_s):
             gathered = (
-                _gather_collection_states(arr_lane, group) if arr_lane else None
+                _gather_collection_states(
+                    arr_lane,
+                    group,
+                    quantize=_quant.sync_quantize_enabled(quantize),
+                )
+                if arr_lane
+                else None
             )
             obj_gathered = (
                 _allgather_object(

@@ -57,6 +57,27 @@ collectives carry ``(C, ...)`` operands. Per-class semantics match the reference
 one-vs-all curve math (``precision_recall_curve.py:207-230`` per class),
 i.e. the fused ``multiclass_*_kernel`` path bit-for-bit on clean data.
 
+**Quantized exchange (ISSUE 12, EQuARX-shaped).** With quantization on
+(``quantize=True`` on the public kernels, or
+``TORCHEVAL_TPU_SYNC_QUANTIZE=1``), the wire shrinks on both collective
+legs without adding a single collective:
+
+* the **count columns** of the bucket exchange ride the ``all_to_all`` as
+  **int8** instead of int32 — unit counts (binary tp/fp, multiclass
+  one-hot rows) are exactly representable, and the merge step's
+  ``cumsum(..., dtype=int32)`` widens BEFORE any accumulation, so results
+  stay bit-exact (widened accumulation). 12 bytes/row becomes 6;
+* the **splitter histogram** all-reduce runs in **bf16** instead of int32
+  (half the fixed 256 KiB round). Bin counts above 256 round, which can
+  only nudge splitter placement — splitters balance load, they never
+  affect values (equal keys still share a bucket; a pathologically
+  degraded split can only trip the existing capacity-overflow error
+  channel, which falls back to the fused path exactly as before).
+
+Collective structure is unchanged — same 3 ``all_to_all`` transfers, same
+``psum`` count, still batched O(1) in the class count under ``vmap``
+(HLO-asserted in ``tests/ops/test_dist_curves.py``).
+
 **NaN scores fail loudly.** ``_desc_key`` maps every NaN to the max key, so
 a NaN-scored *sample* would sort last and merge into one tie group with the
 padding — silently diverging from the fused raw-sample kernels, whose
@@ -73,7 +94,7 @@ from __future__ import annotations
 
 import functools
 import inspect
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -85,6 +106,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.recompile import watched_jit
+from torcheval_tpu.utils.quant import sync_quantize_enabled
 
 # older shard_map's replication checker false-positives on the kernels' scan
 # carries (jax <= 0.4.x: "Scan carry input and output got mismatched
@@ -135,13 +157,18 @@ def _desc_key(s: jax.Array) -> jax.Array:
     return jnp.where(jnp.isnan(s), _PAD_KEY, ~asc)
 
 
-def _splitter_buckets(key: jax.Array, axis: str, k_devices: int):
+def _splitter_buckets(
+    key: jax.Array, axis: str, k_devices: int, quantize: bool = False
+):
     """Per-row destination bucket ids from global histogram splitters.
 
     The histogram is over the key's top 16 bits; the psum makes it global.
     Quantile targets are computed in f32 — splitters need only balance the
     load, not be exact quantiles. Equal keys always get equal buckets (the
-    tie-locality invariant the merge step relies on)."""
+    tie-locality invariant the merge step relies on). Under ``quantize``
+    the all-reduce runs in bf16 (half the fixed payload): counts above 256
+    round, which can only shift splitter placement, never results (module
+    doc, "Quantized exchange")."""
     t = jax.lax.shift_right_logical(key, jnp.uint32(16)).astype(jnp.int32)
     hist = jax.ops.segment_sum(
         jnp.ones_like(t, dtype=jnp.int32),
@@ -149,8 +176,12 @@ def _splitter_buckets(key: jax.Array, axis: str, k_devices: int):
         num_segments=_HIST_BINS,
         indices_are_sorted=False,
     )
-    hist = jax.lax.psum(hist, axis)
-    cum = jnp.cumsum(hist).astype(jnp.float32)
+    if quantize:
+        hist = jax.lax.psum(hist.astype(jnp.bfloat16), axis)
+        cum = jnp.cumsum(hist.astype(jnp.float32))
+    else:
+        hist = jax.lax.psum(hist, axis)
+        cum = jnp.cumsum(hist).astype(jnp.float32)
     total = cum[-1]
     targets = total * (
         jnp.arange(1, k_devices, dtype=jnp.float32) / float(k_devices)
@@ -167,12 +198,20 @@ def _exchange(
     axis: str,
     k_devices: int,
     capacity: int,
+    quantize: bool = False,
 ):
     """Local sort → per-destination bucket slices (padded to ``capacity``)
     → one tiled all_to_all per column. Returns the received columns (first
-    one is the key) and the exact count of rows lost to capacity overflow."""
+    one is the key) and the exact count of rows lost to capacity overflow.
+
+    ``quantize`` ships the count columns as int8 — exact for the unit
+    counts every caller passes, and the merge step widens before any
+    cumulative sum — so the exchange payload halves with bit-identical
+    results (module doc, "Quantized exchange")."""
+    if quantize:
+        cols = tuple(c.astype(jnp.int8) for c in cols)
     skey, *scols = jax.lax.sort((key, *cols), num_keys=1)
-    bucket, _ = _splitter_buckets(skey, axis, k_devices)
+    bucket, _ = _splitter_buckets(skey, axis, k_devices, quantize)
     cnt = jax.ops.segment_sum(
         jnp.ones_like(bucket), bucket, num_segments=k_devices,
         indices_are_sorted=True,
@@ -240,13 +279,15 @@ def _concat_unit_counts(s_list, t_list):
     return key, t, 1 - t, nan_rows
 
 
-def _auroc_body(key, tp, fp, *, axis, k_devices, capacity):
+def _auroc_body(key, tp, fp, *, axis, k_devices, capacity, quantize=False):
     """Bucket exchange + per-shard merge + offset trapezoid for ONE binary
     problem's (key, tp, fp) columns. Returns ``(value, local_overflow)``.
     The multiclass kernels ``vmap`` this over a leading class axis: the
     collectives batch (one tiled all_to_all per column carries every class's
     buckets), so C classes cost the same number of collective rounds as one."""
-    recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
+    recv, overflow = _exchange(
+        (tp, fp), key, axis, k_devices, capacity, quantize
+    )
     ctp, cfp, last, tp_off, fp_off, p_tot, n_tot = _merged_shard(
         *recv, axis, k_devices
     )
@@ -267,9 +308,11 @@ def _auroc_body(key, tp, fp, *, axis, k_devices, capacity):
     return value, overflow
 
 
-def _auprc_body(key, tp, fp, *, axis, k_devices, capacity):
+def _auprc_body(key, tp, fp, *, axis, k_devices, capacity, quantize=False):
     """:func:`_auroc_body`'s average-precision (step integral) twin."""
-    recv, overflow = _exchange((tp, fp), key, axis, k_devices, capacity)
+    recv, overflow = _exchange(
+        (tp, fp), key, axis, k_devices, capacity, quantize
+    )
     ctp, cfp, last, tp_off, fp_off, p_tot, _ = _merged_shard(
         *recv, axis, k_devices
     )
@@ -288,18 +331,20 @@ def _auprc_body(key, tp, fp, *, axis, k_devices, capacity):
     return value, overflow
 
 
-def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity):
+def _auroc_kernel(s_list, t_list, *, axis, k_devices, capacity, quantize):
     key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
     value, overflow = _auroc_body(
-        key, tp, fp, axis=axis, k_devices=k_devices, capacity=capacity
+        key, tp, fp, axis=axis, k_devices=k_devices, capacity=capacity,
+        quantize=quantize,
     )
     return value, jax.lax.psum(overflow + nan_rows, axis)
 
 
-def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity):
+def _auprc_kernel(s_list, t_list, *, axis, k_devices, capacity, quantize):
     key, tp, fp, nan_rows = _concat_unit_counts(s_list, t_list)
     value, overflow = _auprc_body(
-        key, tp, fp, axis=axis, k_devices=k_devices, capacity=capacity
+        key, tp, fp, axis=axis, k_devices=k_devices, capacity=capacity,
+        quantize=quantize,
     )
     return value, jax.lax.psum(overflow + nan_rows, axis)
 
@@ -330,11 +375,12 @@ def _make_mc_kernel(body):
     ``(C, ...)`` all-reduce, so the collective-round count is independent of
     the class count."""
 
-    def kern(s_list, t_list, *, axis, k_devices, capacity):
+    def kern(s_list, t_list, *, axis, k_devices, capacity, quantize):
         key, tp, fp, nan_entries = _mc_class_columns(s_list, t_list)
         values, overflows = jax.vmap(
             functools.partial(
-                body, axis=axis, k_devices=k_devices, capacity=capacity
+                body, axis=axis, k_devices=k_devices, capacity=capacity,
+                quantize=quantize,
             )
         )(key, tp, fp)
         return values, jax.lax.psum(jnp.sum(overflows) + nan_entries, axis)
@@ -351,7 +397,7 @@ _KERNELS = {
 
 
 @functools.lru_cache(maxsize=None)
-def _program(mesh: Mesh, axis: str, which: str):
+def _program(mesh: Mesh, axis: str, which: str, quantize: bool = False):
     """Jitted shard_map program per (mesh, axis, metric); jit handles
     shape-based caching beneath. Capacity is static per trace (derived from
     the local row count). ``axis`` may be a subset of a multi-axis mesh: the
@@ -366,7 +412,8 @@ def _program(mesh: Mesh, axis: str, which: str):
         n_local = sum(int(s.shape[0]) for s in s_list) // k_devices
         capacity = _bucket_capacity(n_local, k_devices)
         f = functools.partial(
-            kern, axis=axis, k_devices=k_devices, capacity=int(capacity)
+            kern, axis=axis, k_devices=k_devices, capacity=int(capacity),
+            quantize=quantize,
         )
         return shard_map(
             f,
@@ -376,10 +423,18 @@ def _program(mesh: Mesh, axis: str, which: str):
             **_SHARD_MAP_KWARGS,
         )(s_list, t_list)
 
-    return watched_jit(impl, name=f"dist_curves.{which}")
+    name = f"dist_curves.{which}" + ("_q8" if quantize else "")
+    return watched_jit(impl, name=name)
 
 
-def _accounted_call(which: str, s_list, t_list, mesh: Mesh, axis: str):
+def _accounted_call(
+    which: str,
+    s_list,
+    t_list,
+    mesh: Mesh,
+    axis: str,
+    quantize: Optional[bool] = None,
+):
     """Dispatch the distributed program with collective accounting: one
     all_to_all exchange per call, whose per-device send payload is derived
     from the same static capacity formula the kernel uses (3 i32/u32
@@ -387,8 +442,11 @@ def _accounted_call(which: str, s_list, t_list, mesh: Mesh, axis: str):
     multiclass kernels' shared exchange). Wall time is the host-side
     dispatch span — the collectives themselves run inside the compiled
     program and are attributed by the XLA profiler via the entry point's
-    ``named_scope``."""
-    program = _program(mesh, axis, which)
+    ``named_scope``. ``quantize`` resolves the per-call override against
+    TORCHEVAL_TPU_SYNC_QUANTIZE (the same knob the metric-sync wire
+    reads) and is part of the compiled-program cache key."""
+    quantize = sync_quantize_enabled(quantize)
+    program = _program(mesh, axis, which, quantize)
     s_list, t_list = list(s_list), list(t_list)
     if not _obs.enabled():
         return program(s_list, t_list)
@@ -396,14 +454,18 @@ def _accounted_call(which: str, s_list, t_list, mesh: Mesh, axis: str):
     n_local = sum(int(s.shape[0]) for s in s_list) // k
     capacity = _bucket_capacity(n_local, k)
     n_cols = int(s_list[0].shape[1]) if s_list[0].ndim == 2 else 1
+    codec = "q8" if quantize else "raw"
     with _obs.span(f"ops.dist_curves.{which}"):
         out = program(s_list, t_list)
-    _obs.counter("dist_curves.exchanges", kernel=which)
+    _obs.counter("dist_curves.exchanges", kernel=which, codec=codec)
     # bytes entering the all_to_all per device: key + tp + fp columns
+    # (u32 key always; int8 counts under the quantized exchange)
+    row_bytes = 4 + 2 * (1 if quantize else 4)
     _obs.counter(
         "dist_curves.exchange_send_bytes",
-        3 * 4 * k * capacity * n_cols,
+        row_bytes * k * capacity * n_cols,
         kernel=which,
+        codec=codec,
     )
     # participating devices = the sharded axis's extent, not the mesh size:
     # remaining mesh axes replicate the exchange, they don't join it
@@ -417,14 +479,17 @@ def sharded_binary_auroc(
     *,
     mesh: Mesh,
     axis: str = "data",
+    quantize: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact AUROC over a mesh-sharded raw sample cache without gathering
     the samples. Returns ``(value, error_rows)`` — a nonzero count means
     the score distribution overloaded a bucket past the send capacity OR
     the cache holds NaN-scored rows (whose sort position here would diverge
     from the fused kernels'; module docstring); either way the value is
-    untrustworthy and callers must raise or fall back."""
-    return _accounted_call("auroc", s_list, t_list, mesh, axis)
+    untrustworthy and callers must raise or fall back. ``quantize``
+    engages the int8/bf16 exchange (module doc, "Quantized exchange");
+    ``None`` defers to TORCHEVAL_TPU_SYNC_QUANTIZE."""
+    return _accounted_call("auroc", s_list, t_list, mesh, axis, quantize)
 
 
 def sharded_binary_auprc(
@@ -433,10 +498,12 @@ def sharded_binary_auprc(
     *,
     mesh: Mesh,
     axis: str = "data",
+    quantize: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact average precision over a mesh-sharded raw cache; see
-    :func:`sharded_binary_auroc` for the error-channel contract."""
-    return _accounted_call("auprc", s_list, t_list, mesh, axis)
+    :func:`sharded_binary_auroc` for the error-channel and ``quantize``
+    contracts."""
+    return _accounted_call("auprc", s_list, t_list, mesh, axis, quantize)
 
 
 def sharded_multiclass_auroc(
@@ -445,6 +512,7 @@ def sharded_multiclass_auroc(
     *,
     mesh: Mesh,
     axis: str = "data",
+    quantize: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact one-vs-all per-class AUROC over a mesh-sharded raw multiclass
     cache (``(N_i, C)`` score blocks + ``(N_i,)`` integer labels, every
@@ -452,8 +520,9 @@ def sharded_multiclass_auroc(
     ``((C,) per-class values, error_rows)`` — same error-channel contract
     as :func:`sharded_binary_auroc` (bucket overflow in any class, or
     NaN-scored per-class entries, make the values untrustworthy; fall back
-    to the fused one-vs-all program)."""
-    return _accounted_call("mc_auroc", s_list, t_list, mesh, axis)
+    to the fused one-vs-all program); ``quantize`` as there — the shared
+    exchange stays 3 collectives, just narrower."""
+    return _accounted_call("mc_auroc", s_list, t_list, mesh, axis, quantize)
 
 
 def sharded_multiclass_auprc(
@@ -462,7 +531,8 @@ def sharded_multiclass_auprc(
     *,
     mesh: Mesh,
     axis: str = "data",
+    quantize: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact one-vs-all per-class average precision over a mesh-sharded raw
     multiclass cache; see :func:`sharded_multiclass_auroc`."""
-    return _accounted_call("mc_auprc", s_list, t_list, mesh, axis)
+    return _accounted_call("mc_auprc", s_list, t_list, mesh, axis, quantize)

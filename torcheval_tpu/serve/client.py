@@ -81,10 +81,14 @@ class _ClientTenant:
         "sendbuf",
         "migrated",
         "needs_resend",
+        "codec",
     )
 
-    def __init__(self, last_seq: int) -> None:
+    def __init__(self, last_seq: int, codec: str = "raw") -> None:
         self.lock = threading.Lock()
+        # the payload codec negotiated for this tenant at attach ("raw"
+        # when the server accepted none): drives every submit/replay pack
+        self.codec = codec
         self.next_seq = last_seq + 1
         self.durable_seq = last_seq
         self.replay: deque = deque()  # (seq, np-args tuple), seq ascending
@@ -127,6 +131,7 @@ class EvalClient:
         breaker_reset_s: float = 1.0,
         replay_capacity: int = 64,
         submit_buffer: int = 1,
+        codec: Optional[str] = None,
     ) -> None:
         from torcheval_tpu.metrics.toolkit import _check_timeout_s
 
@@ -152,6 +157,23 @@ class EvalClient:
                 raise ValueError(
                     f"{knob} must be an int >= {floor}, got {value!r}."
                 )
+        # wire-codec preference (ISSUE 12): "raw" never offers, "delta"
+        # offers the lossless integer codec, "qblk" additionally offers
+        # block-quantized f32 leaves (bounded error — an explicit opt-in).
+        # None defers to TORCHEVAL_TPU_WIRE_CODEC (default raw). The
+        # preference only OFFERS: encoding starts after the server
+        # advertises support at attach, so a raw-only peer degrades the
+        # wire to raw with no protocol error.
+        from torcheval_tpu.utils.quant import wire_codec_default
+
+        if codec is None:
+            codec = wire_codec_default()
+        if codec not in ("raw", "delta", "qblk"):
+            raise ValueError(
+                "codec must be one of 'raw', 'delta', 'qblk' (or None "
+                f"for the TORCHEVAL_TPU_WIRE_CODEC default), got {codec!r}."
+            )
+        self._codec_pref = codec
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
             try:
@@ -439,6 +461,33 @@ class EvalClient:
         time.sleep(min(delay_s, self._backoff_cap_s) * (0.5 + random.random()))
         return delay_s * 2
 
+    @staticmethod
+    def _account_payload(codec: str, np_args_groups, encoded: int) -> None:
+        """Raw-vs-encoded byte counters per codec: the pair makes the
+        wire's compression ratio (and the raw==encoded invariant of the
+        raw codec) readable straight off the client registry."""
+        if not _obs._enabled:
+            return
+        raw = float(
+            sum(
+                int(a.nbytes)
+                for args in np_args_groups
+                for a in args
+            )
+        )
+        _obs.counter("serve.client.payload_raw_bytes", raw, codec=codec)
+        _obs.counter(
+            "serve.client.payload_bytes", float(encoded), codec=codec
+        )
+
+    def _submit_header(
+        self, tenant_id: str, codec: str, **fields: Any
+    ) -> Dict[str, Any]:
+        header = {"tenant": tenant_id, **fields}
+        if codec != "raw":
+            header["codec"] = codec
+        return header
+
     # ----------------------------------------------------------- tenant api
     def attach(
         self,
@@ -463,25 +512,31 @@ class EvalClient:
         server-side and answered with the ORIGINAL success instead of
         ``duplicate_tenant`` — attach is idempotent per call, like
         submit."""
-        header, _ = self._call(
-            "attach",
-            {
-                "tenant": tenant_id,
-                "spec": spec,
-                "nonce": uuid.uuid4().hex,
-                "nan_policy": nan_policy,
-                "watchdog_timeout_s": watchdog_timeout_s,
-                "step_timeout_s": step_timeout_s,
-                "queue_capacity": queue_capacity,
-                "resume": resume,
-                "window_chunks": window_chunks,
-            },
-            timeout_s=timeout_s,
-        )
+        req = {
+            "tenant": tenant_id,
+            "spec": spec,
+            "nonce": uuid.uuid4().hex,
+            "nan_policy": nan_policy,
+            "watchdog_timeout_s": watchdog_timeout_s,
+            "step_timeout_s": step_timeout_s,
+            "queue_capacity": queue_capacity,
+            "resume": resume,
+            "window_chunks": window_chunks,
+        }
+        if self._codec_pref != "raw":
+            # capability exchange: qblk implies the lossless delta codec
+            # as a second choice, so a delta-only server still compresses
+            req["codecs"] = (
+                ["qblk", "delta"]
+                if self._codec_pref == "qblk"
+                else ["delta"]
+            )
+        header, _ = self._call("attach", req, timeout_s=timeout_s)
         last_seq = int(header.get("last_seq", 0))
+        codec = str(header.get("codec") or "raw")
         with self._lock:
-            self._tenants[tenant_id] = _ClientTenant(last_seq)
-        return {"last_seq": last_seq}
+            self._tenants[tenant_id] = _ClientTenant(last_seq, codec)
+        return {"last_seq": last_seq, "codec": codec}
 
     def _tenant_state(self, tenant_id: str) -> _ClientTenant:
         with self._lock:
@@ -540,7 +595,8 @@ class EvalClient:
             # entry in the replay buffer that every future resend and
             # migration chokes on (the server would drop an oversize
             # frame without answering, which reads as host death)
-            spec, blob = pack_tree(list(np_args))
+            spec, blob = pack_tree(list(np_args), codec=state.codec)
+            self._account_payload(state.codec, [np_args], len(blob))
             from torcheval_tpu.serve.wire import _MAX_PAYLOAD_BYTES
 
             if len(blob) > _MAX_PAYLOAD_BYTES:
@@ -558,7 +614,9 @@ class EvalClient:
             try:
                 header, _ = self._call(
                     "submit",
-                    {"tenant": tenant_id, "seq": seq, "args": spec},
+                    self._submit_header(
+                        tenant_id, state.codec, seq=seq, args=spec
+                    ),
                     blob,
                     timeout_s=timeout_s,
                     ambiguity_box=ambiguity,
@@ -667,12 +725,17 @@ class EvalClient:
         take, state.sendbuf = state.sendbuf, []
         seqs = [seq for seq, _args in take]
         spec, parts, total = pack_tree_parts(
-            [list(args) for _seq, args in take]
+            [list(args) for _seq, args in take], codec=state.codec
+        )
+        self._account_payload(
+            state.codec, [args for _seq, args in take], total
         )
         try:
             header, _ = self._call(
                 "submit_many",
-                {"tenant": tenant_id, "seqs": seqs, "args": spec},
+                self._submit_header(
+                    tenant_id, state.codec, seqs=seqs, args=spec
+                ),
                 (parts, total),
                 timeout_s=timeout_s,
             )
@@ -737,10 +800,13 @@ class EvalClient:
         of entries sent."""
         sent = 0
         for seq, np_args in list(state.replay):
-            spec, blob = pack_tree(list(np_args))
+            spec, blob = pack_tree(list(np_args), codec=state.codec)
+            self._account_payload(state.codec, [np_args], len(blob))
             header, _ = self._call(
                 "submit",
-                {"tenant": tenant_id, "seq": seq, "args": spec},
+                self._submit_header(
+                    tenant_id, state.codec, seq=seq, args=spec
+                ),
                 blob,
                 timeout_s=timeout_s,
             )
@@ -946,7 +1012,11 @@ class EvalClient:
                 "the checkpoint nor the replay buffer (are the hosts "
                 "sharing one checkpoint root?).",
             )
-        state = _ClientTenant(0)
+        with self._lock:
+            attached = self._tenants.get(tenant_id)
+        # the router attaches on this host BEFORE adopting, so the codec
+        # that attach negotiated carries into the replayed submits
+        state = _ClientTenant(0, attached.codec if attached else "raw")
         state.next_seq = int(exported["next_seq"])
         state.durable_seq = max(exported_durable, restored_seq)
         state.replay = deque(

@@ -62,12 +62,14 @@ from torcheval_tpu.serve.errors import (
     ServeError,
     WireError,
 )
+from torcheval_tpu.utils import quant as _quant
 from torcheval_tpu.utils.npz import NPZ_FORMAT_ERRORS, npz_views
 
 _logger = logging.getLogger(__name__)
 
 __all__ = [
     "EvalServer",
+    "WIRE_CODECS",
     "pack_tree",
     "pack_tree_parts",
     "unpack_tree",
@@ -78,6 +80,32 @@ __all__ = [
     "recv_frame",
     "recv_frame_into",
 ]
+
+# ------------------------------------------------------------- wire codecs
+# Negotiated payload codecs (ISSUE 12). The raw wire ships every array
+# leaf verbatim inside the npz payload; a negotiated codec re-encodes
+# leaves at pack time, with the decode recipe carried IN THE TREE SPEC —
+# so the receiver needs no per-connection state and a frame is always
+# self-describing:
+#
+#   "delta"  delta + min-offset narrowed integer leaves (LOSSLESS —
+#            results stay bit-identical; int64 label streams narrow ~8x)
+#   "qblk"   everything "delta" does, plus f32 leaves >= 64 elements
+#            block-quantized to int8 with per-block f32 scales (bounded
+#            error: each element within max|block|/254 — utils/quant.py).
+#            An explicit opt-in: score batches decode to *dequantized*
+#            values, so downstream metric values carry the documented
+#            drift
+#
+# Negotiation is a capability exchange at ``attach``: the client offers
+# ``codecs=[...]`` in the attach header, the server answers with its
+# pick, and only then does the client encode — an old server ignores the
+# unknown field and answers without one, an old client never offers, and
+# either way both sides land on raw with no protocol error (the
+# mixed-version interop contract, tested in tests/serve/test_wire_codec.py).
+# Every encoder falls back to a raw leaf when encoding would not shrink
+# it, so a codec can only reduce payload bytes.
+WIRE_CODECS = ("qblk", "delta")
 
 _MAGIC = b"TEW1"
 _HEAD = struct.Struct(">4sIQ")
@@ -263,9 +291,44 @@ def recv_frame_into(
 
 
 # -------------------------------------------------------------- tree coding
-def _tree_encoder(arrays: Dict[str, np.ndarray]):
+def _encode_leaf(
+    arr: np.ndarray, arrays: Dict[str, np.ndarray], codec: str
+) -> Optional[Dict[str, Any]]:
+    """Try the negotiated codec on one array leaf; register the encoded
+    member(s) into ``arrays`` and return the self-describing spec node,
+    or ``None`` when the leaf should ship raw (no win / wrong dtype /
+    non-finite floats — the per-leaf raw fallback)."""
+    if arr.dtype.kind in "iu":
+        parts = _quant.delta_int_parts(arr)
+        if parts is None:
+            return None
+        offset, data = parts
+        key = f"a{len(arrays)}"
+        arrays[key] = data
+        return {
+            "t": "darr",
+            "i": key,
+            "d": arr.dtype.str,
+            "sh": list(arr.shape),
+            "o": offset,
+        }
+    if codec == "qblk" and arr.dtype == np.float32:
+        parts = _quant.q8_parts(arr)
+        if parts is None:
+            return None
+        scales, q = parts
+        key = f"a{len(arrays)}"
+        skey = f"a{len(arrays) + 1}"
+        arrays[key] = q
+        arrays[skey] = scales
+        return {"t": "qarr", "i": key, "s": skey, "sh": list(arr.shape)}
+    return None
+
+
+def _tree_encoder(arrays: Dict[str, np.ndarray], codec: str = "raw"):
     """The shared spec encoder behind :func:`pack_tree` and
-    :func:`pack_tree_parts`: array leaves register into ``arrays``."""
+    :func:`pack_tree_parts`: array leaves register into ``arrays``,
+    re-encoded per the negotiated ``codec`` where that shrinks them."""
 
     def enc(x: Any) -> Any:
         if x is None or isinstance(x, (bool, int, float, str)):
@@ -293,6 +356,10 @@ def _tree_encoder(arrays: Dict[str, np.ndarray]):
                 f"cannot marshal {type(x).__name__} over the eval wire "
                 "(dicts, lists, scalars and numeric array-likes only).",
             )
+        if codec != "raw":
+            node = _encode_leaf(arr, arrays, codec)
+            if node is not None:
+                return node
         key = f"a{len(arrays)}"
         arrays[key] = arr
         return {"t": "arr", "i": key}
@@ -300,13 +367,15 @@ def _tree_encoder(arrays: Dict[str, np.ndarray]):
     return enc
 
 
-def pack_tree(obj: Any) -> Tuple[Any, bytes]:
+def pack_tree(obj: Any, codec: str = "raw") -> Tuple[Any, bytes]:
     """Encode a result/args tree (dicts, lists/tuples, scalars, arrays)
     into a JSON-safe spec plus ONE npz payload holding every array leaf.
     Anything with ``__array__`` (numpy, jax arrays, torch tensors)
-    becomes an array leaf; exact dtype/shape survive the round trip."""
+    becomes an array leaf; exact dtype/shape survive the round trip.
+    ``codec`` engages the negotiated leaf re-encoders (:data:`WIRE_CODECS`
+    block comment) — only send it after the peer advertised support."""
     arrays: Dict[str, np.ndarray] = {}
-    spec = _tree_encoder(arrays)(obj)
+    spec = _tree_encoder(arrays, codec)(obj)
     if not arrays:
         return spec, b""
     buf = io.BytesIO()
@@ -320,7 +389,9 @@ _ZIP_CENTRAL = struct.Struct("<4s6H3I5H2I")
 _ZIP_EOCD = struct.Struct("<4s4H2IH")
 
 
-def pack_tree_parts(obj: Any) -> Tuple[Any, List[Any], int]:
+def pack_tree_parts(
+    obj: Any, codec: str = "raw"
+) -> Tuple[Any, List[Any], int]:
     """:func:`pack_tree` for the ingest hot path: returns ``(spec, parts,
     total_len)`` where ``parts`` is a scatter-gather list whose array-data
     members are MEMORYVIEWS of the caller's own buffers — the payload is
@@ -335,9 +406,11 @@ def pack_tree_parts(obj: Any) -> Tuple[Any, List[Any], int]:
     (checkpoints do: ``resilience.save`` keeps real npz + sha256).
 
     The caller must keep the encoded arrays alive until the send
-    completes (the parts alias their buffers)."""
+    completes (the parts alias their buffers). ``codec`` as in
+    :func:`pack_tree` (codec-encoded members are freshly-allocated
+    narrow arrays, kept alive by the returned parts list itself)."""
     arrays: Dict[str, np.ndarray] = {}
-    spec = _tree_encoder(arrays)(obj)
+    spec = _tree_encoder(arrays, codec)(obj)
     if not arrays:
         return spec, [], 0
     parts: List[Any] = []
@@ -411,7 +484,15 @@ def unpack_tree(spec: Any, payload: Any) -> Any:
     ``ndarray.base``) for as long as any leaf lives, and are READ-ONLY
     when the payload is (a ``bytes`` frame) — callers that mutate a
     decoded result in place must copy it first (``np.load`` used to hand
-    back fresh writable arrays here)."""
+    back fresh writable arrays here).
+
+    Codec-encoded leaves (``darr``/``qarr`` nodes from a negotiated
+    wire codec) are self-describing — the spec carries the decode
+    recipe, so no codec argument is needed here. Their decode
+    necessarily allocates (a cumsum / a dequantization), but the
+    *encoded* members still stage zero-copy through the pool and the
+    decoded array keeps the original (shape, dtype) signature, so the
+    daemon's one-H2D-per-signature-group coalescing is unaffected."""
     arrays: Dict[str, np.ndarray] = {}
     if len(payload):
         try:
@@ -436,7 +517,21 @@ def unpack_tree(spec: Any, payload: Any) -> Any:
                 return tuple(dec(v) for v in s["v"])
             if t == "arr":
                 return arrays[s["i"]]
-        except (KeyError, TypeError, IndexError):
+            if t == "darr":
+                return _quant.delta_int_from_parts(
+                    arrays[s["i"]],
+                    int(s["o"]),
+                    np.dtype(s["d"]),
+                    tuple(s["sh"]),
+                )
+            if t == "qarr":
+                return _quant.q8_from_parts(
+                    arrays[s["s"]], arrays[s["i"]], tuple(s["sh"])
+                )
+        except (KeyError, TypeError, IndexError, ValueError):
+            # ValueError covers codec-node decode failures (a spec shape
+            # that disagrees with the member's element count, a bad dtype
+            # string): same malformed-frame classification as the rest
             pass
         raise WireError("protocol", f"malformed tree spec node: {s!r}.")
 
@@ -575,10 +670,15 @@ class EvalServer:
         host: str = "127.0.0.1",
         port: int = 0,
         backlog: int = 32,
+        codecs: Tuple[str, ...] = WIRE_CODECS,
     ) -> None:
         from torcheval_tpu.serve.ingest import HostBufferPool
 
         self._daemon = daemon
+        # payload codecs this server ACCEPTS (capability exchange at
+        # attach; ``codecs=()`` models a raw-only peer — used by the
+        # mixed-version interop tests, and a safe rollback knob)
+        self._codecs = tuple(codecs)
         # shared staging pool: frame payloads land here and decode as
         # zero-copy views; slots recycle under the ingest aliasing
         # contract (serve/ingest.py)
@@ -660,6 +760,11 @@ class EvalServer:
                 except WireError as e:
                     _logger.warning("eval-wire: dropping connection: %s", e)
                     return
+                except OSError:
+                    # peer reset/closed the socket underneath the read (a
+                    # failed health probe tearing down mid-accept): the
+                    # connection is simply gone, same as a clean EOF
+                    return
                 if frame is None:
                     return
                 header, payload, stage = frame
@@ -690,6 +795,15 @@ class EvalServer:
         tenant = header.get("tenant")
         if _obs._enabled:
             _obs.counter("serve.wire.requests", op=op)
+            if payload is not None and len(payload):
+                # received payload bytes per frame codec: with the raw
+                # leg's bytes beside the encoded leg's, the wire's
+                # compression ratio is readable straight off the registry
+                _obs.counter(
+                    "serve.wire.rx_bytes",
+                    float(len(payload)),
+                    codec=str(header.get("codec", "raw")),
+                )
         if _chaos.host_armed():
             directive = _chaos.on_host_request(op, tenant)
             if directive == "partition":
@@ -877,11 +991,26 @@ class EvalServer:
             "acked_seq": handle._tenant.durable_seq,
         }, b""
 
+    def _negotiate_codec(self, header: Dict[str, Any]) -> Optional[str]:
+        """Capability exchange: the first offered codec this server
+        accepts, or ``None`` (= raw) when the client offered nothing or
+        nothing overlaps. Old clients never offer; a ``codecs=()`` server
+        never accepts — both degrade to raw with no protocol error."""
+        offered = header.get("codecs")
+        if not isinstance(offered, (list, tuple)):
+            return None
+        chosen = next((str(c) for c in offered if c in self._codecs), None)
+        if _obs._enabled:
+            _obs.counter("serve.wire.codec", codec=chosen or "raw")
+        return chosen
+
     def _handle_attach(
         self, header: Dict[str, Any]
     ) -> Tuple[Dict[str, Any], bytes]:
         tenant_id = str(header.get("tenant"))
         nonce = header.get("nonce")
+        codec = self._negotiate_codec(header)
+        codec_fields = {"codec": codec} if codec else {}
         metrics = build_metrics(header.get("spec"))
         kwargs: Dict[str, Any] = {}
         for knob in (
@@ -913,7 +1042,8 @@ class EvalServer:
                     if prior_handle is not None:
                         if prior_nonce == nonce:
                             return {
-                                "last_seq": prior_handle._tenant.durable_seq
+                                "last_seq": prior_handle._tenant.durable_seq,
+                                **codec_fields,
                             }, b""
                         break  # a different caller's committed tenant
                     if (
@@ -926,7 +1056,7 @@ class EvalServer:
         with self._lock:
             self._handles[tenant_id] = handle
             self._attach_nonces[tenant_id] = nonce
-        return {"last_seq": handle._tenant.durable_seq}, b""
+        return {"last_seq": handle._tenant.durable_seq, **codec_fields}, b""
 
     def _attach_pending(self, tenant_id: str) -> bool:
         """True while the daemon holds ``tenant_id`` reserved for an

@@ -1,0 +1,263 @@
+"""Byte-shrinking codecs shared by the sync wire and the cluster wire.
+
+ISSUE 12: every sync lane's payload is observable and the WINDOW lane is
+bounded, so the next throughput multiplier is shrinking the bytes
+themselves — on BOTH transports. This module holds the host-side
+primitives; the device-side analogue (int8 exchange columns + bf16
+splitter histograms inside the ``shard_map`` kernels) lives in
+``ops/dist_curves.py``.
+
+Three primitives, two loss classes:
+
+* **narrow-int** (lossless): an integer array whose value *span* fits a
+  narrower unsigned width ships as ``min`` (8 bytes) + ``width`` (1 byte)
+  + ``(x - min)`` in that width. Decoding widens back to the declared
+  dtype before any accumulation, so folding narrowed count lanes is
+  bit-exact (*widened accumulation* — the EQuARX framing for integer
+  payloads).
+* **delta-int** (lossless): the cluster-wire variant — consecutive
+  differences (computed in int64), then the same min-offset narrowing.
+  Monotone sequences (timestamps, sorted ids) narrow to their step size;
+  bounded-range data (class labels) narrows like narrow-int.
+* **q8 block quantization** (bounded error): EQuARX-style int8 blocks of
+  :data:`Q8_BLOCK` elements with one f32 scale per block
+  (``scale = max|block| / 127``). Per-element error is bounded by
+  ``scale / 2 = max|block| / 254 < 2^-7.98 · max|block|`` (≈ ``2^15``
+  ulps of the f32 block max); encoded size is ``n + 4·ceil(n/256)``
+  bytes vs ``4n`` raw (~3.94×). Non-finite blocks do not quantize —
+  callers fall back to the raw lane (the dist-curves error-channel
+  shape: detect, never silently corrupt).
+
+Every encoder returns ``None`` when encoding would not shrink the
+payload (scalars, tiny arrays, already-narrow dtypes, spans too wide),
+so a codec can be applied unconditionally and degrade to raw per entry.
+Arrays below :data:`Q8_MIN_ELEMENTS` never quantize: small f32 states
+(the scalar ``Sum``/accuracy counters most metrics carry) stay bit-exact
+even with quantization forced on fleet-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Q8_BLOCK",
+    "Q8_MIN_ELEMENTS",
+    "sync_quantize_enabled",
+    "wire_codec_default",
+    "q8_parts",
+    "q8_from_parts",
+    "q8_encode",
+    "q8_decode",
+    "narrow_int_encode",
+    "narrow_int_decode",
+    "delta_int_parts",
+    "delta_int_from_parts",
+    "delta_int_encode",
+    "delta_int_decode",
+]
+
+# elements per q8 block (one f32 scale each). 256 keeps the scale
+# overhead at ~1.6% while bounding each element's error to its own
+# block's dynamic range, not the whole array's.
+Q8_BLOCK = 256
+
+# below this element count quantization cannot meaningfully win (the
+# scale overhead eats the gain) and scalar states would lose exactness
+# for nothing — they stay raw even when quantization is forced on.
+Q8_MIN_ELEMENTS = 64
+
+_SYNC_QUANTIZE_ENV = "TORCHEVAL_TPU_SYNC_QUANTIZE"
+_WIRE_CODEC_ENV = "TORCHEVAL_TPU_WIRE_CODEC"
+
+
+def sync_quantize_enabled(override: Optional[bool] = None) -> bool:
+    """Resolve the metric-sync quantization knob: an explicit per-call
+    ``quantize=`` wins; otherwise the ``TORCHEVAL_TPU_SYNC_QUANTIZE``
+    environment variable (``"1"`` = on); default off."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get(_SYNC_QUANTIZE_ENV, "0") == "1"
+
+
+def wire_codec_default() -> str:
+    """The cluster-wire codec a client prefers when none is passed:
+    ``TORCHEVAL_TPU_WIRE_CODEC`` (``raw`` / ``delta`` / ``qblk``),
+    default ``raw``. ``delta`` is lossless and safe fleet-wide; ``qblk``
+    additionally block-quantizes f32 leaves (bounded error, see module
+    doc) and is an explicit opt-in."""
+    return os.environ.get(_WIRE_CODEC_ENV, "raw")
+
+
+# ------------------------------------------------------- q8 block quant
+def q8_parts(
+    arr: np.ndarray, *, check_finite: bool = True
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Block-quantize a float32 array: ``(scales f32[nblocks], q int8[n])``
+    or ``None`` when the array is too small, non-f32, or non-finite
+    (caller falls back to raw — the error-channel contract).
+    ``check_finite=False`` skips the finiteness scan for callers that
+    already ran it (the sync wire checks once to count its fallback) —
+    non-finite input then produces garbage, so only pass it after a real
+    check."""
+    if arr.dtype != np.float32 or arr.size < Q8_MIN_ELEMENTS:
+        return None
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    if check_finite and not np.isfinite(flat).all():
+        return None
+    n = flat.size
+    nblocks = -(-n // Q8_BLOCK)
+    pad = nblocks * Q8_BLOCK - n
+    padded = np.concatenate([flat, np.zeros(pad, np.float32)]) if pad else flat
+    blocks = padded.reshape(nblocks, Q8_BLOCK)
+    scales = (np.abs(blocks).max(axis=1) / 127.0).astype(np.float32)
+    safe = np.where(scales == 0.0, np.float32(1.0), scales)
+    q = np.clip(np.rint(blocks / safe[:, None]), -127, 127).astype(np.int8)
+    return scales, q.reshape(-1)[:n]
+
+
+def q8_from_parts(
+    scales: np.ndarray, q: np.ndarray, shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Dequantize :func:`q8_parts` output back to float32 of ``shape``."""
+    scales = np.asarray(scales, dtype=np.float32).reshape(-1)
+    q = np.asarray(q, dtype=np.int8).reshape(-1)
+    n = q.size
+    nblocks = scales.size
+    pad = nblocks * Q8_BLOCK - n
+    padded = (
+        np.concatenate([q, np.zeros(pad, np.int8)]) if pad else q
+    ).reshape(nblocks, Q8_BLOCK)
+    out = (padded.astype(np.float32) * scales[:, None]).reshape(-1)[:n]
+    return out.reshape(shape)
+
+
+def q8_encode(
+    arr: np.ndarray, *, check_finite: bool = True
+) -> Optional[bytes]:
+    """:func:`q8_parts` as one byte string (scales then quants) for the
+    sync wire's concatenated payload round. ``None`` when quantization
+    does not apply or would not shrink the entry."""
+    parts = q8_parts(arr, check_finite=check_finite)
+    if parts is None:
+        return None
+    scales, q = parts
+    out = scales.tobytes() + q.tobytes()
+    return out if len(out) < arr.nbytes else None
+
+
+def q8_decode(buf: bytes, shape: Tuple[int, ...]) -> np.ndarray:
+    """Inverse of :func:`q8_encode` (shape comes from the descriptor)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    nblocks = -(-n // Q8_BLOCK)
+    scales = np.frombuffer(buf, dtype=np.float32, count=nblocks)
+    q = np.frombuffer(buf, dtype=np.int8, count=n, offset=4 * nblocks)
+    return q8_from_parts(scales, q, shape)
+
+
+# ------------------------------------------------------------ narrow-int
+_NARROW_HEAD = struct.Struct("<qB")  # int64 min, uint8 byte width
+
+
+def _narrow_width(span: int) -> Optional[int]:
+    if span <= 0xFF:
+        return 1
+    if span <= 0xFFFF:
+        return 2
+    if span <= 0xFFFFFFFF:
+        return 4
+    return None
+
+
+def narrow_int_encode(arr: np.ndarray) -> Optional[bytes]:
+    """Lossless min-offset narrowing of an integer array; ``None`` when
+    it would not shrink (empty, span too wide, dtype already narrow, or
+    values outside int64's exact range)."""
+    if arr.dtype.kind not in "iu" or arr.size == 0:
+        return None
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    lo, hi = int(flat.min()), int(flat.max())
+    if lo < -(2**63) or hi >= 2**63:  # uint64 beyond int64: bail
+        return None
+    width = _narrow_width(hi - lo)
+    if width is None or width >= arr.dtype.itemsize:
+        return None
+    data = (flat.astype(np.int64) - lo).astype(f"<u{width}")
+    out = _NARROW_HEAD.pack(lo, width) + data.tobytes()
+    return out if len(out) < arr.nbytes else None
+
+
+def narrow_int_decode(
+    buf: bytes, dtype: np.dtype, shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Inverse of :func:`narrow_int_encode`, widening back to ``dtype``
+    BEFORE any accumulation touches the values (bit-exact folds)."""
+    lo, width = _NARROW_HEAD.unpack_from(buf)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    data = np.frombuffer(
+        buf, dtype=f"<u{width}", count=n, offset=_NARROW_HEAD.size
+    )
+    return (data.astype(np.int64) + lo).astype(dtype).reshape(shape)
+
+
+# ------------------------------------------------------------- delta-int
+def delta_int_parts(
+    arr: np.ndarray,
+) -> Optional[Tuple[int, np.ndarray]]:
+    """Delta + min-offset narrowing for the cluster wire: returns
+    ``(offset, deltas-minus-offset as a narrow unsigned array)`` or
+    ``None`` when it would not shrink. Lossless: ``cumsum`` of the
+    restored int64 deltas reproduces the values exactly."""
+    if arr.dtype.kind not in "iu" or arr.size == 0:
+        return None
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    lo, hi = int(flat.min()), int(flat.max())
+    if lo < -(2**62) or hi >= 2**62:  # keep every delta exact in int64
+        return None
+    d = np.diff(flat.astype(np.int64), prepend=np.int64(0))
+    dlo = int(d.min())
+    width = _narrow_width(int(d.max()) - dlo)
+    if width is None or width >= arr.dtype.itemsize:
+        return None
+    return dlo, (d - dlo).astype(f"<u{width}")
+
+
+def delta_int_from_parts(
+    data: np.ndarray, offset: int, dtype: np.dtype, shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Inverse of :func:`delta_int_parts`."""
+    d = np.asarray(data).astype(np.int64) + int(offset)
+    return np.cumsum(d).astype(dtype).reshape(shape)
+
+
+def delta_int_encode(arr: np.ndarray) -> Optional[bytes]:
+    """:func:`delta_int_parts` as one byte string (same header layout as
+    narrow-int: int64 offset + uint8 width + data)."""
+    parts = delta_int_parts(arr)
+    if parts is None:
+        return None
+    offset, data = parts
+    out = _NARROW_HEAD.pack(offset, data.dtype.itemsize) + data.tobytes()
+    return out if len(out) < arr.nbytes else None
+
+
+def delta_int_decode(
+    buf: bytes, dtype: np.dtype, shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Inverse of :func:`delta_int_encode`."""
+    offset, width = _NARROW_HEAD.unpack_from(buf)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    data = np.frombuffer(
+        buf, dtype=f"<u{width}", count=n, offset=_NARROW_HEAD.size
+    )
+    return delta_int_from_parts(data, offset, dtype, shape)
