@@ -1361,6 +1361,93 @@ def _measure_dispatch_floor():
     return _slope_pairs(run_chain, short=5, long=38, pairs=3)
 
 
+
+
+def config10_sketch():
+    """ISSUE 13: approx sketch state. Three rows: (a) measured |approx -
+    exact| AUROC error, asserted under the sketch's own a-posteriori bound;
+    (b) resident-state bytes ratio, exact sample cache vs sketch; (c) the
+    slow streaming leg — 1B rows through BinaryAUROC(approx=True) on
+    bounded memory, resident state asserted CONSTANT and RSS growth
+    bounded. The exact path cannot run leg (c) at all on this host: 1B
+    cached (score, target) rows are 8 GB before the first sort — which is
+    precisely the state this mode exists to avoid (the compacted-exact
+    1B headline leg bounds memory by score CARDINALITY; the sketch bounds
+    it unconditionally)."""
+    _jax()
+    import resource
+
+    from torcheval_tpu import sketch as _sk
+    from torcheval_tpu.metrics import BinaryAUROC
+
+    rng = np.random.default_rng(0)
+    n = 50_000 if _SMOKE else 2_000_000
+    s = (rng.lognormal(0, 3, n) * np.where(rng.random(n) < 0.5, -1, 1)).astype(
+        np.float32
+    )
+    t = (rng.random(n) < 0.4).astype(np.float32)
+    exact = BinaryAUROC()
+    exact.update(s, t)
+    approx = BinaryAUROC(approx=True)
+    approx.update(s, t)
+    err = abs(float(exact.compute()) - float(approx.compute()))
+    approx._compact()
+    bound = _sk.auroc_error_bound(approx.sketch_tp, approx.sketch_fp)
+    assert err <= bound + 1e-6, (err, bound)
+    # ppm scale: the raw error (~1e-5 at real sizes) would vanish in
+    # _emit_row's 3-decimal rounding
+    _emit_row("config10_sketch_accuracy_vs_exact", err * 1e6, "abs_error_ppm")
+    cache_bytes = sum(
+        int(np.asarray(x).nbytes) for x in exact.inputs + exact.targets
+    )
+    sketch_bytes = int(np.asarray(approx.sketch_tp).nbytes) + int(
+        np.asarray(approx.sketch_fp).nbytes
+    )
+    _emit_row(
+        "config10_sketch_bytes_ratio", cache_bytes / sketch_bytes, "x"
+    )
+
+    # ---- streaming leg: 1B rows, bounded memory. One pre-generated 4M-row
+    # chunk streams repeatedly (the fold cost is identical; generating 1B
+    # fresh rows would time the RNG, not the sketch).
+    chunk = 65_536 if _SMOKE else 4_194_304
+    total = 10 * chunk if _SMOKE else 1_000_000_000
+    cs = (rng.lognormal(0, 3, chunk)).astype(np.float32)
+    ct = (rng.random(chunk) < 0.4).astype(np.float32)
+    m = BinaryAUROC(approx=True, compaction_threshold=chunk)
+    m.update(cs, ct)
+    m.compute()  # warm the fold + compute programs outside the timed region
+    m.reset()
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    resident0 = None
+    t0 = time.perf_counter()
+    done = 0
+    while done < total:
+        m.update(cs, ct)
+        done += chunk
+        if resident0 is None:
+            m._compact()
+            resident0 = sum(
+                int(np.asarray(v).nbytes)
+                for v in (m.sketch_tp, m.sketch_fp, m.sketch_nan_dropped)
+            )
+    value = float(m.compute())
+    elapsed = time.perf_counter() - t0
+    resident = sum(
+        int(np.asarray(v).nbytes)
+        for v in (m.sketch_tp, m.sketch_fp, m.sketch_nan_dropped)
+    )
+    assert resident == resident0, (resident, resident0)
+    rss_growth_kb = (
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - rss0
+    )
+    # bounded-RSS acceptance: far under the 8 GB the exact cache would
+    # need (ru_maxrss is KB on linux; allow jit/runtime slack)
+    assert rss_growth_kb < 2 * 1024 * 1024, rss_growth_kb
+    assert 0.0 <= value <= 1.0
+    _emit("config10_sketch_1b_rows", done, elapsed, None)
+
+
 def env_dispatch_floor():
     """Record the tunnel's per-dispatch execution cost at bench time.
 
@@ -1419,6 +1506,9 @@ _EXPECTED_ROW_PREFIXES = (
     "config8_cluster_wire_codec_gain",
     "config8_cluster_wire_2host_migration",
     "config8_ingest_overlap_ms",
+    "config10_sketch_accuracy_vs_exact",
+    "config10_sketch_bytes_ratio",
+    "config10_sketch_1b_rows",
     "env_dispatch_floor",
 )
 
@@ -1458,6 +1548,7 @@ def main() -> None:
         checkpoint_overhead,
         config7_serve_tenants,
         config8_cluster,
+        config10_sketch,
         env_dispatch_floor,
     ):
         try:

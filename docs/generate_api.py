@@ -33,6 +33,7 @@ MODULES = [
     "torcheval_tpu.resilience",
     "torcheval_tpu.serve",
     "torcheval_tpu.serve.ingest",
+    "torcheval_tpu.sketch",
     "torcheval_tpu.utils.quant",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
@@ -48,7 +49,14 @@ def _signature(obj) -> str:
         # minor version; normalise so regeneration never churns these lines
         return "(value)"
     try:
-        return str(inspect.signature(obj))
+        import re
+
+        # sentinel defaults repr as `<object object at 0x7f...>` — a fresh
+        # address every process, which made --check churn on every run;
+        # normalise the address away
+        return re.sub(
+            r"0x[0-9a-f]+", "0x...", str(inspect.signature(obj))
+        )
     except (TypeError, ValueError):
         return "(...)"
 

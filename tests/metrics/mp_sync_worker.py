@@ -131,6 +131,7 @@ def main() -> None:
     from torcheval_tpu.metrics import (
         BinaryAUROC,
         MulticlassAccuracy,
+        Quantile,
         Sum,
         Throughput,
     )
@@ -175,6 +176,21 @@ def main() -> None:
     results["auroc_all"] = _jsonable(r)
     r0 = sync_and_compute(auroc, recipient_rank=0)
     results["auroc_r0"] = None if r0 is None else _jsonable(r0)
+
+    # --- ISSUE 13: resident-sketch states over the REAL wire. The sketch
+    # lanes are int32 SUM histograms — the fold is exact bucket-add on any
+    # transport (and LOSSLESS under the quantized codecs CI forces on in
+    # its re-run), so the parent asserts bit-identity against its own
+    # single-stream oracle, not a tolerance.
+    sk = BinaryAUROC(approx=4096, compaction_threshold=512)
+    if a_scores.size:  # rank 2 stays empty (zero sketch merges as zeros)
+        sk.update(jnp.asarray(a_scores), jnp.asarray(a_targets))
+    r = sync_and_compute(sk, recipient_rank="all")
+    results["sketch_auroc_all"] = _jsonable(r)
+    q = Quantile((0.25, 0.75), bucket_count=4096)
+    q.update(jnp.asarray(make_quant_counts(rank).astype(np.float32)))
+    r = sync_and_compute(q, recipient_rank="all")
+    results["sketch_quantile_all"] = [_jsonable(v) for v in np.asarray(r)]
 
     # --- synced metric object + synced state dict on recipient 1
     synced = get_synced_metric(acc, recipient_rank=1)

@@ -31,6 +31,7 @@ from mp_sync_worker import (  # noqa: E402
     make_acc_shard,
     make_auroc_shard,
     make_dict_updates,
+    make_quant_counts,
 )
 
 
@@ -128,6 +129,25 @@ class TestMultiprocessSync(unittest.TestCase):
                 self.assertAlmostEqual(res["auroc_r0"], want, places=5)
             else:
                 self.assertIsNone(res["auroc_r0"])
+
+    def test_sketch_states_sync_bit_identical_to_oracle(self):
+        # ISSUE 13: approx (resident-sketch) metrics over the real wire —
+        # exact bucket-add fold, so equality is BIT-level on every rank,
+        # incl. under the CI re-run with the quantized codecs forced on
+        from torcheval_tpu.metrics import BinaryAUROC, Quantile
+
+        oracle = BinaryAUROC(approx=4096, compaction_threshold=512)
+        qoracle = Quantile((0.25, 0.75), bucket_count=4096)
+        for r in range(WORLD):
+            s, t = make_auroc_shard(r)
+            if s.size:
+                oracle.update(s, t)
+            qoracle.update(make_quant_counts(r).astype(np.float32))
+        want = float(oracle.compute())
+        qwant = [float(v) for v in np.asarray(qoracle.compute())]
+        for res in self.results:
+            self.assertEqual(res["sketch_auroc_all"], want)
+            self.assertEqual(res["sketch_quantile_all"], qwant)
 
     def test_synced_metric_and_state_dict_on_rank_1(self):
         total = WORLD * 64

@@ -361,7 +361,9 @@ class TestQuantizedExchange(unittest.TestCase):
             os.environ, {"TORCHEVAL_TPU_SYNC_QUANTIZE": "1"}
         ):
             v_env, _ = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
-        self.assertEqual(spy.call_args[0][3], True)
+        # env "1" resolves to the bf16 splitter mode (ISSUE 13 widened the
+        # knob: "int8" engages the chunked qpsum instead)
+        self.assertEqual(spy.call_args[0][3], "bf16")
         v_raw, _ = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
         self.assertEqual(float(v_env), float(v_raw))
 
@@ -735,6 +737,127 @@ class TestAdversarialSkewFallback(unittest.TestCase):
             f"\nskew fallback: dist-attempt+fused={t_fallback * 1e3:.1f} ms, "
             f"fused-only={t_fused * 1e3:.1f} ms, n={n}"
         )
+
+
+
+
+class TestInt8QPsum(unittest.TestCase):
+    """ISSUE 13 satellite (ROADMAP 1(b)): the int8-chunked reduce-scatter/
+    all-gather qpsum for the splitter histogram — bit-identical values
+    (splitters only balance load), int8 collectives visible in the HLO,
+    and a clean bf16 fallback when the bin count does not chunk evenly."""
+
+    def setUp(self):
+        self.mesh = data_parallel_mesh()
+
+    def _sharded(self, s, t):
+        return (
+            [shard_batch(self.mesh, jnp.asarray(s))],
+            [shard_batch(self.mesh, jnp.asarray(t))],
+        )
+
+    def test_values_bit_identical_across_all_three_modes(self):
+        # AUROC is asserted BIT-identical: quantized scores make every
+        # trapezoid partial sum exactly representable in f32, so the value
+        # is independent of where the (possibly shifted) splitters put the
+        # rows. AUPRC's step integral is not order-free in f32 — a shifted
+        # splitter regroups the psum'd precision terms — so the int8 mode
+        # (whose histogram is lossy even on small counts, unlike bf16's
+        # exact <=256 integers) is asserted to a few ulps; both sides are
+        # exact decompositions of the same integral.
+        s, t = _tied_data(8 * 300)
+        s_list, t_list = self._sharded(s, t)
+        v_raw, e_raw = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        v_bf, _ = sharded_binary_auroc(
+            s_list, t_list, mesh=self.mesh, quantize=True
+        )
+        v_i8, e_i8 = sharded_binary_auroc(
+            s_list, t_list, mesh=self.mesh, quantize="int8"
+        )
+        self.assertEqual(int(e_raw), 0)
+        self.assertEqual(int(e_i8), 0)
+        self.assertEqual(float(v_raw), float(v_bf))
+        self.assertEqual(float(v_raw), float(v_i8))
+        p_raw, pe = sharded_binary_auprc(s_list, t_list, mesh=self.mesh)
+        p_i8, pi = sharded_binary_auprc(
+            s_list, t_list, mesh=self.mesh, quantize="int8"
+        )
+        self.assertEqual(int(pe), 0)
+        self.assertEqual(int(pi), 0)
+        self.assertAlmostEqual(float(p_raw), float(p_i8), places=6)
+
+    def test_multiclass_bit_identical_and_hlo_int8_collectives(self):
+        C = 5
+        s, t = _mc_tied_data(8 * 200, C)
+        s_list, t_list = self._sharded(s, t)
+        v_raw, _ = sharded_multiclass_auroc(s_list, t_list, mesh=self.mesh)
+        v_i8, _ = sharded_multiclass_auroc(
+            s_list, t_list, mesh=self.mesh, quantize="int8"
+        )
+        # AUROC: bit-identical (exact trapezoid sums, see the binary test)
+        np.testing.assert_array_equal(np.asarray(v_raw), np.asarray(v_i8))
+        p_raw, _ = sharded_multiclass_auprc(s_list, t_list, mesh=self.mesh)
+        p_i8, _ = sharded_multiclass_auprc(
+            s_list, t_list, mesh=self.mesh, quantize="int8"
+        )
+        # AUPRC: few-ulp summation-order drift when a splitter shifts
+        np.testing.assert_allclose(
+            np.asarray(p_raw), np.asarray(p_i8), atol=1e-6
+        )
+        fn = _program(self.mesh, "data", "mc_auroc", "int8")
+        hlo = fn.lower(s_list, t_list).compile().as_text()
+        self.assertIn("s8[", hlo)
+        # the histogram qpsum's int8 legs: at least one s8 all-to-all
+        # (reduce-scatter leg) and one s8 all-gather (re-broadcast leg)
+        a2a_s8 = [
+            line
+            for line in hlo.splitlines()
+            if "all-to-all" in line and "s8[" in line
+        ]
+        ag_s8 = [
+            line
+            for line in hlo.splitlines()
+            if "all-gather" in line and "s8[" in line
+        ]
+        self.assertTrue(a2a_s8)
+        self.assertTrue(ag_s8)
+        # and NO bf16 splitter all-reduce left in the int8 program
+        self.assertNotIn("bf16[", hlo)
+
+    def test_env_int8_engages_and_error_channels_survive(self):
+        import os
+        from unittest import mock
+
+        from torcheval_tpu.ops import dist_curves as dc
+
+        n = 8 * 200
+        s, t = _tied_data(n)
+        s_list, t_list = self._sharded(s, t)
+        with mock.patch.object(
+            dc, "_program", wraps=dc._program
+        ) as spy, mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_SYNC_QUANTIZE": "int8"}
+        ):
+            v_env, _ = sharded_binary_auroc(
+                s_list, t_list, mesh=self.mesh
+            )
+        self.assertEqual(spy.call_args[0][3], "int8")
+        v_raw, _ = sharded_binary_auroc(s_list, t_list, mesh=self.mesh)
+        self.assertEqual(float(v_env), float(v_raw))
+        # NaN + overflow error channels intact under int8
+        s_nan = s.copy()
+        s_nan[1] = np.nan
+        s_list, t_list = self._sharded(s_nan, t)
+        _, err = sharded_binary_auroc(
+            s_list, t_list, mesh=self.mesh, quantize="int8"
+        )
+        self.assertGreaterEqual(int(err), 1)
+        s_const = np.full(n, 0.5, np.float32)
+        s_list, t_list = self._sharded(s_const, t)
+        _, ov = sharded_binary_auroc(
+            s_list, t_list, mesh=self.mesh, quantize="int8"
+        )
+        self.assertGreater(int(ov), 0)
 
 
 if __name__ == "__main__":

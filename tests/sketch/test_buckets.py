@@ -1,0 +1,158 @@
+"""Float-prefix bucket mapping invariants (torcheval_tpu.sketch.buckets).
+
+The whole sketch subsystem rests on four properties pinned here: the order
+key is monotone, buckets are value-range slices (every value lies inside
+its bucket's edges), representatives honor the documented relative-error
+bound for every finite normal magnitude (signs, tails and tiny values
+included), and the mapping is a pure deterministic function (jit == eager,
+vmap-safe) so cross-replica merges agree bucket-for-bucket.
+"""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import sketch
+from torcheval_tpu.sketch import buckets
+
+RNG = np.random.default_rng(42)
+
+
+def _adversarial_values(n=4000):
+    """Signs, heavy tails, ties, extremes — one pool for every test."""
+    return np.concatenate(
+        [
+            (RNG.normal(size=n) * 10).astype(np.float32),
+            RNG.lognormal(0, 6, n).astype(np.float32),  # heavy tail
+            -RNG.lognormal(0, 6, n).astype(np.float32),
+            np.repeat(np.float32([0.25, -0.25, 1e30, 1e-30]), 50),
+            np.float32(
+                [0.0, -0.0, np.inf, -np.inf, 3.4e38, -3.4e38, 1.18e-38]
+            ),
+        ]
+    )
+
+
+class TestKeyAndBuckets(unittest.TestCase):
+    def test_key_monotone_and_zero_canonical(self):
+        x = np.sort(_adversarial_values())
+        k = np.asarray(sketch.ascending_key(jnp.asarray(x))).astype(np.int64)
+        self.assertTrue((np.diff(k) >= 0).all())
+        kz = np.asarray(
+            sketch.ascending_key(jnp.asarray(np.float32([0.0, -0.0])))
+        )
+        self.assertEqual(kz[0], kz[1])
+
+    def test_every_value_within_its_bucket_edges(self):
+        x = _adversarial_values()
+        for bits in (10, 14, 16):
+            idx = np.asarray(sketch.bucket_index(jnp.asarray(x), bits))
+            lo, hi = buckets.bucket_edges(bits)
+            self.assertTrue((idx >= 0).all() and (idx < 1 << bits).all())
+            self.assertTrue((lo[idx] <= x).all() and (x <= hi[idx]).all())
+
+    def test_representative_relative_error_bound(self):
+        x = _adversarial_values()
+        # the documented bound covers finite normal magnitudes; subnormals
+        # flush to the zero bucket (absolute error < 1.18e-38, documented)
+        normal = np.isfinite(x) & (np.abs(x) >= np.finfo(np.float32).tiny)
+        for bits in (10, 13, 16, 20):
+            idx = np.asarray(
+                sketch.bucket_index(jnp.asarray(x[normal]), bits)
+            )
+            reps = buckets.bucket_representatives(bits)[idx]
+            rel = np.abs(reps - x[normal]) / np.abs(x[normal])
+            self.assertLessEqual(rel.max(), sketch.relative_error(bits))
+
+    def test_inf_buckets_and_nan_key(self):
+        bits = 12
+        idx = np.asarray(
+            sketch.bucket_index(
+                jnp.asarray(np.float32([np.inf, -np.inf])), bits
+            )
+        )
+        reps = buckets.bucket_representatives(bits)
+        self.assertEqual(reps[idx[0]], np.inf)
+        self.assertEqual(reps[idx[1]], -np.inf)
+        # NaN maps to the max key (callers mask it before counting)
+        k = np.asarray(
+            sketch.ascending_key(jnp.asarray(np.float32([np.nan])))
+        )
+        self.assertEqual(k[0], 0xFFFFFFFF)
+
+    def test_jit_vmap_agree_with_eager(self):
+        x = _adversarial_values()[:2000]
+        bits = 14
+        eager = np.asarray(sketch.bucket_index(jnp.asarray(x), bits))
+        jitted = np.asarray(
+            jax.jit(lambda v: sketch.bucket_index(v, bits))(jnp.asarray(x))
+        )
+        vmapped = np.asarray(
+            jax.vmap(lambda v: sketch.bucket_index(v, bits))(
+                jnp.asarray(x.reshape(50, -1))
+            )
+        ).reshape(-1)
+        np.testing.assert_array_equal(eager, jitted)
+        np.testing.assert_array_equal(eager, vmapped)
+
+    def test_bucket_bits_validation(self):
+        for bad in (9, 21, 0, -3, 2.5):
+            with self.assertRaises(ValueError):
+                buckets.check_bucket_bits(bad)
+
+    def test_resolve_approx_knob(self):
+        import os
+        from unittest import mock
+
+        from torcheval_tpu.sketch import resolve_approx
+
+        self.assertIsNone(resolve_approx(None))
+        self.assertIsNone(resolve_approx(False))
+        self.assertEqual(resolve_approx(True, default_bits=14), 14)
+        self.assertEqual(resolve_approx(4096), 12)
+        with self.assertRaises(ValueError):
+            resolve_approx(1000)  # not a power of two
+        with self.assertRaises(ValueError):
+            resolve_approx(2)  # below MIN_BUCKET_BITS
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_APPROX": "1"}):
+            self.assertEqual(resolve_approx(None, default_bits=13), 13)
+            self.assertIsNone(resolve_approx(False))  # explicit opt-out wins
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_APPROX": "8192"}):
+            self.assertEqual(resolve_approx(None), 13)
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_APPROX": "bogus"}):
+            with self.assertRaises(ValueError):
+                resolve_approx(None)
+
+    def test_sync_quantize_env_validation(self):
+        import os
+        from unittest import mock
+
+        from torcheval_tpu.utils import quant
+
+        for off in ("0", "", "false", "OFF"):
+            with mock.patch.dict(
+                os.environ, {"TORCHEVAL_TPU_SYNC_QUANTIZE": off}
+            ):
+                self.assertFalse(quant.sync_quantize_enabled())
+                self.assertIs(quant.sync_quantize_mode(), False)
+        for on, mode in (("1", "bf16"), ("true", "bf16"), ("int8", "int8")):
+            with mock.patch.dict(
+                os.environ, {"TORCHEVAL_TPU_SYNC_QUANTIZE": on}
+            ):
+                self.assertTrue(quant.sync_quantize_enabled())
+                self.assertEqual(quant.sync_quantize_mode(), mode)
+        # typos raise everywhere instead of silently aliasing to bf16
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_SYNC_QUANTIZE": "in8t"}
+        ):
+            with self.assertRaises(ValueError):
+                quant.sync_quantize_mode()
+        with self.assertRaises(ValueError):
+            quant.sync_quantize_mode("in8t")
+        self.assertEqual(quant.sync_quantize_mode("INT8"), "int8")
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,254 @@
+"""``Quantile`` aggregation metric + value-sketch approx modes
+(``HitRate``/``ReciprocalRank``/``Cat``) — ISSUE 13.
+
+Quantile estimates are pinned against the true order statistic at rank
+``ceil(q * n)`` (the documented ``inverted_cdf`` convention) within
+``sketch.relative_error(bucket_bits)`` RELATIVE error on adversarial
+distributions; merges are exact bucket adds (merged == single-stream
+bit-identical); the metric rides the deferred window-step (one compiled
+program in a collection) and the resilience checkpoint machinery as plain
+state trees.
+"""
+
+import shutil
+import tempfile
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import sketch
+from torcheval_tpu.metrics import (
+    Cat,
+    HitRate,
+    Mean,
+    MetricCollection,
+    Quantile,
+    ReciprocalRank,
+)
+
+RNG = np.random.default_rng(77)
+
+
+def _true_quantile(values, q):
+    sv = np.sort(values)
+    return float(sv[max(int(np.ceil(q * len(values))) - 1, 0)])
+
+
+class TestQuantileAccuracy(unittest.TestCase):
+    DISTS = {
+        "lognormal_heavy": lambda n: RNG.lognormal(0, 4, n),
+        "normal_signed": lambda n: RNG.normal(0, 100, n),
+        "tied": lambda n: RNG.choice([1.0, 2.0, 2.0, 7.5], n),
+        "tiny_and_huge": lambda n: np.concatenate(
+            [RNG.lognormal(-60, 2, n // 2), RNG.lognormal(60, 2, n - n // 2)]
+        ),
+    }
+
+    def test_within_relative_error_on_adversarial_distributions(self):
+        qs = (0.0, 0.01, 0.25, 0.5, 0.9, 0.99, 1.0)
+        for name, gen in self.DISTS.items():
+            v = gen(20001).astype(np.float32)
+            m = Quantile(q=qs)
+            for chunk in np.array_split(v, 5):
+                m.update(chunk)
+            est = np.asarray(m.compute())
+            for q, e in zip(qs, est):
+                true = _true_quantile(v, q)
+                self.assertLessEqual(
+                    abs(float(e) - true),
+                    sketch.relative_error(16) * abs(true) + 1.2e-38,
+                    f"{name} q={q}",
+                )
+
+    def test_scalar_q_returns_scalar_and_validation(self):
+        m = Quantile(0.5)
+        m.update(np.float32([1, 2, 3]))
+        self.assertEqual(np.asarray(m.compute()).shape, ())
+        for bad_q in (-0.1, 1.5, float("nan"), ()):
+            with self.assertRaises(ValueError):
+                Quantile(bad_q)
+        with self.assertRaises(ValueError):
+            Quantile(0.5, bucket_count=1000)
+        with self.assertRaises(ValueError):
+            Quantile(0.5, nan_policy="bogus")
+
+    def test_empty_is_nan(self):
+        self.assertTrue(np.isnan(float(Quantile(0.5).compute())))
+
+    def test_nan_policy(self):
+        m = Quantile(0.5)
+        m.update(np.float32([1.0, np.nan]))
+        with self.assertRaisesRegex(ValueError, "NaN"):
+            m.compute()
+        ok = Quantile(0.5, nan_policy="ignore")
+        ok.update(np.float32([np.nan, 2.0, 2.0, np.nan]))
+        self.assertLessEqual(
+            abs(float(ok.compute()) - 2.0) / 2.0, sketch.relative_error(16)
+        )
+        self.assertEqual(int(ok.nan_dropped), 2)
+
+    def test_inf_quantiles(self):
+        m = Quantile((0.0, 1.0))
+        m.update(np.float32([-np.inf, 0.0, np.inf]))
+        lo, hi = np.asarray(m.compute())
+        self.assertEqual(lo, -np.inf)
+        self.assertEqual(hi, np.inf)
+
+
+class TestQuantileMergeAndLifecycle(unittest.TestCase):
+    def test_merge_bit_identical_to_single_stream(self):
+        v = RNG.lognormal(1, 2, 9000).astype(np.float32)
+        solo, a, b = Quantile(0.5), Quantile(0.5), Quantile(0.5)
+        for i, chunk in enumerate(np.array_split(v, 6)):
+            (a if i % 2 else b).update(chunk)
+            solo.update(chunk)
+        a.merge_state([b])
+        solo._fold_now()
+        np.testing.assert_array_equal(
+            np.asarray(a.bucket_counts), np.asarray(solo.bucket_counts)
+        )
+        self.assertEqual(float(a.compute()), float(solo.compute()))
+
+    def test_rides_collection_window_step(self):
+        from torcheval_tpu import obs
+
+        obs.enable()
+        try:
+            obs.reset()
+            col = MetricCollection({"q": Quantile(0.5), "m": Mean()})
+            v = RNG.random(6000).astype(np.float32)
+            for chunk in np.array_split(v, 4):
+                col.update(chunk)
+            out = col.compute()
+            counters = obs.snapshot()["counters"]
+            steps = sum(
+                n
+                for k, n in counters.items()
+                if k.startswith("deferred.window_steps{")
+            )
+            # every member folded in ONE window-step program — the sketch
+            # fold is plain additive state, no private lane
+            self.assertEqual(steps, 1)
+            self.assertLessEqual(
+                abs(float(out["q"]) - _true_quantile(v, 0.5)),
+                sketch.relative_error(16),
+            )
+        finally:
+            obs.reset()
+            obs.disable()
+
+    def test_checkpoint_round_trip_mid_window(self):
+        from torcheval_tpu import resilience
+
+        d = tempfile.mkdtemp(prefix="sketch_ckpt_")
+        self.addCleanup(shutil.rmtree, d, ignore_errors=True)
+        m = Quantile((0.1, 0.9))
+        m.update(RNG.random(1000).astype(np.float32))
+        m.update(RNG.random(1000).astype(np.float32))  # pending chunks live
+        want = np.asarray(m.compute())
+        resilience.save(m, d)
+        fresh = Quantile((0.1, 0.9))
+        resilience.restore(fresh, d)
+        np.testing.assert_array_equal(np.asarray(fresh.compute()), want)
+
+    def test_int32_edge_fails_closed(self):
+        import jax.numpy as jnp
+
+        m = Quantile(0.5, bucket_count=4096)
+        big = np.zeros(4096, np.int32)
+        big[:8] = 2**28
+        m.bucket_counts = jnp.asarray(big)
+        with self.assertRaisesRegex(ValueError, "int32-exact"):
+            m.compute()
+
+    def test_sync_schema_rejects_config_drift(self):
+        # bucket_count/q ride the schema digest: replicas whose sketches
+        # cannot bucket-add (or whose quantiles differ) must not fold
+        a, b = Quantile(0.5), Quantile(0.5, bucket_count=4096)
+        self.assertNotEqual(a._sync_schema_extra, b._sync_schema_extra)
+
+
+class TestValueSketchMetrics(unittest.TestCase):
+    def _rank_batches(self, k=4, c=10, n=600):
+        return [
+            (
+                RNG.random((n, c)).astype(np.float32),
+                RNG.integers(0, c, n),
+            )
+            for _ in range(k)
+        ]
+
+    def test_hit_rate_mean_within_bound(self):
+        exact, approx = HitRate(k=3), HitRate(k=3, approx=True)
+        for x, t in self._rank_batches():
+            exact.update(x, t)
+            approx.update(x, t)
+        want = float(np.mean(np.asarray(exact.compute())))
+        got = float(approx.compute())
+        self.assertLessEqual(abs(want - got), sketch.relative_error(16) + 1e-6)
+
+    def test_reciprocal_rank_mean_and_merge_bit_identity(self):
+        batches = self._rank_batches()
+        exact = ReciprocalRank()
+        solo = ReciprocalRank(approx=True)
+        a, b = ReciprocalRank(approx=True), ReciprocalRank(approx=True)
+        for i, (x, t) in enumerate(batches):
+            exact.update(x, t)
+            solo.update(x, t)
+            (a if i % 2 else b).update(x, t)
+        a.merge_state([b])
+        self.assertEqual(float(a.compute()), float(solo.compute()))
+        want = float(np.mean(np.asarray(exact.compute())))
+        self.assertLessEqual(
+            abs(want - float(solo.compute())),
+            sketch.relative_error(16) * max(want, 1e-9) + 1e-6,
+        )
+
+    def test_cat_weighted_histogram_view(self):
+        c = Cat(approx=True)
+        c.update(np.float32([3.0, 1.0, 3.0]))
+        c.update(np.float32([[1.0, 3.0]]))  # any shape pools elementwise
+        vals, counts = c.compute()
+        self.assertEqual(int(np.asarray(counts).sum()), 5)
+        self.assertEqual(len(vals), 2)
+        # representatives within relative error of the true values
+        got = np.sort(np.asarray(vals))
+        for got_v, true_v in zip(got, [1.0, 3.0]):
+            self.assertLessEqual(
+                abs(float(got_v) - true_v) / true_v, sketch.relative_error(16)
+            )
+        with self.assertRaisesRegex(ValueError, "dim=0"):
+            Cat(dim=1, approx=True)
+
+    def test_cat_env_opt_in_with_dim_stays_exact(self):
+        import os
+        from unittest import mock
+
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_APPROX": "1"}):
+            c = Cat(dim=1)  # env cannot apply: exact, no raise
+            self.assertFalse(c._sketch_enabled())
+            with self.assertRaises(ValueError):
+                Cat(dim=1, approx=True)  # explicit ask still raises
+
+    def test_value_sketch_memory_bounded_and_nan_raises(self):
+        from torcheval_tpu.sketch.cache import SKETCH_FOLD_ROWS
+
+        m = HitRate(approx=4096)
+        for _ in range(3):
+            x = RNG.random((SKETCH_FOLD_ROWS // 2 + 10, 4)).astype(
+                np.float32
+            )
+            m.update(x, RNG.integers(0, 4, x.shape[0]))
+            self.assertLess(
+                sum(int(a.size) for a in m.scores),
+                SKETCH_FOLD_ROWS + x.shape[0],
+            )
+        self.assertEqual(np.asarray(m.sketch_counts).shape, (4096,))
+        bad = Cat(approx=True)
+        bad.update(np.float32([np.nan]))
+        with self.assertRaisesRegex(ValueError, "NaN"):
+            bad.compute()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,359 @@
+"""Approx-mode curve metrics vs the exact kernels (ISSUE 13 acceptance).
+
+Pins the three acceptance criteria on ADVERSARIAL score distributions
+(ties, heavy tails, degenerate labels, NaN policy):
+
+* ``approx=`` AUROC/AUPRC/PRC match the exact kernels within the
+  documented, a-posteriori-computable bound (``sketch.auroc_error_bound``
+  / ``auprc_error_bound``) — asserted against the bound computed from the
+  ACTUAL sketch, not a tolerance guess;
+* resident memory is O(buckets) regardless of stream length (asserted:
+  state bytes identical after 10x more data, staging bounded by the fold
+  cadence);
+* ``merge_state`` of sketch states is exact bucket-add — merged ==
+  single-stream bit-identical.
+"""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import sketch
+from torcheval_tpu.metrics import (
+    BinaryAUPRC,
+    BinaryAUROC,
+    BinaryPrecisionRecallCurve,
+    MulticlassAUPRC,
+    MulticlassAUROC,
+    MulticlassPrecisionRecallCurve,
+)
+
+RNG = np.random.default_rng(1234)
+
+
+def _streams():
+    """Named adversarial binary streams: (scores, targets) chunk lists."""
+    n = 3000
+
+    def chunks(s, t, k=4):
+        return list(
+            zip(np.array_split(s.astype(np.float32), k), np.array_split(t, k))
+        )
+
+    smooth_s = RNG.normal(size=n).astype(np.float32)
+    heavy_s = np.concatenate(
+        [RNG.lognormal(0, 5, n // 2), -RNG.lognormal(0, 5, n - n // 2)]
+    ).astype(np.float32)
+    tied_s = RNG.choice(np.float32([0.1, 0.5, 0.5, 0.9]), n)
+    const_s = np.full(n, np.float32(0.25))
+    t = (RNG.random(n) < 0.35).astype(np.float32)
+    all_pos = np.ones(n, np.float32)
+    return {
+        "smooth": chunks(smooth_s, t),
+        "heavy_tail": chunks(heavy_s, t),
+        "massive_ties": chunks(tied_s, t),
+        "constant": chunks(const_s, t),
+        "degenerate_labels": chunks(smooth_s, all_pos),
+    }
+
+
+def _fill(metric, stream):
+    for s, t in stream:
+        metric.update(s, t)
+    return metric
+
+
+class TestBinaryWithinBound(unittest.TestCase):
+    def test_auroc_auprc_within_computed_bound(self):
+        for name, stream in _streams().items():
+            for cls in (BinaryAUROC, BinaryAUPRC):
+                exact = _fill(cls(), stream)
+                approx = _fill(
+                    cls(approx=True, compaction_threshold=1024), stream
+                )
+                e, a = float(exact.compute()), float(approx.compute())
+                approx._compact()  # expose the full resident sketch
+                bound = (
+                    sketch.auroc_error_bound
+                    if cls is BinaryAUROC
+                    else sketch.auprc_error_bound
+                )(approx.sketch_tp, approx.sketch_fp)
+                self.assertLessEqual(
+                    abs(e - a), bound + 1e-6, f"{cls.__name__}/{name}"
+                )
+
+    def test_pure_tie_streams_are_error_free(self):
+        # exact score ties are ties in the exact kernel too: binning adds
+        # ZERO error — the adversarial case that breaks naive binning bounds
+        stream = _streams()["massive_ties"]
+        for cls in (BinaryAUROC, BinaryAUPRC):
+            e = float(_fill(cls(), stream).compute())
+            a = float(_fill(cls(approx=True), stream).compute())
+            self.assertAlmostEqual(e, a, places=6, msg=cls.__name__)
+
+    def test_empty_defaults_match_exact(self):
+        self.assertEqual(float(BinaryAUROC(approx=True).compute()), 0.5)
+        self.assertEqual(float(BinaryAUPRC(approx=True).compute()), 0.0)
+
+    def test_nan_scores_raise_at_compute(self):
+        m = BinaryAUROC(approx=True)
+        m.update(np.float32([0.2, np.nan, 0.7]), np.float32([1, 0, 1]))
+        with self.assertRaisesRegex(ValueError, "NaN"):
+            m.compute()
+        # the poisoned sketch keeps raising after a fold, too
+        m._compact()
+        with self.assertRaisesRegex(ValueError, "NaN"):
+            m.compute()
+
+    def test_compute_idempotent_and_inf_scores_ok(self):
+        m = BinaryAUROC(approx=True)
+        m.update(
+            np.float32([np.inf, -np.inf, 0.5, 0.1]),
+            np.float32([1, 0, 1, 0]),
+        )
+        first = float(m.compute())
+        self.assertEqual(first, float(m.compute()))
+        exact = BinaryAUROC()
+        exact.update(
+            np.float32([np.inf, -np.inf, 0.5, 0.1]),
+            np.float32([1, 0, 1, 0]),
+        )
+        self.assertAlmostEqual(first, float(exact.compute()), places=6)
+
+
+class TestBoundedMemory(unittest.TestCase):
+    def _resident_bytes(self, m):
+        m._compact()
+        return sum(
+            int(np.asarray(v).nbytes)
+            for v in (m.sketch_tp, m.sketch_fp, m.sketch_nan_dropped)
+        )
+
+    def test_state_bytes_independent_of_stream_length(self):
+        def run(n_batches):
+            m = BinaryAUROC(approx=4096, compaction_threshold=2048)
+            for i in range(n_batches):
+                rng = np.random.default_rng(i)
+                m.update(
+                    rng.random(512).astype(np.float32),
+                    (rng.random(512) < 0.5).astype(np.float32),
+                )
+                # the staging cache never outgrows the fold cadence
+                self.assertLess(
+                    sum(int(a.shape[0]) for a in m.inputs), 2048 + 512
+                )
+            return self._resident_bytes(m)
+
+        self.assertEqual(run(5), run(50))
+        self.assertEqual(run(5), 2 * 4096 * 4 + 4)
+
+    def test_sync_payload_is_bounded(self):
+        # _prepare_for_merge_state folds staging: the wire ships ONLY the
+        # fixed-size sketch (+ empty CAT descriptors), never raw samples
+        m = BinaryAUROC(approx=4096)
+        m.update(
+            RNG.random(10_000).astype(np.float32),
+            (RNG.random(10_000) < 0.5).astype(np.float32),
+        )
+        m._prepare_for_merge_state()
+        self.assertEqual(m.inputs, [])
+        self.assertEqual(m.targets, [])
+
+
+class TestExactMerge(unittest.TestCase):
+    def test_merged_equals_single_stream_bit_identical(self):
+        stream = _streams()["heavy_tail"]
+        solo = _fill(BinaryAUROC(approx=True), stream)
+        a = _fill(BinaryAUROC(approx=True), stream[:2])
+        b = _fill(BinaryAUROC(approx=True), stream[2:3])
+        c = _fill(BinaryAUROC(approx=True), stream[3:])
+        b._compact()  # mixed folded/staged replicas must still merge exactly
+        a.merge_state([b, c])
+        a._compact()
+        solo._compact()
+        np.testing.assert_array_equal(
+            np.asarray(a.sketch_tp), np.asarray(solo.sketch_tp)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a.sketch_fp), np.asarray(solo.sketch_fp)
+        )
+        self.assertEqual(float(a.compute()), float(solo.compute()))
+
+    def test_reset_restores_zero_sketch(self):
+        m = _fill(BinaryAUROC(approx=True), _streams()["smooth"])
+        m.reset()
+        self.assertEqual(int(np.asarray(m.sketch_tp).sum()), 0)
+        self.assertEqual(float(m.compute()), 0.5)
+
+
+class TestMulticlass(unittest.TestCase):
+    def _mc_stream(self, c=6, n=4000, k=4):
+        s = RNG.random((n, c)).astype(np.float32)
+        lbl = RNG.integers(0, c, n)
+        return list(zip(np.array_split(s, k), np.array_split(lbl, k)))
+
+    def test_per_class_within_bound(self):
+        c = 6
+        stream = self._mc_stream(c)
+        for cls in (MulticlassAUROC, MulticlassAUPRC):
+            exact = _fill(cls(num_classes=c, average=None), stream)
+            approx = _fill(
+                cls(num_classes=c, average=None, approx=True), stream
+            )
+            e = np.asarray(exact.compute())
+            a = np.asarray(approx.compute())
+            approx._compact()
+            bound_fn = (
+                sketch.auroc_error_bound
+                if cls is MulticlassAUROC
+                else sketch.auprc_error_bound
+            )
+            for ci in range(c):
+                self.assertLessEqual(
+                    abs(float(e[ci]) - float(a[ci])),
+                    bound_fn(approx.sketch_tp[ci], approx.sketch_fp[ci])
+                    + 1e-6,
+                    f"{cls.__name__} class {ci}",
+                )
+
+    def test_macro_average_and_merge(self):
+        c = 4
+        stream = self._mc_stream(c)
+        solo = _fill(MulticlassAUROC(num_classes=c, approx=True), stream)
+        x = _fill(MulticlassAUROC(num_classes=c, approx=True), stream[:2])
+        y = _fill(MulticlassAUROC(num_classes=c, approx=True), stream[2:])
+        x.merge_state([y])
+        self.assertEqual(float(x.compute()), float(solo.compute()))
+
+
+class TestPRCApprox(unittest.TestCase):
+    def test_binary_curve_shape_and_endpoint_parity(self):
+        s = RNG.random(5000).astype(np.float32)
+        t = (RNG.random(5000) < 0.4).astype(np.float32)
+        exact = BinaryPrecisionRecallCurve()
+        approx = BinaryPrecisionRecallCurve(approx=True)
+        exact.update(s, t)
+        approx.update(s, t)
+        p1, r1, t1 = exact.compute()
+        p2, r2, t2 = approx.compute()
+        self.assertEqual(p2.shape[0], r2.shape[0])
+        self.assertEqual(p2.shape[0], t2.shape[0] + 1)
+        # thresholds ascend; the graph origin is appended (reference layout)
+        self.assertTrue((np.diff(np.asarray(t2)) > 0).all())
+        self.assertEqual(float(p2[-1]), 1.0)
+        self.assertEqual(float(r2[-1]), 0.0)
+        # the most-permissive-threshold point is exact: every sample is
+        # predicted positive in both layouts
+        self.assertAlmostEqual(float(p1[0]), float(p2[0]), places=6)
+        self.assertAlmostEqual(float(r1[0]), float(r2[0]), places=6)
+
+    def test_thresholds_within_relative_error_of_scores(self):
+        # scores land in buckets whose representatives are the thresholds:
+        # each reported threshold must be within the documented relative
+        # error of SOME true score (here: scores are one repeated value)
+        m = BinaryPrecisionRecallCurve(approx=True)
+        m.update(np.full(64, np.float32(0.625)), np.ones(64, np.float32))
+        _, _, th = m.compute()
+        self.assertEqual(th.shape[0], 1)
+        self.assertLessEqual(
+            abs(float(th[0]) - 0.625) / 0.625, sketch.relative_error(16)
+        )
+
+    def test_multiclass_requires_num_classes_and_merges(self):
+        with self.assertRaisesRegex(ValueError, "num_classes"):
+            MulticlassPrecisionRecallCurve(approx=True)
+        c = 3
+        m = MulticlassPrecisionRecallCurve(num_classes=c, approx=True)
+        s = RNG.random((2000, c)).astype(np.float32)
+        lbl = RNG.integers(0, c, 2000)
+        m.update(s, lbl)
+        ps, rs, ts = m.compute()
+        self.assertEqual(len(ps), c)
+        for p, r, th in zip(ps, rs, ts):
+            self.assertEqual(p.shape[0], th.shape[0] + 1)
+        # NaN raises with the multiclass noun
+        bad = MulticlassPrecisionRecallCurve(num_classes=c, approx=True)
+        sb = s.copy()
+        sb[0, 1] = np.nan
+        bad.update(sb, lbl)
+        with self.assertRaisesRegex(ValueError, "per-class"):
+            bad.compute()
+
+
+class TestInt32ExactnessEdge(unittest.TestCase):
+    def test_compute_fails_closed_past_int32_total(self):
+        import jax.numpy as jnp
+
+        # a genuine 2.2B-row stream is not testable; install the state a
+        # long stream would produce (per-bucket counts fine, TOTAL past
+        # 2^31) and assert compute refuses instead of wrapping cumsums
+        m = BinaryAUROC(approx=4096)
+        big = np.zeros(4096, np.int32)
+        big[:4] = 2**29
+        m.sketch_tp = jnp.asarray(big)
+        m.sketch_fp = jnp.asarray(big)
+        with self.assertRaisesRegex(ValueError, "int32-exact"):
+            m.compute()
+
+    def test_wrapped_bucket_detected(self):
+        import jax.numpy as jnp
+
+        m = BinaryAUPRC(approx=4096)
+        bad = np.zeros(4096, np.int32)
+        bad[7] = -5  # a per-bucket add that wrapped
+        m.sketch_tp = jnp.asarray(bad)
+        with self.assertRaisesRegex(ValueError, "int32-exact"):
+            m.compute()
+
+    def test_multiclass_bound_is_per_class_not_global(self):
+        import jax.numpy as jnp
+
+        # 1000 classes x ~2.1M samples each: the GRAND total is ~2.1e9 but
+        # every per-class cumsum (the actual wrap risk) is tiny — must NOT
+        # trip (review finding: a cross-class sum raised ~C times early)
+        from torcheval_tpu.sketch.histogram import counts_exactness_flag
+
+        per_class = np.zeros((1000, 4096), np.int32)
+        per_class[:, :2] = 2**20
+        self.assertFalse(bool(counts_exactness_flag(jnp.asarray(per_class))))
+        # but a single class crossing the edge DOES trip
+        hot = per_class.copy()
+        hot[3, :4] = 2**29
+        self.assertTrue(bool(counts_exactness_flag(jnp.asarray(hot))))
+
+    def test_normal_totals_do_not_trip(self):
+        m = BinaryAUROC(approx=4096)
+        m.update(
+            RNG.random(4096).astype(np.float32),
+            (RNG.random(4096) < 0.5).astype(np.float32),
+        )
+        m.compute()  # no raise
+
+
+class TestKnobsAndLifecycle(unittest.TestCase):
+    def test_configurable_bucket_count(self):
+        m = BinaryAUROC(approx=4096)
+        self.assertEqual(np.asarray(m.sketch_tp).shape, (4096,))
+        with self.assertRaises(ValueError):
+            BinaryAUROC(approx=3000)
+
+    def test_env_knob_opt_in_and_opt_out(self):
+        import os
+        from unittest import mock
+
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_APPROX": "1"}):
+            self.assertTrue(BinaryAUROC()._sketch_enabled())
+            self.assertFalse(BinaryAUROC(approx=False)._sketch_enabled())
+        self.assertFalse(BinaryAUROC()._sketch_enabled())
+
+    def test_state_dict_round_trip_bit_identical(self):
+        stream = _streams()["smooth"]
+        m = _fill(BinaryAUROC(approx=True), stream)
+        sd = m.state_dict()
+        fresh = BinaryAUROC(approx=True)
+        fresh.load_state_dict(sd)
+        self.assertEqual(float(fresh.compute()), float(m.compute()))
+
+
+if __name__ == "__main__":
+    unittest.main()

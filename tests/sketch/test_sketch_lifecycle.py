@@ -1,0 +1,141 @@
+"""Sketch state across the durability machinery (ISSUE 13 satellite).
+
+The sketch's whole design bet is that plain int32 SUM state trees ride
+every existing lifecycle layer unchanged. Pinned end to end:
+
+* mid-stream ``resilience.snapshot`` round trip is bit-identical with
+  staged (unfolded) rows pending — restore + continue == uninterrupted;
+* serve evict → reattach resumes an approx tenant bit-identically to an
+  uninterrupted oracle (the ISSUE 8 eviction checkpoints, no new code);
+* a checkpoint whose staging was folded through the SHARDED sketch-psum
+  path (8-device mesh) restores onto a plain single-device metric with
+  identical counts — the replicated-leaf portability contract at unequal
+  device counts (``docs/robustness.md``, "Checkpoint portability").
+"""
+
+import shutil
+import tempfile
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import resilience
+from torcheval_tpu.metrics import BinaryAUROC, Quantile
+
+RNG = np.random.default_rng(31)
+
+
+def _batches(k=6, n=700, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            (rng.lognormal(0, 3, n)).astype(np.float32),
+            (rng.random(n) < 0.4).astype(np.float32),
+        )
+        for _ in range(k)
+    ]
+
+
+class _TmpDirTest(unittest.TestCase):
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="sketch_lc_")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+
+class TestSnapshotMidStream(_TmpDirTest):
+    def test_mid_stream_round_trip_and_resume_bit_identical(self):
+        batches = _batches()
+        oracle = BinaryAUROC(approx=4096, compaction_threshold=1024)
+        for s, t in batches:
+            oracle.update(s, t)
+        want = float(oracle.compute())
+
+        m = BinaryAUROC(approx=4096, compaction_threshold=1024)
+        for s, t in batches[:3]:
+            m.update(s, t)
+        self.assertTrue(m.inputs)  # staged rows genuinely pending
+        resilience.save(m, self.dir)
+        restored = BinaryAUROC(approx=4096, compaction_threshold=1024)
+        resilience.restore(restored, self.dir)
+        for s, t in batches[3:]:
+            restored.update(s, t)
+        self.assertEqual(float(restored.compute()), want)
+        restored._compact()
+        oracle._compact()
+        np.testing.assert_array_equal(
+            np.asarray(restored.sketch_tp), np.asarray(oracle.sketch_tp)
+        )
+
+    def test_quantile_schema_guard_on_bucket_count_drift(self):
+        m = Quantile(0.5, bucket_count=4096)
+        m.update(np.float32([1.0, 2.0]))
+        resilience.save(m, self.dir)
+        other = Quantile(0.5, bucket_count=8192)
+        with self.assertRaises(resilience.CheckpointError):
+            resilience.restore(other, self.dir)
+
+
+class TestServeEvictReattach(_TmpDirTest):
+    def test_evict_then_reattach_resumes_bit_identically(self):
+        from torcheval_tpu.serve import EvalDaemon
+
+        batches = _batches(k=6, seed=3)
+        oracle = BinaryAUROC(approx=4096)
+        for s, t in batches:
+            oracle.update(s, t)
+        want = float(oracle.compute())
+        with EvalDaemon(evict_dir=self.dir) as daemon:
+            h = daemon.attach("tenant", BinaryAUROC(approx=4096))
+            for s, t in batches[:3]:
+                h.submit(s, t)
+            path = daemon.evict("tenant", timeout=60)
+            self.assertTrue(path)
+            h2 = daemon.attach(
+                "tenant", BinaryAUROC(approx=4096), resume="require"
+            )
+            for s, t in batches[3:]:
+                h2.submit(s, t)
+            self.assertEqual(
+                float(np.asarray(h2.compute(timeout=60))), want
+            )
+
+
+class TestPortabilityAcrossDeviceCounts(_TmpDirTest):
+    def test_sharded_fold_checkpoint_restores_on_single_device(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            self.skipTest("needs the 8-device CPU mesh (tests/conftest.py)")
+        mesh = Mesh(np.array(devs[:8]), ("data",))
+        sh = NamedSharding(mesh, P("data"))
+        n = 4096
+        s = RNG.normal(size=n).astype(np.float32)
+        t = (RNG.random(n) < 0.5).astype(np.float32)
+
+        m = BinaryAUROC(approx=4096)
+        m.inputs.append(jax.device_put(jnp.asarray(s), sh))
+        m.targets.append(jax.device_put(jnp.asarray(t), sh))
+        m._cached_samples = n
+        m._compact()  # the sharded sketch-psum fold (dist_curves)
+        self.assertEqual(m.inputs, [])
+        resilience.save(m, self.dir)
+
+        single = BinaryAUROC(approx=4096, device=devs[0])
+        resilience.restore(single, self.dir)
+        plain = BinaryAUROC(approx=4096)
+        plain.update(s, t)
+        plain._compact()
+        np.testing.assert_array_equal(
+            np.asarray(single.sketch_tp), np.asarray(plain.sketch_tp)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single.sketch_fp), np.asarray(plain.sketch_fp)
+        )
+        self.assertEqual(float(single.compute()), float(plain.compute()))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,223 @@
+"""Sketch state on the two-round sync wire (ISSUE 13 satellite).
+
+Drives the REAL collection-sync machinery (encode → descriptor round →
+payload round → per-rank decode → typed fold) over the barrier-threaded
+simulated wire from ``tests/metrics/test_sync_quantized.py``, at world
+size 4:
+
+* ``sync_and_compute``-style merge of approx curve metrics and
+  ``Quantile`` equals the single-stream oracle BIT-identically — the
+  sketch lanes are integer SUM states, so the fold is exact bucket-add
+  on every transport;
+* with the codecs on, sketch lanes encode under the ISSUE 13 ``bucket``
+  codec (sparse nonzero payload) and the
+  ``lane_bytes``/``lane_bytes_encoded`` pair shows the required shrink
+  (>= 4x asserted — realistic sketches land far beyond);
+* a rank-local NaN flag survives the wire (summed) and still raises on
+  every rank after sync;
+* everything here must ALSO pass with ``TORCHEVAL_TPU_SYNC_QUANTIZE=1``
+  in the env — CI re-runs this file exactly so (lossless codecs).
+"""
+
+import threading
+import unittest
+from unittest import mock
+
+import numpy as np
+
+import torcheval_tpu.metrics.toolkit as tk
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import BinaryAUROC, Quantile
+
+WORLD = 4
+
+
+class _SimWire:
+    """Barrier-coordinated allgather stub (the test_sync_quantized shape):
+    each rank thread contributes its own buffer and receives the genuine
+    per-rank stack."""
+
+    def __init__(self, world):
+        self.world = world
+        self.barrier = threading.Barrier(world)
+        self.slots = [None] * world
+        self.tls = threading.local()
+        self.round_bytes = []
+        self._lock = threading.Lock()
+
+    def allgather(self, x, group):
+        assert group is None
+        rank = self.tls.rank
+        self.slots[rank] = np.array(x, copy=True)
+        self.barrier.wait()
+        out = np.stack(self.slots)
+        with self._lock:
+            self.round_bytes.append(int(np.asarray(x).nbytes))
+        self.barrier.wait()
+        return out
+
+
+def run_world(world, fn):
+    sim = _SimWire(world)
+    results = [None] * world
+    errors = []
+
+    def runner(rank):
+        sim.tls.rank = rank
+        try:
+            results[rank] = fn(rank)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append((rank, e))
+
+    with mock.patch.object(
+        tk, "_allgather_stacked_impl", sim.allgather
+    ), mock.patch.object(tk, "_world_size", lambda: world), mock.patch.object(
+        tk, "_process_index", lambda: sim.tls.rank
+    ):
+        threads = [
+            threading.Thread(target=runner, args=(r,)) for r in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0][1]
+    return results, sim
+
+
+def _rank_chunks(rank, n=2048):
+    rng = np.random.default_rng(500 + rank)
+    return (
+        rng.lognormal(0, 3, n).astype(np.float32)
+        * np.where(rng.random(n) < 0.5, -1, 1),
+        (rng.random(n) < 0.4).astype(np.float32),
+    )
+
+
+def _auroc_replica(rank):
+    m = BinaryAUROC(approx=4096, compaction_threshold=512)
+    s, t = _rank_chunks(rank)
+    m.update(s, t)
+    return m
+
+
+def _quantile_replica(rank):
+    m = Quantile((0.1, 0.9), bucket_count=65536)
+    s, _ = _rank_chunks(rank)
+    m.update(s)
+    return m
+
+
+class TestSketchSync(unittest.TestCase):
+    def test_synced_auroc_equals_single_stream_oracle_bit_identical(self):
+        oracle = BinaryAUROC(approx=4096, compaction_threshold=512)
+        for r in range(WORLD):
+            s, t = _rank_chunks(r)
+            oracle.update(s, t)
+        want = float(oracle.compute())
+        oracle._compact()
+
+        def fn(rank):
+            synced = tk.get_synced_metric(
+                _auroc_replica(rank), recipient_rank="all"
+            )
+            return synced
+
+        results, _ = run_world(WORLD, fn)
+        for synced in results:
+            synced._compact()
+            np.testing.assert_array_equal(
+                np.asarray(synced.sketch_tp), np.asarray(oracle.sketch_tp)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(synced.sketch_fp), np.asarray(oracle.sketch_fp)
+            )
+            self.assertEqual(float(synced.compute()), want)
+
+    def test_synced_quantile_bit_identical_and_quantized_lossless(self):
+        oracle = Quantile((0.1, 0.9), bucket_count=65536)
+        for r in range(WORLD):
+            oracle.update(_rank_chunks(r)[0])
+        want = np.asarray(oracle.compute())
+        for quantize in (False, True):
+            results, _ = run_world(
+                WORLD,
+                lambda rank: tk.get_synced_metric(
+                    _quantile_replica(rank),
+                    recipient_rank="all",
+                    quantize=quantize,
+                ),
+            )
+            for synced in results:
+                np.testing.assert_array_equal(
+                    np.asarray(synced.compute()), want
+                )
+
+    def test_sketch_lanes_use_bucket_codec_with_big_ratio(self):
+        obs.enable()
+        try:
+            obs.reset()
+            _, sim_raw = run_world(
+                WORLD,
+                lambda rank: tk.get_synced_metric(
+                    _quantile_replica(rank),
+                    recipient_rank="all",
+                    quantize=False,
+                ),
+            )
+            obs.reset()  # counter ratio below reads the QUANTIZED run only
+            _, sim_q = run_world(
+                WORLD,
+                lambda rank: tk.get_synced_metric(
+                    _quantile_replica(rank),
+                    recipient_rank="all",
+                    quantize=True,
+                ),
+            )
+            # payload round shrinks >= 4x (the ROADMAP 1(c) bar; a 2048-
+            # sample sketch in 64Ki buckets actually lands far beyond)
+            self.assertLessEqual(
+                sim_q.round_bytes[-1] * 4, sim_raw.round_bytes[-1]
+            )
+            counters = obs.snapshot()["counters"]
+            bucket_bytes = [
+                v
+                for k, v in counters.items()
+                if k.startswith("toolkit.sync.lane_bytes_encoded")
+                and "codec=bucket" in k
+            ]
+            self.assertTrue(bucket_bytes, sorted(counters))
+            raw = sum(
+                v
+                for k, v in counters.items()
+                if k.startswith("toolkit.sync.lane_bytes{lane=SUM")
+            )
+            enc = sum(
+                v
+                for k, v in counters.items()
+                if k.startswith("toolkit.sync.lane_bytes_encoded")
+            )
+            self.assertLessEqual(enc * 4, raw)
+        finally:
+            obs.reset()
+            obs.disable()
+
+    def test_nan_flag_survives_the_wire(self):
+        def fn(rank):
+            m = BinaryAUROC(approx=4096)
+            s, t = _rank_chunks(rank, n=64)
+            if rank == 2:
+                s = s.copy()
+                s[0] = np.nan
+            m.update(s, t)
+            return tk.get_synced_metric(m, recipient_rank="all")
+
+        results, _ = run_world(WORLD, fn)
+        for synced in results:
+            with self.assertRaisesRegex(ValueError, "NaN"):
+                synced.compute()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -123,6 +123,20 @@ class TestBenchHygiene(unittest.TestCase):
                 "stay paired with the raw-wire ratio on the same run) "
                 "loses its regression pin",
             )
+        for row in (
+            "config10_sketch_accuracy_vs_exact",
+            "config10_sketch_bytes_ratio",
+            "config10_sketch_1b_rows",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the bounded-"
+                "memory sketch contract (ISSUE 13 — accuracy-vs-exact "
+                "under the documented bound, O(buckets) state, and the "
+                "1B-row stream the exact path cannot run) loses its "
+                "regression pin",
+            )
 
 
 if __name__ == "__main__":

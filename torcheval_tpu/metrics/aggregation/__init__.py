@@ -2,7 +2,8 @@ from torcheval_tpu.metrics.aggregation.cat import Cat
 from torcheval_tpu.metrics.aggregation.max import Max
 from torcheval_tpu.metrics.aggregation.mean import Mean
 from torcheval_tpu.metrics.aggregation.min import Min
+from torcheval_tpu.metrics.aggregation.quantile import Quantile
 from torcheval_tpu.metrics.aggregation.sum import Sum
 from torcheval_tpu.metrics.aggregation.throughput import Throughput
 
-__all__ = ["Cat", "Max", "Mean", "Min", "Sum", "Throughput"]
+__all__ = ["Cat", "Max", "Mean", "Min", "Quantile", "Sum", "Throughput"]

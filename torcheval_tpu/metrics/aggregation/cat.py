@@ -1,46 +1,106 @@
 """Cat metric: concatenate all seen inputs. Reference:
-``torcheval/metrics/aggregation/cat.py``."""
+``torcheval/metrics/aggregation/cat.py``.
+
+ISSUE 13 / ROADMAP 1(c): ``approx=`` swaps the unbounded concat cache for a
+resident value sketch — the score-cache histogram mode that lets CAT-shaped
+state ride the quantized sync codecs at O(buckets) wire bytes.
+``compute()`` then returns the weighted-histogram view ``(values, counts)``
+over the NONEMPTY buckets (bucket representatives + their multiplicities —
+the approximate multiset of everything seen, each value within
+``sketch.relative_error(bucket_bits)``). Requires ``dim == 0`` (the sketch
+pools elements; higher-dim concat structure is not representable).
+"""
 
 from __future__ import annotations
 
-from typing import Iterable
+from typing import Iterable, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
 from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.sketch import (
+    DEFAULT_BUCKET_BITS,
+    ValueSketchCacheMixin,
+    bucket_representatives,
+    resolve_approx,
+)
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class Cat(SampleCacheMetric[jax.Array]):
+class Cat(ValueSketchCacheMixin, SampleCacheMetric[jax.Array]):
     """Concatenate all input arrays along ``dim``.
 
     Reference parity: ``aggregation/cat.py:24-96``, including the quirk that
     merging concatenates each source metric's cache along *that metric's*
-    ``dim`` before appending.
+    ``dim`` before appending. With ``approx=`` set, state is a bounded value
+    sketch instead (module docstring).
     """
 
-    def __init__(self, *, dim: int = 0, device: DeviceLike = None) -> None:
+    def __init__(
+        self, *, dim: int = 0, approx=None, device: DeviceLike = None
+    ) -> None:
         super().__init__(device=device)
         self.dim = dim
+        bits = resolve_approx(approx, default_bits=DEFAULT_BUCKET_BITS)
+        if bits is not None and dim != 0:
+            if approx is None:
+                # env-driven opt-in cannot apply here: stay exact, loudly,
+                # rather than raise inside code that never mentioned approx
+                # (the MulticlassPrecisionRecallCurve convention)
+                from torcheval_tpu.utils.telemetry import log_once
+
+                log_once(
+                    "cat_approx_needs_dim0",
+                    "TORCHEVAL_TPU_APPROX is set but Cat(dim=%d) cannot "
+                    "sketch (the sketch pools elements; higher-dimension "
+                    "concat structure is not representable) — this metric "
+                    "stays exact.",
+                    dim,
+                )
+                bits = None
+            else:
+                raise ValueError(
+                    "approx= requires dim=0: the sketch pools elements and "
+                    "cannot represent higher-dimension concat structure."
+                )
         # Reduction.CAT means axis-0 all_gather concat; for dim != 0 the sync
         # layer must fall back to merge_state, so declare CUSTOM there.
         if dim == 0:
             self._add_cache_state("inputs")
         else:
             self._add_state("inputs", [], reduction=Reduction.CUSTOM)
+        if bits is not None:
+            self._init_value_sketch(bits, "inputs")
 
     def update(self, input: jax.Array) -> "Cat":
-        self.inputs.append(self._input(input))
+        input = self._input(input)
+        self.inputs.append(input)
+        if self._sketch_enabled():
+            self._sketch_stage(input)
         return self
 
-    def compute(self) -> jax.Array:
+    def compute(
+        self,
+    ) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+        if self._sketch_enabled():
+            counts, nan, overflow = self._sketch_counts_parts()
+            from torcheval_tpu.sketch.cache import raise_sketch_overflow
+
+            raise_sketch_overflow(overflow)
+            self._sketch_check_nan(nan)
+            c = np.asarray(counts)
+            keep = c > 0
+            reps = bucket_representatives(self._sketch_bits)[keep]
+            return jnp.asarray(reps), jnp.asarray(c[keep])
         if not self.inputs:
             return jnp.empty((0,))
         return jnp.concatenate(self.inputs, axis=self.dim)
 
     def merge_state(self, metrics: Iterable["Cat"]) -> "Cat":
+        metrics = list(metrics)
         for metric in metrics:
             if metric.inputs:
                 self.inputs.append(
@@ -48,8 +108,13 @@ class Cat(SampleCacheMetric[jax.Array]):
                         jnp.concatenate(metric.inputs, axis=metric.dim), self.device
                     )
                 )
+        if self._sketch_enabled():
+            self._sketch_merge_from(metrics)
+            self._sketch_recount()
         return self
 
     def _prepare_for_merge_state(self) -> None:
+        if self._sketch_enabled():
+            self._sketch_fold()
         if self.inputs:
             self.inputs = [jnp.concatenate(self.inputs, axis=self.dim)]

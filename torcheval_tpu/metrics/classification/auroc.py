@@ -260,12 +260,31 @@ class _CompactingCacheLifecycle:
     # per-class score entries (one bad (N, C) row can contribute up to C)
     _NAN_FLAG_NOUN = "sample(s)"
 
-    def _init_compaction(self, compaction_threshold: Optional[int]) -> None:
+    # bucket_bits of the resident score sketch when the metric runs in
+    # ``approx=`` mode (ISSUE 13), else None (exact unique-threshold
+    # summaries). In approx mode ``compaction_threshold`` is reused as the
+    # staging-cache fold cadence (default ``sketch.SKETCH_FOLD_ROWS``) and
+    # ``_compact`` folds into fixed-size histograms instead of summaries.
+    _sketch_bits: Optional[int] = None
+
+    def _init_compaction(
+        self,
+        compaction_threshold: Optional[int],
+        *,
+        approx_bits: Optional[int] = None,
+        sketch_classes: Optional[int] = None,
+    ) -> None:
         if compaction_threshold is not None and compaction_threshold <= 0:
             raise ValueError(
                 f"compaction_threshold must be positive or None, got "
                 f"{compaction_threshold}."
             )
+        self._sketch_bits = approx_bits
+        self._sketch_classes = sketch_classes
+        if approx_bits is not None and compaction_threshold is None:
+            from torcheval_tpu.sketch.cache import SKETCH_FOLD_ROWS
+
+            compaction_threshold = SKETCH_FOLD_ROWS
         self._compaction_threshold = compaction_threshold
         self._cached_samples = 0
         self._nan_checked = True  # no compactions yet -> nothing to check
@@ -276,16 +295,141 @@ class _CompactingCacheLifecycle:
         self._summary_sorted = True
         self._add_cache_state("inputs")
         self._add_cache_state("targets")
-        self._add_cache_state("summary_scores")
-        self._add_cache_state("summary_tp")
-        self._add_cache_state("summary_fp")
-        # device-side count of NaN-scored samples that reached a compaction;
-        # checked (and raised on) at compute() instead of per compaction
-        self._add_state(
-            "summary_nan_dropped",
-            zeros_state((), dtype=jnp.int32),
-            reduction=Reduction.SUM,
+        if approx_bits is None:
+            self._add_cache_state("summary_scores")
+            self._add_cache_state("summary_tp")
+            self._add_cache_state("summary_fp")
+            # device-side count of NaN-scored samples that reached a
+            # compaction; checked (and raised on) at compute() instead of
+            # per compaction
+            self._add_state(
+                "summary_nan_dropped",
+                zeros_state((), dtype=jnp.int32),
+                reduction=Reduction.SUM,
+            )
+        else:
+            # resident sketch: fixed-size (tp, fp) bucket histograms. SUM
+            # reduction IS the exact merge (bucket add), so sync /
+            # merge_state / checkpoints need no new machinery; int32 counts
+            # follow the repo exactness rule, fail-closed at the edge
+            # (sketch/histogram.counts_exactness_flag). The schema has ONE
+            # definition, shared with the PRC/value sketch mixins.
+            from torcheval_tpu.sketch.cache import (
+                register_score_sketch_states,
+            )
+
+            register_score_sketch_states(self, approx_bits, sketch_classes)
+
+    def _sketch_enabled(self) -> bool:
+        return self._sketch_bits is not None
+
+    def _sketch_compact(self) -> None:
+        """Approx-mode ``_compact``: fold the staged raw cache into the
+        resident bucket histograms (one jitted program, no host reads —
+        there is no adaptive trim to size; the sketch shape is static)."""
+        from torcheval_tpu.sketch.cache import (
+            _count_fold,
+            mc_score_fold_parts,
+            score_fold_parts,
         )
+
+        if not self.inputs:
+            self._cached_samples = 0
+            return
+        n = sum(int(a.shape[0]) for a in self.inputs)
+        dist = self._sketch_sharded_mesh()
+        if dist is not None:
+            # mesh-sharded staging: ONE exact psum of per-shard histograms
+            # consumes the resident format directly — no bucket exchange,
+            # no re-bucketing, no per-sample traffic (ISSUE 13(c))
+            from torcheval_tpu.ops.dist_curves import sharded_sketch_counts
+
+            mesh, axis = dist
+            tp, fp, nan = sharded_sketch_counts(
+                self.inputs,
+                self.targets,
+                mesh=mesh,
+                axis=str(axis),
+                bucket_bits=self._sketch_bits,
+                num_classes=self._sketch_classes,
+            )
+            _obs.counter(
+                "ops.dist_curves.calls",
+                path="sketch",
+                family=(
+                    "binary" if self._sketch_classes is None else "multiclass"
+                ),
+            )
+            _count_fold(
+                "score" if self._sketch_classes is None else "mc_score", n
+            )
+            self.inputs = []
+            self.targets = []
+            # psum outputs are mesh-replicated; device_put re-places them
+            # on the metric's own device/sharding device-to-device (a host
+            # round trip here would synchronize every fold — review
+            # finding), then bucket-add into resident state
+            self.sketch_tp = self.sketch_tp + jax.device_put(
+                tp, self.device
+            )
+            self.sketch_fp = self.sketch_fp + jax.device_put(
+                fp, self.device
+            )
+            self.sketch_nan_dropped = self.sketch_nan_dropped + jax.device_put(
+                nan, self.device
+            )
+            self._cached_samples = 0
+            return
+        if self._sketch_classes is None:
+            tp, fp, nan = score_fold_parts(
+                self.inputs,
+                self.targets,
+                self.sketch_tp,
+                self.sketch_fp,
+                self.sketch_nan_dropped,
+                self._sketch_bits,
+            )
+            _count_fold("score", n)
+        else:
+            tp, fp, nan = mc_score_fold_parts(
+                self.inputs,
+                self.targets,
+                self.sketch_tp,
+                self.sketch_fp,
+                self.sketch_nan_dropped,
+                self._sketch_bits,
+                self._sketch_classes,
+            )
+            _count_fold("mc_score", n)
+        self.inputs = []
+        self.targets = []
+        self.sketch_tp = tp
+        self.sketch_fp = fp
+        self.sketch_nan_dropped = nan
+        self._cached_samples = 0
+
+    def _sketch_value(self, from_parts, *extra_statics):
+        """Dispatch an approx-mode compute program over (staged leftovers,
+        resident sketch) — state untouched, so ``compute()`` stays
+        idempotent — then raise the loud-NaN error AFTER the dispatch (the
+        scalar read overlaps the kernel, the ``_check_nan_flag`` shape)."""
+        *value, nan_total, overflow = from_parts(
+            list(self.inputs),
+            list(self.targets),
+            self.sketch_tp,
+            self.sketch_fp,
+            self.sketch_nan_dropped,
+            self._sketch_bits,
+            *extra_statics,
+        )
+        from torcheval_tpu.sketch.cache import (
+            raise_sketch_nan,
+            raise_sketch_overflow,
+        )
+
+        raise_sketch_overflow(overflow)
+        raise_sketch_nan(nan_total, self._NAN_FLAG_NOUN)
+        return value[0] if len(value) == 1 else tuple(value)
 
     def _compact(self) -> None:
         raise NotImplementedError
@@ -303,7 +447,7 @@ class _CompactingCacheLifecycle:
         # clone+_set_states) may bring in a nonzero NaN flag from another
         # replica — a cached clean check must not survive it
         super()._set_states(values)
-        if "summary_nan_dropped" in values:
+        if "summary_nan_dropped" in values or "sketch_nan_dropped" in values:
             self._nan_checked = False
         if any(k.startswith("summary_") for k in values):
             self._summary_sorted = False  # unknown provenance
@@ -366,6 +510,13 @@ class _CompactingCacheLifecycle:
         self._cached_samples = sum(int(a.shape[0]) for a in self.inputs)
         if self._compaction_threshold is None:
             return
+        if self._sketch_bits is not None:
+            # approx mode: the raw cache is a staging buffer; fold when the
+            # cadence is exceeded (the resident sketch never re-triggers —
+            # its size is static)
+            if self._cached_samples >= self._compaction_threshold:
+                self._compact()
+            return
         # compact when raw rows exceed the threshold, OR when merges have
         # fragmented the summary into multiple buffers past the threshold —
         # merge-fed accumulators receiving already-compacted sources must
@@ -383,12 +534,21 @@ class _CompactingCacheLifecycle:
         self._summary_sorted = False  # concatenated segments may overlap
         # (the recount below may re-compact, legitimately restoring it)
         super().merge_state(metrics)
-        for metric in metrics:
-            # the cache base merges only list states; the scalar NaN flag is
-            # additive across replicas
-            self.summary_nan_dropped = self.summary_nan_dropped + jax.device_put(
-                metric.summary_nan_dropped, self.device
-            )
+        if self._sketch_bits is not None:
+            # the cache base merges only list states; the sketch arrays are
+            # additive across replicas — bucket add IS the exact merge
+            # (ISSUE 13 acceptance: merged == single-stream bit-identical,
+            # integer adds). One shared definition with the mixins.
+            from torcheval_tpu.sketch.cache import merge_score_sketch_states
+
+            merge_score_sketch_states(self, metrics)
+        else:
+            for metric in metrics:
+                # the scalar NaN flag is additive across replicas
+                self.summary_nan_dropped = (
+                    self.summary_nan_dropped
+                    + jax.device_put(metric.summary_nan_dropped, self.device)
+                )
         self._nan_checked = False
         self._recount_cache()
         return self
@@ -423,10 +583,25 @@ class _CompactingCacheLifecycle:
         falls back: a tuple spec entry (rows sharded over several axes at
         once), a sharded trailing dim (per-class score columns must stay
         local to a shard), and row counts not divisible by the axis."""
-        from jax.sharding import NamedSharding
-
+        if self._sketch_bits is not None:
+            return None  # approx compute owns its own (sketch-psum) path
         if self.summary_scores or not self.inputs:
             return None
+        return self._uniform_cache_mesh()
+
+    def _sketch_sharded_mesh(self):
+        """Approx-mode twin of :meth:`_sharded_raw_mesh`: ``(mesh, axis)``
+        when the STAGING cache is uniformly sharded — the resident-sketch
+        fold then runs as one ``shard_map`` psum of fixed-size histograms
+        (``ops/dist_curves.sharded_sketch_counts``) instead of pulling
+        shards to one device."""
+        if not self.inputs:
+            return None
+        return self._uniform_cache_mesh()
+
+    def _uniform_cache_mesh(self):
+        from jax.sharding import NamedSharding
+
         mesh = axis = None
         for a in list(self.inputs) + list(self.targets):
             sh = getattr(a, "sharding", None)
@@ -475,16 +650,34 @@ class _BinaryCurveMetric(_CompactingCacheLifecycle, SampleCacheMetric[jax.Array]
     concatenated summaries (across replicas or processes) may repeat a
     threshold, and the weighted curve kernels merge tied scores by
     construction — no re-compaction is needed for correctness.
+
+    With ``approx=`` (ISSUE 13: ``True`` = default bucket count, an int =
+    bucket count, env ``TORCHEVAL_TPU_APPROX``), the summary states are
+    replaced by a RESIDENT fixed-size score sketch — ``sketch_tp`` /
+    ``sketch_fp`` bucket histograms (``torcheval_tpu.sketch``) — giving
+    O(buckets) memory forever regardless of stream length or score
+    cardinality, exact (bucket-add) merges, and a documented error bound
+    (``sketch.auroc_error_bound`` / ``auprc_error_bound``, computable from
+    the sketch itself). ``compaction_threshold`` then sets the staging-fold
+    cadence (default ``sketch.SKETCH_FOLD_ROWS``).
     """
 
     def __init__(
         self,
         *,
         compaction_threshold: Optional[int] = None,
+        approx=None,
         device: DeviceLike = None,
     ) -> None:
         super().__init__(device=device)
-        self._init_compaction(compaction_threshold)
+        from torcheval_tpu.sketch import DEFAULT_BUCKET_BITS, resolve_approx
+
+        self._init_compaction(
+            compaction_threshold,
+            approx_bits=resolve_approx(
+                approx, default_bits=DEFAULT_BUCKET_BITS
+            ),
+        )
 
     def update(self, input, target) -> "_BinaryCurveMetric":
         input, target = self._input(input), self._input(target)
@@ -496,6 +689,11 @@ class _BinaryCurveMetric(_CompactingCacheLifecycle, SampleCacheMetric[jax.Array]
 
     # ------------------------------------------------------------ compaction
     def _compact(self) -> None:
+        if self._sketch_bits is not None:
+            return self._sketch_compact()
+        return self._summary_compact()
+
+    def _summary_compact(self) -> None:
         """Fold raw cache + summary into one padded unique-threshold summary.
 
         One jitted program (fold + pad + compact); the buffer is padded to a
@@ -597,6 +795,10 @@ class BinaryAUROC(_BinaryCurveMetric):
     """
 
     def compute(self) -> jax.Array:
+        if self._sketch_bits is not None:
+            from torcheval_tpu.sketch.cache import sketch_auroc_from_parts
+
+            return self._sketch_value(sketch_auroc_from_parts)
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.5)
         from torcheval_tpu.ops.dist_curves import sharded_binary_auroc
@@ -670,13 +872,25 @@ class _MulticlassCurveMetric(
         num_classes: Optional[int] = None,
         average: Optional[str] = "macro",
         compaction_threshold: Optional[int] = None,
+        approx=None,
         device: DeviceLike = None,
     ) -> None:
         super().__init__(device=device)
+        from torcheval_tpu.sketch import (
+            DEFAULT_MC_BUCKET_BITS,
+            resolve_approx,
+        )
+
         _mc_curve_param_check(num_classes, average)
         self.num_classes = num_classes
         self.average = average
-        self._init_compaction(compaction_threshold)
+        self._init_compaction(
+            compaction_threshold,
+            approx_bits=resolve_approx(
+                approx, default_bits=DEFAULT_MC_BUCKET_BITS
+            ),
+            sketch_classes=num_classes,
+        )
 
     # one bad (N, C) row contributes one dropped ENTRY per NaN-scored class
     _NAN_FLAG_NOUN = "per-class score entry(ies)"
@@ -692,6 +906,11 @@ class _MulticlassCurveMetric(
         return self
 
     def _compact(self) -> None:
+        if self._sketch_bits is not None:
+            return self._sketch_compact()
+        return self._summary_compact()
+
+    def _summary_compact(self) -> None:
         """Fold the raw cache + per-class summaries into one padded
         ``(K, C)`` summary set (one jitted program; same adaptive-trim
         host-read overlap as the binary :meth:`_BinaryCurveMetric._compact`)."""
@@ -753,6 +972,15 @@ class MulticlassAUROC(_MulticlassCurveMetric):
     gather; see :meth:`_CompactingCacheLifecycle._sharded_raw_mesh`."""
 
     def compute(self) -> jax.Array:
+        if self._sketch_bits is not None:
+            from torcheval_tpu.sketch.cache import (
+                sketch_mc_auroc_from_parts,
+            )
+
+            per_class = self._sketch_value(
+                sketch_mc_auroc_from_parts, self.num_classes
+            )
+            return _mc_average(per_class, self.average)
         if not (self.inputs or self.summary_scores):
             return (
                 jnp.asarray(0.5)
@@ -785,6 +1013,15 @@ class MulticlassAUPRC(_MulticlassCurveMetric):
     :class:`MulticlassAUROC`."""
 
     def compute(self) -> jax.Array:
+        if self._sketch_bits is not None:
+            from torcheval_tpu.sketch.cache import (
+                sketch_mc_auprc_from_parts,
+            )
+
+            per_class = self._sketch_value(
+                sketch_mc_auprc_from_parts, self.num_classes
+            )
+            return _mc_average(per_class, self.average)
         if not (self.inputs or self.summary_scores):
             return (
                 jnp.asarray(0.0)
@@ -818,6 +1055,10 @@ class BinaryAUPRC(_BinaryCurveMetric):
     BASELINE.md config 2)."""
 
     def compute(self) -> jax.Array:
+        if self._sketch_bits is not None:
+            from torcheval_tpu.sketch.cache import sketch_auprc_from_parts
+
+            return self._sketch_value(sketch_auprc_from_parts)
         if not (self.inputs or self.summary_scores):
             return jnp.asarray(0.0)
         from torcheval_tpu.ops.dist_curves import sharded_binary_auprc

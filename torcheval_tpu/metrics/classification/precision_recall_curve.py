@@ -1,5 +1,15 @@
 """PRC class metrics. Reference:
-``torcheval/metrics/classification/precision_recall_curve.py:29-220``."""
+``torcheval/metrics/classification/precision_recall_curve.py:29-220``.
+
+ISSUE 13: both classes grow an opt-in ``approx=`` mode
+(``torcheval_tpu.sketch``) — the unbounded sample cache becomes a staging
+buffer folded into resident fixed-size ``(tp, fp)`` bucket histograms, and
+``compute()`` returns the curve over the NONEMPTY buckets with the bucket
+representatives as thresholds (one point per occupied bucket — a
+data-adaptive cousin of the binned PRC family, with the sketch's documented
+relative-error threshold placement and exact cross-bucket counts). Memory
+is O(buckets) regardless of stream length; merges are exact bucket adds.
+"""
 
 from __future__ import annotations
 
@@ -15,27 +25,59 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
     multiclass_precision_recall_curve,
 )
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.sketch import (
+    DEFAULT_BUCKET_BITS,
+    DEFAULT_MC_BUCKET_BITS,
+    ScoreSketchCacheMixin,
+    resolve_approx,
+)
+from torcheval_tpu.sketch.cache import (
+    raise_sketch_overflow,
+    sketch_mc_prc_from_parts,
+    sketch_prc_from_parts,
+)
+from torcheval_tpu.sketch.histogram import trim_hist_curve
 from torcheval_tpu.utils.devices import DeviceLike
+from torcheval_tpu.utils.telemetry import log_once
 
 _CurveResult = Tuple[jax.Array, jax.Array, jax.Array]
 
 
-class BinaryPrecisionRecallCurve(SampleCacheMetric[_CurveResult]):
-    """Streaming binary precision-recall curve (sample-cache state)."""
+class BinaryPrecisionRecallCurve(
+    ScoreSketchCacheMixin, SampleCacheMetric[_CurveResult]
+):
+    """Streaming binary precision-recall curve (sample-cache state; with
+    ``approx=``, resident-sketch state — see the module docstring)."""
 
-    def __init__(self, *, device: DeviceLike = None) -> None:
+    def __init__(self, *, approx=None, device: DeviceLike = None) -> None:
         super().__init__(device=device)
         self._add_cache_state("inputs")
         self._add_cache_state("targets")
+        bits = resolve_approx(approx, default_bits=DEFAULT_BUCKET_BITS)
+        if bits is not None:
+            self._init_score_sketch(bits)
 
     def update(self, input, target) -> "BinaryPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
         _binary_precision_recall_curve_update_input_check(input, target)
         self.inputs.append(input)
         self.targets.append(target)
+        if self._sketch_enabled():
+            self._score_sketch_stage(input.shape[0])
         return self
 
     def compute(self) -> _CurveResult:
+        if self._sketch_enabled():
+            precision, recall, nonempty, nan, overflow = (
+                sketch_prc_from_parts(
+                    *self._score_sketch_parts(), self._sketch_bits
+                )
+            )
+            raise_sketch_overflow(overflow)
+            self._sketch_check_nan(nan)
+            return trim_hist_curve(
+                precision, recall, nonempty, self._sketch_bits
+            )
         if not self.inputs:
             return jnp.empty((0,)), jnp.empty((0,)), jnp.empty((0,))
         return binary_precision_recall_curve(
@@ -44,17 +86,45 @@ class BinaryPrecisionRecallCurve(SampleCacheMetric[_CurveResult]):
 
 
 class MulticlassPrecisionRecallCurve(
-    SampleCacheMetric[Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]
+    ScoreSketchCacheMixin,
+    SampleCacheMetric[Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]],
 ):
-    """Streaming one-vs-all precision-recall curves per class."""
+    """Streaming one-vs-all precision-recall curves per class (with
+    ``approx=``, resident per-class sketches — requires ``num_classes`` at
+    construction, which sizes the ``(C, B)`` histogram state)."""
 
     def __init__(
-        self, *, num_classes: Optional[int] = None, device: DeviceLike = None
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        approx=None,
+        device: DeviceLike = None,
     ) -> None:
         super().__init__(device=device)
         self.num_classes = num_classes
         self._add_cache_state("inputs")
         self._add_cache_state("targets")
+        bits = resolve_approx(approx, default_bits=DEFAULT_MC_BUCKET_BITS)
+        if bits is not None and num_classes is None:
+            if approx is None:
+                # env-driven opt-in cannot size the (C, B) state without
+                # num_classes: stay exact, loudly, rather than raise inside
+                # code that never mentioned approx
+                log_once(
+                    "mc_prc_approx_needs_num_classes",
+                    "TORCHEVAL_TPU_APPROX is set but "
+                    "MulticlassPrecisionRecallCurve was built without "
+                    "num_classes; the sketch state cannot be sized, so "
+                    "this metric stays exact. Pass num_classes= to opt in.",
+                )
+                bits = None
+            else:
+                raise ValueError(
+                    "approx= requires num_classes at construction (it sizes "
+                    "the per-class sketch state)."
+                )
+        if bits is not None:
+            self._init_score_sketch(bits, num_classes=num_classes)
 
     def update(self, input, target) -> "MulticlassPrecisionRecallCurve":
         input, target = self._input(input), self._input(target)
@@ -65,9 +135,30 @@ class MulticlassPrecisionRecallCurve(
         )
         self.inputs.append(input)
         self.targets.append(target)
+        if self._sketch_enabled():
+            self._score_sketch_stage(input.shape[0])
         return self
 
     def compute(self):
+        if self._sketch_enabled():
+            precision, recall, nonempty, nan, overflow = (
+                sketch_mc_prc_from_parts(
+                    *self._score_sketch_parts(),
+                    self._sketch_bits,
+                    self.num_classes,
+                )
+            )
+            raise_sketch_overflow(overflow)
+            self._sketch_check_nan(nan, "per-class score entry(ies)")
+            precisions, recalls, thresholds = [], [], []
+            for c in range(self.num_classes):
+                pc, rc, tc = trim_hist_curve(
+                    precision[c], recall[c], nonempty[c], self._sketch_bits
+                )
+                precisions.append(pc)
+                recalls.append(rc)
+                thresholds.append(tc)
+            return precisions, recalls, thresholds
         if not self.inputs:
             return [], [], []
         return multiclass_precision_recall_curve(

@@ -4,6 +4,13 @@ Per-sample scores are computed at update time (one fused kernel per batch)
 and cached as a list of device arrays; compute concatenates. The cache holds
 one float per *sample*, not per class, so memory is O(N) regardless of the
 class count.
+
+ISSUE 13: ``approx=`` swaps the per-sample cache for a resident value
+sketch (``torcheval_tpu.sketch``) — O(buckets) memory forever. The
+per-sample vector is then unrepresentable, so ``compute()`` returns the
+MEAN hit rate (the quantity the vector is overwhelmingly reduced to),
+estimated from the sketch within ``sketch.relative_error(bucket_bits)``
+relative error; merges stay exact (bucket add).
 """
 
 from __future__ import annotations
@@ -14,30 +21,59 @@ import jax
 
 from torcheval_tpu.metrics.functional.ranking.hit_rate import hit_rate
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.sketch import (
+    DEFAULT_BUCKET_BITS,
+    ValueSketchCacheMixin,
+    mean_from_counts,
+    resolve_approx,
+)
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class HitRate(SampleCacheMetric[jax.Array]):
+class HitRate(ValueSketchCacheMixin, SampleCacheMetric[jax.Array]):
     """Per-sample hit rate of the target class among the top-``k`` predictions.
 
     Args:
         k: top-k cutoff; ``None`` considers all classes (hit rate 1.0).
+        approx: opt into resident-sketch state (module docstring);
+            ``compute()`` then returns the mean hit rate.
 
     Reference parity: ``ranking/hit_rate.py:19-96``. ``compute()`` returns the
-    concatenated per-sample score vector (empty array before any update).
+    concatenated per-sample score vector (empty array before any update)
+    in exact mode.
     """
 
-    def __init__(self, *, k: Optional[int] = None, device: DeviceLike = None) -> None:
+    def __init__(
+        self,
+        *,
+        k: Optional[int] = None,
+        approx=None,
+        device: DeviceLike = None,
+    ) -> None:
         super().__init__(device=device)
         if k is not None and k <= 0:
             raise ValueError(f"k should be None or positive, got {k}.")
         self.k = k
         self._add_cache_state("scores")
+        bits = resolve_approx(approx, default_bits=DEFAULT_BUCKET_BITS)
+        if bits is not None:
+            self._init_value_sketch(bits, "scores")
 
     def update(self, input, target) -> "HitRate":
         input, target = self._input(input), self._input(target)
-        self.scores.append(hit_rate(input, target, k=self.k))
+        batch = hit_rate(input, target, k=self.k)
+        self.scores.append(batch)
+        if self._sketch_enabled():
+            self._sketch_stage(batch)
         return self
 
     def compute(self) -> jax.Array:
+        if self._sketch_enabled():
+            counts, nan, overflow = self._sketch_counts_parts()
+            result = mean_from_counts(counts, self._sketch_bits)
+            from torcheval_tpu.sketch.cache import raise_sketch_overflow
+
+            raise_sketch_overflow(overflow)
+            self._sketch_check_nan(nan)
+            return result
         return self._concat_cache("scores")

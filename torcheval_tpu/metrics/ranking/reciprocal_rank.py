@@ -1,5 +1,10 @@
 """ReciprocalRank metric. Reference:
-``torcheval/metrics/ranking/reciprocal_rank.py``."""
+``torcheval/metrics/ranking/reciprocal_rank.py``.
+
+ISSUE 13: ``approx=`` swaps the per-sample cache for a resident value
+sketch; ``compute()`` then returns the MEAN reciprocal rank (MRR) within
+``sketch.relative_error(bucket_bits)`` relative error — see
+``ranking/hit_rate.py`` for the shared contract."""
 
 from __future__ import annotations
 
@@ -9,29 +14,57 @@ import jax
 
 from torcheval_tpu.metrics.functional.ranking.reciprocal_rank import reciprocal_rank
 from torcheval_tpu.metrics.sample_cache import SampleCacheMetric
+from torcheval_tpu.sketch import (
+    DEFAULT_BUCKET_BITS,
+    ValueSketchCacheMixin,
+    mean_from_counts,
+    resolve_approx,
+)
 from torcheval_tpu.utils.devices import DeviceLike
 
 
-class ReciprocalRank(SampleCacheMetric[jax.Array]):
+class ReciprocalRank(ValueSketchCacheMixin, SampleCacheMetric[jax.Array]):
     """Per-sample ``1 / (rank+1)`` of the target class (0 beyond ``k``).
 
     Args:
         k: optional top-k cutoff.
+        approx: opt into resident-sketch state; ``compute()`` then returns
+            the mean reciprocal rank.
 
     Reference parity: ``ranking/reciprocal_rank.py:20-100``.
     """
 
-    def __init__(self, *, k: Optional[int] = None, device: DeviceLike = None) -> None:
+    def __init__(
+        self,
+        *,
+        k: Optional[int] = None,
+        approx=None,
+        device: DeviceLike = None,
+    ) -> None:
         super().__init__(device=device)
         if k is not None and k <= 0:
             raise ValueError(f"k should be None or positive, got {k}.")
         self.k = k
         self._add_cache_state("scores")
+        bits = resolve_approx(approx, default_bits=DEFAULT_BUCKET_BITS)
+        if bits is not None:
+            self._init_value_sketch(bits, "scores")
 
     def update(self, input, target) -> "ReciprocalRank":
         input, target = self._input(input), self._input(target)
-        self.scores.append(reciprocal_rank(input, target, k=self.k))
+        batch = reciprocal_rank(input, target, k=self.k)
+        self.scores.append(batch)
+        if self._sketch_enabled():
+            self._sketch_stage(batch)
         return self
 
     def compute(self) -> jax.Array:
+        if self._sketch_enabled():
+            counts, nan, overflow = self._sketch_counts_parts()
+            result = mean_from_counts(counts, self._sketch_bits)
+            from torcheval_tpu.sketch.cache import raise_sketch_overflow
+
+            raise_sketch_overflow(overflow)
+            self._sketch_check_nan(nan)
+            return result
         return self._concat_cache("scores")

@@ -836,11 +836,17 @@ def sync_and_compute(
 #     entries fall back to raw (counted in
 #     toolkit.sync.quantize_fallbacks{reason=nonfinite} — the dist-curves
 #     error-channel shape: detect, never silently corrupt).
+#   codec 3 (bucket): SUM/MAX/MIN integer lanes again — the sparse
+#     nonzero encoding (delta-narrowed indices + narrowed values,
+#     utils/quant.py) that the ISSUE 13 resident sketch histograms made
+#     worth having; LOSSLESS (scatter into zeros, widened accumulation).
+#     Raced against codec 1 per entry; the smaller encoding ships.
 # An encoder that would not shrink an entry returns None and the entry
 # ships raw — the codec can only reduce wire bytes, never grow them.
 
 _SYNC_CODEC_RAW, _SYNC_CODEC_NARROW, _SYNC_CODEC_Q8 = 0, 1, 2
-_SYNC_CODEC_NAMES = ("raw", "narrow", "q8")
+_SYNC_CODEC_BUCKET = 3  # ISSUE 13: sparse nonzero encoding (sketch lanes)
+_SYNC_CODEC_NAMES = ("raw", "narrow", "q8", "bucket")
 _DESC_COLS = 9
 _QUANT_LANES = (Reduction.SUM, Reduction.MAX, Reduction.MIN)
 
@@ -850,11 +856,18 @@ def _encode_sync_entry(
 ) -> Tuple[int, Optional[bytes]]:
     """Pick and run the wire codec for one entry: ``(codec_id, encoded
     bytes or None)``. Raw (``(0, None)``) whenever quantization is off,
-    the lane is not additive, or encoding would not shrink the entry."""
+    the lane is not additive, or encoding would not shrink the entry.
+    Integer lanes race the lossless candidates — min-offset narrowing vs
+    the sparse bucket-payload codec (the resident sketch histograms'
+    natural shape: few occupied buckets in a large count array) — and the
+    smaller encoding wins per entry."""
     if not quantize or local is None or red not in _QUANT_LANES:
         return _SYNC_CODEC_RAW, None
     if local.dtype.kind in "iu":
         enc = _quant.narrow_int_encode(local)
+        enc_b = _quant.bucket_payload_encode(local)
+        if enc_b is not None and (enc is None or len(enc_b) < len(enc)):
+            return _SYNC_CODEC_BUCKET, enc_b
         if enc is not None:
             return _SYNC_CODEC_NARROW, enc
     elif (
@@ -1158,6 +1171,8 @@ def _gather_collection_states(
                 value = _quant.narrow_int_decode(wire, dtype, shape)
             elif codec == _SYNC_CODEC_Q8:
                 value = _quant.q8_decode(wire, shape)
+            elif codec == _SYNC_CODEC_BUCKET:
+                value = _quant.bucket_payload_decode(wire, dtype, shape)
             else:
                 value = np.frombuffer(wire, dtype=dtype).reshape(shape)
             offset += nbytes

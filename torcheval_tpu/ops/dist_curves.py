@@ -106,7 +106,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.recompile import watched_jit
-from torcheval_tpu.utils.quant import sync_quantize_enabled
+from torcheval_tpu.utils.quant import Q8_BLOCK, sync_quantize_mode
 
 # older shard_map's replication checker false-positives on the kernels' scan
 # carries (jax <= 0.4.x: "Scan carry input and output got mismatched
@@ -157,18 +157,67 @@ def _desc_key(s: jax.Array) -> jax.Array:
     return jnp.where(jnp.isnan(s), _PAD_KEY, ~asc)
 
 
+def _q8_blocks(x: jax.Array):
+    """Per-:data:`Q8_BLOCK` int8 quantization of a 1-D f32 array (the
+    device-side twin of ``utils/quant.q8_parts``): ``(scales, int8)``.
+    Requires ``x.shape[-1] % Q8_BLOCK == 0`` (callers guarantee it)."""
+    blocks = x.shape[-1] // Q8_BLOCK
+    b = x.reshape(blocks, Q8_BLOCK)
+    scales = jnp.max(jnp.abs(b), axis=1) / 127.0
+    safe = jnp.where(scales == 0.0, jnp.float32(1.0), scales)
+    q = jnp.clip(jnp.round(b / safe[:, None]), -127, 127).astype(jnp.int8)
+    return scales, q.reshape(-1)
+
+
+def _qpsum_i8(hist: jax.Array, axis: str, k_devices: int) -> jax.Array:
+    """EQuARX-shaped int8-chunked reduce-scatter/all-gather psum of the
+    splitter histogram (ROADMAP 1(b)): each leg moves 1 byte/bin instead
+    of the int32 psum's 4 (the bf16 psum's halving becomes a quartering)
+    at the cost of two SMALL scale collectives (~1.6% of the int8 bytes).
+
+    Structure (all collectives batch under the multiclass ``vmap`` exactly
+    like the bucket exchange): quantize the local histogram to int8 blocks
+    + f32 scales; one tiled ``all_to_all`` lands every rank's copy of MY
+    1/K shard here (+ its scales); dequantize per source, sum in f32 —
+    the reduce-scatter leg; re-quantize the reduced shard and ``all_gather``
+    it (+ scales) — the all-gather leg. Quantization error is bounded per
+    element by ``max|block|/254`` per leg, which can only nudge splitter
+    placement — splitters balance load, never values (module doc)."""
+    h = hist.shape[-1]
+    scales, q = _q8_blocks(hist.astype(jnp.float32))
+    q_r = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    sc_r = jax.lax.all_to_all(
+        scales, axis, split_axis=0, concat_axis=0, tiled=True
+    )
+    shard = h // k_devices
+    part = q_r.reshape(k_devices, shard // Q8_BLOCK, Q8_BLOCK).astype(
+        jnp.float32
+    ) * sc_r.reshape(k_devices, shard // Q8_BLOCK, 1)
+    reduced = jnp.sum(part, axis=0).reshape(shard)
+    sc2, q2 = _q8_blocks(reduced)
+    q_g = jax.lax.all_gather(q2, axis, tiled=True)
+    sc_g = jax.lax.all_gather(sc2, axis, tiled=True)
+    return (
+        q_g.reshape(h // Q8_BLOCK, Q8_BLOCK).astype(jnp.float32)
+        * sc_g.reshape(h // Q8_BLOCK, 1)
+    ).reshape(h)
+
+
 def _splitter_buckets(
-    key: jax.Array, axis: str, k_devices: int, quantize: bool = False
+    key: jax.Array, axis: str, k_devices: int, quantize=False
 ):
     """Per-row destination bucket ids from global histogram splitters.
 
     The histogram is over the key's top 16 bits; the psum makes it global.
     Quantile targets are computed in f32 — splitters need only balance the
     load, not be exact quantiles. Equal keys always get equal buckets (the
-    tie-locality invariant the merge step relies on). Under ``quantize``
-    the all-reduce runs in bf16 (half the fixed payload): counts above 256
-    round, which can only shift splitter placement, never results (module
-    doc, "Quantized exchange")."""
+    tie-locality invariant the merge step relies on). ``quantize`` is the
+    resolved mode: ``"bf16"`` runs the all-reduce in bf16 (half the fixed
+    payload; counts above 256 round), ``"int8"`` runs the chunked qpsum
+    (:func:`_qpsum_i8`, quarter the payload) when the bin count divides
+    into per-rank Q8 blocks, else falls back to bf16. Either can only
+    shift splitter placement, never results (module doc, "Quantized
+    exchange")."""
     t = jax.lax.shift_right_logical(key, jnp.uint32(16)).astype(jnp.int32)
     hist = jax.ops.segment_sum(
         jnp.ones_like(t, dtype=jnp.int32),
@@ -176,7 +225,9 @@ def _splitter_buckets(
         num_segments=_HIST_BINS,
         indices_are_sorted=False,
     )
-    if quantize:
+    if quantize == "int8" and _HIST_BINS % (k_devices * Q8_BLOCK) == 0:
+        cum = jnp.cumsum(_qpsum_i8(hist, axis, k_devices))
+    elif quantize:
         hist = jax.lax.psum(hist.astype(jnp.bfloat16), axis)
         cum = jnp.cumsum(hist.astype(jnp.float32))
     else:
@@ -397,7 +448,7 @@ _KERNELS = {
 
 
 @functools.lru_cache(maxsize=None)
-def _program(mesh: Mesh, axis: str, which: str, quantize: bool = False):
+def _program(mesh: Mesh, axis: str, which: str, quantize=False):
     """Jitted shard_map program per (mesh, axis, metric); jit handles
     shape-based caching beneath. Capacity is static per trace (derived from
     the local row count). ``axis`` may be a subset of a multi-axis mesh: the
@@ -423,8 +474,8 @@ def _program(mesh: Mesh, axis: str, which: str, quantize: bool = False):
             **_SHARD_MAP_KWARGS,
         )(s_list, t_list)
 
-    name = f"dist_curves.{which}" + ("_q8" if quantize else "")
-    return watched_jit(impl, name=name)
+    suffix = {"bf16": "_q8", "int8": "_q8i8"}.get(quantize, "")
+    return watched_jit(impl, name=f"dist_curves.{which}{suffix}")
 
 
 def _accounted_call(
@@ -433,7 +484,7 @@ def _accounted_call(
     t_list,
     mesh: Mesh,
     axis: str,
-    quantize: Optional[bool] = None,
+    quantize=None,  # None=env | False | True/"bf16" | "int8"
 ):
     """Dispatch the distributed program with collective accounting: one
     all_to_all exchange per call, whose per-device send payload is derived
@@ -444,8 +495,10 @@ def _accounted_call(
     program and are attributed by the XLA profiler via the entry point's
     ``named_scope``. ``quantize`` resolves the per-call override against
     TORCHEVAL_TPU_SYNC_QUANTIZE (the same knob the metric-sync wire
-    reads) and is part of the compiled-program cache key."""
-    quantize = sync_quantize_enabled(quantize)
+    reads; ``"int8"`` — per call or in the env — additionally swaps the
+    splitter-histogram psum for the chunked int8 qpsum, ROADMAP 1(b)) and
+    is part of the compiled-program cache key."""
+    quantize = sync_quantize_mode(quantize)
     program = _program(mesh, axis, which, quantize)
     s_list, t_list = list(s_list), list(t_list)
     if not _obs.enabled():
@@ -454,7 +507,7 @@ def _accounted_call(
     n_local = sum(int(s.shape[0]) for s in s_list) // k
     capacity = _bucket_capacity(n_local, k)
     n_cols = int(s_list[0].shape[1]) if s_list[0].ndim == 2 else 1
-    codec = "q8" if quantize else "raw"
+    codec = {"bf16": "q8", "int8": "q8i8"}.get(quantize, "raw")
     with _obs.span(f"ops.dist_curves.{which}"):
         out = program(s_list, t_list)
     _obs.counter("dist_curves.exchanges", kernel=which, codec=codec)
@@ -479,7 +532,7 @@ def sharded_binary_auroc(
     *,
     mesh: Mesh,
     axis: str = "data",
-    quantize: Optional[bool] = None,
+    quantize=None,  # None=env | False | True/"bf16" | "int8"
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact AUROC over a mesh-sharded raw sample cache without gathering
     the samples. Returns ``(value, error_rows)`` — a nonzero count means
@@ -498,7 +551,7 @@ def sharded_binary_auprc(
     *,
     mesh: Mesh,
     axis: str = "data",
-    quantize: Optional[bool] = None,
+    quantize=None,  # None=env | False | True/"bf16" | "int8"
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact average precision over a mesh-sharded raw cache; see
     :func:`sharded_binary_auroc` for the error-channel and ``quantize``
@@ -512,7 +565,7 @@ def sharded_multiclass_auroc(
     *,
     mesh: Mesh,
     axis: str = "data",
-    quantize: Optional[bool] = None,
+    quantize=None,  # None=env | False | True/"bf16" | "int8"
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact one-vs-all per-class AUROC over a mesh-sharded raw multiclass
     cache (``(N_i, C)`` score blocks + ``(N_i,)`` integer labels, every
@@ -531,8 +584,84 @@ def sharded_multiclass_auprc(
     *,
     mesh: Mesh,
     axis: str = "data",
-    quantize: Optional[bool] = None,
+    quantize=None,  # None=env | False | True/"bf16" | "int8"
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact one-vs-all per-class average precision over a mesh-sharded raw
     multiclass cache; see :func:`sharded_multiclass_auroc`."""
     return _accounted_call("mc_auprc", s_list, t_list, mesh, axis, quantize)
+
+
+# ------------------------------------------------------ resident sketch path
+# ISSUE 13(c): approx-mode curve metrics hold their state AS a histogram
+# (``torcheval_tpu.sketch``), so the distributed reduction degenerates from
+# the 3-collective bucket exchange to ONE psum of fixed-size count arrays —
+# the resident histogram is consumed directly, with no re-bucketing pass and
+# no per-sample traffic at all. Exactness note: the sketch psum is NEVER
+# quantized — bucket counts are the metric state itself (bucket add must be
+# exact), unlike the splitter histogram above, which only balances load.
+@functools.lru_cache(maxsize=None)
+def _sketch_program(
+    mesh: Mesh, axis: str, bucket_bits: int, num_classes: Optional[int]
+):
+    from torcheval_tpu.sketch.histogram import (
+        mc_score_hist_fold,
+        score_hist_fold,
+    )
+
+    def impl(s_list, t_list):
+        def kern(s_l, t_l):
+            if num_classes is None:
+                tp, fp, nan = score_hist_fold(
+                    jnp.concatenate(s_l), jnp.concatenate(t_l), bucket_bits
+                )
+            else:
+                tp, fp, nan = mc_score_hist_fold(
+                    jnp.concatenate(s_l, axis=0),
+                    jnp.concatenate(t_l),
+                    bucket_bits,
+                    num_classes,
+                )
+            return (
+                jax.lax.psum(tp, axis),
+                jax.lax.psum(fp, axis),
+                jax.lax.psum(nan, axis),
+            )
+
+        return shard_map(
+            kern,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis)),
+            out_specs=(P(), P(), P()),
+            **_SHARD_MAP_KWARGS,
+        )(s_list, t_list)
+
+    return watched_jit(impl, name="dist_curves.sketch_fold")
+
+
+def sharded_sketch_counts(
+    s_list: List[jax.Array],
+    t_list: List[jax.Array],
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+    bucket_bits: int,
+    num_classes: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold a mesh-sharded raw staging cache straight into GLOBAL sketch
+    histograms: per-shard ``segment_sum`` + one exact int32 ``psum`` round
+    — no sample ever crosses the ICI. Returns replicated
+    ``(tp, fp, nan_count)`` (``(B,)`` binary / ``(C, B)`` one-vs-all with
+    ``num_classes``); the caller bucket-adds them into its resident state.
+    Unlike the exact kernels there is no overflow error channel — the
+    histogram is fixed-size by construction."""
+    program = _sketch_program(mesh, str(axis), bucket_bits, num_classes)
+    s_list, t_list = list(s_list), list(t_list)
+    if not _obs.enabled():
+        return program(s_list, t_list)
+    k = int(mesh.shape[axis])
+    with _obs.span("ops.dist_curves.sketch_fold"):
+        out = program(s_list, t_list)
+    family = "binary" if num_classes is None else "multiclass"
+    _obs.counter("dist_curves.sketch_folds", family=family)
+    _obs.gauge("dist_curves.world_size", k)
+    return out

@@ -1,0 +1,75 @@
+"""``torcheval_tpu.sketch`` — mergeable bounded-memory sketch state.
+
+ISSUE 13 / ROADMAP item 4: the curve and quantile metrics' last O(samples)
+state (sample caches, even compacted ones) becomes an opt-in O(buckets)
+resident sketch — fixed-size bucket-count histograms over a
+distribution-independent float-prefix partition (``buckets.py``), folded by
+pure jit/vmap-safe kernels (``histogram.py``) and merged by plain addition,
+so sketch state rides the deferred window-step, ``merge_state``, the
+two-round sync wire and atomic checkpoints unchanged (``cache.py``).
+
+Consumers: the ``approx=`` mode on ``BinaryAUROC``/``BinaryAUPRC``/
+``MulticlassAUROC``/``MulticlassAUPRC``/``(Multiclass)PrecisionRecallCurve``
+/``HitRate``/``ReciprocalRank``/``Cat`` and the ``Quantile`` aggregation
+metric. Error bounds are documented per function and computable a
+posteriori from the sketch itself (``auroc_error_bound`` /
+``auprc_error_bound`` / ``relative_error``).
+"""
+
+from torcheval_tpu.sketch.buckets import (
+    DEFAULT_BUCKET_BITS,
+    DEFAULT_MC_BUCKET_BITS,
+    MAX_BUCKET_BITS,
+    MIN_BUCKET_BITS,
+    ascending_key,
+    bucket_edges,
+    bucket_index,
+    bucket_representatives,
+    check_bucket_bits,
+    relative_error,
+)
+from torcheval_tpu.sketch.cache import (
+    SKETCH_FOLD_ROWS,
+    ScoreSketchCacheMixin,
+    ValueSketchCacheMixin,
+    resolve_approx,
+)
+from torcheval_tpu.sketch.histogram import (
+    auprc_error_bound,
+    auprc_from_hist,
+    auroc_error_bound,
+    auroc_from_hist,
+    mc_score_hist_fold,
+    mean_from_counts,
+    prc_from_hist,
+    quantiles_from_counts,
+    score_hist_fold,
+    value_hist_fold,
+)
+
+__all__ = [
+    "DEFAULT_BUCKET_BITS",
+    "DEFAULT_MC_BUCKET_BITS",
+    "MIN_BUCKET_BITS",
+    "MAX_BUCKET_BITS",
+    "SKETCH_FOLD_ROWS",
+    "ScoreSketchCacheMixin",
+    "ValueSketchCacheMixin",
+    "ascending_key",
+    "auprc_error_bound",
+    "auprc_from_hist",
+    "auroc_error_bound",
+    "auroc_from_hist",
+    "bucket_edges",
+    "bucket_index",
+    "bucket_representatives",
+    "check_bucket_bits",
+    "mc_score_hist_fold",
+    "mean_from_counts",
+    "prc_from_hist",
+    "quantiles_from_counts",
+    "relative_error",
+    "resolve_approx",
+    "score_hist_fold",
+    "value_hist_fold",
+]

@@ -1,0 +1,176 @@
+"""Float-prefix bucket mapping: the one bucket family behind every sketch.
+
+A score/value sketch needs a *fixed*, *distribution-independent*, *monotone*
+partition of the float line so that (a) bucket counts from any two streams
+merge by plain addition (the mergeability contract — ISSUE 13's "exact merge
+= bucket add"), and (b) the partition is a pure bit-level function that is
+jit/vmap-safe and costs one shift per element inside a fold kernel.
+
+The mapping here is the top ``bucket_bits`` bits of the monotone u32 order
+key the distributed curve kernels already use for their splitter histograms
+(``ops/dist_curves.py:_desc_key``, ascending orientation): every finite f32
+maps through a sign-aware bitcast to a u32 whose *unsigned order equals the
+float order*, and the bucket index is that key's high bits. Because the key's
+layout is ``[sign][8-bit exponent][mantissa]``, keeping ``bucket_bits >= 10``
+means a bucket never spans an exponent boundary, so each bucket's value range
+is a *relative* slice of the line — exactly the DDSketch/t-digest shape:
+
+* **relative-error buckets**: for any normal float ``v``, every value in
+  ``v``'s bucket is within ``relative_error(bucket_bits) = 2**-(bucket_bits
+  - 9)`` of ``v`` (the bucket keeps ``bucket_bits - 9`` mantissa bits; its
+  width over its lower edge is ``<= 2**-(mantissa bits)``). Subnormals and
+  zero get *absolute* slices of a ~1e-38 neighborhood — tighter than any
+  caller cares about.
+* **full-line coverage**: negatives, ``+-0`` (canonicalized to one bucket),
+  ``+-inf`` and every magnitude are covered with no configuration — there is
+  no DDSketch "index range" knob to mis-set, and heavy-tailed streams cannot
+  fall off the edges.
+* **NaN is not representable** (its order is undefined); fold kernels mask
+  NaN elements out and count them into a separate lane so callers can keep
+  the library's loud-NaN contract (``_CompactingCacheLifecycle``).
+
+Bucket *representatives* (the value handed back by quantile queries and used
+as curve thresholds) are the value-space midpoint of the bucket's edge
+values, precomputed host-side per ``bucket_bits`` and embedded as an XLA
+constant — compute kernels never invert keys at runtime. Buckets that lie
+inside the NaN regions of the key space decode to NaN representatives; they
+can never hold a count, and the curve kernels treat (NaN, 0, 0) rows as
+padding by contract (``ops/curves.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# default bucket count exponent: 2^16 buckets = 256 KiB per int32 histogram
+# (the dist_curves splitter-histogram precedent) and a documented relative
+# error of 2^-7 ~ 0.8% on any representative — while curve VALUES (AUROC /
+# AUPRC) see only the within-bucket tie mass, typically orders of magnitude
+# tighter (sketch/histogram.py error bounds).
+DEFAULT_BUCKET_BITS = 16
+# multiclass curve state is (num_classes, B) x2 — default to 2^12 buckets so
+# a 1000-class metric holds 32 MiB, not 512 MiB. The AUROC/AUPRC error bound
+# scales ~1/B and stays ~1e-4 on smooth score distributions at 2^12.
+DEFAULT_MC_BUCKET_BITS = 12
+# below 10 bits a bucket would span exponent boundaries (no mantissa bits
+# left) and the relative-error story collapses; above 20 the "bounded
+# memory" story does (4 MiB per histogram and counting).
+MIN_BUCKET_BITS, MAX_BUCKET_BITS = 10, 20
+
+_NAN_KEY = np.uint32(0xFFFFFFFF)
+
+
+def check_bucket_bits(bucket_bits: int) -> int:
+    if (
+        not isinstance(bucket_bits, int)
+        or not MIN_BUCKET_BITS <= bucket_bits <= MAX_BUCKET_BITS
+    ):
+        raise ValueError(
+            f"bucket_bits must be an int in [{MIN_BUCKET_BITS}, "
+            f"{MAX_BUCKET_BITS}], got {bucket_bits!r}."
+        )
+    return bucket_bits
+
+
+def relative_error(bucket_bits: int) -> float:
+    """Documented per-value bound: any finite normal value and its bucket's
+    representative differ by at most this *relative* amount (conservative
+    full-bucket-width bound; the midpoint representative typically halves
+    it). Subnormal buckets are bounded absolutely by ~1e-38 instead."""
+    return 2.0 ** -(check_bucket_bits(bucket_bits) - 9)
+
+
+def ascending_key(x: jax.Array) -> jax.Array:
+    """Monotone u32 order key, ascending: ``key(a) < key(b)`` iff ``a < b``
+    as floats, ``-0.0`` and ``+0.0`` share one key, every NaN maps to the
+    max key (callers mask NaN before bucketing). The sign-aware bitcast is
+    ``ops/dist_curves.py:_desc_key`` without the final inversion.
+
+    Subnormal magnitudes flush to the zero key explicitly: XLA backends
+    disagree on FTZ/DAZ (CPU flushes ``-1e-40 == 0`` to true, others may
+    not), and the bucket id must be a pure deterministic function of the
+    value for cross-replica merges to agree. Costs < 1.18e-38 absolute
+    error, beneath every documented bound."""
+    x = x.astype(jnp.float32)
+    # where(), not `x + 0.0`: XLA folds add(x, 0) away, sign bit and all
+    tiny = jnp.float32(np.finfo(np.float32).tiny)
+    x = jnp.where(jnp.abs(x) < tiny, jnp.float32(0.0), x)
+    b = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    key = jnp.where(
+        jax.lax.shift_right_logical(b, jnp.uint32(31)) == jnp.uint32(1),
+        ~b,
+        b | jnp.uint32(0x80000000),
+    )
+    return jnp.where(jnp.isnan(x), jnp.uint32(_NAN_KEY), key)
+
+
+def bucket_index(x: jax.Array, bucket_bits: int) -> jax.Array:
+    """Bucket id in ``[0, 2**bucket_bits)`` for every element (NaN lands in
+    the top bucket — mask it out before counting). Pure bit ops: safe under
+    jit, vmap and shard_map."""
+    shift = jnp.uint32(32 - bucket_bits)
+    return jax.lax.shift_right_logical(ascending_key(x), shift).astype(
+        jnp.int32
+    )
+
+
+def _key_to_float(key: np.ndarray) -> np.ndarray:
+    """Host-side inverse of :func:`ascending_key` (vectorized numpy)."""
+    key = np.asarray(key, dtype=np.uint32)
+    positive = (key & np.uint32(0x80000000)) != 0
+    bits = np.where(positive, key & np.uint32(0x7FFFFFFF), ~key).astype(
+        np.uint32
+    )
+    return bits.view(np.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def bucket_edges(bucket_bits: int):
+    """``(lo, hi)`` float32 arrays of every bucket's inclusive value edges,
+    ascending by bucket id. Edges in the key space's NaN regions decode to
+    NaN (those buckets can never hold a count)."""
+    check_bucket_bits(bucket_bits)
+    shift = 32 - bucket_bits
+    ids = np.arange(1 << bucket_bits, dtype=np.uint64)
+    lo_key = (ids << shift).astype(np.uint32)
+    hi_key = ((ids << shift) + ((1 << shift) - 1)).astype(np.uint32)
+    lo = _key_to_float(lo_key)
+    hi = _key_to_float(hi_key)
+    # the +-inf buckets' outward edge keys decode into the NaN bit-pattern
+    # region; clamp to the inward edge so every bucket that can hold a
+    # value has finite-or-inf edges (buckets with BOTH edges NaN lie fully
+    # inside a NaN region and can never hold a count)
+    lo = np.where(np.isnan(lo) & ~np.isnan(hi), hi, lo)
+    hi = np.where(np.isnan(hi) & ~np.isnan(lo), lo, hi)
+    lo.setflags(write=False)
+    hi.setflags(write=False)
+    return lo, hi
+
+
+@functools.lru_cache(maxsize=None)
+def bucket_representatives(bucket_bits: int) -> np.ndarray:
+    """Per-bucket representative value (value-space midpoint of the edges),
+    ascending by bucket id, float32. Precomputed once per ``bucket_bits``
+    and closed over as an XLA constant by the compute kernels. The
+    ``+-inf``-edge buckets keep their infinite edge as representative;
+    NaN-region buckets stay NaN (padding rows by the curve-kernel
+    contract)."""
+    lo, hi = bucket_edges(bucket_bits)
+    # float64 midpoint: (lo + hi) / 2 cannot overflow and rounds once.
+    # NaN-region buckets legitimately produce NaN mids — mute the cast
+    # warning rather than special-case them twice.
+    with np.errstate(invalid="ignore"):
+        mid = (
+            (lo.astype(np.float64) + hi.astype(np.float64)) / 2.0
+        ).astype(np.float32)
+    # an infinite edge dominates the midpoint (inf + finite = inf, which is
+    # the honest representative for the bucket holding +-inf); a NaN edge
+    # paired with a finite one keeps the finite edge
+    mid = np.where(np.isnan(mid) & ~np.isnan(lo), lo, mid)
+    mid = np.where(np.isnan(mid) & ~np.isnan(hi), hi, mid)
+    mid.setflags(write=False)
+    return mid

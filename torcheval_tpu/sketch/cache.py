@@ -1,0 +1,491 @@
+"""Resident-sketch state machinery for the ``approx=`` metric mode.
+
+The metric-side glue between the pure fold/compute math
+(``sketch/histogram.py``) and the sample-cache metric classes: the opt-in
+knob resolution (ctor arg + ``TORCHEVAL_TPU_APPROX`` env), the staged-fold
+cadence (update stays an O(1) host append; one jitted fold program folds the
+staging cache into the resident histogram every ``SKETCH_FOLD_ROWS`` rows,
+the ``_CompactingCacheLifecycle`` cadence shape), the idempotent
+compute-from-parts programs (compute never mutates state — leftover staged
+rows fold into a *temporary* histogram inside the compute program), and a
+mixin for value-sketch metrics (``HitRate`` / ``ReciprocalRank`` / ``Cat``).
+
+State registered by this module is deliberately plain — int32 SUM count
+arrays plus an int32 SUM NaN lane — so approx metrics ride ``merge_state``
+(bucket add = exact merge), the two-round sync wire (SUM lanes, which the
+ISSUE 12/13 codecs narrow- or bucket-encode), ``resilience.snapshot`` and
+the serve evict/reattach machinery with zero new protocol.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# NOTE: torcheval_tpu.metrics.state is imported lazily inside the state
+# registration methods below — the metric modules import this package at
+# module level (Cat/HitRate/... need the mixins), so a module-level import
+# of anything under torcheval_tpu.metrics here would be circular whenever
+# the sketch package loads first.
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.sketch.buckets import (
+    DEFAULT_BUCKET_BITS,
+    check_bucket_bits,
+)
+from torcheval_tpu.sketch.histogram import (
+    auprc_from_hist,
+    auroc_from_hist,
+    counts_exactness_flag,
+    mc_score_hist_fold,
+    prc_points_from_hist,
+    score_hist_fold,
+    value_hist_fold,
+)
+
+# staging-cache fold cadence: updates append host-side (zero dispatch) and
+# one fold program runs per this many rows, so resident memory is bounded by
+# O(buckets) + O(SKETCH_FOLD_ROWS) regardless of stream length
+SKETCH_FOLD_ROWS = 65536
+
+_APPROX_ENV = "TORCHEVAL_TPU_APPROX"
+
+
+def resolve_approx(
+    approx, *, default_bits: int = DEFAULT_BUCKET_BITS
+) -> Optional[int]:
+    """Resolve the ``approx=`` knob to ``bucket_bits`` (or ``None`` = exact).
+
+    ``None`` defers to ``TORCHEVAL_TPU_APPROX`` (``0``/unset = off, ``1`` =
+    on with the family default, an integer = bucket count); ``False`` forces
+    exact even with the env set; ``True`` = family default; an int is the
+    bucket count (a power of two — the bucket id is a bit prefix)."""
+    if approx is None:
+        env = os.environ.get(_APPROX_ENV, "0").strip().lower()
+        if env in ("", "0", "false", "off"):
+            return None
+        if env in ("1", "true", "on"):
+            return default_bits
+        try:
+            approx = int(env)
+        except ValueError:
+            raise ValueError(
+                f"{_APPROX_ENV} must be 0/1/true/false or a bucket count, "
+                f"got {env!r}."
+            ) from None
+    if approx is False:
+        return None
+    if approx is True:
+        return default_bits
+    count = int(approx)
+    bits = count.bit_length() - 1
+    if count <= 0 or (1 << bits) != count:
+        raise ValueError(
+            f"approx bucket count must be a power of two, got {count}."
+        )
+    return check_bucket_bits(bits)
+
+
+def _count_fold(kind: str, rows: int) -> None:
+    if _obs.enabled():
+        _obs.counter("sketch.folds", kind=kind)
+        _obs.counter("sketch.folded_rows", rows, kind=kind)
+
+
+# ------------------------------------------------- jitted fold/compute parts
+# All staged caches arrive as lists (jit retraces per list length, the same
+# bounded-signature regime as the compaction programs in
+# classification/auroc.py). ``bits`` (and ``num_classes``) are static.
+@partial(jax.jit, static_argnums=5)
+def score_fold_parts(raw_s, raw_t, tp, fp, nan_acc, bits):
+    """Fold staged binary batches into the resident ``(tp, fp)`` sketch."""
+    dtp, dfp, nan = score_hist_fold(
+        jnp.concatenate(raw_s), jnp.concatenate(raw_t), bits
+    )
+    return tp + dtp, fp + dfp, nan_acc + nan
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def mc_score_fold_parts(raw_s, raw_t, tp, fp, nan_acc, bits, num_classes):
+    dtp, dfp, nan = mc_score_hist_fold(
+        jnp.concatenate(raw_s, axis=0),
+        jnp.concatenate(raw_t),
+        bits,
+        num_classes,
+    )
+    return tp + dtp, fp + dfp, nan_acc + nan
+
+
+@partial(jax.jit, static_argnums=3)
+def value_fold_parts(cache, counts, nan_acc, bits):
+    """Fold staged value batches into the resident count sketch."""
+    dc, nan = value_hist_fold(
+        jnp.concatenate([c.reshape(-1) for c in cache]), bits
+    )
+    return counts + dc, nan_acc + nan
+
+
+def _folded_score_parts(raw_s, raw_t, tp, fp, nan_acc, bits):
+    """Traced helper: resident sketch plus any staged leftovers, WITHOUT
+    mutating state (compute-path use)."""
+    if raw_s:
+        return score_fold_parts(raw_s, raw_t, tp, fp, nan_acc, bits)
+    return tp, fp, nan_acc
+
+
+@partial(jax.jit, static_argnums=5)
+def sketch_auroc_from_parts(raw_s, raw_t, tp, fp, nan_acc, bits):
+    tp, fp, nan = _folded_score_parts(raw_s, raw_t, tp, fp, nan_acc, bits)
+    return auroc_from_hist(tp, fp, bits), nan, counts_exactness_flag(tp, fp)
+
+
+@partial(jax.jit, static_argnums=5)
+def sketch_auprc_from_parts(raw_s, raw_t, tp, fp, nan_acc, bits):
+    tp, fp, nan = _folded_score_parts(raw_s, raw_t, tp, fp, nan_acc, bits)
+    return auprc_from_hist(tp, fp, bits), nan, counts_exactness_flag(tp, fp)
+
+
+@partial(jax.jit, static_argnums=5)
+def sketch_prc_from_parts(raw_s, raw_t, tp, fp, nan_acc, bits):
+    tp, fp, nan = _folded_score_parts(raw_s, raw_t, tp, fp, nan_acc, bits)
+    precision, recall, nonempty = prc_points_from_hist(tp, fp)
+    return precision, recall, nonempty, nan, counts_exactness_flag(tp, fp)
+
+
+def _folded_mc_parts(raw_s, raw_t, tp, fp, nan_acc, bits, num_classes):
+    if raw_s:
+        return mc_score_fold_parts(
+            raw_s, raw_t, tp, fp, nan_acc, bits, num_classes
+        )
+    return tp, fp, nan_acc
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def sketch_mc_auroc_from_parts(raw_s, raw_t, tp, fp, nan_acc, bits, num_classes):
+    tp, fp, nan = _folded_mc_parts(
+        raw_s, raw_t, tp, fp, nan_acc, bits, num_classes
+    )
+    per_class = jax.vmap(lambda a, b: auroc_from_hist(a, b, bits))(tp, fp)
+    return per_class, nan, counts_exactness_flag(tp, fp)
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def sketch_mc_auprc_from_parts(raw_s, raw_t, tp, fp, nan_acc, bits, num_classes):
+    tp, fp, nan = _folded_mc_parts(
+        raw_s, raw_t, tp, fp, nan_acc, bits, num_classes
+    )
+    per_class = jax.vmap(lambda a, b: auprc_from_hist(a, b, bits))(tp, fp)
+    return per_class, nan, counts_exactness_flag(tp, fp)
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def sketch_mc_prc_from_parts(raw_s, raw_t, tp, fp, nan_acc, bits, num_classes):
+    tp, fp, nan = _folded_mc_parts(
+        raw_s, raw_t, tp, fp, nan_acc, bits, num_classes
+    )
+    precision, recall, nonempty = jax.vmap(prc_points_from_hist)(tp, fp)
+    return precision, recall, nonempty, nan, counts_exactness_flag(tp, fp)
+
+
+@partial(jax.jit, static_argnums=3)
+def value_counts_from_parts(cache, counts, nan_acc, bits):
+    if cache:
+        counts, nan_acc = value_fold_parts(cache, counts, nan_acc, bits)
+    return counts, nan_acc, counts_exactness_flag(counts)
+
+
+# ---------------------------------------------------- shared loud failures
+def raise_sketch_nan(nan, noun: str = "value(s)") -> None:
+    """The ONE definition of the loud-NaN contract (review finding: three
+    verbatim copies drifted-in-waiting). One int32 scalar host read."""
+    dropped = int(nan)
+    if dropped:
+        raise ValueError(
+            f"{dropped} {noun} with NaN scores reached the sketch; NaN "
+            "has no order and cannot be bucketed (the exact kernels "
+            "would count them). Filter NaNs before update() or use "
+            "approx=False."
+        )
+
+
+def raise_sketch_overflow(flag) -> None:
+    """Raise when :func:`histogram.counts_exactness_flag` tripped: the
+    stream outgrew the int32-exact range (>= ~2.1e9 total counts, or a
+    wrapped bucket) and curve/quantile computes would silently wrap their
+    cumulative sums. Failing closed here is the unbounded-stream mode's
+    exactness edge — shard the stream across replicas (merge is exact)
+    before any single sketch accumulates 2^31 samples."""
+    if bool(flag):
+        raise ValueError(
+            "sketch count state exceeded the int32-exact range (~2.1e9 "
+            "total samples per sketch, or a wrapped bucket): curve and "
+            "quantile computes would silently wrap. Reset or split the "
+            "stream across replicas (sketch merges are exact) before a "
+            "single sketch accumulates 2^31 samples."
+        )
+
+
+# ---------------------------------------------- shared state registration
+def register_score_sketch_states(metric, bits: int, num_classes) -> None:
+    """The ONE definition of the resident score-sketch state schema
+    (names, shapes, dtype, reduction) — used by the PRC/value mixins AND
+    the compacting curve lifecycle so the schemas can never diverge."""
+    from torcheval_tpu.metrics.state import Reduction, zeros_state
+
+    shape = (1 << bits,) if num_classes is None else (num_classes, 1 << bits)
+    metric._add_state(
+        "sketch_tp",
+        zeros_state(shape, dtype=jnp.int32),
+        reduction=Reduction.SUM,
+    )
+    metric._add_state(
+        "sketch_fp",
+        zeros_state(shape, dtype=jnp.int32),
+        reduction=Reduction.SUM,
+    )
+    metric._add_state(
+        "sketch_nan_dropped",
+        zeros_state((), dtype=jnp.int32),
+        reduction=Reduction.SUM,
+    )
+
+
+def merge_score_sketch_states(metric, others) -> None:
+    """Bucket-add other replicas' resident score sketches into
+    ``metric`` (the exact merge; staged rows travel via the cache
+    merge)."""
+    for other in others:
+        metric.sketch_tp = metric.sketch_tp + jax.device_put(
+            other.sketch_tp, metric.device
+        )
+        metric.sketch_fp = metric.sketch_fp + jax.device_put(
+            other.sketch_fp, metric.device
+        )
+        metric.sketch_nan_dropped = (
+            metric.sketch_nan_dropped
+            + jax.device_put(other.sketch_nan_dropped, metric.device)
+        )
+
+
+# ------------------------------------------------------- score-sketch mixin
+class ScoreSketchCacheMixin:
+    """Approx mode for (score, target) cache metrics that do NOT carry the
+    exact-summary compaction lifecycle (the PRC curve classes): the raw
+    ``inputs``/``targets`` caches become a staging buffer folded into
+    resident ``(tp, fp)`` bucket histograms every :data:`SKETCH_FOLD_ROWS`
+    rows. The compacting curve metrics (``classification/auroc.py``) carry
+    an integrated branch instead — their fold cadence is the existing
+    ``compaction_threshold`` machinery — but share these jitted fold
+    programs, so the math has one definition."""
+
+    _sketch_bits: Optional[int] = None
+
+    def _init_score_sketch(
+        self, bits: int, *, num_classes: Optional[int] = None
+    ) -> None:
+        self._sketch_bits = bits
+        self._sketch_classes = num_classes
+        self._sketch_staged = 0
+        register_score_sketch_states(self, bits, num_classes)
+
+    def _sketch_enabled(self) -> bool:
+        return self._sketch_bits is not None
+
+    def _score_sketch_stage(self, n_rows: int) -> None:
+        self._sketch_staged += n_rows
+        if self._sketch_staged >= SKETCH_FOLD_ROWS:
+            self._score_sketch_fold()
+
+    def _score_sketch_fold(self) -> None:
+        if self.inputs:
+            if self._sketch_classes is None:
+                tp, fp, nan = score_fold_parts(
+                    self.inputs,
+                    self.targets,
+                    self.sketch_tp,
+                    self.sketch_fp,
+                    self.sketch_nan_dropped,
+                    self._sketch_bits,
+                )
+                _count_fold("score", self._sketch_staged)
+            else:
+                tp, fp, nan = mc_score_fold_parts(
+                    self.inputs,
+                    self.targets,
+                    self.sketch_tp,
+                    self.sketch_fp,
+                    self.sketch_nan_dropped,
+                    self._sketch_bits,
+                    self._sketch_classes,
+                )
+                _count_fold("mc_score", self._sketch_staged)
+            self.inputs = []
+            self.targets = []
+            self.sketch_tp = tp
+            self.sketch_fp = fp
+            self.sketch_nan_dropped = nan
+        self._sketch_staged = 0
+
+    def _score_sketch_parts(self):
+        """Positional args for the ``sketch_*_from_parts`` compute programs
+        (state untouched — staged leftovers fold inside the program)."""
+        return (
+            list(self.inputs),
+            list(self.targets),
+            self.sketch_tp,
+            self.sketch_fp,
+            self.sketch_nan_dropped,
+        )
+
+    def _sketch_check_nan(self, nan, noun: str = "sample(s)") -> None:
+        raise_sketch_nan(nan, noun)
+
+    def _score_sketch_recount(self) -> None:
+        self._sketch_staged = sum(int(a.shape[0]) for a in self.inputs)
+        if self._sketch_staged >= SKETCH_FOLD_ROWS:
+            self._score_sketch_fold()
+
+    def _sketch_merge_from(self, metrics) -> None:
+        merge_score_sketch_states(self, metrics)
+
+    # ------------------------------------------- cooperative lifecycle hooks
+    def _prepare_for_merge_state(self) -> None:
+        if self._sketch_enabled():
+            self._score_sketch_fold()
+        super()._prepare_for_merge_state()
+
+    def merge_state(self, metrics):
+        metrics = list(metrics)
+        super().merge_state(metrics)
+        if self._sketch_enabled():
+            self._sketch_merge_from(metrics)
+            self._score_sketch_recount()
+        return self
+
+    def reset(self):
+        super().reset()
+        if self._sketch_enabled():
+            self._sketch_staged = 0
+        return self
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        super().load_state_dict(state_dict, strict)
+        if self._sketch_enabled():
+            self._score_sketch_recount()
+
+
+# ------------------------------------------------------- value-sketch mixin
+class ValueSketchCacheMixin:
+    """Approx mode for value-cache metrics (``HitRate``/``ReciprocalRank``/
+    ``Cat``): the per-sample cache becomes a staging buffer folded into a
+    resident bucket-count sketch every :data:`SKETCH_FOLD_ROWS` rows.
+
+    Cooperative overrides (``merge_state`` / ``_prepare_for_merge_state`` /
+    ``reset`` / ``load_state_dict``) keep the base ``SampleCacheMetric``
+    protocol; metrics with bespoke merges (``Cat``) call the granular
+    ``_sketch_*`` helpers instead."""
+
+    _sketch_bits: Optional[int] = None
+
+    def _init_value_sketch(self, bits: int, cache_name: str) -> None:
+        from torcheval_tpu.metrics.state import Reduction, zeros_state
+
+        self._sketch_bits = bits
+        self._sketch_cache_name = cache_name
+        self._sketch_staged = 0
+        self._add_state(
+            "sketch_counts",
+            zeros_state((1 << bits,), dtype=jnp.int32),
+            reduction=Reduction.SUM,
+        )
+        self._add_state(
+            "sketch_nan_dropped",
+            zeros_state((), dtype=jnp.int32),
+            reduction=Reduction.SUM,
+        )
+
+    def _sketch_enabled(self) -> bool:
+        return self._sketch_bits is not None
+
+    def _sketch_stage(self, arr) -> None:
+        """Account freshly-appended staging rows; fold at the cadence."""
+        self._sketch_staged += int(arr.size) if arr.ndim else 1
+        if self._sketch_staged >= SKETCH_FOLD_ROWS:
+            self._sketch_fold()
+
+    def _sketch_fold(self) -> None:
+        cache = getattr(self, self._sketch_cache_name)
+        if cache:
+            counts, nan = value_fold_parts(
+                list(cache),
+                self.sketch_counts,
+                self.sketch_nan_dropped,
+                self._sketch_bits,
+            )
+            _count_fold("value", self._sketch_staged)
+            setattr(self, self._sketch_cache_name, [])
+            self.sketch_counts = counts
+            self.sketch_nan_dropped = nan
+        self._sketch_staged = 0
+
+    def _sketch_counts_parts(self):
+        """``(counts, nan, overflow_flag)`` including staged leftovers,
+        without mutating state (idempotent-compute contract)."""
+        cache = getattr(self, self._sketch_cache_name)
+        return value_counts_from_parts(
+            list(cache),
+            self.sketch_counts,
+            self.sketch_nan_dropped,
+            self._sketch_bits,
+        )
+
+    def _sketch_check_nan(self, nan) -> None:
+        raise_sketch_nan(nan)
+
+    def _sketch_recount(self) -> None:
+        cache = getattr(self, self._sketch_cache_name)
+        self._sketch_staged = sum(int(a.size) for a in cache)
+        if self._sketch_staged >= SKETCH_FOLD_ROWS:
+            self._sketch_fold()
+
+    def _sketch_merge_from(self, metrics) -> None:
+        """Bucket-add other replicas' resident sketches (their staged rows
+        arrive through the base cache merge; the follow-up recount folds
+        when over the cadence)."""
+        for metric in metrics:
+            self.sketch_counts = self.sketch_counts + jax.device_put(
+                metric.sketch_counts, self.device
+            )
+            self.sketch_nan_dropped = (
+                self.sketch_nan_dropped
+                + jax.device_put(metric.sketch_nan_dropped, self.device)
+            )
+
+    # ------------------------------------------- cooperative lifecycle hooks
+    def _prepare_for_merge_state(self) -> None:
+        if self._sketch_enabled():
+            # sync ships the bounded sketch, never the staging rows
+            self._sketch_fold()
+        super()._prepare_for_merge_state()
+
+    def merge_state(self, metrics):
+        metrics = list(metrics)
+        super().merge_state(metrics)
+        if self._sketch_enabled():
+            self._sketch_merge_from(metrics)
+            self._sketch_recount()
+        return self
+
+    def reset(self):
+        super().reset()
+        if self._sketch_enabled():
+            self._sketch_staged = 0
+        return self
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        super().load_state_dict(state_dict, strict)
+        if self._sketch_enabled():
+            self._sketch_recount()

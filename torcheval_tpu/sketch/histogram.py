@@ -1,0 +1,311 @@
+"""Mergeable bounded-memory histogram sketches over float-prefix buckets.
+
+Two sketch shapes, both *plain state trees* (int32 count arrays + an int32
+NaN lane) so they ride the existing deferred window-step, ``merge_state``,
+the two-round sync wire (``Reduction.SUM`` lanes — psum/bucket-add is the
+exact merge) and atomic checkpoints with no new machinery:
+
+* **score sketch** — per-bucket ``(tp, fp)`` counts for curve metrics
+  (AUROC / AUPRC / PRC). ``(B,)`` for binary, ``(C, B)`` one-vs-all for
+  multiclass. Compute feeds the counts straight into the existing
+  presorted counts kernels (``ops/curves.py``) with the bucket
+  representatives as thresholds — within-bucket samples become one tie
+  group, which is the *entire* approximation; cross-bucket order is exact.
+* **value sketch** — per-bucket counts of a value multiset for quantile /
+  mean / distribution queries (``Quantile``, approx ``HitRate`` /
+  ``ReciprocalRank`` / ``Cat``).
+
+Error accounting (the documented, tested bounds — all computable a
+posteriori from the sketch itself, so tests assert against the *actual*
+stream, not a model of it):
+
+* AUROC: binning can only re-score positive-negative pairs that share a
+  bucket, each by at most 1/2 (they become trapezoid ties) —
+  ``|approx - exact| <= 0.5 * sum_b tp_b * fp_b / (P * N)``
+  (:func:`auroc_error_bound`). Exact score ties were ties already, so
+  adversarial all-tied streams cost *zero* error.
+* AUPRC: both the exact and the binned step integral assign the ``i``-th
+  positive of a bucket a precision between the bucket's negatives-last and
+  negatives-first extremes; the bound sums those envelopes
+  (:func:`auprc_error_bound`).
+* quantiles / representatives: ``buckets.relative_error(bucket_bits)``
+  relative to the true order statistic (rank resolution is exact — counts
+  are integers).
+
+NaN policy: NaN elements are masked out of every histogram and counted into
+the fold's NaN lane; metric callers raise at ``compute()`` (the
+``_CompactingCacheLifecycle`` loud-NaN contract) unless they opt into
+``nan_policy="ignore"``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.obs.recompile import watched_jit
+from torcheval_tpu.ops.curves import (
+    binary_auprc_counts_presorted_kernel,
+    binary_auroc_counts_presorted_kernel,
+)
+from torcheval_tpu.sketch.buckets import (
+    bucket_index,
+    bucket_representatives,
+    check_bucket_bits,
+)
+
+__all__ = [
+    "score_hist_fold",
+    "mc_score_hist_fold",
+    "value_hist_fold",
+    "auroc_from_hist",
+    "auprc_from_hist",
+    "prc_from_hist",
+    "mean_from_counts",
+    "quantiles_from_counts",
+    "auroc_error_bound",
+    "auprc_error_bound",
+]
+
+
+# ------------------------------------------------------------------ folds
+def score_hist_fold(
+    scores: jax.Array, targets: jax.Array, bucket_bits: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fold a ``(N,)`` binary score/target batch into ``(B,)`` per-bucket
+    ``(tp, fp)`` int32 counts plus the batch's NaN-sample count. Pure and
+    additive: folding chunks in any grouping and adding the results is
+    bit-identical to one fold of the concatenated stream (integer adds)."""
+    nan = jnp.isnan(scores.astype(jnp.float32))
+    t = jnp.where(nan, 0, targets.astype(jnp.int32))
+    f = jnp.where(nan, 0, 1 - targets.astype(jnp.int32))
+    idx = jnp.where(nan, 0, bucket_index(scores, bucket_bits))
+    num = 1 << check_bucket_bits(bucket_bits)
+    tp = jax.ops.segment_sum(t, idx, num_segments=num)
+    fp = jax.ops.segment_sum(f, idx, num_segments=num)
+    return tp, fp, jnp.sum(nan.astype(jnp.int32))
+
+
+def mc_score_hist_fold(
+    scores: jax.Array, labels: jax.Array, bucket_bits: int, num_classes: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One-vs-all fold of an ``(N, C)`` score block + ``(N,)`` integer
+    labels into ``(C, B)`` per-class ``(tp, fp)`` counts plus the NaN
+    per-class score-entry count (one bad row contributes up to C — the
+    multiclass NaN-noun convention of ``classification/auroc.py``)."""
+    onehot = (
+        labels[None, :].astype(jnp.int32)
+        == jnp.arange(num_classes, dtype=jnp.int32)[:, None]
+    ).astype(jnp.int32)  # (C, N)
+    cols = scores.T  # (C, N)
+    nan = jnp.isnan(cols.astype(jnp.float32))
+    t = jnp.where(nan, 0, onehot)
+    f = jnp.where(nan, 0, 1 - onehot)
+    idx = jnp.where(nan, 0, bucket_index(cols, bucket_bits))
+    num = 1 << check_bucket_bits(bucket_bits)
+    seg = jax.vmap(
+        lambda v, i: jax.ops.segment_sum(v, i, num_segments=num)
+    )
+    return seg(t, idx), seg(f, idx), jnp.sum(nan.astype(jnp.int32))
+
+
+def value_hist_fold(
+    values: jax.Array, bucket_bits: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Fold a value batch (any shape — flattened) into ``(B,)`` int32
+    bucket counts plus the batch's NaN count."""
+    flat = values.reshape(-1)
+    nan = jnp.isnan(flat.astype(jnp.float32))
+    idx = jnp.where(nan, 0, bucket_index(flat, bucket_bits))
+    counts = jax.ops.segment_sum(
+        jnp.where(nan, 0, 1),
+        idx,
+        num_segments=1 << check_bucket_bits(bucket_bits),
+    )
+    return counts, jnp.sum(nan.astype(jnp.int32))
+
+
+# --------------------------------------------------------------- computes
+def _desc_reps(bucket_bits: int) -> jnp.ndarray:
+    """Representatives in descending-threshold order (reversed bucket ids)
+    — the presorted counts kernels' row order. Embedded as a constant."""
+    return jnp.asarray(bucket_representatives(bucket_bits)[::-1])
+
+
+def auroc_from_hist(
+    tp: jax.Array, fp: jax.Array, bucket_bits: int
+) -> jax.Array:
+    """AUROC from a ``(B,)`` score sketch: the buckets are already unique
+    descending thresholds once reversed, so the sort-free presorted kernel
+    applies directly (zero-count buckets add zero-width segments; its
+    score column is unused beyond shape, so NaN-region representatives are
+    inert padding by the kernel contract)."""
+    return binary_auroc_counts_presorted_kernel(
+        _desc_reps(bucket_bits), tp[::-1], fp[::-1]
+    )
+
+
+def auprc_from_hist(
+    tp: jax.Array, fp: jax.Array, bucket_bits: int
+) -> jax.Array:
+    """Average precision from a ``(B,)`` score sketch (see
+    :func:`auroc_from_hist`)."""
+    return binary_auprc_counts_presorted_kernel(
+        _desc_reps(bucket_bits), tp[::-1], fp[::-1]
+    )
+
+
+def prc_points_from_hist(
+    tp: jax.Array, fp: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full-length ``(precision, recall, nonempty)`` rows in descending
+    threshold order from a ``(B,)`` score sketch — static shapes for jit;
+    the host API (:func:`prc_from_hist`) trims empty buckets."""
+    ctp = jnp.cumsum(tp[::-1].astype(jnp.int32), dtype=jnp.int32)
+    cfp = jnp.cumsum(fp[::-1].astype(jnp.int32), dtype=jnp.int32)
+    tpf = ctp.astype(jnp.float32)
+    fpf = cfp.astype(jnp.float32)
+    precision = tpf / jnp.maximum(tpf + fpf, 1.0)
+    total_pos = tpf[-1]
+    recall = jnp.where(
+        total_pos > 0, tpf / jnp.maximum(total_pos, 1.0), 1.0
+    )
+    nonempty = (tp + fp)[::-1] > 0
+    return precision, recall, nonempty
+
+
+# module-level program (one jit cache per shape + recompile accounting);
+# a per-call jax.jit wrapper would retrace every invocation invisibly
+_prc_points_program = watched_jit(
+    prc_points_from_hist, name="sketch.prc_points"
+)
+
+
+def trim_hist_curve(
+    precision, recall, nonempty, bucket_bits: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Host-side trim of :func:`prc_points_from_hist` output (full-length
+    DESCENDING-threshold rows) to the reference curve layout: nonempty
+    buckets only, ascending thresholds (the bucket representatives), the
+    ``(precision=1, recall=0)`` graph origin appended. The one shared
+    definition — both the functional :func:`prc_from_hist` and the approx
+    PRC metric classes call it."""
+    keep = np.asarray(nonempty)
+    p = np.asarray(precision)[keep][::-1]
+    r = np.asarray(recall)[keep][::-1]
+    t = bucket_representatives(bucket_bits)[::-1][keep][::-1]
+    p = np.concatenate([p, np.ones(1, dtype=p.dtype)])
+    r = np.concatenate([r, np.zeros(1, dtype=r.dtype)])
+    return jnp.asarray(p), jnp.asarray(r), jnp.asarray(t)
+
+
+def prc_from_hist(
+    tp, fp, bucket_bits: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Reference-layout ``(precision, recall, thresholds)`` from a ``(B,)``
+    score sketch: one point per NONEMPTY bucket, ascending thresholds, the
+    ``(precision=1, recall=0)`` origin appended — the approximate analogue
+    of ``functional...binary_precision_recall_curve`` with bucket
+    representatives as thresholds (host-side trim, like the exact API)."""
+    precision, recall, nonempty = _prc_points_program(
+        jnp.asarray(tp), jnp.asarray(fp)
+    )
+    return trim_hist_curve(precision, recall, nonempty, bucket_bits)
+
+
+def mean_from_counts(counts: jax.Array, bucket_bits: int) -> jax.Array:
+    """Representative-weighted mean of a value sketch — within
+    ``relative_error(bucket_bits)`` of the exact mean of ``|values|`` mass.
+    Empty sketch returns 0.0 (the empty-cache mean convention)."""
+    reps = jnp.asarray(bucket_representatives(bucket_bits))
+    c = counts.astype(jnp.float32)
+    # zero-count NaN-region buckets must not poison the sum (0 * NaN)
+    weighted = jnp.where(counts > 0, c * reps, 0.0)
+    n = jnp.sum(c)
+    return jnp.where(n > 0, jnp.sum(weighted) / jnp.maximum(n, 1.0), 0.0)
+
+
+def quantiles_from_counts(
+    counts: jax.Array, q: Tuple[float, ...], bucket_bits: int
+) -> jax.Array:
+    """Quantile estimates from a value sketch: for each ``q`` the bucket
+    holding the order statistic of (1-indexed) rank ``ceil(q * n)`` — the
+    DDSketch convention (``inverted_cdf``) — whose representative is within
+    ``relative_error(bucket_bits)`` of the true order statistic. Rank
+    resolution is exact up to f32 rank arithmetic (~2^24; beyond that the
+    rank may slip by a few ulps of ``q * n``, never the value bound).
+    Returns NaN per quantile on an empty sketch. jit-safe (static ``q``),
+    so it rides the window-step as a terminal compute."""
+    reps = jnp.asarray(bucket_representatives(bucket_bits))
+    cum = jnp.cumsum(counts.astype(jnp.int32), dtype=jnp.int32)
+    n = cum[-1]
+    qs = jnp.asarray(q, dtype=jnp.float32)
+    rank = jnp.clip(
+        jnp.ceil(qs * n.astype(jnp.float32)).astype(jnp.int32), 1, n
+    )
+    idx = jnp.searchsorted(cum, rank, side="left")
+    vals = reps[jnp.clip(idx, 0, reps.shape[0] - 1)]
+    return jnp.where(n > 0, vals, jnp.float32(jnp.nan))
+
+
+def counts_exactness_flag(*arrays) -> jax.Array:
+    """Traced guard: True when int32 count state can no longer be trusted
+    — a bucket went negative (per-bucket add wrapped) or a cumulative sum
+    would wrap. The compute-side cumsums run along the BUCKET axis, one
+    per count array per leading index (per class for ``(C, B)`` state),
+    so the bound is the worst PER-CUMSUM total — summing across classes
+    would trip ~C times too early (review finding; a 1000-class stream is
+    exact until ~2.1e9 samples PER CLASS). Totals are measured in f32 —
+    exact enough for a threshold, no x64 dependency — against a slightly
+    conservative bound (``2^31·(1 - 2^-7)``) absorbing the f32 rounding.
+    Callers raise a loud error instead of returning silently wrapped
+    curve values: the unbounded-stream mode fails closed at its
+    exactness edge."""
+    neg = jnp.asarray(False)
+    worst = jnp.float32(0.0)
+    for c in arrays:
+        neg = neg | (jnp.min(c) < 0)
+        worst = jnp.maximum(
+            worst, jnp.max(jnp.sum(c.astype(jnp.float32), axis=-1))
+        )
+    return neg | (worst >= jnp.float32(2.0**31 * (1.0 - 2.0**-7)))
+
+
+# ----------------------------------------------------------- error bounds
+def auroc_error_bound(tp, fp) -> float:
+    """A-posteriori bound on ``|approx AUROC - exact AUROC|`` for the
+    stream this ``(B,)`` sketch summarizes: every cross-label pair that
+    shares a bucket moves by at most 1/2 concordance (it becomes a
+    trapezoid tie); cross-bucket pairs are untouched. Float64 host math."""
+    tp = np.asarray(tp, dtype=np.float64)
+    fp = np.asarray(fp, dtype=np.float64)
+    pos, neg = tp.sum(), fp.sum()
+    if pos == 0 or neg == 0:
+        return 0.0
+    return float(0.5 * np.sum(tp * fp) / (pos * neg))
+
+
+def auprc_error_bound(tp, fp) -> float:
+    """A-posteriori bound on ``|approx AP - exact AP|``: within a bucket
+    holding ``t`` positives / ``f`` negatives after cumulative ``(T0, F0)``,
+    every positive's precision — under ANY intra-bucket order, and under
+    the binned tie-group formula — lies in
+    ``[(T0+1)/(T0+1+F0+f), (T0+t)/(T0+t+F0)]``; the bound sums those
+    envelope widths weighted ``t / P``. Descending-threshold cumulative
+    counts, float64 host math."""
+    tp = np.asarray(tp, dtype=np.float64)[::-1]
+    fp = np.asarray(fp, dtype=np.float64)[::-1]
+    pos = tp.sum()
+    if pos == 0:
+        return 0.0
+    ctp = np.cumsum(tp)
+    cfp = np.cumsum(fp)
+    t0 = ctp - tp  # cumulative counts BEFORE each bucket
+    f0 = cfp - fp
+    hi = (t0 + tp) / np.maximum(t0 + tp + f0, 1.0)
+    lo = (t0 + 1.0) / (t0 + 1.0 + f0 + fp)
+    width = np.where(tp > 0, hi - lo, 0.0)
+    return float(np.sum(tp * width) / pos)

@@ -48,7 +48,10 @@ __all__ = [
     "Q8_BLOCK",
     "Q8_MIN_ELEMENTS",
     "sync_quantize_enabled",
+    "sync_quantize_mode",
     "wire_codec_default",
+    "bucket_payload_encode",
+    "bucket_payload_decode",
     "q8_parts",
     "q8_from_parts",
     "q8_encode",
@@ -75,13 +78,63 @@ _SYNC_QUANTIZE_ENV = "TORCHEVAL_TPU_SYNC_QUANTIZE"
 _WIRE_CODEC_ENV = "TORCHEVAL_TPU_WIRE_CODEC"
 
 
+# env spellings that mean "off" — mirrored from the TORCHEVAL_TPU_APPROX
+# parser so 'false'/'off' never silently ENABLE the thing they try to
+# disable (review finding); values are compared case-insensitively
+_QUANTIZE_OFF = ("0", "", "false", "off")
+
+
+def _sync_quantize_env() -> str:
+    return os.environ.get(_SYNC_QUANTIZE_ENV, "0").strip().lower()
+
+
 def sync_quantize_enabled(override: Optional[bool] = None) -> bool:
     """Resolve the metric-sync quantization knob: an explicit per-call
     ``quantize=`` wins; otherwise the ``TORCHEVAL_TPU_SYNC_QUANTIZE``
-    environment variable (``"1"`` = on); default off."""
+    environment variable — ``0``/empty/``false``/``off`` = off (any
+    case), ``1``/``true``/``on``/``bf16``/``int8`` = on, anything else
+    raises (delegated to :func:`sync_quantize_mode` so the env is
+    validated identically everywhere)."""
     if override is not None:
         return bool(override)
-    return os.environ.get(_SYNC_QUANTIZE_ENV, "0") == "1"
+    return sync_quantize_mode() is not False
+
+
+def sync_quantize_mode(override=None):
+    """The dist_curves splitter-histogram reduction mode behind the same
+    knob: ``False`` (exact int32 psum), ``"bf16"`` (half the fixed round —
+    ``quantize=True`` / env ``"1"``, the ISSUE 12 behavior) or ``"int8"``
+    (the EQuARX int8-chunked reduce-scatter/all-gather qpsum — quarter the
+    bytes at +2 small scale collectives; ``quantize="int8"`` / env
+    ``"int8"``, case-insensitive). Either lossy mode can only shift
+    splitter placement, never curve values (``ops/dist_curves.py``,
+    "Quantized exchange")."""
+    if override is not None:
+        if isinstance(override, str):
+            # strings are mode names: validate, don't alias a typo like
+            # "INT8"/"in8t" to the bf16 mode via truthiness (review
+            # finding) — the repo's knob-string convention raises
+            mode = override.strip().lower()
+            if mode not in ("bf16", "int8"):
+                raise ValueError(
+                    f'quantize mode must be "bf16" or "int8" (or a bool), '
+                    f"got {override!r}."
+                )
+            return mode
+        return "bf16" if override else False
+    env = _sync_quantize_env()
+    if env in _QUANTIZE_OFF:
+        return False
+    if env == "int8":
+        return "int8"
+    if env in ("1", "true", "on", "bf16"):
+        return "bf16"
+    # same rationale as the override path: a typo ("in8t") must not
+    # silently alias to a different lossy mode
+    raise ValueError(
+        f"{_SYNC_QUANTIZE_ENV} must be 0/1/true/false/on/off/bf16/int8, "
+        f"got {env!r}."
+    )
 
 
 def wire_codec_default() -> str:
@@ -261,3 +314,83 @@ def delta_int_decode(
         buf, dtype=f"<u{width}", count=n, offset=_NARROW_HEAD.size
     )
     return delta_int_from_parts(data, offset, dtype, shape)
+
+
+# ----------------------------------------------------------- bucket payload
+# ISSUE 13 / ROADMAP 1(c): the resident sketch state (fixed-size bucket
+# histograms — CAT's approx mode, the curve sketches, Quantile) is int32
+# counts that are typically SPARSE: a stream's score cardinality occupies a
+# small fraction of the 2^16 buckets. Min-offset narrowing alone still ships
+# every zero; this codec ships only the nonzero buckets — delta-narrowed
+# indices (sorted, so deltas are tiny) plus narrowed values — and degrades
+# per part: the index block falls back to raw u32, the value block to raw
+# dtype bytes, and the whole encoder to None when it would not shrink.
+# Decode is faithful for ANY integer array (scatter into zeros), so the
+# sync wire may offer it on every integer lane and pick the smaller of
+# narrow/bucket per entry.
+_BUCKET_HEAD = struct.Struct("<IBBI")  # nnz, idx_mode, val_mode, idx_nbytes
+_BUCKET_RAW, _BUCKET_PACKED = 0, 1
+
+
+def bucket_payload_encode(arr: np.ndarray) -> Optional[bytes]:
+    """Sparse nonzero encoding of an integer bucket-count array; ``None``
+    when it would not shrink the raw payload (dense arrays — the caller
+    then tries/keeps min-offset narrowing)."""
+    if arr.dtype.kind not in "iu" or arr.size == 0 or arr.size >= 2**32:
+        return None
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    idx = np.flatnonzero(flat)
+    if idx.size >= 2**32:
+        return None
+    # dense lower bound: the output can never beat header + 1 index byte +
+    # 1 value byte per nonzero — bail before building the real encodings
+    # (a dense lane on the sync hot path otherwise pays flatnonzero +
+    # int64 index copies + two encoders just to fail the final size check)
+    if _BUCKET_HEAD.size + 2 * idx.size >= arr.nbytes:
+        return None
+    if idx.size == 0:
+        out = _BUCKET_HEAD.pack(0, _BUCKET_RAW, _BUCKET_RAW, 0)
+        return out if len(out) < arr.nbytes else None
+    vals = flat[idx]
+    idx_enc = delta_int_encode(idx.astype(np.int64))
+    if idx_enc is not None:
+        idx_mode, idx_part = _BUCKET_PACKED, idx_enc
+    else:  # tiny nnz: the delta header does not amortize
+        idx_mode, idx_part = _BUCKET_RAW, idx.astype("<u4").tobytes()
+    val_enc = narrow_int_encode(vals)
+    if val_enc is not None:
+        val_mode, val_part = _BUCKET_PACKED, val_enc
+    else:
+        val_mode, val_part = _BUCKET_RAW, vals.tobytes()
+    out = (
+        _BUCKET_HEAD.pack(idx.size, idx_mode, val_mode, len(idx_part))
+        + idx_part
+        + val_part
+    )
+    return out if len(out) < arr.nbytes else None
+
+
+def bucket_payload_decode(
+    buf: bytes, dtype: np.dtype, shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Inverse of :func:`bucket_payload_encode`: scatter the nonzero
+    values back into a zeros array of the declared dtype/shape (widening
+    happens before any accumulation — bit-exact folds, the narrow-int
+    contract)."""
+    nnz, idx_mode, val_mode, idx_nbytes = _BUCKET_HEAD.unpack_from(buf)
+    out = np.zeros(shape, dtype=dtype).reshape(-1)
+    if nnz == 0:
+        return out.reshape(shape)
+    off = _BUCKET_HEAD.size
+    idx_buf = buf[off : off + idx_nbytes]
+    if idx_mode == _BUCKET_PACKED:
+        idx = delta_int_decode(idx_buf, np.dtype(np.int64), (nnz,))
+    else:
+        idx = np.frombuffer(idx_buf, dtype="<u4", count=nnz).astype(np.int64)
+    val_buf = buf[off + idx_nbytes :]
+    if val_mode == _BUCKET_PACKED:
+        vals = narrow_int_decode(val_buf, dtype, (nnz,))
+    else:
+        vals = np.frombuffer(val_buf, dtype=dtype, count=nnz)
+    out[idx] = vals
+    return out.reshape(shape)
