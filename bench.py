@@ -1327,6 +1327,114 @@ def config8_cluster():
             d.stop()
 
 
+def config6_retrieval():
+    """ISSUE 14: the retrieval family at extreme vocabulary — NDCG@k over
+    L=1M labels (4096 at smoke), k ∈ {10, 100}, through the streaming
+    top-k engine, plus the label-sharded leg on every local device.
+
+    The dense legs measure the single-device engine (`auto` pick: Pallas
+    VMEM streaming on TPU, partial-selection top_k on CPU) ranking +
+    relevance gather + ideal ranking per row. The sharded leg runs the SAME
+    k=10 workload with the label axis block-distributed across all local
+    devices (``sharded_label_topk`` under the fold): per-shard kernels, ONE
+    O(k·shards) candidate exchange, exact merge. ``_sharded_ratio`` is the
+    sharded/dense rate on the same run (≈1.0 at 1 device; the win is
+    *capacity* — per-device label bytes drop to ~1/shards, which the
+    ``label_bytes`` gauge row asserts whenever shards > 1: THIS is what
+    opens L ~ 10⁶–10⁸ vocabularies that cannot fit one chip)."""
+    jax = _jax()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torcheval_tpu.metrics.functional import ndcg_at_k
+
+    rows, labels = (32, 4096) if _SMOKE else (64, 1_000_000)
+    scores = jax.random.uniform(jax.random.PRNGKey(0), (rows, labels))
+    target = (
+        jax.random.uniform(jax.random.PRNGKey(1), (rows, labels)) > 0.999
+    ).astype(jnp.float32)
+    jax.block_until_ready((scores, target))
+
+    def dense_leg(k):
+        def run():
+            return _block(ndcg_at_k(scores, target, k=k))
+
+        run()  # compile outside the timed window
+        return _time(run)
+
+    rates = {}
+    for k in (10, 100):
+        leg_s = dense_leg(k)
+        rates[k] = rows / leg_s
+        _emit(f"config6_retrieval_L1M_k{k}", rows, leg_s, None, unit="rows/s")
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("label",))
+    shards = devs.size
+    sh = NamedSharding(mesh, P(None, "label"))
+    s_sh = jax.device_put(scores, sh)
+    t_sh = jax.device_put(target, sh)
+    jax.block_until_ready((s_sh, t_sh))
+
+    def sharded_run():
+        return _block(
+            ndcg_at_k(s_sh, t_sh, k=10, label_mesh=(mesh, "label"))
+        )
+
+    sharded_run()
+    sharded_s = _time(sharded_run)
+    sharded_rate = rows / sharded_s
+    _emit("config6_retrieval_L1M_sharded", rows, sharded_s, None, unit="rows/s")
+    _emit_row(
+        "config6_retrieval_L1M_sharded_ratio",
+        sharded_rate / rates[10],
+        f"x of dense k=10 at {shards} label shard(s)",
+    )
+
+    # per-device peak label-axis bytes, via the engine's cost gauges: the
+    # sharded leg must sit at ~1/shards of the dense leg's (the capacity
+    # acceptance observable). Untimed, so obs can be on.
+    from torcheval_tpu import obs as _obs_api
+    from torcheval_tpu.obs import registry as _obs_reg
+    from torcheval_tpu.ops.topk import _pick_method, sharded_label_topk, topk
+
+    was_enabled = _obs_reg._enabled
+    if not was_enabled:
+        _obs_api.enable()
+    try:
+        topk(scores, 10)
+        sharded_label_topk(s_sh, 10, mesh=mesh, label_axis="label")
+        gauges = _obs_reg.snapshot()["gauges"]
+        # read the EXACT keys these two calls just wrote (gauges are
+        # last-write-wins, so even a pre-existing entry from an earlier
+        # config's topk call now holds THIS call's value); a prefix scan
+        # could pick another path's stale gauge when obs was already on
+        dense_path = _pick_method(labels, 10, scores.dtype, "auto")
+        dense_bytes = gauges[
+            f"ops.topk.label_bytes_per_device{{path={dense_path}}}"
+        ]
+        sharded_bytes = gauges[
+            "ops.topk.label_bytes_per_device{path=sharded_label}"
+        ]
+    finally:
+        if not was_enabled:
+            _obs_api.disable()
+    ratio = sharded_bytes / dense_bytes
+    if shards > 1:
+        # RELATIVE bound: an absolute tolerance around 1/shards goes
+        # vacuous as the shard count grows (0.05 absolute at 64 shards
+        # would admit a 4x per-device-bytes regression)
+        assert abs(ratio * shards - 1.0) < 0.05, (
+            f"sharded per-device label bytes {sharded_bytes} are not "
+            f"~1/{shards} of dense {dense_bytes} (ratio {ratio})"
+        )
+    _emit_row(
+        "config6_retrieval_label_bytes_ratio",
+        ratio,
+        f"x of dense per-device label bytes (expect ~1/{shards})",
+    )
+
+
 def _measure_dispatch_floor():
     """The tunnel's per-dispatch execution cost, in seconds (see
     :func:`env_dispatch_floor` for why and how). Shared by the end-of-bench
@@ -1492,6 +1600,11 @@ _EXPECTED_ROW_PREFIXES = (
     "config5_adjacent_dispatch_floor",
     "config5_floor_normalized_dispatches",
     "config5_explicit_sync_accuracy_4proc",
+    "config6_retrieval_L1M_k10",
+    "config6_retrieval_L1M_k100",
+    "config6_retrieval_L1M_sharded",
+    "config6_retrieval_L1M_sharded_ratio",
+    "config6_retrieval_label_bytes_ratio",
     "checkpoint_overhead_save_ms",
     "checkpoint_overhead_restore_ms",
     "checkpoint_overhead_bytes",
@@ -1545,6 +1658,7 @@ def main() -> None:
         config4_topk_multilabel,
         config5_sharded_sync,
         config5_explicit_sync_4proc,
+        config6_retrieval,
         checkpoint_overhead,
         config7_serve_tenants,
         config8_cluster,

@@ -25,6 +25,7 @@ OUT = os.path.join(REPO, "docs", "api")
 MODULES = [
     "torcheval_tpu.metrics",
     "torcheval_tpu.metrics.functional",
+    "torcheval_tpu.metrics.ranking",
     "torcheval_tpu.metrics.toolkit",
     "torcheval_tpu.metrics.collection",
     "torcheval_tpu.metrics.deferred",

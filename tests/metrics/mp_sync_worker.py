@@ -42,6 +42,19 @@ def make_auroc_shard(rank: int):
     return scores, targets
 
 
+RETRIEVAL_K = 7
+RETRIEVAL_L = 512
+
+
+def make_retrieval_shard(rank: int):
+    rng = np.random.default_rng(500 + rank)
+    scores = rng.random((24, RETRIEVAL_L)).astype(np.float32)
+    targets = (rng.random((24, RETRIEVAL_L)) > 0.98).astype(np.float32)
+    if rank == 1:
+        targets[:4] = 0.0  # some invalid rows on one rank
+    return scores, targets
+
+
 def make_dict_updates(rank: int):
     # overlapping and rank-unique keys
     return [("shared", float(rank + 1)), (f"rank{rank}", 10.0 * (rank + 1))]
@@ -221,6 +234,19 @@ def main() -> None:
     results["collection_all"] = {k: _jsonable(v) for k, v in r.items()}
     r1 = sync_and_compute_collection(col, recipient_rank=1)
     results["collection_r1"] = None if r1 is None else sorted(r1)
+
+    # --- ISSUE 14: retrieval family — two scalar SUM lanes per metric, so
+    # the synced mean must be BIT-identical to folding all shards into one
+    # replica (integer valid counts; float sums add in rank order on the
+    # typed wire exactly as the parent's oracle adds them)
+    from torcheval_tpu.metrics import MAP, NDCG, RecallAtK
+
+    r_scores, r_targets = make_retrieval_shard(rank)
+    for key, cls in (("ndcg", NDCG), ("map", MAP), ("recall", RecallAtK)):
+        rm = cls(k=RETRIEVAL_K)
+        rm.update(jnp.asarray(r_scores), jnp.asarray(r_targets))
+        r = sync_and_compute(rm, recipient_rank="all")
+        results[f"retrieval_{key}_all"] = _jsonable(r)
 
     # --- windowed deque-state metric through the object lane: per-update
     # window-entry boundaries must survive the sync (each rank contributes

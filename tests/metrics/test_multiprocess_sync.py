@@ -28,10 +28,12 @@ sys.path.insert(0, _HERE)
 from mp_sync_worker import (  # noqa: E402
     AUROC_SIZES,
     NUM_CLASSES,
+    RETRIEVAL_K,
     make_acc_shard,
     make_auroc_shard,
     make_dict_updates,
     make_quant_counts,
+    make_retrieval_shard,
 )
 
 
@@ -107,6 +109,26 @@ class TestMultiprocessSync(unittest.TestCase):
         want = float((scores.argmax(1) == labels).mean())
         for res in self.results:
             self.assertAlmostEqual(res["acc_all"], want, places=6)
+
+    def test_retrieval_family_syncs_bit_identical_across_ranks(self):
+        # ISSUE 14: NDCG/MAP/Recall are two scalar SUM lanes — every rank's
+        # synced mean must be BIT-identical to every other rank's (same
+        # typed-wire reduction on every rank), and match the single-stream
+        # oracle that folds all four shards into one replica
+        from torcheval_tpu.metrics import MAP, NDCG, RecallAtK
+
+        for key, cls in (("ndcg", NDCG), ("map", MAP), ("recall", RecallAtK)):
+            values = {res[f"retrieval_{key}_all"] for res in self.results}
+            self.assertEqual(
+                len(values), 1, f"{key}: ranks disagree: {values}"
+            )
+            oracle = cls(k=RETRIEVAL_K)
+            for r in range(WORLD):
+                s, t = make_retrieval_shard(r)
+                oracle.update(s, t)
+            self.assertAlmostEqual(
+                values.pop(), float(oracle.compute()), places=5, msg=key
+            )
 
     def test_throughput_sum_counts_max_elapsed(self):
         # counts 100+200+300+400 = 1000; elapsed max = 4.0 -> 250

@@ -1,0 +1,163 @@
+"""Per-tenant ``approx`` knob (ROADMAP 4(c) / ISSUE 14 satellite): one
+attach-time switch opting a tenant's curve/cache metrics into
+bounded-memory sketch state, threaded identically through
+``daemon.attach()``, the wire attach header, and ``EvalClient.attach()``;
+unsupported specs reject with the structured
+``AdmissionError(reason="bad_metrics")`` on every path."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    HitRate,
+    MulticlassAccuracy,
+    Quantile,
+)
+from torcheval_tpu.serve import EvalClient, EvalDaemon, EvalServer
+from torcheval_tpu.serve.errors import AdmissionError
+
+RNG = np.random.default_rng(9)
+N = 4096
+SCORES = RNG.random(N).astype(np.float32)
+TARGETS = (RNG.random(N) < 0.4).astype(np.float32)
+
+
+def _oracle(approx):
+    m = BinaryAUROC(approx=approx)
+    m.update(SCORES, TARGETS)
+    return float(m.compute())
+
+
+class TestDaemonApproxKnob(unittest.TestCase):
+    def test_attach_approx_matches_constructor_approx(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach("t", {"auroc": BinaryAUROC()}, approx=4096)
+            member = h._tenant.collection.metrics["auroc"]
+            self.assertTrue(member._sketch_enabled())
+            h.submit(SCORES, TARGETS, block=True, timeout=120)
+            got = float(np.asarray(h.compute(timeout=120)["auroc"]))
+        self.assertEqual(got, _oracle(4096))
+
+    def test_non_capable_members_pass_through_beside_capable(self):
+        # mixed spec: the curve metric sketches, the counter metric (its
+        # state is already bounded) passes through untouched
+        with EvalDaemon() as daemon:
+            h = daemon.attach(
+                "t",
+                {
+                    "auroc": BinaryAUROC(),
+                    "acc": MulticlassAccuracy(num_classes=2),
+                },
+                approx=True,
+            )
+            members = h._tenant.collection.metrics
+            self.assertTrue(members["auroc"]._sketch_enabled())
+            self.assertFalse(hasattr(members["acc"], "_sketch_enabled"))
+
+    def test_value_cache_metric_switches(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach("t", {"hr": HitRate(k=3)}, approx=True)
+            self.assertTrue(
+                h._tenant.collection.metrics["hr"]._sketch_enabled()
+            )
+
+    def test_always_approx_metric_satisfies_knob(self):
+        with EvalDaemon() as daemon:
+            daemon.attach("t", {"q": Quantile(0.5)}, approx=True)
+
+    def test_no_capable_member_rejects_bad_metrics(self):
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach(
+                    "t", {"acc": MulticlassAccuracy(num_classes=2)},
+                    approx=True,
+                )
+            self.assertEqual(ctx.exception.reason, "bad_metrics")
+            # the reject is structured load-shedding, not a crash: the
+            # daemon keeps admitting
+            daemon.attach("t2", {"acc": MulticlassAccuracy(num_classes=2)})
+
+    def test_streamed_metric_rejects_bad_metrics(self):
+        streamed = BinaryAUROC()
+        streamed.update(SCORES, TARGETS)
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach("t", {"auroc": streamed}, approx=True)
+            self.assertEqual(ctx.exception.reason, "bad_metrics")
+
+    def test_fully_compacted_metric_rejects_bad_metrics(self):
+        # the sneaky already-streamed shape: a compacted curve metric has
+        # EMPTY raw caches (inputs=[] / _cached_samples=0) with every
+        # sample living in summary_* state — switching it would silently
+        # drop real data, so it must reject like the raw-cache case
+        compacted = BinaryAUROC(compaction_threshold=64)
+        compacted.update(SCORES, TARGETS)
+        compacted._compact()
+        self.assertFalse(compacted.inputs)  # the scenario premise
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach("t", {"auroc": compacted}, approx=True)
+            self.assertEqual(ctx.exception.reason, "bad_metrics")
+
+    def test_rejected_admission_leaves_members_unswitched(self):
+        # validate-then-commit: one bad member must not leave the GOOD
+        # member half-switched into a changed state schema
+        good = BinaryAUROC()
+        bad = BinaryAUROC()
+        bad.update(SCORES, TARGETS)  # already streamed → cannot switch
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError):
+                daemon.attach(
+                    "t", {"good": good, "bad": bad}, approx=True
+                )
+        self.assertFalse(good._sketch_enabled())
+        self.assertIn("summary_scores", good.state_names)
+        # and the untouched metric still attaches/serves exactly
+        good.update(SCORES, TARGETS)
+        self.assertEqual(float(good.compute()), _oracle(None))
+
+    def test_approx_false_is_a_no_op(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach("t", {"auroc": BinaryAUROC()}, approx=False)
+            self.assertFalse(
+                h._tenant.collection.metrics["auroc"]._sketch_enabled()
+            )
+
+
+class TestWireApproxKnob(unittest.TestCase):
+    def test_wire_attach_threads_approx_and_value_matches(self):
+        with EvalDaemon() as daemon:
+            server = EvalServer(daemon)
+            client = EvalClient(server.endpoint, request_timeout_s=120.0)
+            try:
+                client.attach(
+                    "w", {"auroc": ["BinaryAUROC", {}]}, approx=4096
+                )
+                client.submit("w", SCORES, TARGETS)
+                got = float(np.asarray(client.compute("w")["auroc"]))
+                self.assertEqual(got, _oracle(4096))
+            finally:
+                client.close()
+                server.close()
+
+    def test_wire_reject_decodes_as_structured_admission_error(self):
+        with EvalDaemon() as daemon:
+            server = EvalServer(daemon)
+            client = EvalClient(server.endpoint, request_timeout_s=120.0)
+            try:
+                with self.assertRaises(AdmissionError) as ctx:
+                    client.attach(
+                        "w",
+                        {"acc": ["MulticlassAccuracy", {"num_classes": 2}]},
+                        approx=True,
+                    )
+                self.assertEqual(ctx.exception.reason, "bad_metrics")
+            finally:
+                client.close()
+                server.close()
+
+
+if __name__ == "__main__":
+    unittest.main()

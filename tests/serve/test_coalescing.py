@@ -9,6 +9,16 @@ their members and however many collections wrap them. The recompile
 watchdog's per-entry signature counts make that an observable; these tests
 pin it, plus the correctness of the name↔canonical mapping and the
 control-first fallback lane.
+
+Signature counts are asserted RELATIVE to a warmed baseline, never as
+absolutes (ISSUE 14 satellite): ``recompile.reset()`` clears the watchdog's
+bookkeeping but NOT jax's compiled-program cache, so when another test file
+(e.g. ``test_wire.py``) has already compiled the same window-step signature
+in this process, the fleet's run records zero fresh traces and an absolute
+``== 1`` assertion miscounts. The baseline owner drives the exact batch
+signature once first — paying the compile iff the cache is cold — and the
+assertion is "the fleet added ZERO signatures beyond the baseline's", which
+holds under any test-file ordering.
 """
 
 import unittest
@@ -49,8 +59,19 @@ class TestProgramSharingAcrossOwners(unittest.TestCase):
             .get("distinct_signatures", 0)
         )
 
+    def _drive_baseline(self, batches):
+        """Run ONE owner through the exact batch signature under test and
+        return the signature count after it — the jit-cache-state-proof
+        baseline the fleet assertions count against (module docstring)."""
+        base = MetricCollection({"base": MulticlassAccuracy(num_classes=5)})
+        for s, l in batches:
+            base.update(s, l)
+        base.compute()
+        return self._window_step_signatures()
+
     def test_differently_named_collections_share_one_program(self):
         batches = _batches(4, seed=0)
+        baseline = self._drive_baseline(batches)
         cols = [
             MetricCollection({name: MulticlassAccuracy(num_classes=5)})
             for name in ("alpha", "beta", "gamma")
@@ -59,12 +80,13 @@ class TestProgramSharingAcrossOwners(unittest.TestCase):
             for s, l in batches:
                 col.update(s, l)
             col.compute()
-        # one close program for all three owners: the member name is not
-        # part of the compiled program's identity
-        self.assertEqual(self._window_step_signatures(), 1)
+        # zero new programs beyond the baseline owner's: the member name is
+        # not part of the compiled program's identity
+        self.assertEqual(self._window_step_signatures(), baseline)
 
     def test_100_tenants_compile_like_one(self):
         batches = _batches(3, seed=1)
+        baseline = self._drive_baseline(batches)
         with EvalDaemon(max_tenants=128) as daemon:
             handles = [
                 daemon.attach(
@@ -80,9 +102,10 @@ class TestProgramSharingAcrossOwners(unittest.TestCase):
                 for i, h in enumerate(handles)
             ]
         # every tenant computed the same stream: identical values, and the
-        # whole fleet shares ONE window-step program signature
+        # whole fleet shares the baseline's window-step program (zero new
+        # signatures for 100 tenants)
         self.assertEqual(len(set(values)), 1)
-        self.assertEqual(self._window_step_signatures(), 1)
+        self.assertEqual(self._window_step_signatures(), baseline)
 
     def test_canonical_mapping_lands_results_under_the_right_names(self):
         # two collections with the same two metric classes under SWAPPED

@@ -124,6 +124,22 @@ class TestBenchHygiene(unittest.TestCase):
                 "loses its regression pin",
             )
         for row in (
+            "config6_retrieval_L1M_k10",
+            "config6_retrieval_L1M_k100",
+            "config6_retrieval_L1M_sharded",
+            "config6_retrieval_L1M_sharded_ratio",
+            "config6_retrieval_label_bytes_ratio",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the extreme-"
+                "vocabulary retrieval contract (ISSUE 14 — the label-"
+                "sharded engine's per-device bytes must stay ~1/shards of "
+                "dense, paired with the dense k-sweep on the same run) "
+                "loses its regression pin",
+            )
+        for row in (
             "config10_sketch_accuracy_vs_exact",
             "config10_sketch_bytes_ratio",
             "config10_sketch_1b_rows",

@@ -66,6 +66,9 @@ class Quantile(DeferredFoldMixin, Metric[jax.Array]):
     _fold_fn = staticmethod(_quantile_fold)
     _fold_per_chunk = True
     _compute_fn = staticmethod(_quantile_compute)
+    # the serve per-tenant approx knob (sketch/cache.py::enable_metric_approx)
+    # treats this metric as already-satisfied: its state IS a sketch
+    _always_approx = True
 
     def __init__(
         self,

@@ -1,0 +1,289 @@
+"""Retrieval metric kernels @ k — NDCG, MAP, Recall, HitRate — over a
+``(num_samples, num_labels)`` relevance matrix (ISSUE 14).
+
+These are the extreme-vocabulary metrics (retrieval / recsys / LLM-head
+eval, L ~ 10⁶–10⁸): every kernel reduces the label axis through the
+streaming top-k engine (``ops/topk.py``), never a full-width sort, and the
+relevance gather rides the engine too. Two label-axis regimes, one math:
+
+* single-device: ``topk(...)`` picks the Pallas VMEM streaming kernel /
+  dense / prune lowering per size and backend; the relevance gather is a
+  local ``take_along_axis`` at the selected indices.
+* label-sharded (``label_mesh=(mesh, axis_name)``): the block-distributed
+  engine (``sharded_label_topk``) runs the per-shard kernel and gathers the
+  relevance INSIDE each shard, so neither the score nor the relevance
+  matrix is ever replicated — the only cross-shard traffic is the
+  O(k·shards) candidate exchange.
+
+Per-sample semantics (the numpy-oracle contract pinned in
+``tests/metrics/test_retrieval.py``):
+
+* a row is VALID when it has at least one relevant label (``target > 0``;
+  for NDCG: a positive ideal DCG). Invalid rows return NaN — the
+  ``hit_rate`` NaN-poison convention — and the class metrics exclude them
+  from the mean.
+* ``recall_at_k``: ``|top-k ∩ relevant| / |relevant|``.
+* ``map_at_k``: ``(1 / min(|relevant|, k)) · Σ_j rel_j · precision@j`` —
+  the standard truncated average precision.
+* ``ndcg_at_k``: graded relevance, linear gains, ``1/log2(rank+2)``
+  discounts; the ideal ordering is the top-k of the relevance row itself
+  (computed through the same engine, so a label-sharded relevance matrix
+  stays sharded).
+* ``retrieval_hit_rate``: 1.0 iff any relevant label ranks in the top-k.
+  For single-label (one-hot) targets and tie-free scores this agrees
+  per-sample with :func:`~torcheval_tpu.metrics.functional.hit_rate` — the
+  k-parametrized alignment the test suite pins.
+
+Tie discipline: ranks come from the engine's ``lax.top_k``-exact order
+(values descending, ties by lowest global index), so every kernel is
+deterministic and bit-stable across the dense, pallas, prune and
+label-sharded paths.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.utils.convert import as_jax
+
+
+def _retrieval_input_check(
+    input: jax.Array, target: jax.Array, k: Optional[int]
+) -> None:
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if target.shape != input.shape:
+        raise ValueError(
+            "`input` and `target` should have the same (num_samples, "
+            f"num_labels) shape, got {input.shape} and {target.shape}."
+        )
+    if k is not None and (type(k) is not int or k <= 0):
+        raise ValueError(f"k should be None or a positive int, got {k!r}.")
+
+
+def _check_label_mesh(label_mesh) -> None:
+    """Eager validation of the ``label_mesh`` knob: ``(mesh,
+    label_axis_name)`` or ``(mesh, label_axis_name, batch_axes)`` — the
+    3-tuple threads the ROW sharding through to the shard_map on
+    batch × label meshes (inside a jitted fold the operand is a tracer, so
+    the engine cannot derive it). Axis names must exist on the mesh NOW: a
+    typo must reject at construction, not as a KeyError at window close
+    after the stream was accepted."""
+    if label_mesh is None:
+        return
+    if (
+        not isinstance(label_mesh, tuple)
+        or len(label_mesh) not in (2, 3)
+        or not isinstance(label_mesh[1], str)
+    ):
+        raise ValueError(
+            "label_mesh must be a (Mesh, label_axis_name) or (Mesh, "
+            f"label_axis_name, batch_axes) tuple, got {label_mesh!r}."
+        )
+    mesh, label_axis = label_mesh[0], label_mesh[1]
+    axes = tuple(getattr(mesh, "shape", {}) or ())
+    if label_axis not in axes:
+        raise ValueError(
+            f"label_mesh names label axis {label_axis!r}, which is not an "
+            f"axis of the mesh (axes: {axes})."
+        )
+    if len(label_mesh) == 3 and label_mesh[2] is not None:
+        batch = label_mesh[2]
+        batch_axes = batch if isinstance(batch, tuple) else (batch,)
+        for a in batch_axes:
+            if a not in axes or a == label_axis:
+                raise ValueError(
+                    f"label_mesh batch axes {batch!r} must name mesh axes "
+                    f"distinct from the label axis (axes: {axes})."
+                )
+
+
+def _label_mesh_parts(label_mesh):
+    """``(mesh, label_axis, batch_axes)`` from a validated 2- or 3-tuple."""
+    mesh, axis = label_mesh[0], label_mesh[1]
+    batch = label_mesh[2] if len(label_mesh) == 3 else None
+    return mesh, axis, batch
+
+
+def _topk_rel(
+    input: jax.Array,
+    target: jax.Array,
+    k: int,
+    topk_method: str,
+    label_mesh,
+) -> jax.Array:
+    """Relevance gathered at the top-k score positions, ``(N, k)``, in the
+    engine's exact rank order."""
+    from torcheval_tpu.ops.topk import sharded_label_topk, topk
+
+    if label_mesh is not None:
+        mesh, axis, batch = _label_mesh_parts(label_mesh)
+        _v, _i, rel = sharded_label_topk(
+            input, k, mesh=mesh, label_axis=axis, batch_axes=batch,
+            method=topk_method, gather=target.astype(jnp.float32),
+        )
+        return rel
+    _v, idx = topk(input, k, method=topk_method)
+    return jnp.take_along_axis(target.astype(jnp.float32), idx, axis=1)
+
+
+def _ideal_topk(target: jax.Array, k: int, topk_method: str, label_mesh):
+    """Top-k of the relevance row itself (the ideal ordering), through the
+    same engine so a sharded relevance matrix stays sharded."""
+    from torcheval_tpu.ops.topk import sharded_label_topk, topk
+
+    t = target.astype(jnp.float32)
+    if label_mesh is not None:
+        mesh, axis, batch = _label_mesh_parts(label_mesh)
+        return sharded_label_topk(
+            t, k, mesh=mesh, label_axis=axis, batch_axes=batch,
+            method=topk_method,
+        )[0]
+    return topk(t, k, method=topk_method)[0]
+
+
+def _num_relevant(target: jax.Array) -> jax.Array:
+    """Per-row relevant-label count — a label-axis sum, which GSPMD reduces
+    with one tiny all-reduce on a sharded target (never a gather)."""
+    return jnp.sum((target > 0).astype(jnp.float32), axis=1)
+
+
+def _resolve_k(k: Optional[int], num_labels: int) -> int:
+    return num_labels if k is None else min(k, num_labels)
+
+
+_KERNEL_STATICS = ("k", "topk_method", "label_mesh")
+
+
+@partial(jax.jit, static_argnames=_KERNEL_STATICS)
+def _recall_kernel(input, target, k, topk_method, label_mesh):
+    k = _resolve_k(k, input.shape[1])
+    hits = jnp.sum(
+        (_topk_rel(input, target, k, topk_method, label_mesh) > 0).astype(
+            jnp.float32
+        ),
+        axis=1,
+    )
+    m = _num_relevant(target)
+    return jnp.where(m > 0, hits / jnp.maximum(m, 1.0), jnp.nan)
+
+
+@partial(jax.jit, static_argnames=_KERNEL_STATICS)
+def _map_kernel(input, target, k, topk_method, label_mesh):
+    k = _resolve_k(k, input.shape[1])
+    rel = (_topk_rel(input, target, k, topk_method, label_mesh) > 0).astype(
+        jnp.float32
+    )
+    prec = jnp.cumsum(rel, axis=1) / jnp.arange(1, k + 1, dtype=jnp.float32)
+    m = _num_relevant(target)
+    denom = jnp.maximum(jnp.minimum(m, float(k)), 1.0)
+    ap = jnp.sum(rel * prec, axis=1) / denom
+    return jnp.where(m > 0, ap, jnp.nan)
+
+
+@partial(jax.jit, static_argnames=_KERNEL_STATICS)
+def _ndcg_kernel(input, target, k, topk_method, label_mesh):
+    k = _resolve_k(k, input.shape[1])
+    disc = 1.0 / jnp.log2(jnp.arange(k, dtype=jnp.float32) + 2.0)
+    gains = _topk_rel(input, target, k, topk_method, label_mesh)
+    dcg = jnp.sum(gains * disc, axis=1)
+    ideal = _ideal_topk(target, k, topk_method, label_mesh)
+    # ragged ideal rows (fewer than k relevant labels): the engine returns
+    # the actual (possibly zero/negative-padded) relevance tail, which
+    # contributes nothing for the standard non-negative graded targets
+    idcg = jnp.sum(jnp.maximum(ideal, 0.0) * disc, axis=1)
+    return jnp.where(idcg > 0, dcg / jnp.where(idcg > 0, idcg, 1.0), jnp.nan)
+
+
+@partial(jax.jit, static_argnames=_KERNEL_STATICS)
+def _hit_rate_kernel(input, target, k, topk_method, label_mesh):
+    k = _resolve_k(k, input.shape[1])
+    hit = jnp.max(
+        (_topk_rel(input, target, k, topk_method, label_mesh) > 0).astype(
+            jnp.float32
+        ),
+        axis=1,
+    )
+    m = _num_relevant(target)
+    return jnp.where(m > 0, hit, jnp.nan)
+
+
+def _entry(kernel, input, target, k, topk_method, label_mesh):
+    input, target = as_jax(input), as_jax(target)
+    _retrieval_input_check(input, target, k)
+    _check_label_mesh(label_mesh)
+    return kernel(input, target, k, topk_method, label_mesh)
+
+
+def recall_at_k(
+    input,
+    target,
+    *,
+    k: Optional[int] = None,
+    topk_method: str = "auto",
+    label_mesh: Optional[Tuple] = None,
+) -> jax.Array:
+    """Per-sample Recall@k: relevant labels ranked in the top ``k`` over the
+    row's relevant-label count (NaN for rows with no relevant label).
+
+    Args:
+        input: scores/logits ``(num_samples, num_labels)``.
+        target: relevance ``(num_samples, num_labels)`` (``> 0`` = relevant).
+        k: cutoff; ``None`` (or ``k >= num_labels``) ranks every label.
+        topk_method: streaming top-k engine lowering (``ops/topk.py``).
+        label_mesh: optional ``(mesh, label_axis_name)`` — or ``(mesh,
+            label_axis_name, batch_axes)`` on batch × label meshes —
+            engaging the label-sharded engine (required inside jit, where
+            operand shardings are invisible).
+    """
+    return _entry(_recall_kernel, input, target, k, topk_method, label_mesh)
+
+
+def map_at_k(
+    input,
+    target,
+    *,
+    k: Optional[int] = None,
+    topk_method: str = "auto",
+    label_mesh: Optional[Tuple] = None,
+) -> jax.Array:
+    """Per-sample MAP@k (truncated average precision): ``(1/min(m, k)) ·
+    Σ_j rel_j · precision@j`` with ``m`` the row's relevant count (NaN for
+    rows with no relevant label). Arguments as :func:`recall_at_k`."""
+    return _entry(_map_kernel, input, target, k, topk_method, label_mesh)
+
+
+def ndcg_at_k(
+    input,
+    target,
+    *,
+    k: Optional[int] = None,
+    topk_method: str = "auto",
+    label_mesh: Optional[Tuple] = None,
+) -> jax.Array:
+    """Per-sample NDCG@k: linear graded gains, ``1/log2(rank+2)`` discounts,
+    normalized by the row's ideal (relevance-sorted) DCG@k (NaN for rows
+    whose ideal DCG is zero). Arguments as :func:`recall_at_k`."""
+    return _entry(_ndcg_kernel, input, target, k, topk_method, label_mesh)
+
+
+def retrieval_hit_rate(
+    input,
+    target,
+    *,
+    k: Optional[int] = None,
+    topk_method: str = "auto",
+    label_mesh: Optional[Tuple] = None,
+) -> jax.Array:
+    """Per-sample HitRate@k over a relevance matrix: 1.0 iff any relevant
+    label ranks in the top ``k`` (NaN for rows with no relevant label).
+    Agrees per-sample with the single-label
+    :func:`~torcheval_tpu.metrics.functional.hit_rate` on one-hot targets
+    with tie-free scores. Arguments as :func:`recall_at_k`."""
+    return _entry(_hit_rate_kernel, input, target, k, topk_method, label_mesh)

@@ -1,4 +1,7 @@
 from torcheval_tpu.metrics.ranking.hit_rate import HitRate
+from torcheval_tpu.metrics.ranking.map import MAP
+from torcheval_tpu.metrics.ranking.ndcg import NDCG
+from torcheval_tpu.metrics.ranking.recall import RecallAtK
 from torcheval_tpu.metrics.ranking.reciprocal_rank import ReciprocalRank
 
-__all__ = ["HitRate", "ReciprocalRank"]
+__all__ = ["HitRate", "MAP", "NDCG", "RecallAtK", "ReciprocalRank"]

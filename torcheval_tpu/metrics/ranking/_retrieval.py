@@ -1,0 +1,142 @@
+"""Shared deferred-fold base for the retrieval family (NDCG@k / MAP@k /
+Recall@k — ISSUE 14).
+
+Unlike the per-sample-cache ranking metrics (``HitRate``,
+``ReciprocalRank``), the retrieval metrics are MEAN metrics over valid rows:
+their state is two scalars (``score_sum`` f32 + ``num_valid`` i32, both
+``Reduction.SUM``), so
+
+* updates ride :class:`~torcheval_tpu.metrics.deferred.DeferredFoldMixin`
+  exactly like the counter families — O(1) host appends, one fused
+  window-step program per budget window, terminal compute inside the same
+  program (``_compute_fn``);
+* toolkit sync / ``merge_state`` / checkpoints need no new machinery — two
+  scalar SUM lanes on the existing typed wire;
+* memory is O(1) at any L: the label axis lives only inside the fold's
+  top-k engine call (``topk_method`` / ``label_mesh`` threaded through
+  ``_fold_params``), never in state.
+
+``label_mesh=(mesh, axis_name)`` opts the fold's engine calls into the
+label-sharded decomposition (``ops/topk.py::sharded_label_topk``) — the
+fold runs inside jit where operand shardings are invisible, so the mesh
+must be threaded explicitly. Both entries are hashable, which is what lets
+them ride the static ``_fold_params`` tuple.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.deferred import DeferredFoldMixin
+from torcheval_tpu.metrics.functional.ranking.retrieval import (
+    _check_label_mesh,
+    _retrieval_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.state import Reduction, zeros_state
+from torcheval_tpu.utils.devices import DeviceLike
+
+
+def _mean_compute(score_sum: jax.Array, num_valid: jax.Array) -> jax.Array:
+    """Mean over valid rows; NaN before the first valid row (the empty-read
+    convention of the per-sample family)."""
+    return jnp.where(
+        num_valid > 0,
+        score_sum / jnp.maximum(num_valid, 1).astype(jnp.float32),
+        jnp.nan,
+    )
+
+
+def valid_mean_deltas(per_sample: jax.Array) -> dict:
+    """One batch's ``{score_sum, num_valid}`` deltas from a NaN-poisoned
+    per-sample score vector — the shared tail of every retrieval fold fn."""
+    valid = ~jnp.isnan(per_sample)
+    return {
+        "score_sum": jnp.sum(jnp.where(valid, per_sample, 0.0)),
+        "num_valid": jnp.sum(valid.astype(jnp.int32)),
+    }
+
+
+class RetrievalMeanMetric(DeferredFoldMixin, Metric[jax.Array]):
+    """Deferred mean-over-valid-rows retrieval metric; subclasses set
+    ``_fold_fn`` (a module-level kernel returning
+    :func:`valid_mean_deltas`)."""
+
+    _fold_per_chunk = True
+    # the engine's sharded lowerings (custom_partitioning / shard_map) have
+    # no jax.vmap batching rule — multi-chunk stacked folds keep the
+    # sequential lax.scan body instead (same choice as TopKMultilabelAccuracy)
+    _fold_vmap = False
+    _compute_fn = staticmethod(_mean_compute)
+
+    def __init__(
+        self,
+        *,
+        k: Optional[int] = None,
+        topk_method: str = "auto",
+        label_mesh: Optional[Tuple] = None,
+        device: DeviceLike = None,
+    ) -> None:
+        # validate the engine knobs EAGERLY (updates defer — a typo must not
+        # surface only at compute(), after the stream was accepted)
+        from torcheval_tpu.ops.topk import _LOCAL_METHODS
+
+        if k is not None and (type(k) is not int or k <= 0):
+            raise ValueError(f"k should be None or a positive int, got {k!r}.")
+        if topk_method not in _LOCAL_METHODS:
+            raise ValueError(
+                f"topk_method must be one of {_LOCAL_METHODS}, got "
+                f"{topk_method!r}."
+            )
+        _check_label_mesh(label_mesh)
+        if label_mesh is not None and device is None:
+            # the fold's shard_map spans the whole mesh, so the window-step
+            # program's states must live there too: bind the metric
+            # mesh-replicated (scalar states — replication is 8 bytes). A
+            # caller-provided device/sharding wins when given; it must span
+            # the same device set.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            device = NamedSharding(label_mesh[0], PartitionSpec())
+        super().__init__(device=device)
+        self.k = k
+        self.topk_method = topk_method
+        self.label_mesh = label_mesh
+        self._add_state(
+            "score_sum", zeros_state((), dtype=jnp.float32),
+            reduction=Reduction.SUM,
+        )
+        self._add_state(
+            "num_valid", zeros_state((), dtype=jnp.int32),
+            reduction=Reduction.SUM,
+        )
+        self._init_deferred()
+        self._fold_params = (k, topk_method, label_mesh)
+
+    def _update_check(self, input, target) -> None:
+        # shape-only: memoised per batch signature by the _defer fast path
+        _retrieval_input_check(input, target, self.k)
+
+    def update(self, input, target):
+        self._defer(self._input(input), self._input(target))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self._deferred_compute()
+
+    def merge_state(self, metrics: Iterable["RetrievalMeanMetric"]):
+        metrics = list(metrics)
+        self._fold_now()
+        for metric in metrics:
+            metric._fold_now()
+        for metric in metrics:
+            self.score_sum = self.score_sum + jax.device_put(
+                metric.score_sum, self.device
+            )
+            self.num_valid = self.num_valid + jax.device_put(
+                metric.num_valid, self.device
+            )
+        return self

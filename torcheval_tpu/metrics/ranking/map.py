@@ -1,0 +1,30 @@
+"""MAP@k metric (ISSUE 14): mean truncated average precision over rows with
+at least one relevant label, riding the deferred window-step with scalar SUM
+state — see ``metrics/ranking/_retrieval.py`` for the shared contract and
+``functional/ranking/retrieval.py`` for the per-sample math."""
+
+from __future__ import annotations
+
+from torcheval_tpu.metrics.functional.ranking.retrieval import _map_kernel
+from torcheval_tpu.metrics.ranking._retrieval import (
+    RetrievalMeanMetric,
+    valid_mean_deltas,
+)
+
+
+def _map_fold(input, target, k, topk_method, label_mesh):
+    return valid_mean_deltas(
+        _map_kernel(input, target, k, topk_method, label_mesh)
+    )
+
+
+class MAP(RetrievalMeanMetric):
+    """Mean MAP@k: ``(1/min(m, k)) · Σ_j rel_j · precision@j`` per row
+    (``m`` = the row's relevant-label count); rows with no relevant label
+    are excluded. Constructor arguments and state as
+    :class:`~torcheval_tpu.metrics.ranking.NDCG`."""
+
+    _fold_fn = staticmethod(_map_fold)
+
+
+__all__ = ["MAP"]

@@ -1,0 +1,46 @@
+"""NDCG@k metric (ISSUE 14): mean normalized discounted cumulative gain over
+rows with a positive ideal DCG, riding the deferred window-step with scalar
+SUM state — see ``metrics/ranking/_retrieval.py`` for the shared contract
+and ``functional/ranking/retrieval.py`` for the per-sample math."""
+
+from __future__ import annotations
+
+import jax
+
+from torcheval_tpu.metrics.functional.ranking.retrieval import _ndcg_kernel
+from torcheval_tpu.metrics.ranking._retrieval import (
+    RetrievalMeanMetric,
+    valid_mean_deltas,
+)
+
+
+# module-level fold fn: shared identity keys the deferred-fold jit cache
+# across metric instances (metrics/deferred.py)
+def _ndcg_fold(input, target, k, topk_method, label_mesh):
+    return valid_mean_deltas(
+        _ndcg_kernel(input, target, k, topk_method, label_mesh)
+    )
+
+
+class NDCG(RetrievalMeanMetric):
+    """Mean NDCG@k: linear graded gains, ``1/log2(rank+2)`` discounts,
+    per-row ideal-DCG normalization; rows with zero ideal DCG are excluded.
+
+    Args:
+        k: cutoff; ``None`` ranks every label.
+        topk_method: streaming top-k engine lowering (``ops/topk.py``) for
+            both the score ranking and the ideal relevance ranking.
+        label_mesh: optional ``(mesh, label_axis_name)`` — or ``(mesh,
+            label_axis_name, batch_axes)`` to keep rows sharded on
+            batch × label meshes — the fold's engine calls run
+            label-sharded (extreme-vocabulary L; the label axis is never
+            replicated). Axis names validate eagerly at construction.
+
+    State: ``score_sum`` (f32) + ``num_valid`` (i32), both SUM — merges,
+    toolkit sync and checkpoints are exact scalar adds.
+    """
+
+    _fold_fn = staticmethod(_ndcg_fold)
+
+
+__all__ = ["NDCG"]

@@ -1,0 +1,31 @@
+"""Recall@k metric (ISSUE 14): mean top-k recall over rows with at least
+one relevant label, riding the deferred window-step with scalar SUM state —
+see ``metrics/ranking/_retrieval.py`` for the shared contract and
+``functional/ranking/retrieval.py`` for the per-sample math."""
+
+from __future__ import annotations
+
+from torcheval_tpu.metrics.functional.ranking.retrieval import _recall_kernel
+from torcheval_tpu.metrics.ranking._retrieval import (
+    RetrievalMeanMetric,
+    valid_mean_deltas,
+)
+
+
+def _recall_fold(input, target, k, topk_method, label_mesh):
+    return valid_mean_deltas(
+        _recall_kernel(input, target, k, topk_method, label_mesh)
+    )
+
+
+class RecallAtK(RetrievalMeanMetric):
+    """Mean Recall@k: ``|top-k ∩ relevant| / |relevant|`` per row; rows with
+    no relevant label are excluded. Constructor arguments and state as
+    :class:`~torcheval_tpu.metrics.ranking.NDCG`. (Named ``RecallAtK`` — the
+    classification namespace already owns ``BinaryRecall`` /
+    ``MulticlassRecall``.)"""
+
+    _fold_fn = staticmethod(_recall_fold)
+
+
+__all__ = ["RecallAtK"]

@@ -10,8 +10,10 @@ from torcheval_tpu.ops.curves import (
     prc_points_kernel,
 )
 from torcheval_tpu.ops.topk import (
+    label_sharding_of,
     pallas_topk,
     prune_topk,
+    sharded_label_topk,
     topk,
     topk_indices,
     topk_values,
@@ -22,10 +24,12 @@ __all__ = [
     "binary_auroc_kernel",
     "class_counts",
     "confusion_matrix_counts",
+    "label_sharding_of",
     "multiclass_prc_points_kernel",
     "pallas_topk",
     "prc_points_kernel",
     "prune_topk",
+    "sharded_label_topk",
     "topk",
     "topk_indices",
     "topk_onehot",

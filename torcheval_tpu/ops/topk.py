@@ -43,6 +43,15 @@ backend (:func:`_pick_method`):
 * ``dense`` — ``jax.lax.top_k`` itself, which wins for small L (the sort is
   cheap and fusion-friendly) and is the only path with defined NaN
   behaviour.
+* ``sharded_label`` — the block-distributed LABEL-axis engine
+  (:func:`sharded_label_topk`, ISSUE 14) for vocabularies that do not fit
+  one device (L ~ 10⁶–10⁸): per-shard streaming selection with global
+  indices, ONE O(k·shards) candidate all-gather, and an exact 2-key merge
+  reproducing ``lax.top_k``'s tie order bit-exactly — the label axis is
+  never replicated. Auto-engaged when the committed operand's label axis is
+  sharded; composes with the other methods (they run per shard) and with
+  batch sharding on multi-axis meshes. Cost model and diagram:
+  docs/performance.md §Label-sharded top-k.
 
 Selection thresholds (measured rationale in docs/performance.md §Streaming
 top-k): ``_DENSE_L_MAX = 1024`` — below this the full sort beats both
@@ -78,10 +87,27 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+from jax.sharding import Mesh, PartitionSpec as _P
+
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs.recompile import watched_jit
 
-_METHODS = ("auto", "dense", "prune", "pallas")
+# older shard_map's replication checker false-positives on per-shard kernels
+# (see ops/dist_curves.py) — disable it where the knob exists
+_SHARD_MAP_KWARGS = (
+    {"check_rep": False}
+    if "check_rep" in inspect.signature(_shard_map).parameters
+    else {}
+)
+
+_METHODS = ("auto", "dense", "prune", "pallas", "sharded_label")
+# local-shard lowerings the label-sharded engine accepts for its per-shard
+# selection (sharded_label composes the OTHER methods, it is not one itself)
+_LOCAL_METHODS = ("auto", "dense", "prune", "pallas")
 
 # Below this label-axis width the full-sort lax.top_k wins: the streaming
 # paths' fixed costs (tile padding, k selection passes / two-stage sort
@@ -323,6 +349,273 @@ sharded_pallas_topk.def_partition(
 )
 
 
+# ------------------------------------------------- label-sharded streaming k
+# ISSUE 14 tentpole: the engine above keeps the whole label axis resident on
+# one device, capping L at what a single chip's VMEM/HBM holds. The
+# block-distributed decomposition of *Large Scale Distributed Linear Algebra
+# With TPUs* (PAPERS.md), applied to selection instead of matmul: shard the
+# LABEL axis across a named mesh axis, run the per-shard streaming kernel on
+# each local tile producing k candidates with GLOBAL original indices (shard
+# offset added in-shard), exchange only the (k·shards) candidate pairs per
+# row with ONE small all-gather, and finish with a narrow exact 2-key merge.
+# The label axis is never replicated: per-device peak label-axis bytes are
+# N·(L/shards)·4, and the candidate exchange is O(k·shards) bytes per row.
+#
+# Tie discipline: global indices make the merge's (value desc, index asc)
+# 2-key sort reproduce ``lax.top_k``'s order bit-exactly — equal values
+# resolve to the MINIMUM global index whatever shard they came from, the PR 3
+# sentinel discipline lifted to the mesh (padding/ragged lanes carry value
+# -inf and the index sentinel, so a real -inf score always beats padding).
+
+
+def label_sharding_of(x):
+    """``(mesh, label_axis, batch_axes)`` when ``x`` is a committed array
+    whose LABEL (second) axis is sharded over exactly one mesh axis of a
+    ``NamedSharding``; ``None`` otherwise (including tracers — inside jit the
+    caller must pass the mesh explicitly). ``batch_axes`` is the row axis'
+    spec entry (a mesh axis name, a tuple of them, or ``None``)."""
+    sharding = getattr(x, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None or len(spec) < 2 or spec[1] is None:
+        return None
+    label = spec[1]
+    if isinstance(label, tuple):
+        if len(label) != 1:
+            return None  # multi-axis label sharding: not supported, stay dense
+        label = label[0]
+    if getattr(mesh, "shape", None) is None or mesh.shape.get(label, 1) < 2:
+        return None
+    batch = spec[0] if len(spec) else None
+    return mesh, label, batch
+
+
+def _local_label_topk(xs, k_local: int, method: str, interpret, mesh_platform):
+    """Per-shard selection over the local label tile — the same lowerings as
+    the single-device engine. ``auto`` resolves against the MESH's platform
+    at program-build time (``lax.platform_dependent`` cannot prune branches
+    inside shard_map, and unlike the single-device entry the mesh names its
+    devices, so the pick is exact rather than host-heuristic): the streaming
+    Pallas kernel on TPU meshes (k within the carry), the backend's fast
+    partial-selection ``top_k`` elsewhere (measured fastest on XLA:CPU —
+    see :func:`_pick_method`)."""
+    if method == "auto":
+        method = (
+            "pallas"
+            if mesh_platform == "tpu" and k_local <= _PALLAS_MAX_K
+            else "dense"
+        )
+    if method == "dense":
+        return jax.lax.top_k(xs, k_local)
+    if method == "prune":
+        return prune_topk(xs, k_local)
+    interp = (mesh_platform != "tpu") if interpret is None else interpret
+    return pallas_topk(xs, k_local, interpret=interp)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_label_program(
+    mesh: Mesh,
+    label_axis: str,
+    batch_axes,
+    k: int,
+    l_total: int,
+    method: str,
+    interpret,
+    with_gather: bool,
+):
+    """Jitted shard_map program per (mesh, label axis, k, L, method); jit
+    handles shape-based caching beneath. ``with_gather`` additionally
+    gathers a second label-wide operand (per-label relevance) at the
+    selected indices INSIDE the shard — the retrieval metrics' path, which
+    keeps the gather local so the relevance matrix is never replicated
+    either."""
+    shards = int(mesh.shape[label_axis])
+    w = _round_up(l_total, shards) // shards  # local label-tile width
+    k_local = min(k, w)
+    mesh_platform = next(iter(mesh.devices.flat)).platform
+    row_spec = batch_axes if batch_axes else None
+    in_spec = _P(row_spec, label_axis)
+    out_spec = _P(row_spec, None)
+
+    def body(xs, *extras):
+        # xs: (rows_local, w) — this shard's label tile
+        s = jax.lax.axis_index(label_axis)
+        base = (s * w).astype(jnp.int32)
+        col = base + jax.lax.broadcasted_iota(jnp.int32, xs.shape, 1)
+        # ragged tiles: lanes past L can never win and carry the sentinel
+        xs = jnp.where(col < l_total, xs.astype(jnp.float32), -jnp.inf)
+        v, li = _local_label_topk(xs, k_local, method, interpret, mesh_platform)
+        gi = li + base  # GLOBAL original index, offset added in-shard
+        gi = jnp.where(gi < l_total, gi, _IDX_SENTINEL)
+        ops = [v, gi]
+        if extras:
+            # local gather: only this shard's k_local candidates read the
+            # relevance tile, so the extra operand stays label-sharded too
+            ops.append(jnp.take_along_axis(extras[0], li, axis=1))
+        # THE one collective: O(k_local·shards) candidate pairs per row
+        gathered = [
+            jax.lax.all_gather(o, label_axis, axis=1, tiled=True) for o in ops
+        ]
+        # exact merge: ascending 2-key sort on (-value, global index) is
+        # descending-value with min-global-index tie-break — lax.top_k's
+        # order bit-exactly (negation is a bijection on NaN-free floats)
+        merged = jax.lax.sort(
+            (-gathered[0], gathered[1], *gathered[2:]),
+            num_keys=2,
+            dimension=1,
+        )
+        out = (-merged[0][:, :k], merged[1][:, :k])
+        if extras:
+            out = out + (merged[2][:, :k],)
+        return out
+
+    n_in = 2 if with_gather else 1
+    n_out = 3 if with_gather else 2
+
+    def impl(x, *extras):
+        l_pad = w * shards
+        if l_pad != x.shape[1]:
+            # pad value is irrelevant: in-shard masking against l_total
+            # already retires every padded lane
+            pad = ((0, 0), (0, l_pad - x.shape[1]))
+            x = jnp.pad(x, pad)
+            extras = tuple(jnp.pad(e, pad) for e in extras)
+        return _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(in_spec,) * n_in,
+            out_specs=(out_spec,) * n_out,
+            **_SHARD_MAP_KWARGS,
+        )(x, *extras)
+
+    return watched_jit(impl, name="ops.sharded_label_topk"), k_local, w
+
+
+def sharded_label_topk(
+    x,
+    k: int,
+    *,
+    mesh: Mesh = None,
+    label_axis: str = None,
+    batch_axes=None,
+    method: str = "auto",
+    interpret=None,
+    gather=None,
+):
+    """Top-k over a LABEL-sharded score matrix: per-shard streaming
+    selection + one O(k·shards) candidate all-gather + a narrow exact merge
+    — bit-identical to ``jax.lax.top_k`` (values AND tie-ordered indices)
+    for NaN-free **f32** inputs, with the label axis never replicated.
+    Like the single-device streaming paths, selection happens in f32
+    (non-f32 operands are cast and the values return as f32; wide integers
+    that collapse in f32 would change values/ties, which is why the
+    ``topk()`` auto-pick only engages this path for f32 operands).
+
+    Args:
+        x: scores ``(rows, labels)``, label-sharded over ``label_axis`` (or
+            pass ``mesh``/``label_axis`` explicitly — required inside jit,
+            where operand shardings are invisible).
+        k: ``1 <= k <= labels``.
+        mesh / label_axis / batch_axes: the mesh decomposition; derived from
+            ``x.sharding`` when omitted. ``batch_axes`` keeps row sharding
+            composable on multi-axis (batch × label) meshes.
+        method: per-shard local lowering (``auto``/``dense``/``prune``/
+            ``pallas`` — the single-device engine's methods).
+        interpret: Pallas interpret override for the local kernel.
+        gather: optional label-wide companion operand ``(rows, labels)``
+            (e.g. a relevance matrix) gathered at the selected indices
+            inside each shard; returned as a third output ``(rows, k)``.
+            Keeps retrieval-metric gathers off the replication path.
+    """
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"x must be 2-D (rows, labels), got shape {x.shape}.")
+    n, l = x.shape
+    if type(k) is not int:
+        raise TypeError(f"Expected `k` to be an integer, but {type(k)} was provided.")
+    if not 1 <= k <= l:
+        raise ValueError(f"requires 1 <= k <= L, got k={k} at L={l}.")
+    if method not in _LOCAL_METHODS:
+        raise ValueError(
+            f"method must be one of {_LOCAL_METHODS}, got {method!r}."
+        )
+    if mesh is None or label_axis is None:
+        derived = label_sharding_of(x)
+        if derived is None:
+            raise ValueError(
+                "sharded_label_topk needs a label-sharded operand or an "
+                "explicit mesh= and label_axis= (inside jit the operand's "
+                "sharding is invisible — always pass them there)."
+            )
+        d_mesh, d_label, _d_batch = derived
+        mesh = mesh if mesh is not None else d_mesh
+        label_axis = label_axis if label_axis is not None else d_label
+    if str(label_axis) not in mesh.shape:
+        raise ValueError(
+            f"label_axis {label_axis!r} is not an axis of the mesh "
+            f"(axes: {tuple(mesh.shape)})."
+        )
+    if batch_axes is None:
+        # derive the ROW sharding from the committed operand even on
+        # explicit-mesh calls (the metric path): dropping it would make the
+        # shard_map in_spec P(None, label) all-gather the batch axis on
+        # (data × label) meshes — exactly the replication this engine
+        # exists to avoid, just on the other axis
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        if spec and len(spec) and spec[0] is not None:
+            batch_axes = spec[0]
+    if isinstance(batch_axes, list):
+        batch_axes = tuple(batch_axes)
+    if gather is not None:
+        gather = jnp.asarray(gather)
+        if gather.shape != x.shape:
+            raise ValueError(
+                f"gather operand must match x's shape {x.shape}, got "
+                f"{gather.shape}."
+            )
+    program, k_local, w = _sharded_label_program(
+        mesh,
+        str(label_axis),
+        batch_axes,
+        k,
+        l,
+        method,
+        interpret,
+        gather is not None,
+    )
+    shards = int(mesh.shape[str(label_axis)])
+    if _obs._enabled:
+        _obs.counter("ops.topk.calls", path="sharded_label")
+        # candidate-exchange accounting: (value f32 + index i32) per
+        # candidate, k_local·shards candidates per row (the gather
+        # companion adds one more f32 column when present)
+        cols = 12 if gather is not None else 8
+        _obs.counter(
+            "ops.topk.merge_bytes", float(n * shards * k_local * cols)
+        )
+        _obs.gauge(
+            "ops.topk.label_bytes_per_device",
+            float(_rows_per_device(mesh, batch_axes, n) * w * 4),
+            path="sharded_label",
+        )
+    if gather is not None:
+        return program(x, gather)
+    return program(x)
+
+
+def _rows_per_device(mesh: Mesh, batch_axes, n: int) -> float:
+    """Rows resident per device given the batch-axis sharding (1 when the
+    row axis is replicated)."""
+    if not batch_axes:
+        return float(n)
+    axes = batch_axes if isinstance(batch_axes, tuple) else (batch_axes,)
+    denom = 1
+    for a in axes:
+        denom *= int(mesh.shape[a])
+    return float(n) / max(denom, 1)
+
+
 # --------------------------------------------------------- threshold-prune
 @functools.partial(watched_jit, static_argnames=("k",))
 def prune_topk(x: jax.Array, k: int) -> tuple:
@@ -406,6 +699,19 @@ def topk(x, k: int, *, method: str = "auto", interpret=None) -> tuple:
         raise TypeError(f"Expected `k` to be an integer, but {type(k)} was provided.")
     if not 1 <= k <= l:
         raise ValueError(f"requires 1 <= k <= L, got k={k} at L={l}.")
+    # label-sharded operands engage the block-distributed engine: forced via
+    # method="sharded_label", or auto-picked when the committed operand's
+    # label axis is sharded (tracers never are — inside jit callers route
+    # through sharded_label_topk with an explicit mesh). f32 only, like the
+    # single-device streaming picks: the sharded kernel selects in f32, and
+    # a silent cast would break the drop-in contract for wide-integer
+    # operands (distinct ints collapsing in f32 changes values AND ties).
+    if method == "sharded_label" or (
+        method == "auto"
+        and x.dtype == jnp.float32
+        and label_sharding_of(x) is not None
+    ):
+        return sharded_label_topk(x, k, interpret=interpret)
     resolved = _pick_method(l, k, x.dtype, method)
     if resolved == "prune" and not _prune_plan(l, k)[3]:
         # prune's own feasibility gate would fall through to dense inside
@@ -416,6 +722,15 @@ def topk(x, k: int, *, method: str = "auto", interpret=None) -> tuple:
     # is platform-dispatched below, so a CPU-committed operand on a TPU
     # host runs dense while this still counts pallas (module docstring)
     _obs.counter("ops.topk.calls", path=resolved)
+    if _obs._enabled:
+        # resident label-axis footprint per device on the single-device
+        # paths (the sharded engine records its own ~1/shards figure) — the
+        # cost gauge the bench's dense-vs-sharded ratio assertion reads
+        _obs.gauge(
+            "ops.topk.label_bytes_per_device",
+            float(x.shape[0] * l * 4),
+            path=resolved,
+        )
     if resolved == "dense":
         return jax.lax.top_k(x, k)
     if resolved == "prune":

@@ -500,6 +500,7 @@ class EvalClient:
         queue_capacity: Optional[int] = None,
         resume: Optional[str] = None,
         window_chunks: Optional[int] = None,
+        approx=None,
         timeout_s: Any = _UNSET,
     ) -> Dict[str, Any]:
         """Attach ``tenant_id`` with a wire metric spec (see
@@ -522,6 +523,7 @@ class EvalClient:
             "queue_capacity": queue_capacity,
             "resume": resume,
             "window_chunks": window_chunks,
+            "approx": approx,
         }
         if self._codec_pref != "raw":
             # capability exchange: qblk implies the lossless delta codec

@@ -222,6 +222,7 @@ class EvalDaemon:
         queue_capacity: Optional[int] = None,
         resume: str = "auto",
         window_chunks: Optional[int] = None,
+        approx=None,
     ) -> TenantHandle:
         """Admit one tenant and return its handle.
 
@@ -238,10 +239,21 @@ class EvalDaemon:
         occupancy (the deferred chunk-count valve): a lower cap closes
         windows more often, which bounds per-tenant pending HBM and sets
         the double-buffering cadence — window N+1 fills and transfers
-        while window N's step executes (ISSUE 11). Raises
-        :class:`AdmissionError` (``"capacity"`` / ``"duplicate_tenant"`` /
-        ``"daemon_stopped"`` / ``"bad_metrics"``) instead of ever
-        over-admitting.
+        while window N's step executes (ISSUE 11). ``approx`` (ROADMAP
+        4(c)) opts this tenant's curve/cache metrics into bounded-memory
+        sketch state (``True`` = family-default bucket count, an int = the
+        bucket count — the metric constructors' ``approx=`` contract,
+        applied at admission): every member with an approx mode switches;
+        members whose state is already bounded (counters, regressions,
+        ``Quantile``) pass through, and a spec where NO member has an
+        approx mode — or where a member supports it but cannot switch
+        (already-streamed state, a multiclass curve without
+        ``num_classes``) — rejects as ``bad_metrics``. A tenant re-attached
+        with a different ``approx`` than its eviction checkpoint cannot
+        restore into the changed state schema — use ``resume="never"`` to
+        start it clean. Raises :class:`AdmissionError` (``"capacity"`` /
+        ``"duplicate_tenant"`` / ``"daemon_stopped"`` / ``"bad_metrics"``)
+        instead of ever over-admitting.
         """
         if nan_policy not in _NAN_POLICIES:
             raise ValueError(
@@ -322,6 +334,37 @@ class EvalDaemon:
                     "bad_metrics",
                     f"tenant {tenant_id!r} metrics are not servable: {e}",
                 ) from e
+            if approx is not None and approx is not False:
+                # per-tenant sketch opt-in (ROADMAP 4(c)): switch every
+                # approx-capable member at admission; reject when the spec
+                # has no capable member or a member cannot switch.
+                # Validate-then-commit: the dry pass runs EVERY member's
+                # checks before anything mutates, so a rejection never
+                # leaves a caller-held instance half-switched into a
+                # changed state schema.
+                from torcheval_tpu.sketch.cache import enable_metric_approx
+
+                try:
+                    capable = [
+                        enable_metric_approx(m, approx, dry_run=True)
+                        for m in collection.metrics.values()
+                    ]
+                except ValueError as e:
+                    self._count_admission("rejected", "bad_metrics")
+                    raise AdmissionError(
+                        "bad_metrics",
+                        f"tenant {tenant_id!r} cannot run approx={approx!r}: "
+                        f"{e}",
+                    ) from e
+                if not any(capable):
+                    self._count_admission("rejected", "bad_metrics")
+                    raise AdmissionError(
+                        "bad_metrics",
+                        f"tenant {tenant_id!r} asked for approx={approx!r} "
+                        "but no metric in its spec has an approx mode.",
+                    )
+                for m in collection.metrics.values():
+                    enable_metric_approx(m, approx)
             if window_chunks is not None:
                 # per-instance valve override (the collection's budget
                 # check reads the probe member; each member's own 2x

@@ -1020,6 +1020,7 @@ class EvalServer:
             "queue_capacity",
             "resume",
             "window_chunks",
+            "approx",
         ):
             if header.get(knob) is not None:
                 kwargs[knob] = header[knob]

@@ -270,6 +270,137 @@ def merge_score_sketch_states(metric, others) -> None:
         )
 
 
+# ---------------------------------------------- serve per-tenant approx knob
+def _drop_state(metric, name: str) -> None:
+    metric._state_name_to_default.pop(name, None)
+    metric._state_name_to_reduction.pop(name, None)
+    getattr(metric, "_cache_dtypes", {}).pop(name, None)
+    if hasattr(metric, name):
+        delattr(metric, name)
+
+
+def _require_fresh(metric, *state_names: str) -> None:
+    """The switchable-instance precondition: NO streamed data anywhere —
+    raw caches, the cached-sample counter, OR the named compacted states
+    (a fully-compacted curve metric has ``inputs=[]`` and
+    ``_cached_samples=0`` while its summary_* states hold every sample; a
+    schema switch would silently drop them)."""
+    held = bool(getattr(metric, "inputs", None)) or bool(
+        getattr(metric, "_cached_samples", 0)
+    )
+    for name in state_names:
+        held = held or bool(getattr(metric, name, None))
+    if held:
+        raise ValueError(
+            "approx= cannot be applied to a metric that already holds "
+            "streamed samples (the registered state schema is part of "
+            "checkpoints and sync lanes); construct it with approx= "
+            "instead."
+        )
+
+
+def _score_sketch_bits(metric, approx):
+    """Shared validation half of the two score-sketch families: the
+    already-streamed guard runs in the caller (state names differ), this
+    resolves ``num_classes`` + the family-default bucket bits."""
+    num_classes = getattr(metric, "num_classes", None)
+    is_mc = hasattr(metric, "num_classes")
+    if is_mc and num_classes is None:
+        raise ValueError(
+            "approx= needs num_classes on the multiclass curve metrics "
+            "(the (C, buckets) sketch state cannot be sized without it)."
+        )
+    bits = resolve_approx(
+        approx,
+        default_bits=DEFAULT_MC_BUCKET_BITS if is_mc else DEFAULT_BUCKET_BITS,
+    )
+    return bits, num_classes
+
+
+def enable_metric_approx(metric, approx, *, dry_run: bool = False) -> bool:
+    """Switch a FRESH approx-capable metric into sketch mode after
+    construction — the serve per-tenant ``approx`` knob (ROADMAP 4(c)):
+    ``daemon.attach(..., approx=...)`` admits a tenant whose curve metrics
+    were built exact and opts them into resident-sketch state in one place,
+    whether they arrived as live instances or through the wire metric spec.
+
+    Returns ``True`` when the metric's class HAS an approx mode (the sketch
+    state is then registered, exactly as the constructor's ``approx=``
+    would have), ``False`` when it does not (counter/regression metrics —
+    their state is already bounded; the caller decides whether that rejects
+    the spec). Raises ``ValueError`` when the class supports approx but
+    THIS instance cannot switch: it already holds streamed samples — raw
+    cache OR compacted summary (the registered state schema is part of
+    checkpoints and sync lanes; it must never change mid-stream) — or its
+    configuration cannot size the sketch (``Cat(dim != 0)``, a multiclass
+    curve without ``num_classes``).
+
+    ``dry_run=True`` runs EVERY check and returns the same value but
+    mutates nothing — callers switching a whole collection validate every
+    member first, then apply (a rejection must never leave earlier members
+    half-switched; ``serve/daemon.py::attach``).
+
+    ``approx`` follows the constructors' contract (``True`` = family
+    default bucket count, an int = bucket count); ``False``/``None`` are a
+    no-op — pass the knob only when the tenant asked for it."""
+    if approx is None or approx is False:
+        return True
+    # --- always-approximate metrics (Quantile): the knob is already satisfied
+    if getattr(metric, "_always_approx", False):
+        return True
+    # --- compacting curve lifecycle (Binary/Multiclass AUROC & AUPRC):
+    # exact-summary states swap for the resident (tp, fp) histograms
+    if hasattr(metric, "_compaction_threshold") and hasattr(metric, "_compact"):
+        if metric._sketch_enabled():
+            return True
+        _require_fresh(
+            metric, "summary_scores", "summary_tp", "summary_fp"
+        )
+        bits, num_classes = _score_sketch_bits(metric, approx)
+        if bits is None or dry_run:
+            return True
+        for name in ("summary_scores", "summary_tp", "summary_fp",
+                     "summary_nan_dropped"):
+            _drop_state(metric, name)
+        metric._sketch_bits = bits
+        metric._sketch_classes = num_classes
+        if metric._compaction_threshold is None:
+            metric._compaction_threshold = SKETCH_FOLD_ROWS
+        register_score_sketch_states(metric, bits, num_classes)
+        return True
+    # --- PRC-family score sketch
+    if isinstance(metric, ScoreSketchCacheMixin):
+        if metric._sketch_enabled():
+            return True
+        _require_fresh(metric)
+        bits, num_classes = _score_sketch_bits(metric, approx)
+        if bits is not None and not dry_run:
+            metric._init_score_sketch(bits, num_classes=num_classes)
+        return True
+    # --- value sketch (HitRate / ReciprocalRank / Cat)
+    if isinstance(metric, ValueSketchCacheMixin):
+        if metric._sketch_enabled():
+            return True
+        cache_name = "scores" if hasattr(metric, "scores") else "inputs"
+        if getattr(metric, "dim", 0) != 0:
+            raise ValueError(
+                "approx= requires dim=0: the sketch pools elements and "
+                "cannot represent higher-dimension concat structure."
+            )
+        if getattr(metric, cache_name):
+            raise ValueError(
+                "approx= cannot be applied to a metric that already holds "
+                "streamed samples (the registered state schema is part of "
+                "checkpoints and sync lanes); construct it with approx= "
+                "instead."
+            )
+        bits = resolve_approx(approx, default_bits=DEFAULT_BUCKET_BITS)
+        if bits is not None and not dry_run:
+            metric._init_value_sketch(bits, cache_name)
+        return True
+    return False
+
+
 # ------------------------------------------------------- score-sketch mixin
 class ScoreSketchCacheMixin:
     """Approx mode for (score, target) cache metrics that do NOT carry the
