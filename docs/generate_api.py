@@ -28,6 +28,7 @@ MODULES = [
     "torcheval_tpu.metrics.ranking",
     "torcheval_tpu.metrics.toolkit",
     "torcheval_tpu.metrics.collection",
+    "torcheval_tpu.metrics.sliced",
     "torcheval_tpu.metrics.deferred",
     "torcheval_tpu.obs",
     "torcheval_tpu.parallel",

@@ -113,6 +113,42 @@ def make_quant_metric(rank: int):
     )
 
 
+# sliced-collection scenario (ISSUE 15): ragged per-rank cohort
+# populations — overlapping pools, one EMPTY rank — synced over the real
+# wire; the parent asserts per-slice bit-identity to its single-stream
+# oracle. All count lanes are int32 SUM, so the CI quantized re-run
+# (TORCHEVAL_TPU_SYNC_QUANTIZE=1) must stay bit-identical too.
+SLICED_POOL = 9
+SLICED_N = 181
+
+
+def make_sliced_shard(rank: int):
+    if rank == 2:
+        return []  # empty rank: contributes only reduce identities
+    rng = np.random.default_rng(600 + rank)
+    pool_ids = (np.arange(SLICED_POOL) + rank * 4) * 97 - 13
+    out = []
+    for _ in range(2):
+        ids = rng.choice(pool_ids, SLICED_N)
+        scores = rng.random(SLICED_N).astype(np.float32)
+        targets = (rng.random(SLICED_N) < 0.5).astype(np.float32)
+        out.append((ids, scores, targets))
+    return out
+
+
+def make_sliced_collection():
+    from torcheval_tpu.metrics import (
+        BinaryAccuracy,
+        BinaryAUROC,
+        SlicedMetricCollection,
+    )
+
+    return SlicedMetricCollection(
+        {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+        capacity=4,
+    )
+
+
 def _jsonable(x):
     arr = np.asarray(x)
     return arr.tolist() if arr.ndim else float(arr)
@@ -204,6 +240,19 @@ def main() -> None:
     q.update(jnp.asarray(make_quant_counts(rank).astype(np.float32)))
     r = sync_and_compute(q, recipient_rank="all")
     results["sketch_quantile_all"] = [_jsonable(v) for v in np.asarray(r)]
+
+    # --- ISSUE 15: sliced collection with ragged per-rank cohort
+    # populations (rank 2 empty). The sliced lanes are plain int32 SUM with
+    # a leading slice axis; the toolkit's post-gather union alignment must
+    # deliver per-slice values bit-identical to the parent's single-stream
+    # oracle on every rank, quantized or not.
+    scol = make_sliced_collection()
+    for b in make_sliced_shard(rank):
+        scol.update(*b)
+    r = sync_and_compute_collection(dict(scol.metrics), recipient_rank="all")
+    results["sliced_ids"] = [int(i) for i in r["acc"]["slice_ids"]]
+    results["sliced_acc"] = _jsonable(r["acc"]["values"])
+    results["sliced_auroc"] = _jsonable(r["auroc"]["values"])
 
     # --- synced metric object + synced state dict on recipient 1
     synced = get_synced_metric(acc, recipient_rank=1)
@@ -348,6 +397,13 @@ def main() -> None:
             {"acc": acc, "auroc": auroc, "tp": t}, recipient_rank="all"
         )  # whole array-lane collection: still one two-round exchange
         results["rounds_collection"] = counts["n"]
+        counts["n"] = 0
+        # sliced collection (ISSUE 15): every slice's state moves in the
+        # SAME two typed rounds — slice count never adds a collective
+        sync_and_compute_collection(
+            dict(scol.metrics), recipient_rank="all"
+        )
+        results["rounds_sliced"] = counts["n"]
         counts["n"] = 0
         # windowed deque state rides the TYPED wire (round-5: stacked rows
         # with per-update boundaries), not the pickled object lane — so a

@@ -171,6 +171,34 @@ class TestMultiprocessSync(unittest.TestCase):
             self.assertEqual(res["sketch_auroc_all"], want)
             self.assertEqual(res["sketch_quantile_all"], qwant)
 
+    def test_sliced_ragged_cohorts_bit_identical_to_oracle(self):
+        # ISSUE 15: per-cohort states with RAGGED per-rank populations
+        # (overlapping pools, rank 2 empty) over the real transport. The
+        # union table is id-sorted and identical on every rank; counter and
+        # sketch lanes are integer SUM, so equality is BIT-level — incl.
+        # under the CI re-run with TORCHEVAL_TPU_SYNC_QUANTIZE=1.
+        from mp_sync_worker import make_sliced_collection, make_sliced_shard
+
+        oracle = make_sliced_collection()
+        for r in range(WORLD):
+            for b in make_sliced_shard(r):
+                oracle.update(*b)
+        want = oracle.compute()
+        order = np.argsort(want["acc"].slice_ids)
+        want_ids = [int(i) for i in want["acc"].slice_ids[order]]
+        want_acc = np.asarray(want["acc"]["values"])[order].tolist()
+        want_auroc = np.asarray(want["auroc"]["values"])[order].tolist()
+        for res in self.results:
+            self.assertEqual(res["sliced_ids"], want_ids)
+            self.assertEqual(res["sliced_acc"], want_acc)
+            self.assertEqual(res["sliced_auroc"], want_auroc)
+
+    def test_sliced_sync_is_two_collective_rounds(self):
+        # every slice's state moves in the SAME two rounds — the slice
+        # axis widens lanes, never adds collectives
+        for res in self.results:
+            self.assertEqual(res["rounds_sliced"], 2)
+
     def test_synced_metric_and_state_dict_on_rank_1(self):
         total = WORLD * 64
         for r, res in enumerate(self.results):

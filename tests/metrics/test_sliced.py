@@ -1,0 +1,628 @@
+"""SlicedMetricCollection core contracts (ISSUE 15).
+
+The acceptance bars pinned here:
+
+* per-slice values BIT-identical to a looped per-slice oracle — the
+  standalone metric fed only that slice's samples — for exact counter
+  members AND sketch members (same integer counts, same kernels);
+* the slice axis adds ZERO device dispatches: K batches x S slices still
+  close as ONE ``deferred.window_step`` program, obs-asserted at two very
+  different slice counts;
+* the sparse id table: first-seen interning, geometric growth (a pure pad
+  — rows never move), int64 ids incl. negatives, checkpoint round-trip of
+  the table bit-identically onto a FRESH smaller-capacity collection;
+* ``merge_collections`` merges replicas by ORIGINAL id;
+* sliceability rejections are loud and name the reason.
+"""
+
+import tempfile
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    ClickThroughRate,
+    Max,
+    MeanSquaredError,
+    MulticlassAccuracy,
+    SlicedMetricCollection,
+    Sum,
+)
+from torcheval_tpu.metrics.sliced import SliceTable, check_sliceable
+
+
+def tearDownModule():
+    # the looped per-slice oracles legitimately trace solo window steps at
+    # one shape PER SLICE — leave the process-wide recompile-watchdog
+    # bookkeeping (and any storm-warning once-keys) clean for later obs
+    # tests that assert a churn-free run stays silent
+    obs.reset()
+
+
+def _batches(seed=0, n_batches=3, n=257, pool=13, id_scale=101):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = rng.integers(0, pool, n).astype(np.int64) * id_scale - 7
+        s = rng.random(n).astype(np.float32)
+        t = (rng.random(n) < 0.4).astype(np.float32)
+        out.append((ids, s, t))
+    return out
+
+
+class TestSliceTable(unittest.TestCase):
+    def test_first_seen_order_and_growth(self):
+        t = SliceTable(2)
+        rows, grew = t.intern(np.asarray([5, 9, 5, 7], np.int64))
+        self.assertTrue(grew)  # 3 distinct ids > capacity 2
+        np.testing.assert_array_equal(rows, [0, 1, 0, 2])
+        self.assertEqual(t.capacity, 4)
+        np.testing.assert_array_equal(t.registered_ids(), [5, 9, 7])
+        rows2, grew2 = t.intern(np.asarray([7, 9], np.int64))
+        self.assertFalse(grew2)
+        np.testing.assert_array_equal(rows2, [2, 1])
+
+    def test_negative_and_64bit_ids(self):
+        t = SliceTable(4)
+        ids = np.asarray([-(1 << 40), (1 << 41) + 3, -1, 0], np.int64)
+        rows, _ = t.intern(ids)
+        np.testing.assert_array_equal(rows, [0, 1, 2, 3])
+        np.testing.assert_array_equal(t.registered_ids(), ids)
+
+    def test_rejects_non_integer_columns(self):
+        t = SliceTable(4)
+        with self.assertRaises(ValueError):
+            t.intern(np.asarray([1.5, 2.5]))
+        with self.assertRaises(ValueError):
+            t.intern(np.zeros((2, 2), np.int64))
+
+    def test_replace_round_trip_and_duplicate_rejection(self):
+        t = SliceTable(4)
+        t.intern(np.asarray([3, 1, 2], np.int64))
+        ids = t.registered_ids()
+        t2 = SliceTable(2)
+        t2.replace(ids, 8)
+        np.testing.assert_array_equal(t2.registered_ids(), ids)
+        self.assertEqual(t2.capacity, 8)
+        with self.assertRaises(ValueError):
+            t2.replace(np.asarray([1, 1], np.int64), 4)
+
+
+class TestSlicedOracleParity(unittest.TestCase):
+    """Per-slice bit-identity against the looped standalone oracle."""
+
+    def _assert_member_matches_oracle(self, result, batches, make_metric):
+        all_ids = np.concatenate([b[0] for b in batches])
+        cols = [np.concatenate([b[i] for b in batches]) for i in (1, 2)]
+        vals = np.asarray(result["values"])
+        self.assertEqual(len(result.slice_ids), len(np.unique(all_ids)))
+        for n, sid in enumerate(result.slice_ids):
+            mask = all_ids == sid
+            oracle = make_metric()
+            oracle.update(cols[0][mask], cols[1][mask])
+            self.assertEqual(
+                float(oracle.compute()), float(vals[n]), msg=f"slice {sid}"
+            )
+
+    def test_exact_and_sketch_members_bit_identical(self):
+        batches = _batches()
+        col = SlicedMetricCollection(
+            {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+            capacity=2,  # forces several geometric growth events
+        )
+        for b in batches:
+            col.update(*b)
+        res = col.compute()
+        self._assert_member_matches_oracle(
+            res["acc"], batches, BinaryAccuracy
+        )
+        self._assert_member_matches_oracle(
+            res["auroc"], batches, lambda: BinaryAUROC(approx=1024)
+        )
+
+    def test_sketch_member_within_documented_bound_of_exact(self):
+        # the approx acceptance bar: per-slice sketch AUROC sits within the
+        # sketch's own a-posteriori bound of the EXACT per-slice AUROC,
+        # computed from that slice's resident histogram
+        from torcheval_tpu import sketch as sk
+
+        batches = _batches(seed=21, pool=7)
+        col = SlicedMetricCollection(
+            {"auroc": BinaryAUROC(approx=1024)}, capacity=4
+        )
+        for b in batches:
+            col.update(*b)
+        res = col.compute()["auroc"]
+        member = col.metrics["auroc"]
+        member._fold_now()
+        tp = np.asarray(member.sketch_tp)
+        fp = np.asarray(member.sketch_fp)
+        all_ids = np.concatenate([b[0] for b in batches])
+        all_s = np.concatenate([b[1] for b in batches])
+        all_t = np.concatenate([b[2] for b in batches])
+        for n, sid in enumerate(res.slice_ids):
+            m = all_ids == sid
+            exact = BinaryAUROC()
+            exact.update(all_s[m], all_t[m])
+            bound = sk.auroc_error_bound(tp[n], fp[n])
+            self.assertLessEqual(
+                abs(
+                    float(np.asarray(res["values"])[n])
+                    - float(exact.compute())
+                ),
+                bound + 1e-6,
+                msg=f"slice {sid}",
+            )
+
+    def test_repeated_compute_is_idempotent(self):
+        batches = _batches(seed=5)
+        col = SlicedMetricCollection({"acc": BinaryAccuracy()}, capacity=4)
+        for b in batches:
+            col.update(*b)
+        first = np.asarray(col.compute()["acc"]["values"])
+        second = np.asarray(col.compute()["acc"]["values"])
+        np.testing.assert_array_equal(first, second)
+
+    def test_multiclass_and_regression_members(self):
+        rng = np.random.default_rng(7)
+        col = SlicedMetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=5)}, capacity=4
+        )
+        mse_col = SlicedMetricCollection({"mse": MeanSquaredError()}, capacity=4)
+        batches = []
+        for _ in range(3):
+            ids = rng.integers(0, 9, 181).astype(np.int64) * 11
+            scores = rng.random((181, 5)).astype(np.float32)
+            labels = rng.integers(0, 5, 181).astype(np.int32)
+            batches.append((ids, scores, labels))
+            col.update(ids, scores, labels)
+            mse_col.update(ids, scores[:, 0], labels.astype(np.float32))
+        res = col.compute()
+        mres = mse_col.compute()["mse"]
+        all_ids = np.concatenate([b[0] for b in batches])
+        all_s = np.concatenate([b[1] for b in batches])
+        all_l = np.concatenate([b[2] for b in batches])
+        for n, sid in enumerate(res["acc"].slice_ids):
+            m = all_ids == sid
+            oracle = MulticlassAccuracy(num_classes=5)
+            oracle.update(all_s[m], all_l[m])
+            self.assertEqual(
+                float(oracle.compute()),
+                float(np.asarray(res["acc"]["values"])[n]),
+            )
+            omse = MeanSquaredError()
+            omse.update(all_s[m, 0], all_l[m].astype(np.float32))
+            # float-sum states: per-slice segment accumulation orders the
+            # adds differently than the oracle's batched tree reduction —
+            # equal within f32 associativity (integer-count members above
+            # are the bit-identical ones)
+            np.testing.assert_allclose(
+                float(omse.compute()),
+                float(np.asarray(mres["values"])[
+                    int(np.nonzero(mres.slice_ids == sid)[0][0])
+                ]),
+                rtol=1e-5,
+            )
+
+    def test_max_member_extrema_reduce(self):
+        rng = np.random.default_rng(9)
+        col = SlicedMetricCollection({"mx": Max()}, capacity=2)
+        ids = rng.integers(0, 6, 300).astype(np.int64)
+        v = rng.standard_normal(300).astype(np.float32)
+        col.update(ids, v)
+        res = col.compute()["mx"]
+        for n, sid in enumerate(res.slice_ids):
+            self.assertEqual(
+                float(v[ids == sid].max()),
+                float(np.asarray(res["values"])[n]),
+            )
+
+
+class TestOneProgramProperty(unittest.TestCase):
+    def _window_steps(self):
+        return sum(
+            v
+            for k, v in obs.snapshot()["counters"].items()
+            if k.startswith("deferred.window_steps")
+        )
+
+    def test_dispatch_count_independent_of_slice_count(self):
+        obs.enable()
+        try:
+            counts = {}
+            for n_slices, pool in ((8, 8), (2048, 2048)):
+                col = SlicedMetricCollection(
+                    {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+                    capacity=n_slices,
+                )
+                batches = _batches(seed=3, n_batches=4, pool=pool)
+                col.update(*batches[0])
+                np.asarray(col.compute()["acc"]["values"])  # warm + register
+                obs.reset()
+                before = self._window_steps()
+                for b in batches:
+                    col.update(*b)
+                col.compute()
+                counts[n_slices] = self._window_steps() - before
+            # K batches x S slices close as ONE program; S never enters
+            self.assertEqual(counts[8], 1)
+            self.assertEqual(counts[8], counts[2048])
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+class TestLifecycle(unittest.TestCase):
+    def test_checkpoint_round_trip_onto_fresh_collection(self):
+        from torcheval_tpu.resilience.snapshot import save, restore
+
+        batches = _batches(seed=11, pool=29)
+        col = SlicedMetricCollection(
+            {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+            capacity=2,
+        )
+        for b in batches:
+            col.update(*b)
+        res = col.compute()
+        with tempfile.TemporaryDirectory() as d:
+            ckpt = save(col, d)
+            fresh = SlicedMetricCollection(
+                {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+                capacity=2,  # smaller than the grown checkpoint capacity
+            )
+            restore(fresh, ckpt)
+            # the sparse id table round-trips bit-identically
+            np.testing.assert_array_equal(
+                fresh.slice_table.registered_ids(),
+                col.slice_table.registered_ids(),
+            )
+            self.assertEqual(
+                fresh.slice_table.capacity, col.slice_table.capacity
+            )
+            r2 = fresh.compute()
+            for key in ("acc", "auroc"):
+                np.testing.assert_array_equal(
+                    np.asarray(r2[key]["values"]),
+                    np.asarray(res[key]["values"]),
+                )
+            # the restored collection keeps streaming (new ids included)
+            ids, s, t = batches[0]
+            fresh.update(ids * 7 + 1, s, t)
+            fresh.compute()
+
+    def test_checkpoint_rejects_trailing_shape_drift(self):
+        from torcheval_tpu.resilience.snapshot import (
+            CheckpointError,
+            save,
+            restore,
+        )
+
+        col = SlicedMetricCollection(
+            {"auroc": BinaryAUROC(approx=1024)},
+            capacity=4,
+            curve_bucket_bits=10,
+        )
+        col.update(*_batches(n_batches=1)[0])
+        with tempfile.TemporaryDirectory() as d:
+            ckpt = save(col, d)
+            drifted = SlicedMetricCollection(
+                {"auroc": BinaryAUROC(approx=1024)},
+                capacity=4,
+                curve_bucket_bits=11,  # different per-slice bucket width
+            )
+            with self.assertRaises(CheckpointError):
+                restore(drifted, ckpt)
+
+    def test_merge_collections_by_original_id(self):
+        batches = _batches(seed=13, n_batches=4, pool=17)
+        make = lambda: SlicedMetricCollection(  # noqa: E731
+            {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+            capacity=2,
+        )
+        whole = make()
+        for b in batches:
+            whole.update(*b)
+        want = whole.compute()
+        a, b_col = make(), make()
+        for b in batches[:2]:
+            a.update(*b)
+        for b in batches[2:]:
+            b_col.update(*b)
+        a.merge_collections([b_col])
+        got = a.compute()
+        for key in ("acc", "auroc"):
+            # align by id: merge appends b's unseen ids after a's
+            order_w = np.argsort(want[key].slice_ids)
+            order_g = np.argsort(got[key].slice_ids)
+            np.testing.assert_array_equal(
+                got[key].slice_ids[order_g], want[key].slice_ids[order_w]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[key]["values"])[order_g],
+                np.asarray(want[key]["values"])[order_w],
+            )
+
+    def test_rejected_growth_rolls_back_the_id_table(self):
+        # review finding: a growth the members REJECT must roll the table
+        # back too — a table grown past the member states would make every
+        # later batch's new cohorts scatter silently out of segment range
+        col = SlicedMetricCollection({"acc": BinaryAccuracy()}, capacity=4)
+        first = (
+            np.asarray([1, 2], np.int64),
+            np.asarray([0.9, 0.1], np.float32),
+            np.asarray([1.0, 0.0], np.float32),
+        )
+        col.update(*first)
+        mark = (col.slice_table.count, col.slice_table.capacity)
+
+        def boom(capacity):
+            raise ValueError("int32 segment-index (simulated)")
+
+        col.metrics["acc"]._check_capacity = boom
+        with self.assertRaisesRegex(ValueError, "segment-index"):
+            col.update(
+                np.arange(10, dtype=np.int64),
+                np.zeros(10, np.float32),
+                np.zeros(10, np.float32),
+            )
+        self.assertEqual(
+            (col.slice_table.count, col.slice_table.capacity), mark
+        )
+        del col.metrics["acc"].__dict__["_check_capacity"]
+        # the collection is fully live: the SAME cohorts register cleanly
+        col.update(
+            np.arange(10, dtype=np.int64),
+            np.zeros(10, np.float32),
+            np.zeros(10, np.float32),
+        )
+        res = col.compute()["acc"]
+        self.assertEqual(res.num_slices, 10)  # {1,2} ∪ {0..9}
+        # and the pre-failure cohorts kept their data (cohort 1: 0.9/1.0
+        # from batch one + 0.0/0.0 from the retry — both correct)
+        self.assertEqual(float(res.value_of(1)), 1.0)
+
+    def test_rejected_merge_fails_closed_before_any_member_mutates(self):
+        # review finding: member merges grow the SHARED table, so a later
+        # member's capacity rejection (the sliced sketch's int32 extent
+        # bound) must fire BEFORE any member merges — a half-merged
+        # collection has no rollback
+        make = lambda: SlicedMetricCollection(  # noqa: E731
+            {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+            capacity=2,
+        )
+        batches = _batches(seed=23, n_batches=4, pool=17)
+        a, b = make(), make()
+        for bt in batches[:2]:
+            a.update(*bt)
+        for bt in batches[2:]:
+            b.update(*bt)
+        acc = a.metrics["acc"]
+        acc._fold_now()
+        table_before = (a.slice_table.count, a.slice_table.capacity)
+        states_before = {
+            name: np.asarray(getattr(acc, name)).copy()
+            for name in acc._sliced_state_names
+        }
+
+        def boom(capacity):
+            raise ValueError("int32 segment-index (simulated)")
+
+        a.metrics["auroc"]._check_capacity = boom
+        with self.assertRaisesRegex(ValueError, "segment-index"):
+            a.merge_collections([b])
+        # 'acc' merges before 'auroc' in member order — the rejection must
+        # have fired before it touched anything
+        self.assertEqual(
+            (a.slice_table.count, a.slice_table.capacity), table_before
+        )
+        for name, before in states_before.items():
+            np.testing.assert_array_equal(np.asarray(getattr(acc, name)), before)
+        del a.metrics["auroc"].__dict__["_check_capacity"]
+        # fully live: the SAME merge now lands and matches the whole stream
+        a.merge_collections([b])
+        whole = make()
+        for bt in batches:
+            whole.update(*bt)
+        want = whole.compute()
+        got = a.compute()
+        for key in ("acc", "auroc"):
+            order_w = np.argsort(want[key].slice_ids)
+            order_g = np.argsort(got[key].slice_ids)
+            np.testing.assert_array_equal(
+                got[key].slice_ids[order_g], want[key].slice_ids[order_w]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[key]["values"])[order_g],
+                np.asarray(want[key]["values"])[order_w],
+            )
+
+    def test_merge_respects_per_state_reductions(self):
+        # review finding: a sum-fold member can carry MAX states (config
+        # grids like BinnedPRC's threshold); merging those additively
+        # would double the grid on rows both replicas hold
+        from torcheval_tpu.metrics import BinaryBinnedPrecisionRecallCurve
+
+        def make():
+            return SlicedMetricCollection(
+                {"prc": BinaryBinnedPrecisionRecallCurve(threshold=5)},
+                capacity=4,
+            )
+
+        batches = _batches(seed=17, pool=6)
+        a, b = make(), make()
+        for bt in batches[:2]:
+            a.update(*bt)
+        for bt in batches[2:]:
+            b.update(*bt)
+        a.merge_collections([b])
+        member = a.metrics["prc"]
+        member._fold_now()
+        grid = np.asarray(
+            BinaryBinnedPrecisionRecallCurve(threshold=5)
+            ._state_name_to_default["threshold"]
+        )
+        # every slice's threshold row is exactly ONE grid, not 2x
+        np.testing.assert_array_equal(
+            np.asarray(member.threshold),
+            np.broadcast_to(grid, (member._table.capacity,) + grid.shape),
+        )
+        # and the counters merged by original id, matching the whole stream
+        whole = make()
+        for bt in batches:
+            whole.update(*bt)
+        want = whole.compute()["prc"]
+        got = a.compute()["prc"]
+        order_g = np.argsort(got.slice_ids)
+        order_w = np.argsort(want.slice_ids)
+        for leaf_g, leaf_w in zip(got["values"], want["values"]):
+            np.testing.assert_array_equal(
+                np.asarray(leaf_g)[order_g], np.asarray(leaf_w)[order_w]
+            )
+
+    def test_reset_forgets_cohorts(self):
+        col = SlicedMetricCollection({"acc": BinaryAccuracy()}, capacity=4)
+        col.update(*_batches(n_batches=1)[0])
+        col.reset()
+        self.assertEqual(col.slice_table.count, 0)
+        ids = np.asarray([77, 78], np.int64)
+        col.update(ids, np.asarray([0.9, 0.1], np.float32), np.asarray([1.0, 0.0], np.float32))
+        res = col.compute()["acc"]
+        np.testing.assert_array_equal(res.slice_ids, ids)
+        np.testing.assert_array_equal(np.asarray(res["values"]), [1.0, 1.0])
+
+
+class TestValidation(unittest.TestCase):
+    def test_unsliceable_members_reject_with_reason(self):
+        # exact curve metric: per-slice sample caches cannot survive
+        with self.assertRaisesRegex(ValueError, "approx"):
+            SlicedMetricCollection({"auroc": BinaryAUROC()})
+        # host/cache state metric (Cat-like) rejects
+        from torcheval_tpu.metrics import Cat
+
+        with self.assertRaisesRegex(ValueError, "cannot be sliced"):
+            SlicedMetricCollection({"cat": Cat()})
+        # streamed template rejects (schema is part of checkpoints)
+        used = BinaryAccuracy()
+        used.update(
+            np.asarray([0.9], np.float32), np.asarray([1.0], np.float32)
+        )
+        with self.assertRaisesRegex(ValueError, "fresh"):
+            SlicedMetricCollection({"acc": used})
+
+    def test_check_sliceable_approx_forwarding(self):
+        # an exact curve template is sliceable iff the serve approx knob
+        # WILL switch it (the validate-then-commit composition)
+        check_sliceable(BinaryAUROC(), approx=1024)
+        with self.assertRaises(ValueError):
+            check_sliceable(BinaryAUROC(), approx=None)
+
+    def test_update_rejects_kwargs_and_bad_columns(self):
+        col = SlicedMetricCollection({"acc": BinaryAccuracy()}, capacity=4)
+        with self.assertRaises(ValueError):
+            col.update(np.asarray([1]), np.asarray([0.5]), weight=1.0)
+        with self.assertRaises(ValueError):
+            col.update(np.asarray([1.5]), np.asarray([0.5], np.float32))
+        with self.assertRaises(ValueError):
+            col.update(np.asarray([1, 2], np.int64))
+
+    def test_mismatched_column_lengths_reject(self):
+        col = SlicedMetricCollection({"acc": BinaryAccuracy()}, capacity=4)
+        with self.assertRaises(ValueError):
+            col.update(
+                np.asarray([1, 2, 3], np.int64),
+                np.asarray([0.5, 0.5], np.float32),
+                np.asarray([1.0, 0.0], np.float32),
+            )
+
+    def test_sketch_extent_fails_closed_before_int32_index_wrap(self):
+        # review finding: the combined segment index is int32 — past
+        # num_slices * (2B+1) > 2^31-1 it would WRAP and silently corrupt
+        # per-slice counts. Construction, and growth that would cross the
+        # bound, must raise with the remedies named instead.
+        from torcheval_tpu.sketch.cache import check_sliced_sketch_extent
+
+        planes = 2 * 1024 + 1  # bits=10
+        at_bound = (2**31 - 1) // planes
+        check_sliced_sketch_extent(10, at_bound)  # inside: fine
+        with self.assertRaisesRegex(ValueError, "int32 segment-index"):
+            check_sliced_sketch_extent(10, at_bound + 1)
+        # construction rejects INSTANTLY (before materializing multi-GB
+        # default histograms): default 16-bit buckets cap at ~16k slices
+        with self.assertRaisesRegex(ValueError, "int32 segment-index"):
+            SlicedMetricCollection(
+                {"auroc": BinaryAUROC(approx=True)}, capacity=20_000
+            )
+        # growth path: the pre-pad validation rejects a capacity past the
+        # bound with the member left consistent at its old capacity
+        col = SlicedMetricCollection(
+            {"auroc": BinaryAUROC(approx=1024)},
+            capacity=4,
+            curve_bucket_bits=10,
+        )
+        col.update(
+            np.asarray([1, 2], np.int64),
+            np.asarray([0.5, 0.5], np.float32),
+            np.asarray([1.0, 0.0], np.float32),
+        )
+        col.slice_table.replace(
+            col.slice_table.registered_ids(), at_bound + 1
+        )
+        with self.assertRaisesRegex(ValueError, "int32 segment-index"):
+            col._grow_members()
+        self.assertEqual(int(col.metrics["auroc"].sketch_tp.shape[0]), 4)
+
+    def test_sliceable_family_coverage(self):
+        for metric in (
+            BinaryAccuracy(),
+            MulticlassAccuracy(num_classes=3),
+            MeanSquaredError(),
+            Sum(),
+            Max(),
+            ClickThroughRate(),
+        ):
+            check_sliceable(metric)
+
+
+class TestSlicedResult(unittest.TestCase):
+    def test_accessors_and_dict_protocol(self):
+        col = SlicedMetricCollection({"acc": BinaryAccuracy()}, capacity=4)
+        ids = np.asarray([9, 4], np.int64)
+        col.update(
+            np.asarray([9, 9, 4], np.int64),
+            np.asarray([0.9, 0.1, 0.8], np.float32),
+            np.asarray([1.0, 1.0, 1.0], np.float32),
+        )
+        res = col.compute()["acc"]
+        np.testing.assert_array_equal(res.slice_ids, ids)
+        self.assertEqual(res.num_slices, 2)
+        self.assertEqual(float(res.value_of(4)), 1.0)
+        self.assertEqual(res.as_dict()[9], 0.5)
+        with self.assertRaises(KeyError):
+            res.value_of(123)
+        # dict protocol intact (the wire marshals it as a plain dict)
+        self.assertEqual(sorted(res.keys()), ["slice_ids", "values"])
+        self.assertEqual(len(list(res.values())), 2)
+
+    def test_tuple_valued_results_are_tree_aware(self):
+        # review finding: members whose compute returns a TUPLE per slice
+        # (curve points) must index each leaf's slice axis in as_dict /
+        # value_of, not the stack axis np.asarray would invent
+        from torcheval_tpu.metrics.sliced import SlicedResult
+
+        ids = np.asarray([7, 8, 9], np.int64)
+        precision = np.arange(6, dtype=np.float32).reshape(3, 2)
+        recall = precision + 100.0
+        res = SlicedResult(ids, (precision, recall))
+        d = res.as_dict()
+        self.assertEqual(sorted(d), [7, 8, 9])
+        np.testing.assert_array_equal(d[9][0], precision[2])
+        np.testing.assert_array_equal(d[9][1], recall[2])
+        v = res.value_of(8)
+        np.testing.assert_array_equal(np.asarray(v[0]), precision[1])
+
+
+if __name__ == "__main__":
+    unittest.main()

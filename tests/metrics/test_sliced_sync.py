@@ -1,0 +1,158 @@
+"""Sliced-state sync over the simulated 4-rank wire (ISSUE 15).
+
+Rides the ``test_sync_quantized`` barrier-threaded wire harness: the REAL
+two-round exchange — schema digest, per-rank descriptors (ragged leading
+dims!), payload concatenation, per-rank decode, the post-gather sorted-union
+row alignment, the per-reduction fold — everything but the transport (the
+real 4-process world rides ``test_multiprocess_sync.py``'s sliced scenario).
+
+Pinned contracts:
+
+* ragged per-rank cohort populations (overlapping, disjoint, and EMPTY
+  ranks) sync BIT-identically to a single-stream oracle for exact counter
+  members and sketch members alike;
+* the collective count is exactly the wire's two rounds and INDEPENDENT of
+  the slice count — the slice axis rides the same SUM lanes, only wider;
+* the quantized codecs (ISSUE 12/13) apply to the sliced int32 lanes as-is
+  and stay lossless;
+* the synced clone is a fully live sliced member: union id table installed,
+  capacity adopted, further updates accepted.
+"""
+
+import unittest
+
+import numpy as np
+
+import torcheval_tpu.metrics.toolkit as tk
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    SlicedMetricCollection,
+)
+from tests.metrics.test_sync_quantized import run_world
+
+WORLD = 4
+
+
+def _rank_batches(rank: int, pool: int = 9, n: int = 211):
+    """Deterministic ragged shards: rank 2 is EMPTY; the others hold
+    overlapping-but-different cohort pools."""
+    if rank == 2:
+        return []
+    rng = np.random.default_rng(40 + rank)
+    pool_ids = (np.arange(pool) + rank * (pool // 2)) * 97 - 13
+    out = []
+    for _ in range(2):
+        ids = rng.choice(pool_ids, n)
+        s = rng.random(n).astype(np.float32)
+        t = (rng.random(n) < 0.5).astype(np.float32)
+        out.append((ids, s, t))
+    return out
+
+
+def _make_col(capacity: int = 4):
+    return SlicedMetricCollection(
+        {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+        capacity=capacity,
+    )
+
+
+def _oracle(pool: int = 9):
+    col = _make_col()
+    for r in range(WORLD):
+        for b in _rank_batches(r, pool=pool):
+            col.update(*b)
+    return col.compute()
+
+
+class TestSlicedSync(unittest.TestCase):
+    def _sync_world(self, pool=9, quantize=None):
+        def fn(rank):
+            col = _make_col()
+            for b in _rank_batches(rank, pool=pool):
+                col.update(*b)
+            return tk.sync_and_compute_collection(
+                dict(col.metrics), recipient_rank="all", quantize=quantize
+            )
+
+        return run_world(WORLD, fn)
+
+    def _assert_matches_oracle(self, results, want):
+        for res in results:
+            for key in ("acc", "auroc"):
+                got = res[key]
+                # the synced union table is id-sorted; align the oracle
+                order = np.argsort(want[key].slice_ids)
+                np.testing.assert_array_equal(
+                    got["slice_ids"], want[key].slice_ids[order]
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(got["values"]),
+                    np.asarray(want[key]["values"])[order],
+                )
+
+    def test_ragged_cohorts_bit_identical_to_single_stream_oracle(self):
+        results, _ = self._sync_world()
+        self._assert_matches_oracle(results, _oracle())
+
+    def test_two_rounds_independent_of_slice_count(self):
+        rounds = {}
+        for pool in (5, 512):
+            _, sim = self._sync_world(pool=pool)
+            rounds[pool] = len(sim.round_bytes) // WORLD
+        # the ≤3-collective acceptance bar, obs/wire-asserted: the typed
+        # exchange is exactly TWO rounds at 5 slices and at ~1500 (3 ranks
+        # x 512-pool) — the slice axis widens lanes, never adds rounds
+        self.assertEqual(rounds[5], 2)
+        self.assertEqual(rounds[512], 2)
+
+    def test_quantized_codecs_stay_lossless_on_sliced_lanes(self):
+        results_q, sim_q = self._sync_world(quantize=True)
+        self._assert_matches_oracle(results_q, _oracle())
+        _, sim_raw = self._sync_world(quantize=False)
+        # the sketch lanes are sparse int32 histograms: the bucket/narrow
+        # codecs must actually engage (payload strictly below raw)
+        self.assertLess(sim_q.round_bytes[-1], sim_raw.round_bytes[-1])
+
+    def test_synced_clone_is_live(self):
+        def fn(rank):
+            c = _make_col()
+            for b in _rank_batches(rank):
+                c.update(*b)
+            return {
+                name: tk.get_synced_metric(m, recipient_rank="all")
+                for name, m in c.metrics.items()
+            }
+
+        synced_all, _ = run_world(WORLD, fn)
+        member = synced_all[0]["acc"]
+        before = member._table.count
+        self.assertGreater(before, 0)
+        # keep streaming into the synced clone, new cohorts included
+        member.update(
+            np.asarray([0, 1], np.int32),
+            np.asarray([0.9, 0.2], np.float32),
+            np.asarray([1.0, 0.0], np.float32),
+        )
+        member.compute()
+
+    def test_empty_rank_contributes_identity(self):
+        # rank 2 never updates: its lanes are all-default with count 0 and
+        # must fold as the reduce identity (asserted implicitly by the
+        # oracle test; here pin the union table does NOT contain ghosts)
+        results, _ = self._sync_world()
+        ids = results[0]["acc"]["slice_ids"]
+        want_ids = np.unique(
+            np.concatenate(
+                [
+                    np.concatenate([b[0] for b in _rank_batches(r)])
+                    for r in range(WORLD)
+                    if _rank_batches(r)
+                ]
+            )
+        )
+        np.testing.assert_array_equal(ids, want_ids)
+
+
+if __name__ == "__main__":
+    unittest.main()

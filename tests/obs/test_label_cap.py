@@ -1,0 +1,130 @@
+"""Registry label-cardinality guard (ISSUE 15 satellite).
+
+Per-tenant / per-entry label maps previously grew without bound under
+churn; the cap drops NEW label sets past the per-name limit — counted into
+``obs.labels.dropped{instrument=}`` and warned once per name — so nobody is
+tempted to emit per-slice labels (slice results flow through ``compute()``,
+never through obs labels).
+"""
+
+import unittest
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs.registry import (
+    Registry,
+    set_label_cardinality_cap,
+)
+
+
+class TestLabelCardinalityCap(unittest.TestCase):
+    def setUp(self):
+        self.prev = set_label_cardinality_cap(4)
+        self.addCleanup(set_label_cardinality_cap, self.prev)
+        self.registry = Registry()
+
+    def test_counter_series_capped_and_drops_counted(self):
+        for i in range(10):
+            self.registry.counter("serve.ingest.batches", tenant=f"t{i}")
+        snap = self.registry.snapshot()
+        kept = [
+            k
+            for k in snap["counters"]
+            if k.startswith("serve.ingest.batches{")
+        ]
+        self.assertEqual(len(kept), 4)
+        self.assertEqual(
+            snap["counters"][
+                "obs.labels.dropped{instrument=serve.ingest.batches}"
+            ],
+            6.0,
+        )
+
+    def test_existing_series_keep_recording_past_the_cap(self):
+        for i in range(6):
+            self.registry.counter("c", tenant=f"t{i}")
+        self.registry.counter("c", tenant="t0", delta=5.0)
+        snap = self.registry.snapshot()
+        self.assertEqual(snap["counters"]["c{tenant=t0}"], 6.0)
+
+    def test_unlabeled_series_never_capped(self):
+        for i in range(6):
+            self.registry.counter("labeled", i=str(i))
+        for _ in range(3):
+            self.registry.counter("plain")
+        self.assertEqual(
+            self.registry.snapshot()["counters"]["plain"], 3.0
+        )
+
+    def test_cap_spans_instrument_kinds(self):
+        # gauges, histograms and spans share the same per-name guard
+        for i in range(8):
+            self.registry.gauge("g", float(i), k=str(i))
+            self.registry.histo("h", float(i), k=str(i))
+            with self.registry.span("s", k=str(i)):
+                pass
+        snap = self.registry.snapshot()
+        self.assertEqual(
+            len([k for k in snap["gauges"] if k.startswith("g{")]), 4
+        )
+        self.assertEqual(
+            len([k for k in snap["histograms"] if k.startswith("h{")]), 4
+        )
+        self.assertEqual(
+            len([k for k in snap["spans"] if k.startswith("s{")]), 4
+        )
+        self.assertEqual(
+            snap["counters"]["obs.labels.dropped{instrument=g}"], 4.0
+        )
+
+    def test_names_capped_independently(self):
+        for i in range(5):
+            self.registry.counter("a", k=str(i))
+        for i in range(3):
+            self.registry.counter("b", k=str(i))
+        snap = self.registry.snapshot()
+        self.assertEqual(
+            len([k for k in snap["counters"] if k.startswith("b{")]), 3
+        )
+        self.assertNotIn("obs.labels.dropped{instrument=b}", snap["counters"])
+
+    def test_reset_clears_the_admission_count(self):
+        for i in range(6):
+            self.registry.counter("c", k=str(i))
+        self.registry.reset()
+        for i in range(3):
+            self.registry.counter("c", k=str(i))
+        snap = self.registry.snapshot()
+        self.assertEqual(
+            len([k for k in snap["counters"] if k.startswith("c{")]), 3
+        )
+
+    def test_cap_validation(self):
+        with self.assertRaises(ValueError):
+            set_label_cardinality_cap(0)
+        with self.assertRaises(ValueError):
+            set_label_cardinality_cap("lots")
+
+    def test_default_registry_obs_helpers_ride_the_cap(self):
+        obs.enable()
+        try:
+            obs.reset()
+            for i in range(6):
+                obs.counter("capped.series", k=str(i))
+            snap = obs.snapshot()
+            self.assertEqual(
+                len(
+                    [
+                        k
+                        for k in snap["counters"]
+                        if k.startswith("capped.series{")
+                    ]
+                ),
+                4,
+            )
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+if __name__ == "__main__":
+    unittest.main()

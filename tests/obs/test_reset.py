@@ -95,6 +95,48 @@ class TestCrossModuleReset(unittest.TestCase):
         self.assertEqual(first, 1)
         self.assertEqual(second, 2)
 
+    def test_reset_clears_per_instance_signature_stores(self):
+        # ISSUE 15 regression: reset() re-arms the storm warning AND must
+        # clear every watched_jit instance's per-static-key signature set —
+        # otherwise the re-armed warning fires on the very next SINGLE
+        # trace over stale counts (one test's legitimate shape diversity
+        # leaking a storm into a later churn-free run)
+        obs.set_retrace_threshold(3)
+        f = obs.watched_jit(lambda x: x + 1.0, name="reset.storm.fresh")
+        for n in range(1, 6):
+            f(jnp.asarray(np.ones(n, np.float32)))  # a legitimate storm
+        obs.reset()
+        logger, handler, records = _capture_telemetry()
+        try:
+            # ONE new shape after reset: a fresh run, no storm
+            f(jnp.asarray(np.ones(32, np.float32)))
+        finally:
+            logger.removeHandler(handler)
+        self.assertEqual(
+            [
+                r.getMessage()
+                for r in records
+                if "reset.storm.fresh" in r.getMessage()
+            ],
+            [],
+        )
+
+    def test_dropped_wrapper_store_is_collectable(self):
+        # review finding: the reset registry must hold instance stores
+        # WEAKLY — a dynamically-created wrapper's signature store dies
+        # with its closure instead of being pinned forever
+        import gc
+
+        from torcheval_tpu.obs import recompile
+
+        before = len(recompile._group_stores)
+        f = obs.watched_jit(lambda x: x - 1.0, name="reset.storm.dropme")
+        f(jnp.asarray(np.ones(3, np.float32)))
+        self.assertEqual(len(recompile._group_stores), before + 1)
+        del f
+        gc.collect()
+        self.assertEqual(len(recompile._group_stores), before)
+
     def test_reset_while_disabled_is_safe_and_total(self):
         obs.enable()
         obs.counter("reset.c")

@@ -1,0 +1,212 @@
+"""Sliced tenants through the serve stack (ISSUE 15).
+
+The serve integration surface: ``daemon.attach(slices=...)`` admission,
+the wire attach header + ``EvalClient.attach(slices=...)``, submit with the
+slice-id column, per-slice compute results over the wire, and the
+evict→reattach round trip carrying the sparse id table bit-identically.
+
+Plus the ISSUE 15 satellite regression: ``approx=`` (PR 14's per-tenant
+knob) must COMPOSE with a sliced attach under validate-then-commit — a
+spec that cannot slice rejects as ``bad_metrics`` BEFORE any member is
+switched into sketch state, so a caller-held collection never ends up
+half-mutated by a failed sliced admission.
+"""
+
+import tempfile
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    Cat,
+    SlicedMetricCollection,
+)
+from torcheval_tpu.serve import (
+    AdmissionError,
+    EvalClient,
+    EvalDaemon,
+    EvalServer,
+    metric_spec,
+)
+
+
+def _batches(seed=0, n_batches=3, n=200):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = rng.integers(0, 9, n).astype(np.int64) * 13 - 5
+        s = rng.random(n).astype(np.float32)
+        t = (rng.random(n) < 0.4).astype(np.float32)
+        out.append((ids, s, t))
+    return out
+
+
+def _spec():
+    return {"acc": BinaryAccuracy(), "auroc": BinaryAUROC()}
+
+
+class TestSlicedAttach(unittest.TestCase):
+    def test_attach_submit_compute(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach(
+                "t1", _spec(), approx=1024, slices={"capacity": 4}
+            )
+            self.assertIsInstance(
+                h._tenant.collection, SlicedMetricCollection
+            )
+            for b in _batches():
+                h.submit(*b)
+            res = h.compute()
+            self.assertEqual(
+                sorted(res["acc"]), ["slice_ids", "values"]
+            )
+            self.assertEqual(
+                len(res["acc"]["slice_ids"]),
+                len(np.unique(np.concatenate([b[0] for b in _batches()]))),
+            )
+            h.detach()
+
+    def test_slices_knob_shapes(self):
+        with EvalDaemon() as daemon:
+            daemon.attach("a", _spec(), approx=True, slices=True).detach()
+            daemon.attach("b", _spec(), approx=True, slices=16).detach()
+            with self.assertRaises(ValueError):
+                daemon.attach("c", _spec(), approx=True, slices={"nope": 1})
+            with self.assertRaises(ValueError):
+                daemon.attach("d", _spec(), approx=True, slices="yes")
+
+    def test_prebuilt_sliced_collection_passes_through(self):
+        col = SlicedMetricCollection(
+            {"acc": BinaryAccuracy()}, capacity=8
+        )
+        with EvalDaemon() as daemon:
+            h = daemon.attach("t1", col, slices=True)
+            self.assertIs(h._tenant.collection, col)
+            h.detach()
+
+    def test_evict_reattach_round_trips_id_table(self):
+        batches = _batches(seed=2)
+        with tempfile.TemporaryDirectory() as d:
+            with EvalDaemon(evict_dir=d) as daemon:
+                h = daemon.attach(
+                    "t1", _spec(), approx=1024, slices={"capacity": 2}
+                )
+                for b in batches:
+                    h.submit(*b)
+                want = h.compute()
+                table = h._tenant.collection.slice_table.registered_ids()
+                daemon.evict("t1")
+                h2 = daemon.attach(
+                    "t1",
+                    _spec(),
+                    approx=1024,
+                    slices={"capacity": 2},
+                    resume="require",
+                )
+                np.testing.assert_array_equal(
+                    h2._tenant.collection.slice_table.registered_ids(),
+                    table,
+                )
+                got = h2.compute()
+                for key in ("acc", "auroc"):
+                    np.testing.assert_array_equal(
+                        got[key]["slice_ids"], want[key]["slice_ids"]
+                    )
+                    np.testing.assert_array_equal(
+                        np.asarray(got[key]["values"]),
+                        np.asarray(want[key]["values"]),
+                    )
+                # the resumed tenant keeps streaming, new cohorts included
+                ids, s, t = batches[0]
+                h2.submit(ids * 31 + 2, s, t)
+                h2.compute()
+
+
+class TestApproxSlicedComposition(unittest.TestCase):
+    """ISSUE 15 satellite: validate-then-commit covers slice expansion."""
+
+    def test_unsliceable_member_rejects_before_approx_commits(self):
+        # Cat has an approx mode (the value sketch) but no slice
+        # expansion: a sliced attach must reject as bad_metrics WITHOUT
+        # enable_metric_approx having switched the caller-held instances
+        cat = Cat()
+        auroc = BinaryAUROC()
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach(
+                    "t1",
+                    {"auroc": auroc, "cat": cat},
+                    approx=1024,
+                    slices=True,
+                )
+            self.assertEqual(ctx.exception.reason, "bad_metrics")
+        # neither member was half-switched by the failed admission
+        self.assertFalse(cat._sketch_enabled())
+        self.assertIsNone(getattr(auroc, "_sketch_bits", None))
+        self.assertIn("summary_tp", auroc._state_name_to_default)
+
+    def test_exact_curve_without_approx_rejects_sliced(self):
+        # an exact curve cannot slice (per-slice sample caches); the
+        # rejection must name the approx requirement
+        with EvalDaemon() as daemon:
+            with self.assertRaises(AdmissionError) as ctx:
+                daemon.attach("t1", _spec(), slices=True)
+            self.assertEqual(ctx.exception.reason, "bad_metrics")
+            self.assertIn("approx", str(ctx.exception))
+
+    def test_approx_with_slices_expands_sketch_members(self):
+        with EvalDaemon() as daemon:
+            h = daemon.attach("t1", _spec(), approx=1024, slices=True)
+            member = h._tenant.collection.metrics["auroc"]
+            self.assertEqual(member._bits, 10)  # 1024 buckets
+            h.detach()
+
+
+class TestSlicedWire(unittest.TestCase):
+    def setUp(self):
+        self.daemon = EvalDaemon().start()
+        self.server = EvalServer(self.daemon)
+        self.client = EvalClient(
+            self.server.endpoint, request_timeout_s=30.0
+        )
+        self.addCleanup(self.daemon.stop)
+        self.addCleanup(self.server.close)
+        self.addCleanup(self.client.close)
+
+    def test_wire_attach_submit_compute_matches_local(self):
+        batches = _batches(seed=4)
+        with EvalDaemon() as local:
+            h = local.attach(
+                "ref", _spec(), approx=1024, slices={"capacity": 4}
+            )
+            for b in batches:
+                h.submit(*b)
+            want = h.compute()
+        spec = {
+            "acc": metric_spec("BinaryAccuracy"),
+            "auroc": metric_spec("BinaryAUROC"),
+        }
+        self.client.attach("w1", spec, approx=1024, slices={"capacity": 4})
+        for b in batches:
+            self.client.submit("w1", *b)
+        got = self.client.compute("w1")
+        for key in ("acc", "auroc"):
+            np.testing.assert_array_equal(
+                got[key]["slice_ids"], want[key]["slice_ids"]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got[key]["values"]),
+                np.asarray(want[key]["values"]),
+            )
+
+    def test_wire_rejects_unsliceable_spec(self):
+        spec = {"auroc": metric_spec("BinaryAUROC")}
+        with self.assertRaises(AdmissionError) as ctx:
+            self.client.attach("w2", spec, slices=True)
+        self.assertEqual(ctx.exception.reason, "bad_metrics")
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -153,6 +153,56 @@ class TestBenchHygiene(unittest.TestCase):
                 "1B-row stream the exact path cannot run) loses its "
                 "regression pin",
             )
+        for row in (
+            "config11_sliced_1m",
+            "config11_sliced_ratio",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the million-"
+                "cohort sliced-eval contract (ISSUE 15 — per-slice "
+                "accuracy+AUROC at the power-law distribution, ratio vs "
+                "the unsliced collection on identical rows) loses its "
+                "regression pin",
+            )
+
+    def test_loopback_rows_carry_machine_readable_sandbox_caveat(self):
+        # ISSUE 15 satellite (ROADMAP 1a/6): the 1-core loopback artifacts
+        # must be marked IN the JSON rows so trajectory tooling stops
+        # reading them as regressions — prose caveats were not enough
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_under_test2", os.path.join(_REPO, "bench.py")
+        )
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        for row in (
+            "config8_cluster_wire_codec_gain",
+            "config8_cluster_wire_1host_ratio",
+            "config11_sliced_ratio",
+        ):
+            self.assertIn(
+                row,
+                bench._SANDBOX_CAVEAT_ROWS,
+                f"{row} lost its sandbox_caveat field: the 1-core "
+                "loopback/serial-scatter artifact would read as a "
+                "regression again",
+            )
+        import io
+        import json
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            bench._emit_row("config8_cluster_wire_codec_gain", 0.7, "x")
+            bench._emit_row("config1_multiclass_accuracy_c5", 1.0, "x")
+        caveated, plain = (
+            json.loads(line) for line in buf.getvalue().splitlines()
+        )
+        self.assertIn("sandbox_caveat", caveated)
+        self.assertNotIn("sandbox_caveat", plain)
 
 
 if __name__ == "__main__":

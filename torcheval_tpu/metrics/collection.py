@@ -44,6 +44,13 @@ share one compiled program whatever their members are named — the
 property that lets ``torcheval_tpu.serve`` run hundreds of tenants (one
 collection each) off a handful of compiled programs.
 
+Per-cohort eval (ISSUE 15): :class:`~torcheval_tpu.metrics.sliced.
+SlicedMetricCollection` subclasses this collection — its ``update`` interns
+the batch's ``slice_ids`` column into dense rows host-side and then rides
+``_update_impl`` verbatim, so the window fast path, signature memoisation,
+budget valve and one-program close below serve the sliced members (whose
+states carry a leading slice axis) without modification.
+
 Donation caveat (unchanged semantics, window trigger): after a window step,
 previously captured references to a member's state arrays are invalid on
 donating backends (their buffers were donated). Read state through the

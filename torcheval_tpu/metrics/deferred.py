@@ -97,6 +97,17 @@ same trick the reference itself plays in ``_prepare_for_merge_state``
   (N, C) score batch after (N,) label batches) flush the pending list first
   so one fold never mixes ranks.
 
+**Slice expansion rides this machinery unchanged (ISSUE 15).** A
+``SlicedMetricCollection`` member (``metrics/sliced.py``) is just a
+``DeferredFoldMixin`` metric whose states carry a leading ``[num_slices]``
+axis and whose chunks carry a dense int32 row column first: its fold is a
+concat-regime ``_fold_fn`` ending in one segment scatter, its terminal
+compute a ``jax.vmap`` over axis 0 — so the shared window, the one donated
+window-step program, group folds, donation holds and the obs counters all
+apply per the contracts in this module with zero sliced-specific branches
+here. The layout contract (slice axis leading; a future per-window axis
+outside it) lives in docs/performance.md "Sliced metrics".
+
 Tracer transparency: when ``update`` is called inside someone else's trace
 (a user jitting their eval step around a metric), deferral would leak
 tracers into the pending list — so tracer args take the eager fold path,

@@ -721,6 +721,17 @@ def get_synced_metric(
         return clone_metric(metric)
     if recipient_rank != "all" and _process_index() != recipient_rank:
         return None
+    if getattr(metric, "_sliced_sync", False):
+        # row-keyed sliced states (ISSUE 15): ranks hold ragged cohort
+        # populations under private id→row mappings, so the gathered
+        # leading axes are NOT elementwise-alignable yet. Remap every
+        # rank's rows onto the deterministic sorted-union id table —
+        # pure local post-gather work, zero extra collective rounds; the
+        # fold below then treats the slices as the ordinary SUM/MAX/MIN
+        # lanes they are (with a leading axis).
+        from torcheval_tpu.metrics.sliced import align_sliced_gathered
+
+        gathered = align_sliced_gathered(metric, gathered)
     folded = _fold_states(gathered, metric._state_name_to_reduction)
     synced = clone_metric(metric)
     for name, red in metric._state_name_to_reduction.items():
@@ -734,6 +745,10 @@ def get_synced_metric(
             # semantics to a local merge_state fold
             value = deque(value, maxlen=getattr(default, "maxlen", None))
         synced._set_states({name: value})
+    if getattr(metric, "_sliced_sync", False):
+        # the union table is now IN the installed id lanes; rebuild the
+        # synced clone's host table/capacity/statics to match
+        synced._adopt_state_shapes()
     return synced
 
 

@@ -66,6 +66,7 @@ from torcheval_tpu.obs.registry import (
     enabled,
     gauge,
     histo,
+    set_label_cardinality_cap,
     snapshot,
     span,
 )
@@ -105,6 +106,7 @@ __all__ = [
     "prometheus_text",
     "reset",
     "retrace_threshold",
+    "set_label_cardinality_cap",
     "set_retrace_threshold",
     "set_timeline_capacity",
     "snapshot",

@@ -37,6 +37,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -52,7 +53,33 @@ _WARN_KEY_PREFIX = "torcheval_tpu.obs.recompile/"
 _lock = threading.Lock()
 # entry-point name -> {abstract signature -> trace count}
 _traces: Dict[str, Dict[Any, int]] = {}
+# every LIVE watched_jit instance's per-static-key signature store, so
+# reset() can clear them: a reset that re-arms the storm warnings but keeps
+# stale per-instance signature sets would re-fire instantly on the next
+# single trace (ISSUE 15 regression — per-slice oracle loops in one test
+# leaked storm state into a later test's churn-free assertion). Held
+# WEAKLY (review finding): a dropped wrapper's store must be collectable
+# with its closure, not pinned by this registry forever — dynamic
+# watched_jit factories (ops/topk.py per-config lowerings, user wrappers)
+# would otherwise accumulate dead stores without bound.
+_group_stores: "weakref.WeakSet" = weakref.WeakSet()
 _threshold = 8
+
+
+class _GroupStore(dict):
+    """A watched_jit instance's static-key -> {dynamic signatures} store.
+    A dict subclass ONLY so :data:`_group_stores` can reference it weakly
+    (plain dicts have no ``__weakref__`` slot). Identity hash/eq restore
+    set-membership semantics dict removes: the WeakSet must treat two
+    (possibly both-empty, hence dict-equal) stores as distinct members."""
+
+    __hash__ = object.__hash__
+
+    def __eq__(self, other):
+        return self is other
+
+    def __ne__(self, other):
+        return self is not other
 
 
 def retrace_threshold() -> int:
@@ -173,10 +200,16 @@ def trace_counts() -> Dict[str, Dict[str, int]]:
 
 
 def reset(*, rearm_warnings: bool = True) -> None:
-    """Clear trace bookkeeping (and by default re-arm the once-per-entry
-    warnings) — fresh-run semantics for tests and long-lived processes."""
+    """Clear trace bookkeeping — the module table AND every watched_jit
+    instance's per-static-key signature store (and by default re-arm the
+    once-per-entry warnings) — fresh-run semantics for tests and
+    long-lived processes. Clearing the instance stores matters: a re-armed
+    warning over surviving signature sets would re-fire on the very next
+    trace of an entry an earlier run legitimately stormed."""
     with _lock:
         _traces.clear()
+        for groups in list(_group_stores):
+            groups.clear()
     if rearm_warnings:
         reset_once_keys(_WARN_KEY_PREFIX)
 
@@ -211,8 +244,12 @@ def watched_jit(
     label = name or getattr(fun, "__qualname__", None) or repr(fun)
     # THIS instance's static-key -> {dynamic signatures} store: the storm
     # warning counts retraces of one program (one jit instance, one static
-    # configuration), never across instances that share a label
-    groups: Dict[Any, set] = {}
+    # configuration), never across instances that share a label. Registered
+    # (weakly) module-wide so reset() clears it with the rest of the
+    # bookkeeping while a dropped wrapper's store stays collectable.
+    groups: Dict[Any, set] = _GroupStore()
+    with _lock:
+        _group_stores.add(groups)
     # trace-detection cell: the probe flips it, the obs-enabled dispatch
     # wrapper clears-then-checks it around each call, so a compile-bearing
     # dispatch is distinguishable from a cache hit without touching jit

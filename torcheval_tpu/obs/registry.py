@@ -85,6 +85,31 @@ def _label_key(labels: Dict[str, Any]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+# ---------------------------------------------------------- label cardinality
+# Per-instrument-name cap on DISTINCT label sets (ISSUE 15 satellite). The
+# registry holds every (name, labels) series forever — per-tenant labels
+# under churn (thousands of tenants over a daemon's lifetime) would grow the
+# maps without bound, and per-SLICE labels (millions of cohorts) would be a
+# memory bomb: slice results flow through compute(), never through obs
+# labels. Past the cap, NEW label sets for a name are dropped (existing
+# series keep recording), counted into ``obs.labels.dropped{name=}`` and
+# warned once per name — loud, bounded, and impossible to mistake for data.
+_LABEL_SETS_CAP = 1024
+_DROPPED_NAME = "obs.labels.dropped"
+
+
+def set_label_cardinality_cap(cap: int) -> int:
+    """Set the per-name distinct-label-set cap (returns the previous one).
+    Applies to series CREATION: lowering the cap does not evict existing
+    series. Test hook + escape hatch for unusually wide fleets."""
+    global _LABEL_SETS_CAP
+    if not isinstance(cap, int) or cap < 1:
+        raise ValueError(f"label cardinality cap must be an int >= 1, got {cap!r}.")
+    prev = _LABEL_SETS_CAP
+    _LABEL_SETS_CAP = cap
+    return prev
+
+
 def format_key(name: str, labels: _LabelKey) -> str:
     """``name`` or ``name{k=v,...}`` — the snapshot-key spelling shared by
     :meth:`Registry.snapshot` and the cross-rank merge (``obs/distributed``),
@@ -276,7 +301,40 @@ class Registry:
         self._gauges: Dict[Tuple[str, _LabelKey], Gauge] = {}
         self._histos: Dict[Tuple[str, _LabelKey], Histogram] = {}
         self._spans: Dict[Tuple[str, _LabelKey], SpanStats] = {}
+        # distinct LABELED series created per instrument name, across all
+        # instrument kinds — the label-cardinality guard's admission count
+        self._label_sets: Dict[str, int] = {}
         self._local = threading.local()
+
+    # ------------------------------------------------- label-cardinality cap
+    def _admit_labels_locked(self, name: str, labels: _LabelKey) -> bool:
+        """Called under the lock when a series is about to be CREATED:
+        unlabeled series and the drop-accounting counter itself always
+        admit; labeled series admit until the per-name cap."""
+        if not labels or name == _DROPPED_NAME:
+            return True
+        n = self._label_sets.get(name, 0)
+        if n >= _LABEL_SETS_CAP:
+            return False
+        self._label_sets[name] = n + 1
+        return True
+
+    def _count_dropped(self, name: str) -> None:
+        """Outside the lock: account + warn once per capped name."""
+        # literal name (== _DROPPED_NAME): the doc-drift lint scans for it
+        self.counter("obs.labels.dropped", instrument=name)
+        from torcheval_tpu.utils.telemetry import log_once
+
+        log_once(
+            f"obs.labels.capped:{name}",
+            "obs registry: instrument %r exceeded the per-name label "
+            "cardinality cap (%d distinct label sets); new label sets are "
+            "dropped (existing series keep recording). High-cardinality "
+            "dimensions (per-slice cohorts!) belong in compute() results, "
+            "not obs labels. See docs/observability.md.",
+            name,
+            _LABEL_SETS_CAP,
+        )
 
     # ------------------------------------------------------------ instruments
     def counter(self, name: str, delta: float = 1.0, **labels: Any) -> None:
@@ -285,8 +343,14 @@ class Registry:
         with self._lock:
             c = self._counters.get(key)
             if c is None:
-                c = self._counters[key] = Counter()
-            c.inc(delta)
+                if not self._admit_labels_locked(name, key[1]):
+                    c = None
+                else:
+                    c = self._counters[key] = Counter()
+            if c is not None:
+                c.inc(delta)
+                return
+        self._count_dropped(name)
 
     def gauge(self, name: str, value: float, **labels: Any) -> None:
         """Set gauge ``name`` (created on first use) to ``value``."""
@@ -294,8 +358,14 @@ class Registry:
         with self._lock:
             g = self._gauges.get(key)
             if g is None:
-                g = self._gauges[key] = Gauge()
-            g.set(value)
+                if not self._admit_labels_locked(name, key[1]):
+                    g = None
+                else:
+                    g = self._gauges[key] = Gauge()
+            if g is not None:
+                g.set(value)
+                return
+        self._count_dropped(name)
 
     def histo(self, name: str, value: float, **labels: Any) -> None:
         """Record ``value`` into histogram ``name`` (created on first use)."""
@@ -303,8 +373,14 @@ class Registry:
         with self._lock:
             h = self._histos.get(key)
             if h is None:
-                h = self._histos[key] = Histogram()
-            h.record(value)
+                if not self._admit_labels_locked(name, key[1]):
+                    h = None
+                else:
+                    h = self._histos[key] = Histogram()
+            if h is not None:
+                h.record(value)
+                return
+        self._count_dropped(name)
 
     def span(self, name: str, **labels: Any) -> _Span:
         """Context manager timing a host-side span.
@@ -341,11 +417,19 @@ class Registry:
         t0: Optional[float] = None,
     ) -> None:
         key = (path, labels)
+        dropped = False
         with self._lock:
             s = self._spans.get(key)
             if s is None:
-                s = self._spans[key] = SpanStats()
-            s.record(seconds)
+                if not self._admit_labels_locked(path, labels):
+                    dropped = True
+                else:
+                    s = self._spans[key] = SpanStats()
+            if s is not None:
+                s.record(seconds)
+        if dropped:
+            self._count_dropped(path)
+            return
         # default-registry spans mirror into the event timeline ring
         # (obs/trace.py): the sink call sits OUTSIDE the registry lock
         if _span_sink is not None and self is default_registry:
@@ -445,6 +529,7 @@ class Registry:
             self._gauges.clear()
             self._histos.clear()
             self._spans.clear()
+            self._label_sets.clear()
 
 
 # The process-wide default registry every library call site reports into.

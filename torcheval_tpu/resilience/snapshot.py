@@ -715,19 +715,34 @@ def restore(obj: Any, path: str) -> Any:
                     and hasattr(default, "shape")
                     and tuple(value.shape) != tuple(default.shape)
                 ):
-                    # config drift the digest cannot see: two replicas
-                    # of the same class/state/reduction schema whose
-                    # constructor args size the state differently
-                    # (e.g. macro accuracy's per-class counters under
-                    # a different num_classes)
-                    raise CheckpointError(
-                        "schema_mismatch",
-                        f"state {sname!r} of metric {mkey!r} has shape "
-                        f"{tuple(value.shape)} in the checkpoint but "
-                        f"{tuple(default.shape)} in the restore target "
-                        "— same metric schema, drifted configuration "
-                        "(e.g. num_classes/num_tasks)?",
+                    # sliced states (ISSUE 15): the LEADING dim is the
+                    # dense slice capacity, which legitimately differs
+                    # between a fresh member and a grown checkpoint —
+                    # the member's load_state_dict re-derives capacity
+                    # and the id table from the restored lanes. Trailing
+                    # dims (the real per-slice schema) must still match.
+                    resizable = sname in getattr(
+                        metrics[mkey], "_lead_resizable_states", ()
                     )
+                    if not (
+                        resizable
+                        and len(value.shape) == len(default.shape)
+                        and tuple(value.shape[1:])
+                        == tuple(default.shape[1:])
+                    ):
+                        # config drift the digest cannot see: two replicas
+                        # of the same class/state/reduction schema whose
+                        # constructor args size the state differently
+                        # (e.g. macro accuracy's per-class counters under
+                        # a different num_classes)
+                        raise CheckpointError(
+                            "schema_mismatch",
+                            f"state {sname!r} of metric {mkey!r} has shape "
+                            f"{tuple(value.shape)} in the checkpoint but "
+                            f"{tuple(default.shape)} in the restore target "
+                            "— same metric schema, drifted configuration "
+                            "(e.g. num_classes/num_tasks)?",
+                        )
                 trees[mkey][sname] = value
         except (ValueError, OSError, KeyError, BadZipFile) as e:
             raise CheckpointError(

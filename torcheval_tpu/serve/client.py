@@ -501,6 +501,7 @@ class EvalClient:
         resume: Optional[str] = None,
         window_chunks: Optional[int] = None,
         approx=None,
+        slices=None,
         timeout_s: Any = _UNSET,
     ) -> Dict[str, Any]:
         """Attach ``tenant_id`` with a wire metric spec (see
@@ -512,7 +513,12 @@ class EvalClient:
         failure (our attach landed, the ack did not) is recognized
         server-side and answered with the ORIGINAL success instead of
         ``duplicate_tenant`` — attach is idempotent per call, like
-        submit."""
+        submit. ``slices`` threads the per-cohort config (ISSUE 15:
+        ``True`` / capacity int / ``{"capacity":, "curve_bucket_bits":}``)
+        — every ``submit`` for a sliced tenant must then carry the
+        ``slice_ids`` integer column as its FIRST argument, and
+        ``compute`` returns per-slice ``{"slice_ids": ..., "values": ...}``
+        results per member."""
         req = {
             "tenant": tenant_id,
             "spec": spec,
@@ -524,6 +530,7 @@ class EvalClient:
             "resume": resume,
             "window_chunks": window_chunks,
             "approx": approx,
+            "slices": slices,
         }
         if self._codec_pref != "raw":
             # capability exchange: qblk implies the lossless delta codec

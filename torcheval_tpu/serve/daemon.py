@@ -223,6 +223,7 @@ class EvalDaemon:
         resume: str = "auto",
         window_chunks: Optional[int] = None,
         approx=None,
+        slices=None,
     ) -> TenantHandle:
         """Admit one tenant and return its handle.
 
@@ -251,9 +252,19 @@ class EvalDaemon:
         ``num_classes``) — rejects as ``bad_metrics``. A tenant re-attached
         with a different ``approx`` than its eviction checkpoint cannot
         restore into the changed state schema — use ``resume="never"`` to
-        start it clean. Raises :class:`AdmissionError` (``"capacity"`` /
-        ``"duplicate_tenant"`` / ``"daemon_stopped"`` / ``"bad_metrics"``)
-        instead of ever over-admitting.
+        start it clean. ``slices`` (ISSUE 15) opts this tenant into
+        per-cohort eval: ``True`` (defaults), an int (initial dense
+        capacity), or ``{"capacity": int, "curve_bucket_bits": int}`` —
+        the tenant's metrics become a
+        :class:`~torcheval_tpu.metrics.SlicedMetricCollection`, every
+        ``submit`` must carry the ``slice_ids`` integer column FIRST, and
+        ``compute`` returns per-slice results keyed by original ids. The
+        sliceability of every member is validated BEFORE the ``approx``
+        knob commits (validate-then-commit covers slice expansion too): a
+        spec with an unsliceable member rejects as ``bad_metrics`` without
+        half-switching anything. Raises :class:`AdmissionError`
+        (``"capacity"`` / ``"duplicate_tenant"`` / ``"daemon_stopped"`` /
+        ``"bad_metrics"``) instead of ever over-admitting.
         """
         if nan_policy not in _NAN_POLICIES:
             raise ValueError(
@@ -334,6 +345,29 @@ class EvalDaemon:
                     "bad_metrics",
                     f"tenant {tenant_id!r} metrics are not servable: {e}",
                 ) from e
+            slice_cfg = self._normalize_slices(slices)
+            from torcheval_tpu.metrics.sliced import (
+                SlicedMetricCollection,
+                check_sliceable,
+            )
+
+            if slice_cfg is not None and not isinstance(
+                collection, SlicedMetricCollection
+            ):
+                # sliceability dry pass BEFORE the approx knob commits:
+                # validate-then-commit must cover slice-expanded members
+                # too — a spec with one unsliceable member rejects here
+                # without any member having been switched to sketch state
+                try:
+                    for m in collection.metrics.values():
+                        check_sliceable(m, approx=approx)
+                except ValueError as e:
+                    self._count_admission("rejected", "bad_metrics")
+                    raise AdmissionError(
+                        "bad_metrics",
+                        f"tenant {tenant_id!r} cannot run slices="
+                        f"{slices!r}: {e}",
+                    ) from e
             if approx is not None and approx is not False:
                 # per-tenant sketch opt-in (ROADMAP 4(c)): switch every
                 # approx-capable member at admission; reject when the spec
@@ -365,6 +399,20 @@ class EvalDaemon:
                     )
                 for m in collection.metrics.values():
                     enable_metric_approx(m, approx)
+            if slice_cfg is not None and not isinstance(
+                collection, SlicedMetricCollection
+            ):
+                try:
+                    collection = SlicedMetricCollection(
+                        collection.metrics, **slice_cfg
+                    )
+                except ValueError as e:
+                    self._count_admission("rejected", "bad_metrics")
+                    raise AdmissionError(
+                        "bad_metrics",
+                        f"tenant {tenant_id!r} cannot run slices="
+                        f"{slices!r}: {e}",
+                    ) from e
             if window_chunks is not None:
                 # per-instance valve override (the collection's budget
                 # check reads the probe member; each member's own 2x
@@ -469,6 +517,33 @@ class EvalDaemon:
             if _obs._enabled:
                 _obs.gauge("serve.tenants.active", float(len(self._tenants)))
         return TenantHandle(self, tenant)
+
+    @staticmethod
+    def _normalize_slices(slices) -> Optional[dict]:
+        """``slices`` knob → SlicedMetricCollection kwargs (or ``None`` =
+        unsliced). ``True`` = defaults, an int = initial dense capacity, a
+        dict allows ``capacity`` / ``curve_bucket_bits``. Validated at the
+        admission boundary so a typo'd config rejects the attach instead
+        of surfacing later as tenant poison."""
+        if slices is None or slices is False:
+            return None
+        if slices is True:
+            return {}
+        if isinstance(slices, int):
+            return {"capacity": slices}
+        if isinstance(slices, dict):
+            allowed = {"capacity", "curve_bucket_bits"}
+            unknown = set(slices) - allowed
+            if unknown:
+                raise ValueError(
+                    f"unknown slices config keys {sorted(unknown)}; "
+                    f"allowed: {sorted(allowed)}."
+                )
+            return {k: int(v) for k, v in slices.items()}
+        raise ValueError(
+            "slices must be True, an int capacity, or a config dict, "
+            f"got {slices!r}."
+        )
 
     @staticmethod
     def _best_serve_ckpt(ckpt_dir: Optional[str]) -> Optional[str]:
@@ -842,6 +917,13 @@ class EvalDaemon:
         for tenant, items in plans:
             if tenant.nan_policy == "reject":
                 continue
+            if getattr(tenant.collection, "_host_ingest_only", False):
+                # sliced tenants (ISSUE 15): the slice-id column must stay
+                # host-side until the collection interns it — a coalesced
+                # H2D here would strand the ids on device and force a
+                # readback per batch. Slice routing as a staging-pass
+                # step is the ROADMAP 3(c) follow-up seam.
+                continue
             probe = getattr(tenant.collection, "_defer_probe", None)
             device = getattr(probe, "_plain_device", None)
             if device is None:
@@ -993,8 +1075,14 @@ class EvalDaemon:
         pass's gates)."""
         probe = getattr(tenant.collection, "_defer_probe", None)
         device = getattr(probe, "_plain_device", None)
-        if device is None or not args or not all(
-            type(a) is np.ndarray and a.dtype.kind in "biufc" for a in args
+        if (
+            device is None
+            or getattr(tenant.collection, "_host_ingest_only", False)
+            or not args
+            or not all(
+                type(a) is np.ndarray and a.dtype.kind in "biufc"
+                for a in args
+            )
         ):
             return None
         from torcheval_tpu.serve import ingest as _ingest

@@ -1021,6 +1021,7 @@ class EvalServer:
             "resume",
             "window_chunks",
             "approx",
+            "slices",
         ):
             if header.get(knob) is not None:
                 kwargs[knob] = header[knob]

@@ -34,9 +34,12 @@ import jax.numpy as jnp
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.sketch.buckets import (
     DEFAULT_BUCKET_BITS,
+    MAX_BUCKET_BITS,
+    bucket_index,
     check_bucket_bits,
 )
 from torcheval_tpu.sketch.histogram import (
+    _desc_reps,
     auprc_from_hist,
     auroc_from_hist,
     counts_exactness_flag,
@@ -399,6 +402,129 @@ def enable_metric_approx(metric, approx, *, dry_run: bool = False) -> bool:
             metric._init_value_sketch(bits, cache_name)
         return True
     return False
+
+
+# ------------------------------------------------- sliced sketch folds (ISSUE 15)
+# Per-slice score sketches for SlicedMetricCollection: every slice keeps its
+# own (tp, fp) bucket histogram, folded by ONE combined-index segment_sum
+# (slice_row * buckets + bucket) so the scratch stays O(batch) instead of
+# O(batch x buckets) — the shape the generic per-sample vmap fold would pay.
+# The per-slice state is O(buckets) int32, so a million cohorts of curve
+# state survive on bounded memory when the bucket count is sized for it.
+
+# Sliced sketches may go COARSER than the standalone MIN_BUCKET_BITS floor:
+# below 10 bits a bucket spans exponent boundaries and the per-value
+# relative-error story collapses — but a per-slice AUROC/AUPRC only needs
+# the bucket ORDER (the curve kernels never read the representatives), and
+# at a million slices every extra bit doubles hundreds of MB of state. The
+# a-posteriori error bounds (auroc_error_bound) stay computable and honest
+# at any width; docs/performance.md "Sliced metrics" carries the cost model.
+SLICED_MIN_BUCKET_BITS = 4
+
+
+def check_sliced_bucket_bits(bucket_bits: int) -> int:
+    if (
+        not isinstance(bucket_bits, int)
+        or not SLICED_MIN_BUCKET_BITS <= bucket_bits <= MAX_BUCKET_BITS
+    ):
+        raise ValueError(
+            "sliced curve_bucket_bits must be an int in "
+            f"[{SLICED_MIN_BUCKET_BITS}, {MAX_BUCKET_BITS}], got "
+            f"{bucket_bits!r}."
+        )
+    return bucket_bits
+
+
+def check_sliced_sketch_extent(bucket_bits: int, num_slices: int) -> None:
+    """Fail closed at the sliced sketch's addressing edge (review finding):
+    the combined segment index is ``rows * planes + plane`` in int32, so
+    ``num_slices * (2^(bits+1) + 1)`` must stay <= 2^31 - 1 — past it the
+    index silently WRAPS and per-slice counts corrupt (and the flat
+    histogram's memory explodes long before that helps anyone). Raised at
+    member registration / capacity growth, never inside the program, with
+    the two remedies named. Default 16-bit buckets cap out at ~16k slices;
+    a million cohorts need <= 14 planes' worth, i.e. coarse widths
+    (``curve_bucket_bits`` 4-6) or a sharded slice axis
+    (docs/performance.md "Sliced metrics")."""
+    planes = 2 * (1 << bucket_bits) + 1
+    if num_slices * planes > 2**31 - 1:
+        raise ValueError(
+            f"sliced sketch extent {num_slices} slices x {planes} planes "
+            f"(curve_bucket_bits={bucket_bits}) exceeds the int32 segment-"
+            "index range (2^31-1): per-slice histogram counts would "
+            "silently corrupt. Use a coarser curve_bucket_bits (each bit "
+            "halves the slice headroom) or shard the slice axis across "
+            "hosts (docs/performance.md, 'Sliced metrics')."
+        )
+
+
+def sliced_score_hist_fold(rows, scores, targets, bits, num_slices):
+    """Fold one ``(N,)`` binary score/target batch into per-slice
+    ``(num_slices, B)`` ``(tp, fp)`` int32 histograms plus a per-slice NaN
+    lane, routed by the dense ``rows`` column. Additive and integer-exact:
+    any chunking of the stream sums to the same counts, so per-slice values
+    are bit-identical to a looped per-slice fold of the same kernel.
+
+    ONE combined-index scatter carries everything: each sample lands in
+    plane ``2*bucket + (1 - target)`` of its slice's ``2B + 1`` planes
+    (NaN samples in the last plane), so the fold pays a single
+    segment_sum pass over the batch however many count lanes the sketch
+    keeps — XLA:CPU's scatter is serial per update, so pass count, not
+    lane count, is the cost (docs/performance.md "Sliced metrics")."""
+    check_sliced_bucket_bits(bits)
+    rows = rows.astype(jnp.int32)
+    nan = jnp.isnan(scores.astype(jnp.float32))
+    t = targets.astype(jnp.int32)
+    b = bucket_index(scores, bits)
+    num_buckets = 1 << bits
+    planes = 2 * num_buckets + 1
+    plane = jnp.where(nan, 2 * num_buckets, 2 * b + (1 - t))
+    idx = rows * planes + plane
+    hist = jax.ops.segment_sum(
+        jnp.ones_like(rows), idx, num_segments=num_slices * planes
+    ).reshape(num_slices, planes)
+    return {
+        "sketch_tp": hist[:, 0 : 2 * num_buckets : 2],
+        "sketch_fp": hist[:, 1 : 2 * num_buckets : 2],
+        "sketch_nan_dropped": hist[:, 2 * num_buckets],
+    }
+
+
+def sliced_curve_values(tp, fp, bits, kind):
+    """Per-slice curve values from ``(S, B)`` sketches: the SAME presorted
+    counts kernel the standalone sketch metrics compute through, vmapped
+    over the slice axis — per-slice values are bit-identical to
+    :func:`~torcheval_tpu.sketch.histogram.auroc_from_hist` on that slice's
+    counts. For coarse sliced widths (below the standalone bucket-bits
+    floor) the representatives row is inert zeros: the counts kernels use
+    the score column for shape only."""
+    from torcheval_tpu.ops.curves import (
+        binary_auprc_counts_presorted_kernel,
+        binary_auroc_counts_presorted_kernel,
+    )
+
+    kernel = (
+        binary_auroc_counts_presorted_kernel
+        if kind == "auroc"
+        else binary_auprc_counts_presorted_kernel
+    )
+    try:
+        reps = _desc_reps(bits)
+    except ValueError:  # coarse sliced width: representatives undefined
+        reps = jnp.zeros((1 << bits,), jnp.float32)
+    return jax.vmap(lambda a, b: kernel(reps, a[::-1], b[::-1]))(tp, fp)
+
+
+def sliced_curve_compute(tp, fp, nan, _hi, _lo, _count, bits, kind):
+    """Terminal ``_compute_fn`` of the sliced score-sketch member (the id
+    states ride the registration order but the curve ignores them): returns
+    ``(per_slice_values, exactness_flag, nan_total)`` — the host-side
+    ``_on_window_result`` raises on the flags and wraps the values."""
+    return (
+        sliced_curve_values(tp, fp, bits, kind),
+        counts_exactness_flag(tp, fp),
+        jnp.sum(nan),
+    )
 
 
 # ------------------------------------------------------- score-sketch mixin
