@@ -1,0 +1,268 @@
+"""Delta-snapshot algebra (ISSUE 16 tentpole): ``Registry.delta_since``
+cursors, ``obs.stream.collect`` / ``DeltaAccumulator``.
+
+The contract under test: applying every delta in order reconstructs the
+full snapshot EXACTLY (delta∘delta == snapshot diff), cursors stay
+monotonic across ``obs.reset()`` (a reset bumps the generation and the
+next delta is full, never a misfolded diff), and sparse histogram bucket
+deltas sum exactly to the count delta — the accumulator never drifts.
+"""
+
+import threading
+import unittest
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import trace as obs_trace
+from torcheval_tpu.obs.registry import Registry
+from torcheval_tpu.obs.stream import (
+    DeltaAccumulator,
+    collect,
+    delta_nbytes,
+)
+
+
+class TestRegistryDelta(unittest.TestCase):
+    def setUp(self):
+        self.reg = Registry()
+
+    def test_first_delta_is_full(self):
+        self.reg.counter("c", 3)
+        delta, cursor = self.reg.delta_since(None)
+        self.assertTrue(delta["full"])
+        self.assertEqual(delta["counters"]["c"], 3.0)
+        self.assertEqual(delta["seq"], 1)
+        self.assertIsNotNone(cursor)
+
+    def test_incremental_delta_carries_only_changes(self):
+        self.reg.counter("c", 3)
+        self.reg.gauge("g", 1.0)
+        _, cursor = self.reg.delta_since(None)
+        self.reg.counter("c", 2)
+        delta, _ = self.reg.delta_since(cursor)
+        self.assertFalse(delta["full"])
+        self.assertEqual(delta["counters"], {"c": 2.0})
+        self.assertEqual(delta["gauges"], {})  # unchanged gauge absent
+
+    def test_quiet_registry_yields_empty_delta(self):
+        self.reg.counter("c")
+        _, cursor = self.reg.delta_since(None)
+        delta, _ = self.reg.delta_since(cursor)
+        self.assertEqual(delta["counters"], {})
+        self.assertEqual(delta["gauges"], {})
+        self.assertEqual(delta["histograms"], {})
+        self.assertEqual(delta["spans"], {})
+
+    def test_histogram_bucket_deltas_sum_exactly_to_count_delta(self):
+        for v in (0.001, 0.01, 0.01, 1.0, 30.0):
+            self.reg.histo("h", v)
+        _, cursor = self.reg.delta_since(None)
+        for v in (0.01, 0.5, 0.5, 100.0):
+            self.reg.histo("h", v)
+        delta, _ = self.reg.delta_since(cursor)
+        h = delta["histograms"]["h"]
+        self.assertEqual(sum(n for _i, n in h["buckets"]), h["count"])
+        self.assertEqual(h["count"], 4)
+        # every sparse entry is a strictly positive increment
+        self.assertTrue(all(n > 0 for _i, n in h["buckets"]))
+
+    def test_span_delta_ships_absolute_max(self):
+        self.reg._record_span("s", (), 0.5)
+        _, cursor = self.reg.delta_since(None)
+        self.reg._record_span("s", (), 0.1)
+        delta, _ = self.reg.delta_since(cursor)
+        s = delta["spans"]["s"]
+        self.assertEqual(s["count"], 1)
+        self.assertAlmostEqual(s["total_seconds"], 0.1)
+        # max is monotone within a generation: absolute, not a diff
+        self.assertAlmostEqual(s["max_seconds"], 0.5)
+
+    def test_cursor_seq_is_monotonic(self):
+        seqs = []
+        cursor = None
+        for _ in range(5):
+            self.reg.counter("c")
+            delta, cursor = self.reg.delta_since(cursor)
+            seqs.append(delta["seq"])
+        self.assertEqual(seqs, sorted(seqs))
+        self.assertEqual(len(set(seqs)), len(seqs))
+
+    def test_reset_bumps_generation_and_forces_full_delta(self):
+        self.reg.counter("c", 10)
+        _, cursor = self.reg.delta_since(None)
+        gen0 = cursor.gen
+        self.reg.reset()
+        self.reg.counter("c", 1)
+        delta, cursor2 = self.reg.delta_since(cursor)
+        self.assertTrue(delta["full"])
+        self.assertGreater(delta["gen"], gen0)
+        # the counter restarts from 1 — NOT a negative diff vs the old 10
+        self.assertEqual(delta["counters"]["c"], 1.0)
+        # and the seq still advanced (monotonic across resets)
+        self.assertGreater(cursor2.seq, cursor.seq)
+
+
+class TestDeltaComposition(unittest.TestCase):
+    """delta∘delta == snapshot diff, through the accumulator."""
+
+    def _pump(self, reg, seed):
+        reg.counter("events", 1 + seed)
+        reg.counter("bytes", 10.0 * (seed + 1), lane="SUM")
+        reg.gauge("depth", float(seed))
+        for v in (0.001 * (seed + 1), 0.1, 2.0**seed):
+            reg.histo("lat", v)
+        reg._record_span("step", (), 0.01 * (seed + 1))
+
+    def test_accumulated_deltas_reconstruct_snapshot_exactly(self):
+        reg = Registry()
+        acc = DeltaAccumulator()
+        cursor = None
+        for seed in range(4):
+            self._pump(reg, seed)
+            delta, cursor = reg.delta_since(cursor)
+            acc.apply(delta)
+        want, got = reg.snapshot(), acc.snapshot()
+        self.assertEqual(got["counters"], want["counters"])
+        self.assertEqual(got["gauges"], want["gauges"])
+        for key, h in want["histograms"].items():
+            g = got["histograms"][key]
+            self.assertEqual(g["count"], h["count"])
+            self.assertAlmostEqual(g["sum"], h["sum"])
+            for q in ("p50", "p95", "p99"):
+                self.assertAlmostEqual(g[q], h[q])
+        for key, s in want["spans"].items():
+            g = got["spans"][key]
+            self.assertEqual(g["count"], s["count"])
+            self.assertAlmostEqual(g["total_seconds"], s["total_seconds"])
+            self.assertAlmostEqual(g["max_seconds"], s["max_seconds"])
+
+    def test_two_step_composition_equals_one_step(self):
+        """Folding deltas A->B and B->C equals the single delta A->C."""
+        reg = Registry()
+        self._pump(reg, 0)
+        _, base = reg.delta_since(None)
+
+        self._pump(reg, 1)
+        d1, mid = reg.delta_since(base)
+        self._pump(reg, 2)
+        d2, _ = reg.delta_since(mid)
+
+        direct, _ = reg.delta_since(base)
+
+        two = DeltaAccumulator()
+        two.apply(d1)
+        two.apply(d2)
+        one = DeltaAccumulator()
+        one.apply(direct)
+        self.assertEqual(
+            two.snapshot()["counters"], one.snapshot()["counters"]
+        )
+        self.assertEqual(
+            two.snapshot()["gauges"], one.snapshot()["gauges"]
+        )
+        th = two.snapshot()["histograms"]
+        oh = one.snapshot()["histograms"]
+        self.assertEqual(
+            {k: v["count"] for k, v in th.items()},
+            {k: v["count"] for k, v in oh.items()},
+        )
+
+    def test_full_delta_clears_accumulator_state(self):
+        reg = Registry()
+        reg.counter("c", 5)
+        acc = DeltaAccumulator()
+        d, cursor = reg.delta_since(None)
+        acc.apply(d)
+        reg.reset()
+        reg.counter("c", 2)
+        d2, _ = reg.delta_since(cursor)
+        self.assertTrue(d2["full"])
+        acc.apply(d2)
+        # post-reset truth, not 5+2
+        self.assertEqual(acc.snapshot()["counters"]["c"], 2.0)
+
+    def test_concurrent_writers_never_break_the_algebra(self):
+        reg = Registry()
+        acc = DeltaAccumulator()
+        stop = threading.Event()
+
+        def writer():
+            while not stop.is_set():
+                reg.counter("spin")
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            cursor = None
+            for _ in range(20):
+                delta, cursor = reg.delta_since(cursor)
+                acc.apply(delta)
+        finally:
+            stop.set()
+            t.join(5.0)
+        delta, _ = reg.delta_since(cursor)
+        acc.apply(delta)
+        self.assertEqual(
+            acc.snapshot()["counters"]["spin"],
+            reg.snapshot()["counters"]["spin"],
+        )
+
+
+class TestStreamCollect(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.disable)
+        self.addCleanup(obs.reset)
+
+    def test_collect_includes_timeline_events_once(self):
+        obs_trace.instant("evt.a", kind="test")
+        delta, cursor = collect()
+        names = [e["name"] for e in delta["events"]]
+        self.assertIn("evt.a", names)
+        obs_trace.instant("evt.b", kind="test")
+        delta2, _ = collect(cursor)
+        names2 = [e["name"] for e in delta2["events"]]
+        self.assertNotIn("evt.a", names2)  # already streamed
+        self.assertIn("evt.b", names2)
+
+    def test_collect_trims_event_floods_and_counts_them(self):
+        for i in range(40):
+            obs_trace.instant(f"evt.{i}", kind="test")
+        delta, _ = collect(max_events=10)
+        self.assertEqual(len(delta["events"]), 10)
+        self.assertEqual(delta["events_trimmed"], 30)
+        # the newest events survive the trim
+        self.assertEqual(delta["events"][-1]["name"], "evt.39")
+
+    def test_cursor_survives_obs_reset(self):
+        obs_trace.instant("evt.a", kind="test")
+        _, cursor = collect()
+        obs.reset()  # clears the ring AND bumps the registry generation
+        obs.enable()
+        obs_trace.instant("evt.c", kind="test")
+        # the full delta rewinds the event cursor: post-reset events are
+        # delivered even though the all-time index moved backwards
+        delta, _ = collect(cursor)
+        self.assertTrue(delta["full"])
+        self.assertIn(
+            "evt.c", [e["name"] for e in delta["events"]]
+        )
+
+    def test_delta_nbytes_is_compact_json_length(self):
+        delta, _ = collect()
+        self.assertGreater(delta_nbytes(delta), 0)
+        self.assertIsInstance(delta_nbytes(delta), int)
+
+    def test_obs_reset_forces_full_collect(self):
+        obs.counter("c", 2)
+        _, cursor = collect()
+        obs.reset()
+        obs.enable()
+        obs.counter("c", 1)
+        delta, _ = collect(cursor)
+        self.assertTrue(delta["full"])
+        self.assertEqual(delta["counters"]["c"], 1.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

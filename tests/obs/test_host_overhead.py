@@ -17,6 +17,8 @@ import numpy as np
 
 from torcheval_tpu import obs
 from torcheval_tpu.obs import registry as obs_registry
+from torcheval_tpu.obs import slo as obs_slo
+from torcheval_tpu.obs import stream as obs_stream
 from torcheval_tpu.obs import trace as obs_trace
 
 
@@ -67,7 +69,15 @@ class TestDisabledPathZeroObsWork(unittest.TestCase):
         # warm any lazy caches on the exact path under measurement
         for _ in range(5):
             col.update(batch)
-        obs_files = (obs_trace.__file__, obs_registry.__file__)
+        # ISSUE 16: the streaming/SLO modules are IMPORTED (top of this
+        # file) but idle — merely having them loaded must not add
+        # allocations to the armed disabled-path update
+        obs_files = (
+            obs_trace.__file__,
+            obs_registry.__file__,
+            obs_stream.__file__,
+            obs_slo.__file__,
+        )
         tracemalloc.start(25)
         try:
             snap0 = tracemalloc.take_snapshot()

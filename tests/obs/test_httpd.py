@@ -1,0 +1,85 @@
+"""Stdlib metrics endpoint (ISSUE 16 satellite): ``GET /metrics``
+(Prometheus exposition) + ``GET /health`` (JSON from the wired
+provider), ephemeral ports, contained provider failures, idempotent
+close."""
+
+import json
+import unittest
+import urllib.error
+import urllib.request
+
+from torcheval_tpu.obs.httpd import MetricsServer
+from torcheval_tpu.obs.registry import Registry
+
+
+class TestMetricsServer(unittest.TestCase):
+    def _server(self, **kw):
+        srv = MetricsServer(port=0, **kw).start()
+        self.addCleanup(srv.close)
+        return srv
+
+    def _get(self, srv, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10
+        )
+
+    def test_metrics_serves_prometheus_text(self):
+        reg = Registry()
+        reg.counter("requests", 3, lane="a")
+        srv = self._server(registry=reg)
+        resp = self._get(srv, "/metrics")
+        self.assertEqual(resp.status, 200)
+        self.assertIn("text/plain", resp.headers["Content-Type"])
+        body = resp.read().decode()
+        self.assertIn("requests", body)
+        self.assertIn("# TYPE", body)
+
+    def test_health_default_is_minimal_ok(self):
+        srv = self._server()
+        resp = self._get(srv, "/health")
+        self.assertEqual(json.loads(resp.read().decode()), {"ok": True})
+
+    def test_health_serves_wired_provider(self):
+        srv = self._server(
+            health_provider=lambda: {"schema": 1, "queue": {"depth": 2}}
+        )
+        body = json.loads(self._get(srv, "/health").read().decode())
+        self.assertEqual(body["schema"], 1)
+        self.assertEqual(body["queue"]["depth"], 2)
+
+    def test_broken_provider_is_a_contained_500(self):
+        def broken():
+            raise RuntimeError("daemon mid-shutdown")
+
+        srv = self._server(health_provider=broken)
+        with self.assertRaises(urllib.error.HTTPError) as ctx:
+            self._get(srv, "/health")
+        self.assertEqual(ctx.exception.code, 500)
+        body = json.loads(ctx.exception.read().decode())
+        self.assertFalse(body["ok"])
+        self.assertIn("daemon mid-shutdown", body["error"])
+        # the server survives the broken provider
+        self.assertEqual(self._get(srv, "/metrics").status, 200)
+
+    def test_unknown_path_is_404(self):
+        srv = self._server()
+        with self.assertRaises(urllib.error.HTTPError) as ctx:
+            self._get(srv, "/nope")
+        self.assertEqual(ctx.exception.code, 404)
+
+    def test_ephemeral_port_is_readable_and_close_is_idempotent(self):
+        srv = MetricsServer(port=0).start()
+        self.assertGreater(srv.port, 0)
+        self.assertEqual(srv.address, ("127.0.0.1", srv.port))
+        srv.close()
+        srv.close()  # idempotent
+
+    def test_start_is_idempotent(self):
+        srv = self._server()
+        port = srv.port
+        srv.start()
+        self.assertEqual(srv.port, port)
+
+
+if __name__ == "__main__":
+    unittest.main()

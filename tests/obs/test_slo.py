@@ -1,0 +1,244 @@
+"""SLO objectives + burn alarms (ISSUE 16 tentpole leg 4): windowed
+burn-rate math over the cumulative histograms, edge-triggered breach
+semantics (exactly one alarm per transition), and the thread-safe alarm
+hook registry."""
+
+import threading
+import unittest
+
+from torcheval_tpu.obs import slo as slo_mod
+from torcheval_tpu.obs.registry import Registry
+from torcheval_tpu.obs.slo import (
+    Slo,
+    evaluate_slos,
+    fire_alarm,
+    on_alarm,
+    register_slo,
+    registered_slos,
+    remove_alarm,
+    unregister_slo,
+)
+
+
+class TestSloValidation(unittest.TestCase):
+    def test_rejects_bad_knobs(self):
+        for kw in (
+            {"threshold_s": 0.0},
+            {"threshold_s": -1.0},
+            {"window_s": 0.0},
+            {"budget": 0.0},
+            {"budget": 1.5},
+        ):
+            kwargs = {
+                "instrument": "x",
+                "threshold_s": 0.1,
+                "window_s": 10.0,
+                "budget": 0.01,
+            }
+            kwargs.update(kw)
+            with self.assertRaises(ValueError):
+                Slo("o", **kwargs)
+
+
+class TestSloEvaluation(unittest.TestCase):
+    def setUp(self):
+        self.reg = Registry()
+        slo_mod._reset_for_tests()
+        self.addCleanup(slo_mod._reset_for_tests)
+
+    def _slo(self, **kw):
+        kwargs = dict(
+            instrument="lat",
+            threshold_s=0.1,
+            window_s=10.0,
+            budget=0.1,
+        )
+        kwargs.update(kw)
+        return Slo("p99", **kwargs)
+
+    def test_no_observations_no_burn(self):
+        slo = self._slo()
+        res = slo.evaluate(registry=self.reg, now=0.0)
+        self.assertEqual(res["burn_rate"], 0.0)
+        self.assertEqual(res["breaches"], [])
+
+    def test_good_traffic_stays_under_budget(self):
+        slo = self._slo()
+        slo.evaluate(registry=self.reg, now=0.0)
+        for _ in range(100):
+            self.reg.histo("lat", 0.01)  # well under threshold
+        res = slo.evaluate(registry=self.reg, now=1.0)
+        self.assertEqual(res["burn_rate"], 0.0)
+        self.assertEqual(res["breaches"], [])
+
+    def test_bad_traffic_breaches_once_edge_triggered(self):
+        fired = []
+        on_alarm(fired.append)
+        slo = self._slo()
+        slo.evaluate(registry=self.reg, now=0.0)
+        for _ in range(50):
+            self.reg.histo("lat", 5.0)  # way over threshold
+        res = slo.evaluate(registry=self.reg, now=1.0)
+        self.assertGreaterEqual(res["burn_rate"], 1.0)
+        self.assertEqual(len(res["breaches"]), 1)
+        self.assertEqual(len(fired), 1)
+        self.assertEqual(fired[0]["kind"], "slo.breach")
+        self.assertEqual(fired[0]["objective"], "p99")
+        # a stuck-bad series alarms ONCE, not once per evaluation
+        for t in (2.0, 3.0, 4.0):
+            res = slo.evaluate(registry=self.reg, now=t)
+            self.assertEqual(res["breaches"], [])
+        self.assertEqual(len(fired), 1)
+        # breach counter recorded exactly once
+        self.assertEqual(
+            self.reg.snapshot()["counters"]["slo.breach{objective=p99}"],
+            1.0,
+        )
+
+    def test_rearms_after_window_slides_clean(self):
+        fired = []
+        on_alarm(fired.append)
+        slo = self._slo()
+        slo.evaluate(registry=self.reg, now=0.0)
+        for _ in range(10):
+            self.reg.histo("lat", 5.0)
+        slo.evaluate(registry=self.reg, now=1.0)
+        self.assertEqual(len(fired), 1)
+        # bad traffic stops; window slides past it -> burn returns to 0
+        for _ in range(100):
+            self.reg.histo("lat", 0.01)
+        slo.evaluate(registry=self.reg, now=12.0)
+        res = slo.evaluate(registry=self.reg, now=24.0)
+        self.assertEqual(res["burn_rate"], 0.0)
+        # a fresh burst alarms AGAIN (the edge re-armed)
+        for _ in range(10):
+            self.reg.histo("lat", 5.0)
+        slo.evaluate(registry=self.reg, now=25.0)
+        self.assertEqual(len(fired), 2)
+
+    def test_tenant_label_carried_into_breach_counter(self):
+        slo = self._slo()
+        slo.evaluate(registry=self.reg, now=0.0)
+        for _ in range(10):
+            self.reg.histo("lat", 5.0, tenant="t7")
+        slo.evaluate(registry=self.reg, now=1.0)
+        counters = self.reg.snapshot()["counters"]
+        self.assertIn(
+            "slo.breach{objective=p99,tenant=t7}", counters
+        )
+
+    def test_burn_rate_gauge_always_recorded(self):
+        slo = self._slo()
+        slo.evaluate(registry=self.reg, now=0.0)
+        gauges = self.reg.snapshot()["gauges"]
+        self.assertIn("slo.burn_rate{objective=p99}", gauges)
+
+    def test_min_count_suppresses_thin_windows(self):
+        slo = self._slo(min_count=5)
+        slo.evaluate(registry=self.reg, now=0.0)
+        self.reg.histo("lat", 5.0)  # 1 bad observation < min_count
+        res = slo.evaluate(registry=self.reg, now=1.0)
+        self.assertEqual(res["burn_rate"], 0.0)
+
+    def test_registry_reset_rearms_series(self):
+        fired = []
+        on_alarm(fired.append)
+        slo = self._slo()
+        slo.evaluate(registry=self.reg, now=0.0)
+        for _ in range(10):
+            self.reg.histo("lat", 5.0)
+        slo.evaluate(registry=self.reg, now=1.0)
+        self.assertEqual(len(fired), 1)
+        self.reg.reset()
+        slo.evaluate(registry=self.reg, now=2.0)  # forgets dropped series
+        for _ in range(10):
+            self.reg.histo("lat", 5.0)
+        slo.evaluate(registry=self.reg, now=3.0)
+        self.assertEqual(len(fired), 2)
+
+    def test_span_instruments_evaluate_too(self):
+        fired = []
+        on_alarm(fired.append)
+        slo = self._slo(instrument="step")
+        slo.evaluate(registry=self.reg, now=0.0)
+        for _ in range(10):
+            self.reg._record_span("step", (), 5.0)
+        res = slo.evaluate(registry=self.reg, now=1.0)
+        self.assertGreaterEqual(res["burn_rate"], 1.0)
+        self.assertEqual(len(fired), 1)
+
+
+class TestAlarmRegistry(unittest.TestCase):
+    def setUp(self):
+        slo_mod._reset_for_tests()
+        self.addCleanup(slo_mod._reset_for_tests)
+
+    def test_raising_callback_never_blocks_others(self):
+        got = []
+
+        def bad(payload):
+            raise RuntimeError("boom")
+
+        on_alarm(bad)
+        on_alarm(got.append)
+        fire_alarm({"kind": "test"})
+        self.assertEqual(got, [{"kind": "test"}])
+
+    def test_register_is_idempotent_and_removal_works(self):
+        got = []
+        on_alarm(got.append)
+        on_alarm(got.append)  # no double registration
+        fire_alarm({"kind": "a"})
+        self.assertEqual(len(got), 1)
+        remove_alarm(got.append)
+        remove_alarm(got.append)  # no-op when absent
+        fire_alarm({"kind": "b"})
+        self.assertEqual(len(got), 1)
+
+    def test_concurrent_fire_and_register_is_safe(self):
+        got = []
+        stop = threading.Event()
+
+        def churner():
+            def cb(_p):
+                pass
+
+            while not stop.is_set():
+                on_alarm(cb)
+                remove_alarm(cb)
+
+        t = threading.Thread(target=churner, daemon=True)
+        t.start()
+        try:
+            on_alarm(got.append)
+            for _ in range(200):
+                fire_alarm({"kind": "x"})
+        finally:
+            stop.set()
+            t.join(5.0)
+        self.assertEqual(len(got), 200)
+
+
+class TestModuleRegistry(unittest.TestCase):
+    def setUp(self):
+        slo_mod._reset_for_tests()
+        self.addCleanup(slo_mod._reset_for_tests)
+
+    def test_register_evaluate_unregister(self):
+        reg = Registry()
+        slo = Slo(
+            "o", instrument="lat", threshold_s=0.1, window_s=10.0
+        )
+        register_slo(slo)
+        register_slo(slo)  # idempotent
+        self.assertEqual(registered_slos(), [slo])
+        results = evaluate_slos(registry=reg, now=0.0)
+        self.assertEqual(len(results), 1)
+        self.assertEqual(results[0]["objective"], "o")
+        unregister_slo(slo)
+        self.assertEqual(registered_slos(), [])
+        self.assertEqual(evaluate_slos(registry=reg), [])
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -67,6 +67,15 @@ def _oracle(tenant: str) -> float:
     return float(np.asarray(m.compute()))
 
 
+def _wait(predicate, timeout_s=10.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
 def _artifact_dir(scenario: str) -> str:
     configured = os.environ.get("TORCHEVAL_TPU_TEST_ARTIFACT_DIR")
     if configured:
@@ -193,6 +202,38 @@ class _ClusterDrillMixin:
             t for t, ep in cls.placement_before.items() if ep == cls.ep_a
         ]
 
+        # ISSUE 16: stream fleet telemetry for the WHOLE drill — pushes
+        # must ride the existing wire without a single extra collective
+        # round, and the chaos-killed host must surface as STALE in
+        # fleet_status (the failure detector, not the stream, evicts)
+        cls.fleet_modes = cls.router.subscribe_obs(0.25, stale_after_s=1.0)
+        cls.fleet_warmed = _wait(
+            lambda: all(
+                not h["stale"]
+                for h in cls.router.fleet_status()["hosts"].values()
+            )
+        )
+        # quiescent push window: nothing but telemetry flows, so host
+        # A's collective-round counter must not move at all
+        probe = EvalClient(cls.ep_a, request_timeout_s=30.0)
+        rounds_before = probe.snapshot()["snapshot"]["counters"].get(
+            "toolkit.sync.rounds", 0
+        )
+        pushes_before = cls.router.fleet_status()["hosts"][cls.ep_a][
+            "pushes"
+        ]
+        cls.fleet_pushed = _wait(
+            lambda: cls.router.fleet_status()["hosts"][cls.ep_a]["pushes"]
+            >= pushes_before + 3
+        )
+        cls.sync_rounds_during_pushes = (
+            rounds_before,
+            probe.snapshot()["snapshot"]["counters"].get(
+                "toolkit.sync.rounds", 0
+            ),
+        )
+        probe.close()
+
         # phase 1: 3 batches each, round-robin, then flush -> durable in
         # the SHARED root (this is what migration restores)
         for i in range(PHASE1):
@@ -200,6 +241,23 @@ class _ClusterDrillMixin:
                 cls.router.submit(t, *_make_batch(t, i))
         for t in cls.tenants:
             cls.router.flush(t)
+
+        # the fleet view reflects phase-1 ingest within a push interval
+        # or two: an A tenant is in the per-tenant queue map and the
+        # submit-latency EWMA has left zero
+        def _fleet_sees_ingest():
+            lr = cls.router.fleet_status()["hosts"][cls.ep_a][
+                "load_report"
+            ]
+            return (
+                lr is not None
+                and any(
+                    t in lr["queue"]["per_tenant"] for t in cls.a_tenants
+                )
+                and lr["latency"]["submit_ewma_s"] > 0.0
+            )
+
+        cls.fleet_saw_ingest = _wait(_fleet_sees_ingest, timeout_s=5.0)
 
         # phase 2: concurrent producer threads over disjoint tenant
         # halves; chaos takes B down at its first phase-2 submit
@@ -228,6 +286,21 @@ class _ClusterDrillMixin:
             for t in cls.tenants
         }
         cls.placement_after = cls.router.placement()
+
+        # with B dead its stream goes quiet: fleet_status must mark it
+        # stale within the horizon while KEEPING it visible (a dead host
+        # silently vanishing from the fleet view is how outages hide)
+        cls.fleet_b_stale = _wait(
+            lambda: cls.router.fleet_status()["hosts"]
+            .get(cls.ep_b, {})
+            .get("stale", False),
+            timeout_s=5.0,
+        )
+        cls.fleet_status_final = cls.router.fleet_status()
+        with open(os.path.join(cls.outdir, "fleet.status.json"), "w") as f:
+            json.dump(cls.fleet_status_final, f, indent=2, default=str)
+        with open(os.path.join(cls.outdir, "fleet.trace.json"), "w") as f:
+            f.write(cls.router.fleet_chrome_trace())
 
         # flight record: router-side counters + migration span, and the
         # surviving host's obs snapshot, into test-artifacts
@@ -258,6 +331,18 @@ class _ClusterDrillMixin:
         except subprocess.TimeoutExpired:
             cls.proc_b.kill()
         cls.router.close()
+        _wait(
+            lambda: not [
+                t
+                for t in threading.enumerate()
+                if "torcheval-tpu-obs-" in t.name
+            ]
+        )
+        cls.leaked_obs_threads = [
+            t.name
+            for t in threading.enumerate()
+            if "torcheval-tpu-obs-" in t.name
+        ]
         obs.disable()
 
     def test_both_hosts_held_tenants_before_the_fault(self):
@@ -355,12 +440,49 @@ class _ClusterDrillMixin:
             self.assertIn(t, found)
             self.assertTrue(os.path.isdir(found[t]), found[t])
 
+    def test_fleet_stream_rode_the_wire_for_free(self):
+        """ISSUE 16: both hosts subscribed in push mode, the fleet view
+        warmed up and reflected ingest, and a pure-push window moved the
+        host's ``toolkit.sync.rounds`` counter by exactly zero — the
+        telemetry stream adds no collective round."""
+        self.assertEqual(
+            self.fleet_modes,
+            {self.ep_a: "push", self.ep_b: "push"},
+        )
+        self.assertTrue(self.fleet_warmed, "fleet never warmed up")
+        self.assertTrue(self.fleet_pushed, "push channel stalled")
+        before, after = self.sync_rounds_during_pushes
+        self.assertEqual(before, after)
+        self.assertTrue(
+            self.fleet_saw_ingest,
+            f"fleet never reflected phase-1 ingest: "
+            f"{self.fleet_status_final}",
+        )
+
+    def test_dead_host_marked_stale_not_dropped(self):
+        """The stream marks the killed host STALE within the horizon but
+        never removes it: eviction stays with the failure detector. The
+        partitioned variant is exempt — its process (and publisher
+        thread) survives, so its stream legitimately stays fresh."""
+        if self.ACTION == "host_partition":
+            self.skipTest("partitioned host keeps pushing; never stale")
+        self.assertTrue(
+            self.fleet_b_stale, "killed host never went stale"
+        )
+        host = self.fleet_status_final["hosts"][self.ep_b]
+        self.assertTrue(host["stale"], host)
+
+    def test_no_subscriber_threads_leaked(self):
+        self.assertEqual(self.leaked_obs_threads, [])
+
     def test_artifacts_written(self):
         for name in (
             "router.obs.json",
             "router.trace.json",
             "hostA.obs.json",
             "hostA.trace.json",
+            "fleet.status.json",
+            "fleet.trace.json",
         ):
             self.assertTrue(
                 os.path.getsize(os.path.join(self.outdir, name)) > 0, name

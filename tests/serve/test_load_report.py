@@ -1,0 +1,129 @@
+"""Structured load reports (ISSUE 16 tentpole leg 3): the
+``EvalDaemon.load_report()`` schema is a WIRE contract — routers,
+dashboards and the ``/health`` endpoint all parse it — so this test pins
+every key of schema 1. Adding a key is fine (extend the pin); renaming
+or removing one requires a schema bump and a deliberate edit here."""
+
+import json
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.serve import EvalDaemon
+
+NUM_CLASSES = 4
+
+# schema 1, frozen: every (path, type) a consumer may rely on
+_SCHEMA_1 = {
+    "schema": int,
+    "ts": float,
+    "uptime_s": float,
+    "running": bool,
+    "draining": bool,
+    "capacity.max_tenants": int,
+    "capacity.active_tenants": int,
+    "queue.depth": int,
+    "queue.capacity": int,
+    "queue.per_tenant": dict,
+    "ingest.backlog_bytes": int,
+    "totals.attached": int,
+    "totals.quarantined": int,
+    "totals.evicted": int,
+    "latency.submit_ewma_s": float,
+    "latency.step_ewma_s": float,
+    "latency.submit_p99_s": float,
+    "latency.step_p99_s": float,
+    "window.occupancy_mean": float,
+    "window.samples": int,
+    "hbm.bytes_max_entry": float,
+    "hbm.bytes_sum": float,
+}
+
+
+def _lookup(report, path):
+    node = report
+    for part in path.split("."):
+        node = node[part]
+    return node
+
+
+class TestLoadReportSchema(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.reset)
+        self.addCleanup(obs.disable)
+        self.daemon = EvalDaemon().start()
+        self.addCleanup(self.daemon.stop)
+
+    def test_schema_1_keys_and_types_are_stable(self):
+        report = self.daemon.load_report()
+        self.assertEqual(report["schema"], 1)
+        for path, typ in _SCHEMA_1.items():
+            node = _lookup(report, path)
+            self.assertIsInstance(
+                node, typ, f"{path} is {type(node).__name__}, want {typ.__name__}"
+            )
+        # no key drift within the pinned sections either: a consumer
+        # iterating a section must not meet a stranger without a bump
+        self.assertEqual(
+            sorted(report.keys()),
+            sorted(
+                {
+                    "schema",
+                    "ts",
+                    "uptime_s",
+                    "running",
+                    "draining",
+                    "capacity",
+                    "queue",
+                    "ingest",
+                    "totals",
+                    "latency",
+                    "window",
+                    "hbm",
+                }
+            ),
+        )
+
+    def test_report_is_json_serialisable(self):
+        json.dumps(self.daemon.load_report())
+
+    def test_report_reflects_traffic(self):
+        handle = self.daemon.attach(
+            "t1", {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)}
+        )
+        handle.submit(
+            np.zeros(8, np.int64), np.zeros(8, np.int64), block=True, timeout=60
+        )
+        handle.compute(timeout=60)
+        report = self.daemon.load_report()
+        self.assertEqual(report["capacity"]["active_tenants"], 1)
+        self.assertEqual(report["totals"]["attached"], 1)
+        self.assertIn("t1", report["queue"]["per_tenant"])
+        self.assertGreater(report["latency"]["submit_ewma_s"], 0.0)
+        self.assertGreater(report["latency"]["step_ewma_s"], 0.0)
+        self.assertGreater(report["latency"]["submit_p99_s"], 0.0)
+
+    def test_health_embeds_the_load_report(self):
+        health = self.daemon.health()
+        self.assertEqual(health["load_report"]["schema"], 1)
+
+    def test_report_works_with_obs_disabled(self):
+        # the report must degrade, not crash, when the registry is off
+        # (latency p99s and hbm read zeros; the daemon-native EWMAs and
+        # queue walk still report)
+        obs.disable()
+        report = self.daemon.load_report()
+        self.assertEqual(report["schema"], 1)
+        self.assertTrue(report["running"])
+
+    def test_draining_flag_flips(self):
+        self.daemon.drain()
+        self.assertTrue(self.daemon.load_report()["draining"])
+
+
+if __name__ == "__main__":
+    unittest.main()

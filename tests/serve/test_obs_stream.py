@@ -1,0 +1,355 @@
+"""Obs push channel over the serve wire (ISSUE 16 tentpole legs 2-3):
+``subscribe_obs`` push delivery, degradation against old peers, final
+flush on drain/stop, publisher retirement, and the router's fleet fold
+— all over real loopback sockets (port 0, OS-assigned)."""
+
+import tempfile
+import threading
+import time
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.serve import (
+    EvalClient,
+    EvalDaemon,
+    EvalRouter,
+    EvalServer,
+    WireError,
+    metric_spec,
+)
+
+NUM_CLASSES = 4
+
+
+def _batch(n=8):
+    return (
+        np.zeros(n, np.int64),
+        np.zeros(n, np.int64),
+    )
+
+
+def _wait(predicate, timeout_s=10.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _no_obs_threads():
+    return not [
+        t.name
+        for t in threading.enumerate()
+        if "torcheval-tpu-obs-" in t.name
+    ]
+
+
+class _OldServer(EvalServer):
+    """A pre-ISSUE-16 peer: ``subscribe_obs`` is an unknown op, rejected
+    structurally (the PR 12 negotiation discipline under test)."""
+
+    def _handle(self, op, header, payload, stage_box=None):
+        if op == "subscribe_obs":
+            raise WireError("protocol", f"unknown wire op {op!r}.")
+        return super()._handle(op, header, payload, stage_box)
+
+
+class _StreamMixin:
+    server_cls = EvalServer
+
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.reset)
+        self.addCleanup(obs.disable)
+        self.daemon = EvalDaemon().start()
+        self.server = self.server_cls(self.daemon)
+        self.client = EvalClient(
+            self.server.endpoint,
+            request_timeout_s=30.0,
+            max_attempts=2,
+            backoff_base_s=0.01,
+        )
+        self.addCleanup(self.daemon.stop)
+        self.addCleanup(self.server.close)
+        self.addCleanup(self.client.close)
+
+    def _attach(self, tenant="t1"):
+        self.client.attach(
+            tenant,
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+        )
+
+
+class TestPushChannel(_StreamMixin, unittest.TestCase):
+    def test_push_delivers_deltas_and_load_report(self):
+        self._attach()
+        pushes = []
+        sub = self.client.subscribe_obs(0.1, on_push=pushes.append)
+        self.addCleanup(sub.stop)
+        self.assertEqual(sub.mode, "push")
+        self.client.submit("t1", *_batch())
+        self.assertTrue(_wait(lambda: sub.received >= 2))
+        msg = sub.last
+        self.assertEqual(msg["op"], "obs_push")
+        self.assertEqual(msg["endpoint"], self.server.endpoint)
+        self.assertEqual(msg["delta"]["v"], 1)
+        self.assertEqual(msg["load_report"]["schema"], 1)
+        # seqs on the channel are monotonic
+        seqs = [p["push_seq"] for p in pushes]
+        self.assertEqual(seqs, sorted(seqs))
+        # the first push is a full baseline, later ones are diffs
+        self.assertTrue(pushes[0]["delta"]["full"])
+
+    def test_deltas_fold_to_the_host_registry(self):
+        from torcheval_tpu.obs.stream import DeltaAccumulator
+
+        self._attach()
+        acc = DeltaAccumulator()
+        sub = self.client.subscribe_obs(0.05, on_push=lambda m: acc.apply(m["delta"]))
+        self.addCleanup(sub.stop)
+        for _ in range(3):
+            self.client.submit("t1", *_batch())
+        self.assertTrue(
+            _wait(
+                lambda: acc.snapshot()["counters"].get(
+                    "serve.ingest.batches{tenant=t1}"
+                )
+                == 3.0
+            ),
+            f"accumulated: {acc.snapshot()['counters']}",
+        )
+
+    def test_drain_final_flush_reaches_subscriber(self):
+        self._attach()
+        sub = self.client.subscribe_obs(30.0)  # no timer tick in this test
+        self.addCleanup(sub.stop)
+        self.client.submit("t1", *_batch())
+        self.client.drain()
+        # the daemon's flush hook pushed synchronously at drain: the
+        # subscriber sees the final state without waiting 30s
+        self.assertTrue(_wait(lambda: sub.received >= 1))
+        counters = sub.last["delta"]["counters"]
+        self.assertIn("serve.ingest.batches{tenant=t1}", counters)
+
+    def test_stop_retires_publisher_and_reader_threads(self):
+        sub = self.client.subscribe_obs(0.05)
+        self.assertTrue(_wait(lambda: sub.received >= 1))
+        sub.stop()
+        self.assertFalse(sub.alive)
+        self.assertTrue(_wait(_no_obs_threads), "obs threads leaked")
+
+    def test_client_close_stops_subscriptions(self):
+        sub = self.client.subscribe_obs(0.05)
+        self.client.close()
+        self.assertTrue(_wait(lambda: not sub.alive))
+        self.assertTrue(_wait(_no_obs_threads), "obs threads leaked")
+
+    def test_server_close_final_flushes_then_severs(self):
+        sub = self.client.subscribe_obs(30.0)
+        self.addCleanup(sub.stop)
+        self.server.close()
+        # close() flushes each publisher before severing: one last push
+        self.assertTrue(_wait(lambda: sub.received >= 1))
+        self.assertTrue(_wait(lambda: not sub.alive))
+
+    def test_push_counters_recorded_on_host(self):
+        sub = self.client.subscribe_obs(0.05)
+        self.addCleanup(sub.stop)
+        self.assertTrue(_wait(lambda: sub.received >= 2))
+        counters = obs.snapshot()["counters"]
+        self.assertGreaterEqual(counters.get("obs.stream.pushes", 0), 2)
+
+    def test_bad_interval_rejected_at_the_boundary(self):
+        for bad in (0.0, -1.0, float("nan"), float("inf")):
+            with self.assertRaises(ValueError):
+                self.client.subscribe_obs(bad)
+
+    def test_pushes_add_zero_collective_rounds(self):
+        self._attach()
+        rounds_before = obs.snapshot()["counters"].get(
+            "toolkit.sync.rounds", 0
+        )
+        sub = self.client.subscribe_obs(0.05)
+        self.addCleanup(sub.stop)
+        self.client.submit("t1", *_batch())
+        self.assertTrue(_wait(lambda: sub.received >= 3))
+        rounds_after = obs.snapshot()["counters"].get(
+            "toolkit.sync.rounds", 0
+        )
+        self.assertEqual(rounds_before, rounds_after)
+
+
+class TestOldPeerDegradation(_StreamMixin, unittest.TestCase):
+    server_cls = _OldServer
+
+    def test_old_server_degrades_to_polling(self):
+        self._attach()
+        polls = []
+        sub = self.client.subscribe_obs(0.1, on_push=polls.append)
+        self.addCleanup(sub.stop)
+        self.assertEqual(sub.mode, "poll")
+        self.assertTrue(_wait(lambda: sub.received >= 1))
+        msg = sub.last
+        self.assertEqual(msg["op"], "obs_poll")
+        # the poll fallback still carries the structured load report
+        self.assertEqual(msg["load_report"]["schema"], 1)
+        self.assertIn("health", msg)
+
+    def test_fallback_raise_surfaces_the_protocol_error(self):
+        with self.assertRaises(WireError) as ctx:
+            self.client.subscribe_obs(0.1, fallback="raise")
+        self.assertEqual(ctx.exception.reason, "protocol")
+
+    def test_bad_fallback_rejected(self):
+        with self.assertRaises(ValueError):
+            self.client.subscribe_obs(0.1, fallback="maybe")
+
+
+class TestRouterFleet(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.reset)
+        self.addCleanup(obs.disable)
+        self.root = tempfile.mkdtemp(prefix="tpu_fleet_test_")
+        self.d1 = EvalDaemon(evict_dir=self.root).start()
+        self.d2 = EvalDaemon(evict_dir=self.root).start()
+        self.s1 = EvalServer(self.d1)
+        self.s2 = _OldServer(self.d2)
+        self.router = EvalRouter(
+            [self.s1.endpoint, self.s2.endpoint],
+            request_timeout_s=30.0,
+            max_attempts=2,
+            backoff_base_s=0.01,
+        )
+        self.addCleanup(self.d1.stop)
+        self.addCleanup(self.d2.stop)
+        self.addCleanup(self.s1.close)
+        self.addCleanup(self.s2.close)
+        self.addCleanup(self.router.close)
+
+    def test_fleet_status_folds_mixed_version_hosts(self):
+        modes = self.router.subscribe_obs(0.1)
+        self.assertEqual(modes[self.s1.endpoint], "push")
+        self.assertEqual(modes[self.s2.endpoint], "poll")
+        self.assertTrue(
+            _wait(
+                lambda: all(
+                    not h["stale"]
+                    for h in self.router.fleet_status()["hosts"].values()
+                )
+            ),
+            f"still stale: {self.router.fleet_status()['hosts']}",
+        )
+        fs = self.router.fleet_status()
+        for ep in (self.s1.endpoint, self.s2.endpoint):
+            host = fs["hosts"][ep]
+            self.assertTrue(host["alive"])
+            self.assertEqual(host["load_report"]["schema"], 1)
+        self.assertEqual(fs["hosts"][self.s1.endpoint]["mode"], "push")
+        self.assertEqual(fs["hosts"][self.s2.endpoint]["mode"], "poll")
+
+    def test_fleet_status_reflects_ingest_within_one_interval(self):
+        self.router.subscribe_obs(0.1)
+        ep = self.router.attach(
+            "t1",
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+        )
+        for _ in range(3):
+            self.router.submit("t1", *_batch())
+
+        def sees_ingest():
+            host = self.router.fleet_status()["hosts"][ep]
+            lr = host["load_report"]
+            # the report reflects the traffic: the tenant's queue shows
+            # up per-tenant and the submit-latency EWMA left zero
+            return (
+                lr is not None
+                and "t1" in lr["queue"]["per_tenant"]
+                and lr["latency"]["submit_ewma_s"] > 0.0
+            )
+
+        self.assertTrue(
+            _wait(sees_ingest),
+            f"fleet never saw the ingest: {self.router.fleet_status()}",
+        )
+
+    def test_killed_host_goes_stale_within_horizon(self):
+        self.router.subscribe_obs(0.1, stale_after_s=0.5)
+        self.assertTrue(
+            _wait(
+                lambda: not self.router.fleet_status()["hosts"][
+                    self.s1.endpoint
+                ]["stale"]
+            )
+        )
+        # kill the push host without telling the router
+        self.s1.close()
+        self.d1.stop()
+        self.assertTrue(
+            _wait(
+                lambda: self.router.fleet_status()["hosts"][
+                    self.s1.endpoint
+                ]["stale"],
+                timeout_s=5.0,
+            ),
+            "killed host never went stale",
+        )
+        # the stream going stale did NOT evict the host: the failure
+        # detector (health probe / tenant op) stays authoritative
+        self.assertIn(self.s1.endpoint, self.router.alive)
+
+    def test_unsubscribe_stops_all_stream_threads(self):
+        self.router.subscribe_obs(0.05)
+        self.assertTrue(
+            _wait(
+                lambda: any(
+                    h["pushes"] > 0
+                    for h in self.router.fleet_status()["hosts"].values()
+                )
+            )
+        )
+        self.router.unsubscribe_obs()
+        self.assertTrue(_wait(_no_obs_threads), "obs threads leaked")
+
+    def test_fleet_chrome_trace_tags_events_per_host(self):
+        import json
+
+        self.router.subscribe_obs(0.1)
+        self.router.attach(
+            "t1",
+            {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)},
+        )
+        self.router.submit("t1", *_batch())
+
+        def host_events_arrived():
+            trace = json.loads(self.router.fleet_chrome_trace())
+            pids = {e.get("pid") for e in trace["traceEvents"]}
+            return self.s1.endpoint in pids
+
+        self.assertTrue(
+            _wait(host_events_arrived),
+            "pushed events never appeared under the host's pid",
+        )
+
+    def test_resubscribe_is_idempotent(self):
+        self.router.subscribe_obs(0.1)
+        self.router.subscribe_obs(0.1)  # drops + replaces the streams
+        self.assertTrue(
+            _wait(
+                lambda: any(
+                    not h["stale"]
+                    for h in self.router.fleet_status()["hosts"].values()
+                )
+            )
+        )
+        self.router.unsubscribe_obs()
+        self.assertTrue(_wait(_no_obs_threads))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,76 @@
+"""``serve.queue_depth{tenant=}`` dequeue-side fix (ISSUE 16 satellite).
+
+Before this PR the histogram was recorded only on the submit path, so a
+drained queue kept reporting its high-water mark forever: dashboards
+showed phantom backlog after the daemon had caught up. The worker now
+records depth 0 when it pops a tenant's whole queue, so the series'
+LATEST observation reaches 0 after a drain."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.serve import EvalDaemon
+
+NUM_CLASSES = 4
+
+
+def _depth_histo(tenant):
+    return obs.snapshot()["histograms"].get(
+        f"serve.queue_depth{{tenant={tenant}}}"
+    )
+
+
+class TestQueueDepthReachesZero(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.reset)
+        self.addCleanup(obs.disable)
+
+    def test_depth_series_reaches_zero_after_drain(self):
+        with EvalDaemon() as daemon:
+            handle = daemon.attach(
+                "t1", {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)}
+            )
+            for _ in range(6):
+                handle.submit(
+                    np.zeros(8, np.int64),
+                    np.zeros(8, np.int64),
+                    timeout=60,
+                )
+            handle.compute(timeout=60)  # forces the queue to drain
+            h = _depth_histo("t1")
+            self.assertIsNotNone(h, "depth histogram never recorded")
+            # the submit path records exactly one observation per submit
+            # (6 here) — any further observations are the dequeue-side
+            # zeros this PR adds, and zeros land in the lowest bucket
+            self.assertGreater(h["count"], 6)
+            from torcheval_tpu.obs import registry as _registry
+
+            for kind, name, lb, value in (
+                _registry.default_registry._items()
+            ):
+                if kind == "histo" and name == "serve.queue_depth":
+                    buckets = value[0]
+                    self.assertGreater(
+                        buckets[0], 0, "no zero-depth observations"
+                    )
+
+    def test_dequeue_record_is_gated_when_disabled(self):
+        obs.disable()
+        with EvalDaemon() as daemon:
+            handle = daemon.attach(
+                "t1", {"acc": MulticlassAccuracy(num_classes=NUM_CLASSES)}
+            )
+            handle.submit(
+                np.zeros(8, np.int64), np.zeros(8, np.int64), timeout=60
+            )
+            handle.compute(timeout=60)
+        self.assertIsNone(_depth_histo("t1"))
+
+
+if __name__ == "__main__":
+    unittest.main()

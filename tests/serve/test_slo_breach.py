@@ -1,0 +1,155 @@
+"""SLO breach drill (ISSUE 16 acceptance): a chaos ``ingest_delay``
+stalls one submit, the stall lands in ``serve.submit.latency``, the
+publisher tick evaluates the registered SLO against it, and EXACTLY ONE
+``slo.breach`` alarm callback fires — asserted from the obs snapshot
+written to test-artifacts, the same evidence trail the cluster drills
+leave."""
+
+import json
+import os
+import tempfile
+import threading
+import time
+import unittest
+from unittest import mock
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.obs import slo as slo_mod
+from torcheval_tpu.resilience import chaos
+from torcheval_tpu.serve import EvalClient, EvalDaemon, EvalServer, metric_spec
+
+NUM_CLASSES = 4
+DELAY_S = 0.5
+
+
+def _artifact_dir() -> str:
+    configured = os.environ.get("TORCHEVAL_TPU_TEST_ARTIFACT_DIR")
+    if configured:
+        out = os.path.join(configured, "slo_breach_drill")
+        os.makedirs(out, exist_ok=True)
+        return out
+    return tempfile.mkdtemp(prefix="tpu_slo_breach_")
+
+
+class _ChaosEnv:
+    def __init__(self, **env):
+        self.env = {k: str(v) for k, v in env.items()}
+
+    def __enter__(self):
+        self._patch = mock.patch.dict(os.environ, self.env)
+        self._patch.__enter__()
+        chaos.reset_for_tests()
+
+    def __exit__(self, *exc):
+        self._patch.__exit__(*exc)
+        chaos.reset_for_tests()
+
+
+class TestSloBreachDrill(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        obs.enable()
+        slo_mod._reset_for_tests()
+        self.addCleanup(slo_mod._reset_for_tests)
+        self.addCleanup(obs.reset)
+        self.addCleanup(obs.disable)
+
+    def test_ingest_delay_fires_exactly_one_breach_alarm(self):
+        alarms = []
+        alarm_lock = threading.Lock()
+
+        def on_breach(payload):
+            with alarm_lock:
+                alarms.append(payload)
+
+        obs.on_alarm(on_breach)
+        obs.register_slo(
+            obs.Slo(
+                "submit_p99",
+                instrument="serve.submit.latency",
+                threshold_s=DELAY_S / 4.0,
+                window_s=60.0,
+                budget=0.01,
+            )
+        )
+        with _ChaosEnv(
+            TORCHEVAL_TPU_CHAOS="1",
+            TORCHEVAL_TPU_CHAOS_ACTION="ingest_delay",
+            TORCHEVAL_TPU_CHAOS_TENANT="t1",
+            TORCHEVAL_TPU_CHAOS_STEP="2",
+            TORCHEVAL_TPU_CHAOS_DELAY_S=str(DELAY_S),
+        ):
+            daemon = EvalDaemon().start()
+            server = EvalServer(daemon)
+            client = EvalClient(server.endpoint, request_timeout_s=60.0)
+            self.addCleanup(daemon.stop)
+            self.addCleanup(server.close)
+            self.addCleanup(client.close)
+            client.attach(
+                "t1",
+                {
+                    "acc": metric_spec(
+                        "MulticlassAccuracy", num_classes=NUM_CLASSES
+                    )
+                },
+            )
+            # the publisher tick IS the SLO evaluator in production:
+            # subscribing arms it
+            sub = client.subscribe_obs(0.1)
+            self.addCleanup(sub.stop)
+            for _ in range(4):  # step 2 eats the chaos stall
+                client.submit(
+                    "t1", np.zeros(8, np.int64), np.zeros(8, np.int64)
+                )
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                with alarm_lock:
+                    if alarms:
+                        break
+                time.sleep(0.05)
+            # a few more publisher ticks: edge-triggering must hold
+            time.sleep(0.5)
+
+        snapshot = obs.snapshot()
+        outdir = _artifact_dir()
+        with open(os.path.join(outdir, "obs_snapshot.json"), "w") as f:
+            json.dump(snapshot, f, indent=2, default=str)
+        with alarm_lock:
+            fired = list(alarms)
+        with open(os.path.join(outdir, "alarms.json"), "w") as f:
+            json.dump(fired, f, indent=2, default=str)
+
+        # --- assertions read from the artifacts, drill-style ---
+        with open(os.path.join(outdir, "alarms.json")) as f:
+            fired = json.load(f)
+        self.assertEqual(
+            len(fired), 1, f"expected exactly one alarm, got {fired}"
+        )
+        self.assertEqual(fired[0]["kind"], "slo.breach")
+        self.assertEqual(fired[0]["objective"], "submit_p99")
+        self.assertIn("t1", fired[0]["series"])
+        self.assertGreaterEqual(fired[0]["burn_rate"], 1.0)
+        with open(os.path.join(outdir, "obs_snapshot.json")) as f:
+            snap = json.load(f)
+        self.assertEqual(
+            snap["counters"].get(
+                "slo.breach{objective=submit_p99,tenant=t1}"
+            ),
+            1.0,
+        )
+        self.assertIn(
+            "slo.burn_rate{objective=submit_p99}", snap["gauges"]
+        )
+        # the stall itself is visible where the SLO looked: the latency
+        # histogram's max-side tail crossed the threshold
+        lat = snap["histograms"].get(
+            "serve.submit.latency{tenant=t1}"
+        )
+        self.assertIsNotNone(lat)
+        self.assertGreaterEqual(lat["p99"], DELAY_S / 4.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

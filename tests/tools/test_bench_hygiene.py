@@ -154,6 +154,18 @@ class TestBenchHygiene(unittest.TestCase):
                 "regression pin",
             )
         for row in (
+            "config12_obs_stream_overhead",
+            "config12_obs_delta_bytes",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the telemetry-"
+                "stream contract (ISSUE 16 — push channel ≤2% ingest "
+                "overhead and delta payloads a fraction of full "
+                "snapshots) loses its regression pin",
+            )
+        for row in (
             "config11_sliced_1m",
             "config11_sliced_ratio",
         ):
@@ -182,6 +194,7 @@ class TestBenchHygiene(unittest.TestCase):
             "config8_cluster_wire_codec_gain",
             "config8_cluster_wire_1host_ratio",
             "config11_sliced_ratio",
+            "config12_obs_stream_overhead",
         ):
             self.assertIn(
                 row,

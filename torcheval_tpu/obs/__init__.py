@@ -36,6 +36,16 @@ rank-tagged tenant bars in the Chrome trace, and a daemon
 ``health(sync=True)`` view built on :func:`sync_snapshot`'s one-collective
 cross-rank merge.
 
+Since ISSUE 16 the subsystem also *streams*: delta snapshots
+(``stream.py`` — ``Registry.delta_since`` cursors, O(changed) per tick)
+ride a push frame on the serve wire to ``EvalRouter.fleet_status()``;
+``obs/slo.py`` declares latency objectives over the rolling histograms
+(``Slo``, ``register_slo``) with edge-triggered burn alarms through the
+thread-safe ``obs.on_alarm(cb)`` hook registry; ``obs/httpd.py`` serves
+``GET /metrics`` (Prometheus) and ``GET /health`` from a stdlib HTTP
+thread (``EvalDaemon(metrics_port=...)``). See docs/observability.md
+("Fleet telemetry").
+
 Usage::
 
     from torcheval_tpu import obs
@@ -70,6 +80,18 @@ from torcheval_tpu.obs.registry import (
     snapshot,
     span,
 )
+from torcheval_tpu.obs.httpd import MetricsServer
+from torcheval_tpu.obs.slo import (
+    Slo,
+    evaluate_slos,
+    fire_alarm,
+    on_alarm,
+    register_slo,
+    remove_alarm,
+    unregister_slo,
+)
+from torcheval_tpu.obs.stream import DeltaAccumulator, StreamCursor
+from torcheval_tpu.obs.stream import collect as collect_delta
 from torcheval_tpu.obs.trace import chrome_trace
 from torcheval_tpu.obs.trace import events as timeline_events
 from torcheval_tpu.obs.trace import set_capacity as set_timeline_capacity
@@ -93,17 +115,27 @@ def reset() -> None:
 
 
 __all__ = [
+    "DeltaAccumulator",
     "Histogram",
+    "MetricsServer",
     "Registry",
+    "Slo",
+    "StreamCursor",
     "chrome_trace",
+    "collect_delta",
     "counter",
     "default_registry",
     "disable",
     "enable",
     "enabled",
+    "evaluate_slos",
+    "fire_alarm",
     "gauge",
     "histo",
+    "on_alarm",
     "prometheus_text",
+    "register_slo",
+    "remove_alarm",
     "reset",
     "retrace_threshold",
     "set_label_cardinality_cap",
@@ -115,5 +147,6 @@ __all__ = [
     "timeline_events",
     "to_json",
     "trace_counts",
+    "unregister_slo",
     "watched_jit",
 ]

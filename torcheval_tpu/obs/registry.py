@@ -292,6 +292,24 @@ class _Span:
         )
 
 
+class DeltaCursor:
+    """Opaque position token for :meth:`Registry.delta_since`.
+
+    Holds the generation the registry was in when the cursor was issued, a
+    strictly-increasing sequence number (monotonic even across
+    :meth:`Registry.reset` — a reset bumps the generation, never rewinds the
+    sequence), and the per-series baseline values the next delta diffs
+    against. The baseline lives here, not in the registry: the registry has
+    no per-key dirty tracking and must not grow per-subscriber state."""
+
+    __slots__ = ("gen", "seq", "base")
+
+    def __init__(self, gen: int, seq: int, base: Dict[tuple, Any]) -> None:
+        self.gen = gen
+        self.seq = seq
+        self.base = base
+
+
 class Registry:
     """Thread-safe collection of counters, gauges and span timers."""
 
@@ -304,6 +322,10 @@ class Registry:
         # distinct LABELED series created per instrument name, across all
         # instrument kinds — the label-cardinality guard's admission count
         self._label_sets: Dict[str, int] = {}
+        # bumped by reset(); lets delta_since detect a cursor issued against
+        # state that no longer exists and answer with a full diff instead of
+        # a nonsensical (negative-counter) incremental one
+        self._generation = 0
         self._local = threading.local()
 
     # ------------------------------------------------- label-cardinality cap
@@ -498,38 +520,130 @@ class Registry:
         ``(buckets, count, sum)`` — buckets copied as tuples so the consumer
         never aliases live mutable state."""
         with self._lock:
-            out: list = [
-                ("counter", n, lb, c.value)
-                for (n, lb), c in self._counters.items()
-            ]
-            out.extend(
-                ("gauge", n, lb, g.value)
-                for (n, lb), g in self._gauges.items()
+            return self._items_locked()
+
+    def _items_locked(self) -> list:
+        out: list = [
+            ("counter", n, lb, c.value)
+            for (n, lb), c in self._counters.items()
+        ]
+        out.extend(
+            ("gauge", n, lb, g.value)
+            for (n, lb), g in self._gauges.items()
+        )
+        out.extend(
+            ("histo", n, lb, (tuple(h.buckets), h.count, h.sum))
+            for (n, lb), h in self._histos.items()
+        )
+        out.extend(
+            (
+                "span",
+                n,
+                lb,
+                (s.count, s.total_seconds, s.max_seconds, tuple(s.buckets)),
             )
-            out.extend(
-                ("histo", n, lb, (tuple(h.buckets), h.count, h.sum))
-                for (n, lb), h in self._histos.items()
-            )
-            out.extend(
-                (
-                    "span",
-                    n,
-                    lb,
-                    (s.count, s.total_seconds, s.max_seconds, tuple(s.buckets)),
-                )
-                for (n, lb), s in self._spans.items()
-            )
-            return out
+            for (n, lb), s in self._spans.items()
+        )
+        return out
+
+    # ---------------------------------------------------------------- deltas
+    def delta_since(self, cursor: Optional[DeltaCursor]) -> tuple:
+        """Diff the registry against ``cursor`` → ``(delta, new_cursor)``.
+
+        ``delta`` is a plain JSON-serialisable dict carrying ONLY the series
+        that changed since the cursor was issued — the O(changed) unit the
+        obs push channel ships instead of full snapshots
+        (``obs/stream.py`` folds deltas back into snapshots):
+
+        * ``counters`` — increments (``new - base``; > 0 by monotonicity);
+        * ``gauges`` — new absolute values (a gauge is last-write-wins, a
+          numeric difference would be meaningless);
+        * ``histograms`` / ``spans`` — sparse per-bucket count increments
+          (``[[index, +n], ...]``) plus count/sum (span: count/total/max)
+          increments; bucket increments sum exactly to the count increment.
+
+        ``cursor=None`` (or a cursor from before the last :meth:`reset` —
+        detected by generation) yields a FULL diff with ``"full": True``.
+        The returned cursor's ``seq`` strictly increases across calls on the
+        same cursor chain, including across resets."""
+        with self._lock:
+            # one critical section for both: a reset() between reading the
+            # items and the generation would mislabel old values as new-gen
+            gen = self._generation
+            items = self._items_locked()
+        fresh = cursor is None or cursor.gen != gen
+        base: Dict[tuple, Any] = {} if fresh else cursor.base
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        histos: Dict[str, Any] = {}
+        spans: Dict[str, Any] = {}
+        new_base: Dict[tuple, Any] = {}
+        for kind, name, lb, value in items:
+            bkey = (kind, name, lb)
+            new_base[bkey] = value
+            prev = base.get(bkey)
+            key = format_key(name, lb)
+            if kind == "counter":
+                d = value - (prev or 0.0)
+                if d != 0.0:
+                    counters[key] = d
+            elif kind == "gauge":
+                if prev is None or value != prev:
+                    gauges[key] = value
+            elif kind == "histo":
+                buckets, count, total = value
+                pb, pc, ps = prev if prev is not None else ((), 0, 0.0)
+                if count != pc:
+                    histos[key] = {
+                        "buckets": [
+                            [i, c - (pb[i] if i < len(pb) else 0)]
+                            for i, c in enumerate(buckets)
+                            if c != (pb[i] if i < len(pb) else 0)
+                        ],
+                        "count": count - pc,
+                        "sum": total - ps,
+                    }
+            else:  # span
+                count, total, mx, buckets = value
+                pc, pt, pm, pb = prev if prev is not None else (0, 0.0, 0.0, ())
+                if count != pc:
+                    spans[key] = {
+                        "buckets": [
+                            [i, c - (pb[i] if i < len(pb) else 0)]
+                            for i, c in enumerate(buckets)
+                            if c != (pb[i] if i < len(pb) else 0)
+                        ],
+                        "count": count - pc,
+                        "total_seconds": total - pt,
+                        # max is monotone within a generation: ship the new
+                        # absolute max, the accumulator takes max() over it
+                        "max_seconds": mx,
+                    }
+        seq = 1 if cursor is None else cursor.seq + 1
+        delta = {
+            "v": 1,
+            "gen": gen,
+            "seq": seq,
+            "full": bool(fresh),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": histos,
+            "spans": spans,
+        }
+        return delta, DeltaCursor(gen, seq, new_base)
 
     def reset(self) -> None:
         """Drop every instrument (fresh registry semantics). Live span
-        contexts on other threads finish into fresh entries."""
+        contexts on other threads finish into fresh entries. Outstanding
+        :class:`DeltaCursor` holders observe the generation bump and get a
+        full diff on their next :meth:`delta_since`."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._histos.clear()
             self._spans.clear()
             self._label_sets.clear()
+            self._generation += 1
 
 
 # The process-wide default registry every library call site reports into.

@@ -166,6 +166,23 @@ def event_count() -> int:
         return len(_ring)
 
 
+def events_since(offset: int):
+    """``(events, total)`` — events whose all-time index is ``>= offset``,
+    plus the all-time count (``dropped + ring``), read under ONE lock so the
+    pair is consistent. The obs stream's timeline cursor: a subscriber holds
+    the last ``total`` it saw and gets only newer events on the next delta
+    (events already evicted from the ring are simply gone — bounded memory
+    wins over completeness, same contract as the ring itself). An ``offset``
+    ahead of ``total`` (ring was :func:`clear`-ed, e.g. ``obs.reset()``)
+    rewinds to the whole ring."""
+    with _lock:
+        total = _dropped + len(_ring)
+        if offset > total:
+            offset = 0
+        start = max(0, offset - _dropped)
+        return [e.as_dict() for e in list(_ring)[start:]], total
+
+
 def dropped() -> int:
     """Events evicted since the last :func:`clear` (ring overflow)."""
     with _lock:

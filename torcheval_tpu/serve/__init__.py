@@ -50,13 +50,21 @@ layer on top of the same daemon:
   explicit ``drain`` migrates tenants by restoring their shared-root
   checkpoints on a survivor and replaying the un-durable tail.
 
+Since ISSUE 16 the wire also *streams telemetry*: an ``obs_push`` frame
+kind carries O(changed) registry deltas + timeline events + each
+daemon's structured ``load_report`` on a per-subscription timer
+(``EvalClient.subscribe_obs`` — degrading to ``health()`` polling
+against old peers), and the router folds the streams into
+``EvalRouter.fleet_status()`` / ``fleet_chrome_trace()`` with staleness
+marking. See docs/observability.md ("Fleet telemetry").
+
 See docs/robustness.md ("Serving", "Cluster") for the tenant lifecycle,
 the failure-semantics table and the migration contract, and ``bench.py``'s
 ``config7_serve_tenants_*`` / ``config8_cluster_*`` rows for the
 throughput contracts.
 """
 
-from torcheval_tpu.serve.client import EvalClient, metric_spec
+from torcheval_tpu.serve.client import EvalClient, ObsSubscription, metric_spec
 from torcheval_tpu.serve.daemon import EvalDaemon
 from torcheval_tpu.serve.errors import (
     AdmissionError,
@@ -78,6 +86,7 @@ __all__ = [
     "EvalDaemon",
     "EvalRouter",
     "EvalServer",
+    "ObsSubscription",
     "ServeError",
     "TenantError",
     "TenantEvictedError",

@@ -58,7 +58,7 @@ from torcheval_tpu.serve.wire import (
     unpack_tree,
 )
 
-__all__ = ["EvalClient", "metric_spec"]
+__all__ = ["EvalClient", "ObsSubscription", "metric_spec"]
 
 _UNSET = object()
 
@@ -107,6 +107,71 @@ class _ClientTenant:
         # advances the daemon watermark past the hole and a flush prunes
         # the never-applied entry as "durable"
         self.needs_resend = False
+
+
+class ObsSubscription:
+    """One live obs stream from a host (``EvalClient.subscribe_obs``).
+
+    ``mode`` is ``"push"`` when the server speaks the ISSUE 16 push
+    channel (a dedicated socket outside the request pool carries
+    ``obs_push`` frames on the server's timer) or ``"poll"`` when the
+    peer rejected the op structurally — an OLD server — and the
+    subscription degraded to calling ``health()`` on the same cadence
+    (mixed versions degrade, never break). Either way ``on_push`` fires
+    with one message dict per tick and :attr:`last` holds the newest;
+    push messages carry ``delta`` + ``load_report``, poll messages carry
+    ``load_report`` + the full ``health`` dict (no delta — polling has
+    no cursor). ``stop()`` is idempotent and joins the reader thread."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        interval_s: float,
+        on_push: Optional[Any] = None,
+    ) -> None:
+        self.endpoint = endpoint
+        self.interval_s = interval_s
+        self.mode: Optional[str] = None
+        self.last: Optional[Dict[str, Any]] = None
+        self.last_at: Optional[float] = None
+        self.received = 0
+        self._on_push = on_push
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def alive(self) -> bool:
+        """True while the reader/poller thread runs (a dead host ends a
+        push subscription; a poll subscription keeps trying)."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def _record(self, msg: Dict[str, Any]) -> None:
+        self.last = msg
+        self.last_at = time.monotonic()
+        self.received += 1
+        if self._on_push is not None:
+            try:
+                self._on_push(msg)
+            except Exception:  # noqa: BLE001 - a bad callback can't kill
+                pass  # the stream; next tick still delivers
+
+    def stop(self) -> None:
+        self._stop.set()
+        sock = self._sock
+        if sock is not None:
+            # the push reader blocks in recv: severing the socket wakes it
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
 
 
 class EvalClient:
@@ -211,6 +276,7 @@ class EvalClient:
         self._breaker_opened_at = 0.0
         self._breaker_probing = False
         self._tenants: Dict[str, _ClientTenant] = {}
+        self._subscriptions: List[ObsSubscription] = []
 
     # ------------------------------------------------------------ transport
     def _checkout(self) -> socket.socket:
@@ -262,6 +328,9 @@ class EvalClient:
         with self._lock:
             self._closed = True
             pool, self._pool = self._pool, []
+            subs, self._subscriptions = self._subscriptions, []
+        for sub in subs:
+            sub.stop()
         for sock in pool:
             try:
                 sock.close()
@@ -958,6 +1027,156 @@ class EvalClient:
         collection for drills and dashboards)."""
         header, payload = self._call("snapshot", {}, timeout_s=timeout_s)
         return unpack_tree(header["result"], payload)
+
+    # ------------------------------------------------------------ obs stream
+    def subscribe_obs(
+        self,
+        interval_s: float = 1.0,
+        *,
+        on_push: Optional[Any] = None,
+        fallback: str = "poll",
+    ) -> ObsSubscription:
+        """Subscribe to the host's obs push channel (ISSUE 16).
+
+        Opens a DEDICATED socket (outside the request pool — pushes are
+        server-paced and must not occupy a pooled request slot), sends
+        ``subscribe_obs``, and spawns a reader thread delivering each
+        ``obs_push`` frame (registry delta + timeline events +
+        ``load_report``) to ``on_push`` and :attr:`ObsSubscription.last`.
+
+        An old server rejects the op with ``WireError("protocol")`` —
+        never retried, never a failover trigger — and with
+        ``fallback="poll"`` (default) the subscription degrades to
+        polling ``health()`` on the same cadence (``mode == "poll"``).
+        ``fallback="raise"`` surfaces the protocol error instead. The
+        subscription is registered with this client and stopped by
+        ``close()``."""
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        _check_timeout_s(interval_s)
+        if fallback not in ("poll", "raise"):
+            raise ValueError(
+                f"fallback must be 'poll' or 'raise', got {fallback!r}."
+            )
+        with self._lock:
+            if self._closed:
+                raise ServeError("client_closed", "EvalClient is closed.")
+        sub = ObsSubscription(self.endpoint, float(interval_s), on_push)
+        try:
+            sock = socket.create_connection(
+                self._addr, timeout=self._connect_timeout_s
+            )
+        except OSError as e:
+            raise WireError(
+                "transport",
+                f"cannot connect to {self.endpoint} for obs stream: {e}",
+                endpoint=self.endpoint,
+            ) from e
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        accepted = False
+        try:
+            sock.settimeout(self._request_timeout_s)
+            send_frame(sock, {"op": "subscribe_obs", "interval_s": interval_s})
+            frame = recv_frame(sock)
+            if frame is None:
+                raise WireError(
+                    "transport",
+                    f"{self.endpoint} closed the connection before "
+                    "answering subscribe_obs.",
+                    endpoint=self.endpoint,
+                )
+            header, _payload = frame
+            if header.get("ok"):
+                accepted = True
+            else:
+                err = decode_error(header.get("error", {}))
+                if (
+                    isinstance(err, WireError)
+                    and getattr(err, "reason", None) == "protocol"
+                    and fallback == "poll"
+                ):
+                    # PR 12 discipline: an old peer degrades, never breaks
+                    accepted = False
+                else:
+                    raise err
+        except socket.timeout:
+            self._discard(sock)
+            raise WireError(
+                "request_timeout",
+                f"subscribe_obs to {self.endpoint} produced no response "
+                f"within {self._request_timeout_s}s.",
+                endpoint=self.endpoint,
+            ) from None
+        except OSError as e:
+            self._discard(sock)
+            raise WireError(
+                "transport",
+                f"subscribe_obs to {self.endpoint} failed: {e}",
+                endpoint=self.endpoint,
+            ) from e
+        except BaseException:
+            self._discard(sock)
+            raise
+        if accepted:
+            sub.mode = "push"
+            sub._sock = sock
+            sock.settimeout(None)  # pushes arrive on the server's timer
+            sub._thread = threading.Thread(
+                target=self._obs_read_loop,
+                args=(sub, sock),
+                name="torcheval-tpu-obs-subscriber",
+                daemon=True,
+            )
+        else:
+            sub.mode = "poll"
+            self._discard(sock)  # the poller uses the request pool
+            sub._thread = threading.Thread(
+                target=self._obs_poll_loop,
+                args=(sub,),
+                name="torcheval-tpu-obs-poller",
+                daemon=True,
+            )
+        with self._lock:
+            self._subscriptions.append(sub)
+        sub._thread.start()
+        return sub
+
+    @staticmethod
+    def _obs_read_loop(sub: ObsSubscription, sock: socket.socket) -> None:
+        while not sub._stop.is_set():
+            try:
+                frame = recv_frame(sock)
+            except (OSError, WireError):
+                break  # host died or stop() severed the socket
+            if frame is None:
+                break  # server closed: final flush already delivered
+            header, _payload = frame
+            if header.get("op") == "obs_push":
+                sub._record(header)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _obs_poll_loop(self, sub: ObsSubscription) -> None:
+        while not sub._stop.wait(sub.interval_s):
+            try:
+                health = self.health(attempts=1)
+            except (ServeError, WireError, OSError):
+                if self._closed:
+                    break
+                continue  # keep polling; the router judges staleness
+            sub._record(
+                {
+                    "op": "obs_poll",
+                    "endpoint": self.endpoint,
+                    "load_report": health.get("load_report"),
+                    "health": health,
+                }
+            )
 
     def drain(self, *, timeout_s: Any = _UNSET) -> Dict[str, Optional[str]]:
         """Ask the host to drain (evict-and-checkpoint every tenant).

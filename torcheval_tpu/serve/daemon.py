@@ -146,6 +146,7 @@ class EvalDaemon:
         evict_dir: Optional[str] = None,
         evict_keep_last: int = 2,
         watchdog_interval_s: float = 0.25,
+        metrics_port: Optional[int] = None,
     ) -> None:
         if max_tenants < 1:
             raise ValueError(f"max_tenants must be >= 1, got {max_tenants}.")
@@ -159,6 +160,10 @@ class EvalDaemon:
         self._evict_dir: Optional[str] = evict_dir
         self._evict_keep_last = evict_keep_last
         self._watchdog_interval_s = watchdog_interval_s
+        # metrics_port: bind the stdlib Prometheus/health scrape endpoint
+        # (obs/httpd.py) on start(); 0 = ephemeral port, None = no endpoint
+        self._metrics_port = metrics_port
+        self._metrics_server = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._tenants: Dict[str, _Tenant] = {}
@@ -169,6 +174,12 @@ class EvalDaemon:
         self._seq = 0
         self._started_at: Optional[float] = None
         self._totals = {"attached": 0, "quarantined": 0, "evicted": 0}
+        # aggregate submit/step latency EWMAs (alpha below) feeding
+        # load_report(); plain floats, no registry round trip
+        self._lat_ewma: Dict[str, float] = {}
+        # callbacks the wire layer registers to get a final obs push out
+        # before telemetry consumers would otherwise see a silent stop
+        self._flush_hooks: list = []
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "EvalDaemon":
@@ -183,7 +194,22 @@ class EvalDaemon:
                 daemon=True,
             )
             self._thread.start()
+        if self._metrics_port is not None and self._metrics_server is None:
+            from torcheval_tpu.obs.httpd import MetricsServer
+
+            self._metrics_server = MetricsServer(
+                port=self._metrics_port,
+                health_provider=self.load_report,
+            ).start()
         return self
+
+    @property
+    def metrics_address(self) -> Optional[tuple]:
+        """``(host, port)`` of the scrape endpoint, or ``None`` when the
+        daemon was built without ``metrics_port``."""
+        if self._metrics_server is None:
+            return None
+        return self._metrics_server.address
 
     def stop(self, *, timeout: Optional[float] = 10.0) -> None:
         """Stop the worker. Outstanding compute/detach promises are failed
@@ -201,8 +227,41 @@ class EvalDaemon:
                 return
             self._running = False
             self._cond.notify_all()
+        # final obs flush BEFORE the worker join: subscribers get the last
+        # delta (including this stop's own instruments) while the wire
+        # publishers are still alive
+        self._notify_flush_hooks()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+
+    # ---------------------------------------------------------- flush hooks
+    def _add_flush_hook(self, cb) -> None:
+        """Register ``cb()`` to run on ``drain()`` and ``stop()`` — the
+        obs push channel's final-flush seam (``wire.EvalServer`` wires its
+        publishers here so a subscriber's last delta is never lost to a
+        graceful shutdown)."""
+        with self._lock:
+            if cb not in self._flush_hooks:
+                self._flush_hooks.append(cb)
+
+    def _remove_flush_hook(self, cb) -> None:
+        with self._lock:
+            try:
+                self._flush_hooks.remove(cb)
+            except ValueError:
+                pass
+
+    def _notify_flush_hooks(self) -> None:
+        with self._lock:
+            hooks = list(self._flush_hooks)
+        for cb in hooks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 - shutdown must proceed
+                _logger.exception("serve: obs flush hook raised; continuing")
 
     def __enter__(self) -> "EvalDaemon":
         return self.start()
@@ -620,6 +679,7 @@ class EvalDaemon:
         RIGHT HERE on every path that does not enqueue (dedup, shed,
         drain reject, dead tenant) — a shed batch must never leak its
         staging slot."""
+        t0 = time.perf_counter()
         deadline = (
             time.monotonic() + timeout
             if (block and timeout is not None)
@@ -696,10 +756,26 @@ class EvalDaemon:
         finally:
             if stage is not None:
                 stage.release()
+        elapsed = time.perf_counter() - t0
+        self._ewma("submit", elapsed)
         if _obs._enabled:
             _obs.counter("serve.ingest.batches", tenant=tenant.id)
             _obs.histo("serve.queue_depth", float(depth), tenant=tenant.id)
+            # admission-to-enqueue latency: the SLO drill's instrument (a
+            # chaos ingest_delay stalls exactly this path) and the
+            # load_report's submit_p99_s source
+            _obs.histo("serve.submit.latency", elapsed, tenant=tenant.id)
         return True
+
+    _EWMA_ALPHA = 0.2
+
+    def _ewma(self, key: str, seconds: float) -> None:
+        prev = self._lat_ewma.get(key)
+        self._lat_ewma[key] = (
+            seconds
+            if prev is None
+            else prev + self._EWMA_ALPHA * (seconds - prev)
+        )
 
     def _shed(self, tenant: _Tenant, reason: str) -> None:
         tenant.sheds += 1
@@ -843,6 +919,9 @@ class EvalDaemon:
             _trace.instant(
                 "serve.drained", kind="serve", tenants=len(out)
             )
+        # subscribers see the drain's own counters/trace in a final push
+        # rather than learning about it from a dead socket
+        self._notify_flush_hooks()
         return out
 
     # ---------------------------------------------------------- worker side
@@ -882,6 +961,12 @@ class EvalDaemon:
                 items = list(t.queue)
                 t.queue.clear()
                 plans.append((t, items))
+                if _obs._enabled:
+                    # dequeue-side occupancy sample: the pop empties the
+                    # queue while we hold the lock, so an idle-draining
+                    # tenant's depth series actually falls to 0 instead of
+                    # freezing at the last submit's reading (ISSUE 16 fix)
+                    _obs.histo("serve.queue_depth", 0.0, tenant=t.id)
         if not plans:
             return plans
         self._cond.notify_all()
@@ -976,6 +1061,13 @@ class EvalDaemon:
                     )
 
     def _serve_tenant(self, tenant: _Tenant, items) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._serve_tenant_inner(tenant, items)
+        finally:
+            self._ewma("step", time.perf_counter() - t0)
+
+    def _serve_tenant_inner(self, tenant: _Tenant, items) -> None:
         with _obs.span("serve.tenant.step", tenant=tenant.id):
             for idx, (kind, payload, promise) in enumerate(items):
                 try:
@@ -1385,6 +1477,109 @@ class EvalDaemon:
             t.queue.clear()
 
     # --------------------------------------------------------------- health
+    _LOAD_REPORT_SCHEMA = 1
+
+    def load_report(self) -> Dict[str, Any]:
+        """Structured, schema-versioned load telemetry for this host —
+        the unit the obs push channel labels into every delta, ``health()``
+        embeds, the ``/health`` scrape endpoint serves, and
+        ``EvalRouter.fleet_status()`` folds per host (the signal layer
+        ROADMAP item 1's placement loop consumes).
+
+        Top-level keys are STABLE under ``schema == 1`` (pinned by
+        ``tests/serve/test_load_report.py``); additions bump the schema::
+
+            {"schema": 1, "ts": ..., "uptime_s": ..., "running": ...,
+             "draining": ..., "capacity": {...}, "queue": {...},
+             "latency": {...}, "window": {...}, "ingest": {...},
+             "hbm": {...}, "totals": {...}}
+
+        Latency p99s fold the registry's ``serve.submit.latency``
+        histograms / ``serve.tenant.step`` span buckets across tenants
+        (bucket summation — exact); EWMAs are the daemon's own running
+        aggregates; HBM folds the ``obs.cost.hbm_bytes{entry=}`` gauges.
+        When obs is disabled the registry-derived fields read 0 — the
+        queue/capacity/totals fields are daemon-native and always live."""
+        now = time.monotonic()
+        with self._cond:
+            per_tenant = {
+                t.id: len(t.queue) for t in self._tenants.values()
+            }
+            backlog = 0
+            for t in self._tenants.values():
+                for kind, payload, _p in t.queue:
+                    if kind == "batch":
+                        for a in payload[1] or ():
+                            backlog += int(getattr(a, "nbytes", 0) or 0)
+            out: Dict[str, Any] = {
+                "schema": self._LOAD_REPORT_SCHEMA,
+                "ts": time.time(),
+                "uptime_s": (
+                    now - self._started_at if self._started_at else 0.0
+                ),
+                "running": self._running,
+                "draining": self._draining,
+                "capacity": {
+                    "max_tenants": self._max_tenants,
+                    "active_tenants": len(self._tenants),
+                },
+                "queue": {
+                    "depth": sum(per_tenant.values()),
+                    "capacity": sum(
+                        t.capacity for t in self._tenants.values()
+                    ),
+                    "per_tenant": per_tenant,
+                },
+                "ingest": {"backlog_bytes": backlog},
+                "totals": dict(self._totals),
+            }
+            ewma = dict(self._lat_ewma)
+        # registry folds OUTSIDE the daemon lock (the registry has its own)
+        from torcheval_tpu.obs.registry import (
+            HISTOGRAM_BUCKETS,
+            default_registry,
+            percentile_from_buckets,
+        )
+
+        submit_b = [0] * HISTOGRAM_BUCKETS
+        submit_c = 0
+        step_b = [0] * HISTOGRAM_BUCKETS
+        step_c = 0
+        occ_sum, occ_c = 0.0, 0
+        hbm_max, hbm_sum = 0.0, 0.0
+        for kind, name, _lb, value in default_registry._items():
+            if kind == "histo" and name == "serve.submit.latency":
+                for i, c in enumerate(value[0]):
+                    submit_b[i] += c
+                submit_c += value[1]
+            elif kind == "span" and name == "serve.tenant.step":
+                for i, c in enumerate(value[3]):
+                    step_b[i] += c
+                step_c += value[0]
+            elif kind == "histo" and name == "deferred.window_occupancy":
+                occ_sum += value[2]
+                occ_c += value[1]
+            elif kind == "gauge" and name == "obs.cost.hbm_bytes":
+                hbm_max = max(hbm_max, value)
+                hbm_sum += value
+        out["latency"] = {
+            "submit_ewma_s": ewma.get("submit", 0.0),
+            "step_ewma_s": ewma.get("step", 0.0),
+            "submit_p99_s": percentile_from_buckets(
+                submit_b, submit_c, 0.99
+            ),
+            "step_p99_s": percentile_from_buckets(step_b, step_c, 0.99),
+        }
+        out["window"] = {
+            "occupancy_mean": occ_sum / occ_c if occ_c else 0.0,
+            "samples": occ_c,
+        }
+        out["hbm"] = {
+            "bytes_max_entry": hbm_max,
+            "bytes_sum": hbm_sum,
+        }
+        return out
+
     def health(
         self,
         *,
@@ -1433,6 +1628,10 @@ class EvalDaemon:
                 "totals": dict(self._totals),
                 "tenants": tenants,
             }
+        # outside the lock: load_report() re-acquires it (and the old-peer
+        # fallback path reads this — a subscriber polling health() still
+        # sees the same structured load telemetry a push would carry)
+        out["load_report"] = self.load_report()
         if sync:
             from torcheval_tpu import obs
 

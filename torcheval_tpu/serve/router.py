@@ -33,6 +33,18 @@ Observability: ``serve.router.migrations{reason=}``,
 ``serve.router.probe_failures{endpoint=}``, plus a
 ``serve.router.migrate`` span per migrated host (a migration-blackout
 bar in the Chrome trace).
+
+Fleet telemetry (ISSUE 16): ``subscribe_obs()`` opens one obs push
+stream per alive host (``EvalClient.subscribe_obs`` — delta snapshots +
+``load_report`` on the server's timer, degrading to ``health()`` polling
+against old peers); the router folds each host's deltas into a
+:class:`~torcheval_tpu.obs.DeltaAccumulator` and keeps its latest load
+report. ``fleet_status()`` serves the folded view with staleness marking
+(a host whose last push is older than ``stale_after_s`` — default three
+push intervals — is ``stale`` BEFORE the failure detector evicts it);
+``fleet_chrome_trace()`` merges every host's pushed timeline events into
+one Chrome trace, pid per host. None of it adds collective rounds: the
+stream rides the serve wire, not the toolkit funnel.
 """
 
 from __future__ import annotations
@@ -117,6 +129,15 @@ class EvalRouter:
         # an in-flight migration to finish.
         self._cv = threading.Condition(self._lock)
         self._migrating: set = set()
+        # fleet telemetry (ISSUE 16): per-endpoint folded obs state,
+        # guarded by its own lock — push callbacks run on subscriber
+        # threads and must never contend with migration's router lock
+        self._fleet_lock = threading.Lock()
+        self._obs_subs: Dict[str, Any] = {}
+        self._fleet: Dict[str, Dict[str, Any]] = {}
+        self._obs_interval_s: Optional[float] = None
+        self._stale_after_s: Optional[float] = None
+        self._fleet_max_events = 4096
 
     # ------------------------------------------------------------ placement
     def _place(self, tenant_id: str) -> str:
@@ -150,6 +171,7 @@ class EvalRouter:
             return {t: rec.endpoint for t, rec in self._tenants.items()}
 
     def close(self) -> None:
+        self.unsubscribe_obs()
         for client in self._clients.values():
             client.close()
 
@@ -390,6 +412,175 @@ class EvalRouter:
             "alive": self.alive,
             "tenants": self.placement(),
         }
+
+    # ------------------------------------------------------ fleet telemetry
+    def subscribe_obs(
+        self,
+        interval_s: float = 1.0,
+        *,
+        stale_after_s: Optional[float] = None,
+        max_events: int = 4096,
+    ) -> Dict[str, str]:
+        """Open one obs push stream per alive host (ISSUE 16) and fold
+        what arrives into the router's fleet view.
+
+        Each host streams O(changed) registry deltas + timeline events +
+        its structured ``load_report`` on its own timer; an old host that
+        rejects the op degrades to ``health()`` polling on the same
+        cadence (``mode == "poll"``). ``stale_after_s`` (default three
+        push intervals) is the staleness horizon :meth:`fleet_status`
+        marks hosts against. Returns ``{endpoint: mode}``. Idempotent:
+        re-subscribing first drops the existing streams."""
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        _check_timeout_s(interval_s)
+        if stale_after_s is None:
+            stale_after_s = 3.0 * float(interval_s)
+        _check_timeout_s(stale_after_s)
+        if max_events < 0:
+            raise ValueError(f"max_events must be >= 0, got {max_events}.")
+        self.unsubscribe_obs()
+        with self._fleet_lock:
+            self._obs_interval_s = float(interval_s)
+            self._stale_after_s = float(stale_after_s)
+            self._fleet_max_events = int(max_events)
+        modes: Dict[str, str] = {}
+        for ep in self.alive:
+            try:
+                sub = self._clients[ep].subscribe_obs(
+                    interval_s,
+                    on_push=lambda msg, _ep=ep: self._on_obs_push(_ep, msg),
+                )
+            except (WireError, ServeError) as e:
+                _logger.warning(
+                    "router: obs subscription to %s failed: %s", ep, e
+                )
+                continue
+            with self._fleet_lock:
+                self._obs_subs[ep] = sub
+            modes[ep] = sub.mode
+        return modes
+
+    def unsubscribe_obs(self) -> None:
+        """Stop every obs stream (folded fleet state is kept)."""
+        with self._fleet_lock:
+            subs, self._obs_subs = self._obs_subs, {}
+        for sub in subs.values():
+            sub.stop()
+
+    def _on_obs_push(self, endpoint: str, msg: Dict[str, Any]) -> None:
+        """Fold one pushed (or polled) obs message into the fleet view.
+        Runs on the subscription's thread — only ``_fleet_lock`` here."""
+        from torcheval_tpu.obs.stream import DeltaAccumulator
+
+        with self._fleet_lock:
+            rec = self._fleet.get(endpoint)
+            if rec is None:
+                rec = {
+                    "acc": DeltaAccumulator(),
+                    "events": [],
+                    "events_trimmed": 0,
+                    "report": None,
+                    "received_at": 0.0,
+                    "mode": "poll",
+                    "pushes": 0,
+                }
+                self._fleet[endpoint] = rec
+            rec["mode"] = (
+                "push" if msg.get("op") == "obs_push" else "poll"
+            )
+            rec["received_at"] = time.monotonic()
+            rec["pushes"] += 1
+            if msg.get("load_report") is not None:
+                rec["report"] = msg["load_report"]
+            delta = msg.get("delta")
+            if delta:
+                rec["acc"].apply(delta)
+                events = delta.get("events") or ()
+                if events:
+                    rec["events"].extend(events)
+                    overflow = (
+                        len(rec["events"]) - self._fleet_max_events
+                    )
+                    if overflow > 0:
+                        del rec["events"][:overflow]
+                        rec["events_trimmed"] += overflow
+                rec["events_trimmed"] += int(
+                    delta.get("events_trimmed", 0)
+                )
+
+    def fleet_status(
+        self, *, stale_after_s: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """The folded fleet view: per-host latest ``load_report``, push
+        age, and a ``stale`` flag (no load report yet, or the last one is
+        older than ``stale_after_s``). A killed host goes stale here
+        within one horizon — BEFORE a health probe or tenant op marks it
+        dead — which is the point: the stream is the early-warning
+        channel, the failure detector stays authoritative for eviction.
+        Pure local fold; no network, no collective rounds."""
+        if stale_after_s is None:
+            stale_after_s = self._stale_after_s
+        if stale_after_s is None:
+            stale_after_s = 3.0  # fleet view without an active stream
+        now = time.monotonic()
+        alive = set(self.alive)
+        hosts: Dict[str, Any] = {}
+        with self._fleet_lock:
+            endpoints = set(self._fleet) | set(self._obs_subs)
+            for ep in sorted(endpoints | alive):
+                rec = self._fleet.get(ep)
+                sub = self._obs_subs.get(ep)
+                age = (
+                    now - rec["received_at"]
+                    if rec is not None and rec["received_at"]
+                    else None
+                )
+                hosts[ep] = {
+                    "alive": ep in alive,
+                    "mode": rec["mode"] if rec else (
+                        sub.mode if sub is not None else None
+                    ),
+                    "subscribed": sub is not None,
+                    "age_s": age,
+                    "stale": age is None or age > stale_after_s,
+                    "load_report": rec["report"] if rec else None,
+                    "pushes": rec["pushes"] if rec else 0,
+                }
+        return {
+            "hosts": hosts,
+            "alive": sorted(alive),
+            "tenants": self.placement(),
+            "stale_after_s": float(stale_after_s),
+        }
+
+    def fleet_snapshot(self, endpoint: str) -> Dict[str, Any]:
+        """The accumulated registry snapshot for one host (exact fold of
+        every delta received so far, ``Registry.snapshot()`` shape)."""
+        with self._fleet_lock:
+            rec = self._fleet.get(endpoint)
+            if rec is None:
+                raise ValueError(
+                    f"no obs stream state for endpoint {endpoint!r}."
+                )
+            return rec["acc"].snapshot()
+
+    def fleet_chrome_trace(self, **json_kwargs: Any) -> str:
+        """One Chrome/Perfetto trace for the whole fleet: every host's
+        pushed timeline events merged into the router's own timeline via
+        ``obs.chrome_trace(extra_events=)``, with ``pid`` = the host
+        endpoint — each host renders as its own process row, tenant spans
+        nested under it. Open in ``chrome://tracing`` / Perfetto."""
+        from torcheval_tpu.obs import chrome_trace
+
+        extra: List[Dict[str, Any]] = []
+        with self._fleet_lock:
+            for ep, rec in self._fleet.items():
+                for e in rec["events"]:
+                    tagged = dict(e)
+                    tagged["rank"] = ep  # pid=host in the merged trace
+                    extra.append(tagged)
+        return chrome_trace(extra_events=extra, **json_kwargs)
 
     # ------------------------------------------------------------ migration
     def _wait_not_migrating(

@@ -19,7 +19,8 @@ Frame layout (all integers big-endian)::
 
 Request headers carry ``op`` (``attach`` / ``submit`` / ``compute`` /
 ``sync_compute`` / ``flush`` / ``detach`` / ``drain`` / ``health`` /
-``snapshot``) plus op-specific fields; responses carry ``ok`` and either
+``snapshot`` / ``subscribe_obs``) plus op-specific fields; responses
+carry ``ok`` and either
 the result or a structured ``error`` object that reconstructs the
 serve-side exception CLASS, ``reason``, and ``retryable`` flag on the
 client (:func:`encode_error` / :func:`decode_error`) — a remote caller
@@ -39,6 +40,23 @@ ambiguous failure (connection died after send, before the ack) — while
 the metric state is exactly-once. Acks return the tenant's *durable*
 watermark (highest seq covered by a published checkpoint) so clients can
 prune their bounded replay buffers.
+
+**Obs push channel (ISSUE 16).** ``subscribe_obs`` flips a connection
+from request-response to server-push: after the ``ok`` ack, a
+per-subscription :class:`_ObsPublisher` thread owns the socket and ships
+``obs_push`` frames on an ``interval_s`` timer — each carrying the
+registry's delta-since-cursor (``obs/stream.py``, O(changed) bytes), the
+timeline events since the cursor, and the daemon's structured
+``load_report()``. Pure TCP: zero collective rounds, ever. A final flush
+rides the daemon's ``drain()``/``stop()`` hooks so the last delta
+(including the drain's own counters) reaches subscribers before the
+socket dies. An OLD server rejects the unknown op structurally
+(``WireError("protocol")``) and the subscriber degrades to polling
+``health()`` — mixed versions degrade, never break (the PR 12
+discipline). Slow subscribers are bounded by the socket send buffer
+plus a send timeout: a push that cannot be written in time is dropped
+WITH the subscriber (counted in ``obs.stream.dropped``) — a wedged
+scraper can never grow daemon-side memory or block a drain.
 """
 
 from __future__ import annotations
@@ -645,6 +663,145 @@ def build_metrics(spec: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# -------------------------------------------------------------- obs push
+class _ObsPublisher:
+    """One obs-push subscription: a thread that owns a handed-over
+    connection and ships ``obs_push`` frames on a timer (see module doc).
+
+    Timer discipline: fixed-rate scheduling against ``monotonic`` — a
+    push that takes longer than ``interval_s`` (slow subscriber, giant
+    delta) does not accumulate debt; the skipped ticks are counted into
+    ``obs.stream.dropped`` (no telemetry is lost — the next delta folds
+    everything since the cursor — but the *cadence* contract was missed
+    and the subscriber deserves to know). The send carries a timeout: a
+    peer that stops reading long enough to fill its socket buffer AND
+    outlast the timeout is dropped entirely (a partial frame write is
+    unrecoverable framing-wise), which bounds daemon-side cost at one
+    in-flight frame per subscriber."""
+
+    def __init__(
+        self,
+        server: "EvalServer",
+        conn: socket.socket,
+        interval_s: float,
+    ) -> None:
+        self._server = server
+        self._conn = conn
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._send_lock = threading.Lock()
+        self._cursor = None
+        self._push_seq = 0
+        self._thread = threading.Thread(
+            target=self._run,
+            name="torcheval-tpu-obs-publisher",
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        # a wedged subscriber must not block a drain's final flush
+        # indefinitely: bound every frame write
+        try:
+            self._conn.settimeout(max(5.0, 5.0 * self._interval_s))
+        except OSError:
+            pass
+        daemon = self._server._daemon
+        add_hook = getattr(daemon, "_add_flush_hook", None)
+        if add_hook is not None:
+            add_hook(self.flush)
+        self._thread.start()
+
+    def _run(self) -> None:
+        interval = self._interval_s
+        next_t = time.monotonic() + interval
+        while not self._stop.is_set():
+            timeout = next_t - time.monotonic()
+            if timeout > 0 and self._stop.wait(timeout):
+                break
+            now = time.monotonic()
+            missed = -1
+            while next_t <= now:
+                next_t += interval
+                missed += 1
+            if missed > 0 and _obs._enabled:
+                _obs.counter("obs.stream.dropped", float(missed))
+            try:
+                from torcheval_tpu.obs import slo as _slo
+
+                _slo.evaluate_slos()
+            except Exception:  # noqa: BLE001 - a bad SLO can't kill pushes
+                _logger.exception("obs-push: SLO evaluation raised")
+            if not self._push():
+                break
+        self._retire()
+
+    def _push(self) -> bool:
+        """Ship one delta; False when the subscriber is gone/wedged."""
+        from torcheval_tpu.obs import stream as _stream
+
+        with self._send_lock:
+            if self._stop.is_set():
+                return False
+            delta, cursor = _stream.collect(self._cursor)
+            try:
+                report = self._server._daemon.load_report()
+            except Exception:  # noqa: BLE001 - report trouble != channel
+                report = None
+            self._push_seq += 1
+            header = {
+                "op": "obs_push",
+                "push_seq": self._push_seq,
+                "endpoint": self._server.endpoint,
+                "delta": delta,
+                "load_report": report,
+            }
+            try:
+                send_frame(self._conn, header)
+            except (OSError, ValueError):
+                # socket.timeout is an OSError: a subscriber that cannot
+                # take one frame within the bounded window is dropped and
+                # the drop counted — never buffered against
+                if _obs._enabled:
+                    _obs.counter("obs.stream.dropped")
+                return False
+            # only advance the cursor on a successful write: a failed
+            # push's changes stay pending (they would fold into the next
+            # delta if the subscriber were still there)
+            self._cursor = cursor
+            if _obs._enabled:
+                _obs.counter("obs.stream.pushes")
+        return True
+
+    def flush(self) -> None:
+        """Synchronous final push (daemon drain()/stop() hook, and
+        server.close()): the caller's thread ships the delta so the data
+        is on the wire before the socket is severed."""
+        if not self._stop.is_set():
+            self._push()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _retire(self) -> None:
+        """Publisher exit path: deregister everywhere and close the
+        socket (it was removed from request-response service at
+        handover; nothing else will)."""
+        daemon = self._server._daemon
+        remove_hook = getattr(daemon, "_remove_flush_hook", None)
+        if remove_hook is not None:
+            remove_hook(self.flush)
+        with self._server._lock:
+            self._server._conns.discard(self._conn)
+            try:
+                self._server._publishers.remove(self)
+            except ValueError:
+                pass
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
 # ------------------------------------------------------------------- server
 class EvalServer:
     """TCP front end for one :class:`EvalDaemon`.
@@ -689,6 +846,7 @@ class EvalServer:
         self._attach_nonces: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._conns: set = set()
+        self._publishers: list = []
         self._running = True
         # chaos host_partition: once tripped the server stops ACKing —
         # requests are read and dropped, modelling a half-dead host whose
@@ -708,12 +866,21 @@ class EvalServer:
     def close(self) -> None:
         """Stop accepting AND sever live connections — a closed server is
         fully gone from the network's point of view (clients see dead
-        sockets, not a listener that answers on old connections)."""
+        sockets, not a listener that answers on old connections). Obs
+        subscribers get a best-effort final push first."""
         self._running = False
         try:
             self._sock.close()
         except OSError:
             pass
+        with self._lock:
+            publishers = list(self._publishers)
+        for pub in publishers:
+            try:
+                pub.flush()
+            except Exception:  # noqa: BLE001 - close must proceed
+                pass
+            pub.stop()
         with self._lock:
             conns = list(self._conns)
         for conn in conns:
@@ -753,6 +920,7 @@ class EvalServer:
             pass
         with self._lock:
             self._conns.add(conn)
+        handed_over = False
         try:
             while self._running:
                 try:
@@ -775,17 +943,47 @@ class EvalServer:
                 response = self._dispatch(header, payload, stage)
                 if response is None:
                     continue  # partition tripped ON this request
+                pub = None
+                if response[0].get("ok") and response[0].get("subscribed"):
+                    # register the publisher BEFORE acking: the client
+                    # treats the ack as "subscribed", so a close() racing
+                    # this window must already see the publisher or the
+                    # final-flush-on-close guarantee silently lapses
+                    pub = _ObsPublisher(
+                        self,
+                        conn,
+                        float(response[0]["interval_s"]),
+                    )
+                    with self._lock:
+                        if not self._running:
+                            return  # closing: never ack, just drop
+                        self._publishers.append(pub)
                 try:
                     send_frame(conn, *response)
                 except OSError:
+                    if pub is not None:
+                        with self._lock:
+                            try:
+                                self._publishers.remove(pub)
+                            except ValueError:
+                                pass
+                    return
+                if pub is not None:
+                    # ack sent: the connection now belongs to the
+                    # publisher thread (it stays in _conns so close()
+                    # severs it; the publisher discards + closes it when
+                    # it retires)
+                    handed_over = True
+                    pub.start()
                     return
         finally:
-            with self._lock:
-                self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            if not handed_over:
+                with self._lock:
+                    self._conns.discard(conn)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(
@@ -873,6 +1071,22 @@ class EvalServer:
             return {"tenants": drained}, b""
         if op == "attach":
             return self._handle_attach(header)
+        if op == "subscribe_obs":
+            interval_s = header.get("interval_s", 1.0)
+            try:
+                interval_s = float(interval_s)
+            except (TypeError, ValueError):
+                interval_s = float("nan")
+            if not (interval_s > 0.0) or interval_s != interval_s:
+                raise WireError(
+                    "bad_request",
+                    f"subscribe_obs interval_s must be a positive number, "
+                    f"got {header.get('interval_s')!r}.",
+                )
+            # the ack doubles as the handover signal: _serve_connection
+            # sees "subscribed" in the ok response and hands the socket
+            # to a publisher thread instead of reading another request
+            return {"subscribed": True, "interval_s": interval_s}, b""
         if op not in (
             "submit",
             "submit_many",
