@@ -266,6 +266,15 @@ _SANDBOX_CAVEAT_ROWS = {
         "segment fold vectorizes and the slice axis costs a vector "
         "lane (docs/performance.md, Sliced metrics)"
     ),
+    "config11_sliced_1m_sharded_ratio": (
+        "1core-8dev: the 8 mesh devices timeshare ONE core, so every "
+        "shard's masked block-range scatter serializes (~8x the scatter "
+        "row work back-to-back) and the wall-clock ratio understates a "
+        "real mesh; the sandbox-provable claim is the in-leg capacity "
+        "assert — state_bytes_per_device{path=sharded} is exactly "
+        "1/shards of {path=xla} — while the VMEM-tiled kernel win is "
+        "the TPU claim (docs/performance.md, Sliced metrics)"
+    ),
     "config12_obs_stream_overhead": (
         "loopback-1core: the obs publisher thread timeshares the single "
         "ingest core; the <=2% target applies where telemetry "
@@ -1697,6 +1706,124 @@ def config11_sliced():
     )
 
 
+def config11_sliced_sharded():
+    """ISSUE 17: the config11 workload with the slice axis SHARDED over
+    every local device (``mesh_axis``). Two rows plus one hard in-leg
+    assert:
+
+    * ``config11_sliced_1m_sharded`` — sharded-collection throughput on
+      the IDENTICAL stream (same seed/affine map as ``config11_sliced``);
+    * ``config11_sliced_1m_sharded_ratio`` — vs the unsliced pair on the
+      same rows, directly comparable to ``config11_sliced_ratio``. On
+      this sandbox the 8 "devices" timeshare ONE core, so each shard's
+      masked block-range scatter serializes and the ratio UNDERSTATES a
+      real mesh (the caveat field says so); the kernel-path win is the
+      TPU claim (docs/performance.md).
+    * the sandbox-PROVABLE claim asserts unconditionally when obs is on:
+      ``ops.scatter.state_bytes_per_device{path=sharded}`` must be
+      exactly ``1/shards`` of the unsharded ``{path=xla}`` gauge for the
+      same fold — the capacity math that puts a million-cohort sketch
+      back inside per-device memory and the int32 segment-index bound.
+    """
+    _jax()
+    import jax
+
+    from torcheval_tpu import obs
+    from torcheval_tpu.metrics import (
+        BinaryAccuracy,
+        BinaryAUROC,
+        MetricCollection,
+        SlicedMetricCollection,
+    )
+
+    n_slices = 4_096 if _SMOKE else 1_000_000
+    rows = 16_384 if _SMOKE else 1_048_576
+    n_batches = 4 if _SMOKE else 16
+    bits = 4
+    shards = len(jax.devices())
+    # IDENTICAL stream to config11_sliced: same rng seed, same affine map
+    rng = np.random.default_rng(0)
+    total = rows * (n_batches + 1)
+    zipf = (rng.zipf(1.3, total) - 1) % n_slices
+    base = np.concatenate([np.arange(n_slices), zipf])[:total]
+    ids = base.astype(np.int64) * 7919 + 13
+    scores = rng.random(total).astype(np.float32)
+    targets = (rng.random(total) < 0.4).astype(np.float32)
+
+    def batch(i):
+        sl = slice(i * rows, (i + 1) * rows)
+        return ids[sl], scores[sl], targets[sl]
+
+    def build(sharded):
+        kw = {"mesh_axis": "slices"} if sharded else {}
+        return SlicedMetricCollection(
+            {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+            capacity=n_slices,
+            curve_bucket_bits=bits,
+            **kw,
+        )
+
+    def epoch(col):
+        for i in range(1, n_batches + 1):
+            col.update(*batch(i))
+        res = col.compute()
+        np.asarray(res["acc"]["values"])
+        np.asarray(res["auroc"]["values"])
+
+    # unsharded twin: builds (or reuses) the xla-path fold so the
+    # {path=xla} capacity gauge is populated for the ratio assert below
+    plain_sliced = build(sharded=False)
+    plain_sliced.update(*batch(0))
+    np.asarray(plain_sliced.compute()["acc"]["values"])
+
+    sharded_col = build(sharded=True)
+    sharded_col.update(*batch(0))
+    np.asarray(sharded_col.compute()["acc"]["values"])
+    epoch(sharded_col)  # warm the window-step program
+    t0 = time.perf_counter()
+    epoch(sharded_col)
+    sharded_s = time.perf_counter() - t0
+    _emit(
+        f"config11_sliced_1m_sharded_{shards}dev",
+        n_batches * rows,
+        sharded_s,
+        None,
+    )
+
+    if obs.enabled():
+        gauges = obs.snapshot()["gauges"]
+        per_dev = gauges["ops.scatter.state_bytes_per_device{path=sharded}"]
+        full = gauges["ops.scatter.state_bytes_per_device{path=xla}"]
+        # the capacity acceptance: resident scatter state per device is
+        # exactly the global extent over the shard count
+        assert per_dev * shards == full, (per_dev, shards, full)
+
+    plain = MetricCollection(
+        {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)}
+    )
+
+    def plain_epoch():
+        for i in range(1, n_batches + 1):
+            _sl, s, t = batch(i)
+            plain.update(s, t)
+        res = plain.compute()
+        np.asarray(res["acc"])
+        np.asarray(res["auroc"])
+
+    plain.update(batch(0)[1], batch(0)[2])
+    plain.compute()
+    plain_epoch()
+    t0 = time.perf_counter()
+    plain_epoch()
+    plain_s = time.perf_counter() - t0
+    _emit_row(
+        "config11_sliced_1m_sharded_ratio",
+        plain_s / sharded_s,
+        f"x of unsliced rate on identical rows, slice axis {shards}-way "
+        "sharded (vs config11_sliced_ratio on the same stream)",
+    )
+
+
 def config12_obs_stream():
     """ISSUE 16 acceptance: streaming telemetry is near-free for ingest.
 
@@ -1862,6 +1989,8 @@ _EXPECTED_ROW_PREFIXES = (
     "config10_sketch_1b_rows",
     "config11_sliced_1m",
     "config11_sliced_ratio",
+    "config11_sliced_1m_sharded",
+    "config11_sliced_1m_sharded_ratio",
     "config12_obs_stream_overhead",
     "config12_obs_delta_bytes",
     "env_dispatch_floor",
@@ -1906,6 +2035,7 @@ def main() -> None:
         config8_cluster,
         config10_sketch,
         config11_sliced,
+        config11_sliced_sharded,
         config12_obs_stream,
         env_dispatch_floor,
     ):

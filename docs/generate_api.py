@@ -39,6 +39,7 @@ MODULES = [
     "torcheval_tpu.utils.quant",
     "torcheval_tpu.tools",
     "torcheval_tpu.ops",
+    "torcheval_tpu.ops.scatter",
     "torcheval_tpu.utils.test_utils",
 ]
 

@@ -136,16 +136,18 @@ def make_sliced_shard(rank: int):
     return out
 
 
-def make_sliced_collection():
+def make_sliced_collection(mesh=None, mesh_axis=None):
     from torcheval_tpu.metrics import (
         BinaryAccuracy,
         BinaryAUROC,
         SlicedMetricCollection,
     )
 
+    kw = {} if mesh_axis is None else {"mesh": mesh, "mesh_axis": mesh_axis}
     return SlicedMetricCollection(
         {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
         capacity=4,
+        **kw,
     )
 
 
@@ -164,6 +166,13 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # ISSUE 17: give every process TWO local CPU devices so the sharded
+    # sliced scenario can split the slice axis over a per-process mesh.
+    # Every other scenario is device-count-agnostic (the wire moves host
+    # bytes via process_allgather; state stays replicated locally).
+    from torcheval_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(2)
     # join the world through the public bootstrap helper, fed torch-elastic
     # style env vars — exactly how a launch script written for the reference
     # (torchrun setting MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE) would drive it
@@ -253,6 +262,37 @@ def main() -> None:
     results["sliced_ids"] = [int(i) for i in r["acc"]["slice_ids"]]
     results["sliced_acc"] = _jsonable(r["acc"]["values"])
     results["sliced_auroc"] = _jsonable(r["auroc"]["values"])
+
+    # --- ISSUE 17: the SAME sliced scenario with the slice axis SHARDED
+    # over this process's LOCAL 2-device mesh. The wire is process-level
+    # (host bytes via process_allgather — the local np.asarray gather
+    # assembles the global slice axis from addressable shards without a
+    # cross-process collective), so per-process device sharding composes
+    # with it; the install path re-shards the union-aligned state. Synced
+    # both ways: transport default (raw, or quantized under the CI
+    # re-run's env knob) AND explicit quantize=True — per-slice values
+    # must be bit-identical to the parent's unsharded oracle either way.
+    from jax.sharding import Mesh as _Mesh
+
+    local_mesh = _Mesh(np.asarray(jax.local_devices()), ("slices",))
+    scol_sh = make_sliced_collection(mesh=local_mesh, mesh_axis="slices")
+    for b in make_sliced_shard(rank):
+        scol_sh.update(*b)
+    results["sliced_sharded_replicated"] = bool(
+        scol_sh.metrics["auroc"].sketch_tp.sharding.is_fully_replicated
+    )
+    r = sync_and_compute_collection(
+        dict(scol_sh.metrics), recipient_rank="all"
+    )
+    results["sliced_sharded_ids"] = [int(i) for i in r["acc"]["slice_ids"]]
+    results["sliced_sharded_acc"] = _jsonable(r["acc"]["values"])
+    results["sliced_sharded_auroc"] = _jsonable(r["auroc"]["values"])
+    rq = sync_and_compute_collection(
+        dict(scol_sh.metrics), recipient_rank="all", quantize=True
+    )
+    results["sliced_sharded_q_ids"] = [int(i) for i in rq["acc"]["slice_ids"]]
+    results["sliced_sharded_q_acc"] = _jsonable(rq["acc"]["values"])
+    results["sliced_sharded_q_auroc"] = _jsonable(rq["auroc"]["values"])
 
     # --- synced metric object + synced state dict on recipient 1
     synced = get_synced_metric(acc, recipient_rank=1)

@@ -47,8 +47,9 @@ def _launch_world(tmpdir: str) -> list:
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # workers pick their own platform (cpu) before backend init; scrub any
-    # device-count forcing so each process models one single-device host
+    # workers pick their own platform (cpu) AND force their own local
+    # device count (2, for the sharded sliced scenario) before backend
+    # init; scrub any inherited forcing so the worker's choice wins
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
@@ -192,6 +193,30 @@ class TestMultiprocessSync(unittest.TestCase):
             self.assertEqual(res["sliced_ids"], want_ids)
             self.assertEqual(res["sliced_acc"], want_acc)
             self.assertEqual(res["sliced_auroc"], want_auroc)
+
+    def test_sliced_sharded_leg_bit_identical_raw_and_quantized(self):
+        # ISSUE 17: the same scenario with the slice axis sharded over
+        # each process's LOCAL 2-device mesh. The per-rank states were
+        # genuinely split (not replicated) and both the transport-default
+        # sync and the explicit quantize=True sync deliver per-slice
+        # values bit-identical to the UNSHARDED single-stream oracle.
+        from mp_sync_worker import make_sliced_collection, make_sliced_shard
+
+        oracle = make_sliced_collection()
+        for r in range(WORLD):
+            for b in make_sliced_shard(r):
+                oracle.update(*b)
+        want = oracle.compute()
+        order = np.argsort(want["acc"].slice_ids)
+        want_ids = [int(i) for i in want["acc"].slice_ids[order]]
+        want_acc = np.asarray(want["acc"]["values"])[order].tolist()
+        want_auroc = np.asarray(want["auroc"]["values"])[order].tolist()
+        for res in self.results:
+            self.assertFalse(res["sliced_sharded_replicated"])
+            for prefix in ("sliced_sharded", "sliced_sharded_q"):
+                self.assertEqual(res[f"{prefix}_ids"], want_ids)
+                self.assertEqual(res[f"{prefix}_acc"], want_acc)
+                self.assertEqual(res[f"{prefix}_auroc"], want_auroc)
 
     def test_sliced_sync_is_two_collective_rounds(self):
         # every slice's state moves in the SAME two rounds — the slice

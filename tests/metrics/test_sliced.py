@@ -549,6 +549,18 @@ class TestValidation(unittest.TestCase):
         check_sliced_sketch_extent(10, at_bound)  # inside: fine
         with self.assertRaisesRegex(ValueError, "int32 segment-index"):
             check_sliced_sketch_extent(10, at_bound + 1)
+        # the remedy names the EXACT serve knob (ISSUE 17): slice-axis
+        # sharding relaxes the bound to per-shard
+        with self.assertRaisesRegex(
+            ValueError, r'slices=\{"mesh_axis": \.\.\.\}'
+        ):
+            check_sliced_sketch_extent(10, at_bound + 1)
+        # ... and the bound IS per shard: the same extent passes when
+        # split over enough shards, and fails closed past the
+        # per-shard edge
+        check_sliced_sketch_extent(10, 2 * at_bound, shards=2)
+        with self.assertRaisesRegex(ValueError, "int32 segment-index"):
+            check_sliced_sketch_extent(10, 2 * (at_bound + 1), shards=2)
         # construction rejects INSTANTLY (before materializing multi-GB
         # default histograms): default 16-bit buckets cap at ~16k slices
         with self.assertRaisesRegex(ValueError, "int32 segment-index"):

@@ -1,0 +1,260 @@
+"""Slice-axis-sharded collections (ISSUE 17 tentpole) on the forced
+8-device CPU mesh (``tests/conftest.py``): numeric BIT-parity with the
+unsharded twin (integer counters exact, sketch curves exact — the fold
+order per slice is identical), real ``P(axis)`` state placement, the
+no-state-replication HLO bound on the fold lowering, growth / merge /
+reset / clone / cross-load round trips, and the per-shard sketch-extent
+envelope."""
+
+import copy
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    SlicedMetricCollection,
+)
+from torcheval_tpu.metrics.sliced import _ID_STATE_NAMES, _sliced_fold
+
+SHARDS = 8
+
+
+def _make(sharded: bool, capacity: int = 8, **kw):
+    mesh_kw = {"mesh_axis": "slices"} if sharded else {}
+    return SlicedMetricCollection(
+        {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+        capacity=capacity,
+        **mesh_kw,
+        **kw,
+    )
+
+
+def _batches(n_unique: int, n_batches: int = 3, n: int = 4096, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    pool = np.arange(n_unique, dtype=np.int64) * 991 + 7
+    out = []
+    for _ in range(n_batches):
+        ids = rng.choice(pool, n)
+        scores = rng.random(n).astype(np.float32)
+        targets = (rng.random(n) < 0.5).astype(np.float32)
+        out.append((ids, scores, targets))
+    return out
+
+
+def _feed(col, batches):
+    for b in batches:
+        col.update(*b)
+    return col
+
+
+def _values(col):
+    res = col.compute()
+    return {
+        "ids": np.asarray(res["acc"].slice_ids),
+        "acc": np.asarray(res["acc"]["values"]),
+        "auroc": np.asarray(res["auroc"]["values"]),
+    }
+
+
+def _assert_same(test, got, want):
+    np.testing.assert_array_equal(got["ids"], want["ids"])
+    np.testing.assert_array_equal(got["acc"], want["acc"])
+    np.testing.assert_array_equal(got["auroc"], want["auroc"])
+
+
+class TestShardedParity(unittest.TestCase):
+    def _parity(self, n_unique, capacity):
+        batches = _batches(n_unique)
+        want = _values(_feed(_make(False, capacity), batches))
+        col = _feed(_make(True, capacity), batches)
+        got = _values(col)
+        _assert_same(self, got, want)
+        return col
+
+    def test_parity_small_capacity(self):
+        # S=8: one slice row per shard
+        col = self._parity(n_unique=8, capacity=8)
+        self.assertEqual(col.slice_table.capacity, 8)
+
+    def test_parity_wide_with_growth_past_2048(self):
+        # S >= 2048 with table growth crossing the sharded capacity
+        # (growth stays a multiple of the shard count; the sketch curves
+        # stay bit-identical because each slice's histogram sees the same
+        # adds in the same order, just on its owning shard)
+        col = self._parity(n_unique=2500, capacity=2048)
+        self.assertGreaterEqual(col.slice_table.capacity, 2560)
+        self.assertEqual(col.slice_table.capacity % SHARDS, 0)
+
+    def test_states_genuinely_sharded_ids_replicated(self):
+        col = _feed(_make(True, capacity=64), _batches(48))
+        for m in col.metrics.values():
+            m._fold_now()
+            for name in m._sliced_state_names:
+                st = getattr(m, name)
+                self.assertEqual(st.sharding.spec, P("slices"), name)
+                self.assertFalse(st.sharding.is_fully_replicated, name)
+                # each device holds exactly capacity/8 slice rows
+                shard_rows = {
+                    s.data.shape[0] for s in st.addressable_shards
+                }
+                self.assertEqual(shard_rows, {64 // SHARDS}, name)
+            for name in _ID_STATE_NAMES:
+                st = getattr(m, name)
+                self.assertTrue(
+                    st.sharding.is_fully_replicated, name
+                )
+
+    def test_capacity_rounds_up_to_shard_multiple(self):
+        col = _make(True, capacity=3)
+        self.assertEqual(col.slice_table.capacity, SHARDS)
+
+    def test_explicit_mesh_and_validation(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("cohorts",))
+        col = SlicedMetricCollection(
+            {"acc": BinaryAccuracy()},
+            capacity=8,
+            mesh=mesh,
+            mesh_axis="cohorts",
+        )
+        _feed(col, _batches(8, n_batches=1))
+        col.metrics["acc"]._fold_now()
+        st = col.metrics["acc"].num_correct
+        self.assertEqual(st.sharding.spec, P("cohorts"))
+        with self.assertRaisesRegex(ValueError, "mesh_axis"):
+            SlicedMetricCollection(
+                {"acc": BinaryAccuracy()}, capacity=8, mesh=mesh
+            )
+        with self.assertRaisesRegex(ValueError, "nope"):
+            SlicedMetricCollection(
+                {"acc": BinaryAccuracy()},
+                capacity=8,
+                mesh=mesh,
+                mesh_axis="nope",
+            )
+
+    def test_merge_collections_parity(self):
+        batches = _batches(40, n_batches=4)
+        want = _values(_feed(_make(False), batches))
+        a = _feed(_make(True), batches[:2])
+        b = _feed(_make(True), batches[2:])
+        _assert_same(self, _values(a.merge_collections([b])), want)
+
+    def test_reset_then_reuse_parity(self):
+        batches = _batches(24)
+        col = _feed(_make(True), batches)
+        col.compute()
+        col.reset()
+        _assert_same(
+            self,
+            _values(_feed(col, batches)),
+            _values(_feed(_make(False), batches)),
+        )
+
+    def test_deepcopy_keeps_sharding_and_parity(self):
+        col = _feed(_make(True), _batches(24))
+        want = _values(col)
+        clone = copy.deepcopy(col)
+        _assert_same(self, _values(clone), want)
+        m = clone.metrics["auroc"]
+        m._fold_now()
+        self.assertEqual(m.sketch_tp.sharding.spec, P("slices"))
+        # the clone shares the SAME mesh object (meshes carry live device
+        # handles — they are session singletons, not state)
+        self.assertIs(
+            clone._slice_shard[0], col._slice_shard[0]
+        )
+
+    def test_state_dicts_cross_load_both_directions(self):
+        batches = _batches(24)
+        want = _values(_feed(_make(False), batches))
+        sharded = _feed(_make(True), batches)
+        plain = _make(False)
+        plain.load_state_dicts(sharded.state_dicts())
+        _assert_same(self, _values(plain), want)
+        back = _make(True)
+        back.load_state_dicts(plain.state_dicts())
+        _assert_same(self, _values(back), want)
+        m = back.metrics["auroc"]
+        self.assertEqual(m.sketch_tp.sharding.spec, P("slices"))
+
+
+class TestShardedFoldHLO(unittest.TestCase):
+    """The no-state-replication bound: the compiled window fold holds no
+    per-device full-extent ``[S, ...]`` buffer and runs no all-gather —
+    every state-sized array in the program is the ``S/8`` shard tile."""
+
+    def _compiled_fold_text(self, member, arg_shapes):
+        fold = jax.jit(
+            lambda *a: _sliced_fold(*a, *member._fold_params)
+        )
+        return fold.lower(*arg_shapes).compile().as_text()
+
+    def test_counter_member_fold(self):
+        col = _make(True, capacity=4096)
+        n = 2048
+        hlo = self._compiled_fold_text(
+            col.metrics["acc"],
+            (
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+            ),
+        )
+        self.assertNotIn("all-gather", hlo)
+        for full in ("f32[4096,", "s32[4096,", "f32[4096]", "s32[4096]"):
+            self.assertNotIn(full, hlo)
+        self.assertIn("[512", hlo)  # the per-shard block tile
+
+    def test_sketch_member_fold(self):
+        col = _make(True, capacity=4096)
+        m = col.metrics["auroc"]
+        n = 2048
+        fold = jax.jit(lambda *a: m._fold_fn(*a, *m._fold_params))
+        hlo = (
+            fold.lower(
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+                jax.ShapeDtypeStruct((n,), jnp.float32),
+            )
+            .compile()
+            .as_text()
+        )
+        self.assertNotIn("all-gather", hlo)
+        # the global histogram would be s32[4096,1024]; only the
+        # per-shard s32[512,1024] tile may exist per device
+        self.assertNotIn("[4096,1024]", hlo)
+        self.assertIn("[512,1024]", hlo)
+
+
+class TestShardedSketchExtent(unittest.TestCase):
+    """The int32 segment-index bound is PER SHARD: capacities the
+    unsharded member must reject fit once split over the mesh (the
+    acceptance criterion's capacity math — materializing a real ~2^31
+    histogram is a TPU-pod exercise, so the envelope is proven at the
+    member validation hook the construction/growth paths call)."""
+
+    def test_member_bound_is_per_shard(self):
+        plain = _make(False).metrics["auroc"]
+        sharded = _make(True).metrics["auroc"]
+        planes = 2 * 1024 + 1  # approx=1024 with matching bucket bits
+        bound = (2**31 - 1) // planes
+        # past the unsharded bound: plain member fails closed...
+        with self.assertRaisesRegex(ValueError, "int32 segment-index"):
+            plain._check_capacity(SHARDS * bound)
+        # ...the 8-shard member accepts the same capacity...
+        sharded._check_capacity(SHARDS * bound)
+        # ...and fails closed again past ITS per-shard edge, still naming
+        # the serve knob
+        with self.assertRaisesRegex(
+            ValueError, r'slices=\{"mesh_axis": \.\.\.\}'
+        ):
+            sharded._check_capacity(SHARDS * (bound + 1))
+
+
+if __name__ == "__main__":
+    unittest.main()

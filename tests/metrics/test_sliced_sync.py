@@ -24,6 +24,7 @@ import unittest
 import numpy as np
 
 import torcheval_tpu.metrics.toolkit as tk
+from torcheval_tpu import obs
 from torcheval_tpu.metrics import (
     BinaryAccuracy,
     BinaryAUROC,
@@ -65,6 +66,21 @@ def _oracle(pool: int = 9):
     return col.compute()
 
 
+def _assert_matches_oracle(results, want):
+    for res in results:
+        for key in ("acc", "auroc"):
+            got = res[key]
+            # the synced union table is id-sorted; align the oracle
+            order = np.argsort(want[key].slice_ids)
+            np.testing.assert_array_equal(
+                got["slice_ids"], want[key].slice_ids[order]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got["values"]),
+                np.asarray(want[key]["values"])[order],
+            )
+
+
 class TestSlicedSync(unittest.TestCase):
     def _sync_world(self, pool=9, quantize=None):
         def fn(rank):
@@ -78,18 +94,7 @@ class TestSlicedSync(unittest.TestCase):
         return run_world(WORLD, fn)
 
     def _assert_matches_oracle(self, results, want):
-        for res in results:
-            for key in ("acc", "auroc"):
-                got = res[key]
-                # the synced union table is id-sorted; align the oracle
-                order = np.argsort(want[key].slice_ids)
-                np.testing.assert_array_equal(
-                    got["slice_ids"], want[key].slice_ids[order]
-                )
-                np.testing.assert_array_equal(
-                    np.asarray(got["values"]),
-                    np.asarray(want[key]["values"])[order],
-                )
+        _assert_matches_oracle(results, want)
 
     def test_ragged_cohorts_bit_identical_to_single_stream_oracle(self):
         results, _ = self._sync_world()
@@ -152,6 +157,116 @@ class TestSlicedSync(unittest.TestCase):
             )
         )
         np.testing.assert_array_equal(ids, want_ids)
+
+
+class TestSlicedSyncSharded(unittest.TestCase):
+    """ISSUE 17: the same two-round wire with slice-axis-SHARDED members
+    (``mesh_axis`` over the forced 8-device CPU mesh).
+
+    Threading caveat: XLA:CPU collectives rendezvous by RunId across ALL
+    local devices, so if two rank THREADS each launch a mesh-collective
+    program on the shared 8-device backend concurrently, both wait for 8
+    participants that never arrive and the world deadlocks. Every
+    collective-bearing program (the update folds, ``compute``) therefore
+    runs SEQUENTIALLY on the main thread here; only the sync itself —
+    host-byte gather/align/install, which never enters a collective —
+    rides the threaded wire harness. The real multi-PROCESS world (own
+    devices per process) has no such constraint and is covered by
+    ``test_multiprocess_sync.py``'s sharded sliced scenario.
+    """
+
+    @staticmethod
+    def _make_sharded_col():
+        return SlicedMetricCollection(
+            {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+            capacity=4,
+            mesh_axis="slices",
+        )
+
+    def _sync_world_sharded(self, quantize=None):
+        cols = []
+        for rank in range(WORLD):
+            col = self._make_sharded_col()
+            for b in _rank_batches(rank):
+                col.update(*b)
+            for m in col.metrics.values():
+                m._fold_now()  # drain folds BEFORE entering the threads
+            cols.append(col)
+
+        def fn(rank):
+            return {
+                name: tk.get_synced_metric(
+                    m, recipient_rank="all", quantize=quantize
+                )
+                for name, m in cols[rank].metrics.items()
+            }
+
+        synced, sim = run_world(WORLD, fn)
+        results = [
+            {name: m.compute() for name, m in rank_res.items()}
+            for rank_res in synced
+        ]
+        return synced, results, sim
+
+    def test_sharded_sync_bit_identical_to_unsharded_oracle(self):
+        _, results, sim = self._sync_world_sharded()
+        _assert_matches_oracle(results, _oracle())
+        # two members synced one at a time: 2 wire rounds each, no
+        # sharding-induced extra collectives on the wire
+        self.assertEqual(len(sim.round_bytes) // WORLD, 4)
+
+    def test_sharded_quantized_lossless_and_codec_engages(self):
+        obs.enable()
+        try:
+            obs.reset()
+            _, results_q, sim_q = self._sync_world_sharded(quantize=True)
+            counters = obs.snapshot()["counters"]
+        finally:
+            obs.disable()
+            obs.reset()
+        _assert_matches_oracle(results_q, _oracle())
+        _, _, sim_raw = self._sync_world_sharded(quantize=False)
+        # the sparse int32 sketch lanes still engage the bucket/narrow
+        # codecs on the gathered global payload: strictly below raw, and
+        # the encoded/raw counter ratio holds the >= 4x sketch-lane bar
+        self.assertLess(sim_q.round_bytes[-1], sim_raw.round_bytes[-1])
+        raw = sum(
+            v
+            for k, v in counters.items()
+            if k.startswith("toolkit.sync.lane_bytes{")
+        )
+        enc = sum(
+            v
+            for k, v in counters.items()
+            if k.startswith("toolkit.sync.lane_bytes_encoded{")
+        )
+        self.assertGreater(raw, 0)
+        self.assertLessEqual(enc * 4, raw)
+        self.assertTrue(
+            any(
+                "codec=bucket" in k or "codec=narrow" in k
+                for k in counters
+                if k.startswith("toolkit.sync.lane_bytes_encoded{")
+            ),
+            sorted(k for k in counters if "lane_bytes_encoded" in k),
+        )
+
+    def test_synced_clone_stays_sharded_and_live(self):
+        from jax.sharding import PartitionSpec as P
+
+        synced, _, _ = self._sync_world_sharded()
+        member = synced[0]["auroc"]
+        for name in member._sliced_state_names:
+            st = getattr(member, name)
+            self.assertEqual(st.sharding.spec, P("slices"))
+        # still live: stream new cohorts into the synced clone
+        member.update(
+            np.asarray([123456, 7], np.int64),
+            np.asarray([0.9, 0.2], np.float32),
+            np.asarray([1.0, 0.0], np.float32),
+        )
+        member._fold_now()
+        member.compute()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,287 @@
+"""Segment scatter (``ops/scatter.py``, ISSUE 17): the VMEM-tiled Pallas
+kernel (interpret-mode parity off-TPU), the block-range sharded route on
+the forced-8-CPU mesh, the GSPMD ``custom_partitioning`` wrapper, the
+auto-pick envelope, the obs path/capacity accounting, and the fail-closed
+validation errors."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torcheval_tpu import obs
+from torcheval_tpu.ops.scatter import (
+    _PALLAS_MAX_SEGMENTS,
+    _resolve_method,
+    pallas_segment_sum,
+    segment_scatter,
+    sharded_pallas_segment_sum,
+)
+
+RNG = np.random.default_rng(17)
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()), ("slices",))
+
+
+def _ref_sum(vals, rows, num_segments):
+    out = np.zeros((num_segments,) + vals.shape[1:], np.float64)
+    for r, v in zip(rows, vals):
+        if 0 <= r < num_segments:
+            out[r] += v
+    return out
+
+
+class TestPallasSegmentSum(unittest.TestCase):
+    """Interpret-mode kernel parity with ``jax.ops.segment_sum`` — the
+    same numbers the Mosaic lowering must produce on TPU."""
+
+    def _check(self, n, d, num_segments, msg=""):
+        vals = RNG.integers(0, 5, (n, d)).astype(np.float32)
+        rows = RNG.integers(-2, num_segments + 3, n)  # OOB both sides
+        got = pallas_segment_sum(
+            jnp.asarray(vals), jnp.asarray(rows), num_segments,
+            interpret=True,
+        )
+        want = jax.ops.segment_sum(
+            jnp.asarray(vals), jnp.asarray(rows), num_segments=num_segments
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=msg
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), _ref_sum(vals, rows, num_segments), err_msg=msg
+        )
+
+    def test_parity_across_shapes(self):
+        for n, d, s in (
+            (211, 3, 7),     # everything ragged vs the tile plan
+            (1024, 128, 64),  # exact lane tiles
+            (37, 1, 513),     # segment extent crosses a seg_tile boundary
+            (8, 130, 9),      # d past one lane tile
+        ):
+            self._check(n, d, s, msg=f"n={n} d={d} segs={s}")
+
+    def test_empty_sample_stream(self):
+        got = pallas_segment_sum(
+            jnp.zeros((0, 4), jnp.float32),
+            jnp.zeros((0,), jnp.int32),
+            6,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.zeros((6, 4)))
+
+    def test_shape_validation(self):
+        with self.assertRaisesRegex(ValueError, "vals \\(N, D\\)"):
+            pallas_segment_sum(
+                jnp.zeros((4, 2, 2)), jnp.zeros((4,), jnp.int32), 3
+            )
+        with self.assertRaisesRegex(ValueError, "vals \\(N, D\\)"):
+            pallas_segment_sum(
+                jnp.zeros((4, 2)), jnp.zeros((5,), jnp.int32), 3
+            )
+
+
+class TestSegmentScatterLocal(unittest.TestCase):
+    def test_xla_reduces(self):
+        vals = RNG.random((64, 2)).astype(np.float32)
+        rows = RNG.integers(0, 5, 64)
+        for reduce, op in (
+            ("sum", jax.ops.segment_sum),
+            ("max", jax.ops.segment_max),
+            ("min", jax.ops.segment_min),
+        ):
+            got = segment_scatter(
+                jnp.asarray(vals), jnp.asarray(rows), 5, reduce=reduce
+            )
+            want = op(jnp.asarray(vals), jnp.asarray(rows), num_segments=5)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_forced_pallas_matches_xla_with_nd_tail(self):
+        # the sliced fold scatters (N, k, d)-stacked deltas: the kernel
+        # path flattens the tail and must restore it bit-identically
+        vals = RNG.integers(0, 9, (128, 3, 4)).astype(np.int32)
+        rows = RNG.integers(0, 11, 128)
+        got = segment_scatter(
+            jnp.asarray(vals), jnp.asarray(rows), 11, method="pallas"
+        )
+        want = jax.ops.segment_sum(
+            jnp.asarray(vals), jnp.asarray(rows), num_segments=11
+        )
+        self.assertEqual(got.dtype, want.dtype)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_validation_errors(self):
+        v = jnp.zeros((4, 2), jnp.float32)
+        r = jnp.zeros((4,), jnp.int32)
+        with self.assertRaisesRegex(ValueError, "reduce must be"):
+            segment_scatter(v, r, 3, reduce="mean")
+        with self.assertRaisesRegex(ValueError, "method must be"):
+            segment_scatter(v, r, 3, method="mosaic")
+        with self.assertRaisesRegex(ValueError, "sum.*only"):
+            segment_scatter(v, r, 3, reduce="max", method="pallas")
+        with self.assertRaisesRegex(ValueError, "together"):
+            segment_scatter(v, r, 3, mesh=_mesh())
+        with self.assertRaisesRegex(ValueError, "together"):
+            segment_scatter(v, r, 3, axis="slices")
+
+    def test_auto_pick_envelope(self):
+        v32 = jnp.zeros((8, 4), jnp.float32)
+        # CPU never auto-picks the kernel; TPU does inside the envelope
+        self.assertEqual(_resolve_method("auto", "sum", 64, v32, "cpu"), "xla")
+        self.assertEqual(
+            _resolve_method("auto", "sum", 64, v32, "tpu"), "pallas"
+        )
+        self.assertEqual(
+            _resolve_method(
+                "auto", "sum", _PALLAS_MAX_SEGMENTS + 1, v32, "tpu"
+            ),
+            "xla",
+        )
+        self.assertEqual(_resolve_method("auto", "max", 64, v32, "tpu"), "xla")
+        self.assertEqual(
+            _resolve_method(
+                "auto", "sum", 64, jnp.zeros((8, 1024), jnp.float32), "tpu"
+            ),
+            "xla",
+        )
+        # explicit method always wins
+        self.assertEqual(
+            _resolve_method("pallas", "sum", 10**9, v32, "cpu"), "pallas"
+        )
+
+
+class TestSegmentScatterSharded(unittest.TestCase):
+    """The block-range route on the forced 8-device CPU mesh: output born
+    ``P(axis)``-sharded, bit-identical to the unsharded reduction, no
+    state-sized gather in the lowering."""
+
+    def _parity(self, reduce):
+        mesh = _mesh()
+        vals = RNG.integers(0, 7, (512, 4)).astype(np.int32)
+        rows = RNG.integers(-3, 44, 512)  # OOB rows drop on both routes
+        got = segment_scatter(
+            jnp.asarray(vals),
+            jnp.asarray(rows),
+            40,
+            reduce=reduce,
+            mesh=mesh,
+            axis="slices",
+        )
+        self.assertEqual(got.sharding.spec, P("slices"))
+        want = segment_scatter(jnp.asarray(vals), jnp.asarray(rows), 40,
+                               reduce=reduce)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # every addressable shard holds exactly 1/8 of the segment axis
+        for s in got.addressable_shards:
+            self.assertEqual(s.data.shape, (5, 4))
+
+    def test_sharded_parity_all_reduces(self):
+        for reduce in ("sum", "max", "min"):
+            self._parity(reduce)
+
+    def test_uneven_extent_fails_closed(self):
+        with self.assertRaisesRegex(ValueError, "not a multiple"):
+            segment_scatter(
+                jnp.zeros((4, 2), jnp.float32),
+                jnp.zeros((4,), jnp.int32),
+                42,  # 42 % 8 != 0
+                mesh=_mesh(),
+                axis="slices",
+            )
+
+    def test_no_state_sized_all_gather_in_hlo(self):
+        mesh = _mesh()
+        num_segments, d = 4096, 8
+
+        def fold(vals, rows):
+            return segment_scatter(
+                vals, rows, num_segments, mesh=mesh, axis="slices"
+            )
+
+        hlo = (
+            jax.jit(fold)
+            .lower(
+                jax.ShapeDtypeStruct((256, d), jnp.float32),
+                jax.ShapeDtypeStruct((256,), jnp.int32),
+            )
+            .compile()
+            .as_text()
+        )
+        self.assertNotIn("all-gather", hlo)
+        self.assertNotIn(f"f32[{num_segments},{d}]", hlo)  # no full-extent buf
+        self.assertIn(f"f32[{num_segments // 8},{d}]", hlo)  # per-shard tile
+
+
+class TestCustomPartitioning(unittest.TestCase):
+    """``sharded_pallas_segment_sum``: sample-sharded operands reduce
+    locally per shard + one psum instead of an operand all-gather."""
+
+    def test_single_device_identity(self):
+        vals = RNG.random((64, 4)).astype(np.float32)
+        rows = RNG.integers(0, 6, 64)
+        got = sharded_pallas_segment_sum(
+            jnp.asarray(vals), jnp.asarray(rows), 6, True
+        )
+        want = pallas_segment_sum(
+            jnp.asarray(vals), jnp.asarray(rows), 6, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_sample_sharded_operand_folds_with_psum(self):
+        mesh = _mesh()
+        n, d, segs = 1024, 4, 16
+        vals = RNG.integers(0, 5, (n, d)).astype(np.float32)
+        rows = RNG.integers(0, segs, n)
+        vs = jax.device_put(
+            jnp.asarray(vals), NamedSharding(mesh, P("slices", None))
+        )
+        rs = jax.device_put(
+            jnp.asarray(rows), NamedSharding(mesh, P("slices"))
+        )
+        fn = jax.jit(
+            lambda v, r: sharded_pallas_segment_sum(v, r, segs, True)
+        )
+        got = fn(vs, rs)
+        np.testing.assert_array_equal(
+            np.asarray(got), _ref_sum(vals, rows, segs)
+        )
+
+
+class TestScatterObs(unittest.TestCase):
+    def test_path_counter_and_capacity_gauge(self):
+        mesh = _mesh()
+        vals = jnp.ones((256, 4), jnp.float32)
+        rows = jnp.zeros((256,), jnp.int32)
+        obs.enable()
+        try:
+            obs.reset()
+            segment_scatter(vals, rows, 64)
+            segment_scatter(vals, rows, 64, method="pallas", interpret=True)
+            segment_scatter(vals, rows, 64, mesh=mesh, axis="slices")
+            snap = obs.snapshot()
+            c = snap["counters"]
+            self.assertEqual(c["ops.scatter.calls{path=xla}"], 1)
+            self.assertEqual(c["ops.scatter.calls{path=pallas}"], 1)
+            self.assertEqual(c["ops.scatter.calls{path=sharded}"], 1)
+            g = snap["gauges"]
+            full = 64 * 4 * 4  # segments * lanes * f32
+            self.assertEqual(
+                g["ops.scatter.state_bytes_per_device{path=xla}"], full
+            )
+            # the capacity observable: per-device bytes shrink by the
+            # shard count on the sharded path
+            self.assertEqual(
+                g["ops.scatter.state_bytes_per_device{path=sharded}"],
+                full / 8,
+            )
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -13,6 +13,12 @@ Modes (argv: <mode> <dir> <device_count> <out_json>):
   explicitly SHARDED over a mesh axis sized to the device count; restore
   must succeed on an equal mesh and raise the structured
   ``CheckpointError("unsupported")`` on an unequal one.
+* ``save_sliced_sharded`` / ``restore_sliced_plain`` /
+  ``restore_sliced_sharded`` — ISSUE 17: a slice-axis-sharded
+  ``SlicedMetricCollection`` checkpoint restores REPLICATED on an
+  unsharded (1-device) target and re-shards onto an equal mesh; an
+  unequal mesh still raises the structured
+  ``CheckpointError("unsupported")`` before any state write.
 """
 
 import json
@@ -63,6 +69,42 @@ def _sharded_metric(n_devices: int):
 
     mesh = Mesh(np.array(jax.devices()), ("x",))
     return VecState().to(NamedSharding(mesh, P("x")))
+
+
+SLICED_N = 181
+SLICED_BATCHES = 4
+
+
+def make_sliced_batch(i: int):
+    rng = np.random.default_rng(4321 + i)
+    ids = (rng.zipf(1.4, SLICED_N) * 7919 + 13).astype(np.int64)
+    scores = rng.random(SLICED_N).astype(np.float32)
+    targets = (rng.random(SLICED_N) < 0.5).astype(np.int32)
+    return ids, scores, targets
+
+
+def _sliced_collection(sharded: bool):
+    from torcheval_tpu.metrics import (
+        BinaryAccuracy,
+        BinaryAUROC,
+        SlicedMetricCollection,
+    )
+
+    kw = {"mesh_axis": "slices"} if sharded else {}
+    return SlicedMetricCollection(
+        {"acc": BinaryAccuracy(), "auroc": BinaryAUROC(approx=1024)},
+        capacity=4,
+        **kw,
+    )
+
+
+def _sliced_values(col) -> dict:
+    res = col.compute()
+    return {
+        "ids": np.asarray(res["acc"].slice_ids).tolist(),
+        "acc": np.asarray(res["acc"]["values"]).tolist(),
+        "auroc": np.asarray(res["auroc"]["values"]).tolist(),
+    }
 
 
 def main() -> None:
@@ -126,6 +168,35 @@ def main() -> None:
         except CheckpointError as e:
             result["error_reason"] = e.reason
             result["error_message"] = str(e)
+    elif mode == "save_sliced_sharded":
+        from torcheval_tpu.resilience import save
+
+        col = _sliced_collection(sharded=True)
+        for i in range(SLICED_BATCHES):
+            col.update(*make_sliced_batch(i))
+        m = col.metrics["auroc"]
+        result["sharding_replicated"] = bool(
+            m.sketch_tp.sharding.is_fully_replicated
+        )
+        result["checkpoint"] = save(col, directory)
+        result["values"] = _sliced_values(col)
+    elif mode in ("restore_sliced_plain", "restore_sliced_sharded"):
+        from torcheval_tpu.resilience import CheckpointError, restore
+
+        col = _sliced_collection(sharded=mode.endswith("sharded"))
+        try:
+            restore(col, directory)
+        except CheckpointError as e:
+            result["error_reason"] = e.reason
+            result["error_message"] = str(e)
+        else:
+            m = col.metrics["auroc"]
+            result["sharding_replicated"] = bool(
+                m.sketch_tp.sharding.is_fully_replicated
+            )
+            # still live post-restore: stream one more batch, then compute
+            col.update(*make_sliced_batch(SLICED_BATCHES))
+            result["values"] = _sliced_values(col)
     else:
         raise SystemExit(f"unknown mode {mode!r}")
 

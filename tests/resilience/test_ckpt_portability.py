@@ -118,5 +118,53 @@ class TestShardedStatePortability(unittest.TestCase):
         self.assertIn("mesh", restored["error_message"])
 
 
+class TestSlicedShardedPortability(unittest.TestCase):
+    """ISSUE 17: slice-axis-sharded checkpoints. The payload carries the
+    GLOBAL slice-axis value and the block-range layout is a function of
+    capacity alone, so a sharded save restores REPLICATED on a 1-device
+    host and re-shards bit-identically onto an equal mesh; an unequal
+    mesh stays the structured ``unsupported`` failure."""
+
+    @classmethod
+    def setUpClass(cls):
+        # ONE sharded save feeds all three restore legs (each restore is
+        # read-only on the checkpoint; fresh processes per leg regardless)
+        cls.root = tempfile.mkdtemp(prefix="tpu_port_sliced_")
+        cls.saved = _run("save_sliced_sharded", cls.root, 8)
+
+    def _oracle_values(self):
+        from mp_portability_worker import (
+            SLICED_BATCHES,
+            _sliced_collection,
+            _sliced_values,
+            make_sliced_batch,
+        )
+
+        col = _sliced_collection(sharded=False)
+        for i in range(SLICED_BATCHES + 1):  # restore modes add one batch
+            col.update(*make_sliced_batch(i))
+        return _sliced_values(col)
+
+    def test_sharded_save_restores_replicated_on_1_device(self):
+        self.assertFalse(
+            self.saved["sharding_replicated"]
+        )  # genuinely sharded at save time
+        restored = _run("restore_sliced_plain", self.root, 1)
+        self.assertNotIn("error_reason", restored)
+        self.assertTrue(restored["sharding_replicated"])
+        self.assertEqual(restored["values"], self._oracle_values())
+
+    def test_sharded_save_reshards_on_equal_mesh(self):
+        restored = _run("restore_sliced_sharded", self.root, 8)
+        self.assertNotIn("error_reason", restored)
+        self.assertFalse(restored["sharding_replicated"])  # re-sharded
+        self.assertEqual(restored["values"], self._oracle_values())
+
+    def test_unequal_mesh_raises_structured_unsupported(self):
+        restored = _run("restore_sliced_sharded", self.root, 4)
+        self.assertEqual(restored.get("error_reason"), "unsupported")
+        self.assertIn("mesh", restored["error_message"])
+
+
 if __name__ == "__main__":
     unittest.main()

@@ -178,6 +178,19 @@ class TestBenchHygiene(unittest.TestCase):
                 "the unsliced collection on identical rows) loses its "
                 "regression pin",
             )
+        for row in (
+            "config11_sliced_1m_sharded",
+            "config11_sliced_1m_sharded_ratio",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the slice-"
+                "axis-sharded contract (ISSUE 17 — per-device scatter "
+                "state exactly 1/shards of the unsharded gauge, paired "
+                "with the unsliced ratio on the same run) loses its "
+                "regression pin",
+            )
 
     def test_loopback_rows_carry_machine_readable_sandbox_caveat(self):
         # ISSUE 15 satellite (ROADMAP 1a/6): the 1-core loopback artifacts
@@ -194,6 +207,7 @@ class TestBenchHygiene(unittest.TestCase):
             "config8_cluster_wire_codec_gain",
             "config8_cluster_wire_1host_ratio",
             "config11_sliced_ratio",
+            "config11_sliced_1m_sharded_ratio",
             "config12_obs_stream_overhead",
         ):
             self.assertIn(

@@ -78,11 +78,13 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as _P
 
 from torcheval_tpu.metrics.collection import MetricCollection
 from torcheval_tpu.metrics.deferred import DeferredFoldMixin
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.state import Reduction
+from torcheval_tpu.ops.scatter import segment_scatter
 from torcheval_tpu.utils.devices import DeviceLike
 
 __all__ = [
@@ -124,19 +126,50 @@ class SliceTable:
     actually registered new ids (rare once the hot cohort set is seen).
     """
 
-    __slots__ = ("ids", "count", "capacity", "version", "_sorted_ids", "_sorted_rows")
+    __slots__ = (
+        "ids",
+        "count",
+        "capacity",
+        "granularity",
+        "version",
+        "_sorted_ids",
+        "_sorted_rows",
+    )
 
-    def __init__(self, capacity: int = _DEFAULT_CAPACITY) -> None:
+    def __init__(
+        self, capacity: int = _DEFAULT_CAPACITY, *, granularity: int = 1
+    ) -> None:
         # >= 1 at construction; a capacity-0 table can still ARISE from the
         # sync union of all-empty ranks (replace()), and intern() grows it
         if not isinstance(capacity, int) or capacity < 1:
             raise ValueError(f"capacity must be an int >= 1, got {capacity!r}.")
-        self.capacity = capacity
+        # dense capacity stays a multiple of ``granularity`` through every
+        # growth path — the slice-axis sharding contract: each of N mesh
+        # shards owns a contiguous block-range tile of capacity/N rows, so
+        # the leading state axis must always divide evenly
+        self.granularity = max(int(granularity), 1)
+        self.capacity = self.round_capacity(capacity)
         self.count = 0
-        self.ids = np.zeros(capacity, np.int64)
+        self.ids = np.zeros(self.capacity, np.int64)
         self.version = 0  # bumped on every mutation: the id-state refresh key
         self._sorted_ids = np.empty(0, np.int64)
         self._sorted_rows = np.empty(0, np.int64)
+
+    def round_capacity(self, capacity: int) -> int:
+        """``capacity`` rounded up to the table's granularity (identity for
+        the default granularity 1 — the unsharded layout is unchanged)."""
+        g = self.granularity
+        return -(-int(capacity) // g) * g
+
+    def predict_growth(self, need: int) -> int:
+        """The capacity :meth:`intern` would settle on for ``need`` rows —
+        the ONE definition of the growth schedule (geometric doubling, then
+        granularity round-up), shared with ``merge_collections``'s
+        fail-closed pre-validation."""
+        cap = max(self.capacity, 1)
+        while cap < int(need):
+            cap *= 2
+        return self.round_capacity(cap)
 
     def _rebuild_index(self) -> None:
         order = np.argsort(self.ids[: self.count], kind="stable")
@@ -173,11 +206,10 @@ class SliceTable:
             fresh = uniq[np.argsort(first)]  # first-seen order, deterministic
             need = self.count + fresh.shape[0]
             if need > self.capacity:
-                # max(..., 1): a zero-capacity table exists after syncing
-                # all-empty ranks (union of nothing) and must still grow
-                new_cap = max(self.capacity, 1)
-                while new_cap < need:
-                    new_cap *= 2
+                # max(..., 1) inside predict_growth: a zero-capacity table
+                # exists after syncing all-empty ranks (union of nothing)
+                # and must still grow
+                new_cap = self.predict_growth(need)
                 grown = np.zeros(new_cap, np.int64)
                 grown[: self.count] = self.ids[: self.count]
                 self.ids = grown
@@ -311,16 +343,21 @@ def _sliced_fold(*xs):
     vmapped over the sample axis (batch-of-one calls keep the member math
     byte-for-byte the standalone kernel's), then ONE segment scatter into
     the dense slice axis. Trailing statics:
-    ``(base_fn, base_params, num_slices, reduce_kind)``; leading operands:
-    ``(rows, *update_columns)`` — concatenated whole-window columns (the
-    concat fold regime: the segment op wants the full stream once)."""
-    base_fn, base_params, num_slices, reduce_kind = xs[-4:]
+    ``(base_fn, base_params, num_slices, reduce_kind, shard)`` where
+    ``shard`` is ``None`` or a hashable ``(mesh, axis)`` pair; leading
+    operands: ``(rows, *update_columns)`` — concatenated whole-window
+    columns (the concat fold regime: the segment op wants the full stream
+    once). The scatter routes through ``ops.scatter.segment_scatter``:
+    unsharded it resolves to the identical XLA segment op (or the Pallas
+    VMEM kernel on TPU); sharded it applies each shard's block-range tile
+    in-program with no state-sized collective."""
+    base_fn, base_params, num_slices, reduce_kind, shard = xs[-5:]
     rows = xs[0].astype(jnp.int32)
-    cols = xs[1:-4]
+    cols = xs[1:-5]
     per_sample = jax.vmap(
         lambda *a: base_fn(*(c[None] for c in a), *base_params)
     )(*cols)
-    seg = _SEGMENT_OPS[reduce_kind]
+    mesh, axis = shard if shard is not None else (None, None)
     # group same-(trailing-shape, dtype) deltas into ONE stacked segment op:
     # XLA:CPU's scatter is serial per update row, so the PASS count over the
     # batch — not the state count — is the cost; a binary counter pair folds
@@ -334,12 +371,24 @@ def _sliced_fold(*xs):
     for (_shape, _dtype), names in groups.items():
         if len(names) == 1:
             name = names[0]
-            out[name] = seg(
-                per_sample[name], rows, num_segments=num_slices
+            out[name] = segment_scatter(
+                per_sample[name],
+                rows,
+                num_slices,
+                reduce=reduce_kind,
+                mesh=mesh,
+                axis=axis,
             )
             continue
         stacked = jnp.stack([per_sample[n] for n in names], axis=-1)
-        folded = seg(stacked, rows, num_segments=num_slices)
+        folded = segment_scatter(
+            stacked,
+            rows,
+            num_slices,
+            reduce=reduce_kind,
+            mesh=mesh,
+            axis=axis,
+        )
         for i, name in enumerate(names):
             out[name] = folded[..., i]
     return out
@@ -384,12 +433,99 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
     _fold_per_chunk = False  # concat regime: one segment scatter per window
     _sliced_sync = True
 
-    def __init__(self, table: SliceTable, device: DeviceLike = None) -> None:
+    def __init__(
+        self,
+        table: SliceTable,
+        device: DeviceLike = None,
+        shard: Optional[Tuple[Mesh, str]] = None,
+    ) -> None:
+        if shard is not None:
+            mesh, axis = shard
+            if axis not in mesh.shape:
+                raise ValueError(
+                    f"mesh axis {axis!r} not in mesh axes "
+                    f"{tuple(mesh.shape)}."
+                )
+            if device is None:
+                # inputs and the replicated id lanes live mesh-wide; the
+                # sliced states are RE-placed P(axis) after registration
+                device = NamedSharding(mesh, _P())
         super().__init__(device=device)
+        self._shard = shard
+        self._shards = int(shard[0].shape[shard[1]]) if shard else 1
         self._table = table
         self._table_version = -1
         self._row_defaults: Dict[str, np.ndarray] = {}
         self._sliced_state_names: Tuple[str, ...] = ()
+
+    # ------------------------------------------------------------- placement
+    def _sliced_sharding(self) -> Optional[NamedSharding]:
+        """The slice-axis state sharding: shard ``s`` of N owns the
+        contiguous block-range tile ``[s*cap/N, (s+1)*cap/N)`` of the
+        leading axis (``ops.topk.shard_tile_width`` decomposition). ``None``
+        when unsharded."""
+        if self._shard is None:
+            return None
+        mesh, axis = self._shard
+        return NamedSharding(mesh, _P(axis))
+
+    def _place_sliced_states(self) -> None:
+        """(Re-)pin every sliced state's leading axis to the mesh tiles.
+        Every path that materializes sliced state host-side or replicated —
+        registration, growth, sync-union install, restore, merge, reset —
+        funnels through here so the state is NEVER left replicated on a
+        sharded member (the HLO-asserted no-replication bound)."""
+        sharding = self._sliced_sharding()
+        if sharding is None:
+            return
+        for name in self._sliced_state_names:
+            setattr(
+                self,
+                name,
+                jax.device_put(jnp.asarray(getattr(self, name)), sharding),
+            )
+        # id lanes + watermark stay replicated but must live on the SAME
+        # mesh (one device set per donated window-step program)
+        for name in _ID_STATE_NAMES:
+            if hasattr(self, name):
+                setattr(
+                    self,
+                    name,
+                    jax.device_put(
+                        jnp.asarray(getattr(self, name)), self._device
+                    ),
+                )
+
+    def __deepcopy__(self, memo):
+        # Mesh handles are process-local singletons (Device objects do not
+        # pickle/deepcopy); share them by reference like Metric shares
+        # _device — seeding the memo covers every nested reference too
+        # (_shard, _fold_params)
+        if self._shard is not None:
+            memo[id(self._shard[0])] = self._shard[0]
+            memo[id(self._shard)] = self._shard
+        return super().__deepcopy__(memo)
+
+    def __getstate__(self):
+        # pickling degrades to UNSHARDED (matching Metric's Sharding
+        # degradation): mesh handles cannot cross process boundaries; the
+        # state payload is the global value either way
+        state = super().__getstate__()
+        if self._shard is not None:
+            state["_shard"] = None
+            state["_shards"] = 1
+            state.pop("_fold_params", None)
+            state.pop("_compute_params", None)
+            for name in self._sliced_state_names + _ID_STATE_NAMES:
+                if name in state:
+                    state[name] = np.asarray(state[name])
+        return state
+
+    def __setstate__(self, state):
+        refit = "_fold_params" not in state
+        super().__setstate__(state)
+        if refit:
+            self._refit_params()
 
     # -------------------------------------------------------- registration
     def _register_sliced_state(
@@ -403,6 +539,13 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
         self._add_state(name, default, reduction=reduction)
         self._row_defaults[name] = row_default
         self._sliced_state_names = self._sliced_state_names + (name,)
+        if self._shard is not None:
+            sharding = self._sliced_sharding()
+            setattr(
+                self,
+                name,
+                jax.device_put(jnp.asarray(getattr(self, name)), sharding),
+            )
 
     def _register_id_states(self) -> None:
         self._add_state(
@@ -439,7 +582,11 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
     def _grow_to(self, capacity: int) -> None:
         """Pad every sliced state's leading axis to ``capacity`` (rows never
         move — interning is append-only, so growth is a pure default-pad;
-        O(log total-slices) growth events under geometric doubling)."""
+        O(log total-slices) growth events under geometric doubling). On a
+        sharded member growth runs host-side (the eager concat would have
+        to reconcile a P(axis) operand with a replicated pad) and the grown
+        state re-pins to the mesh tiles — rare by the doubling schedule, so
+        the round trip never shows in the steady loop."""
         for name in self._sliced_state_names + ("slice_ids_hi", "slice_ids_lo"):
             cur = getattr(self, name)
             cur_len = int(cur.shape[0])
@@ -448,16 +595,31 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
             row_default = self._row_defaults.get(
                 name, np.zeros((), np.int32)
             )
-            fill = jnp.broadcast_to(
-                jnp.asarray(row_default),
-                (capacity - cur_len,) + tuple(np.shape(row_default)),
-            )
-            setattr(
-                self, name, jnp.concatenate([jnp.asarray(cur), fill], axis=0)
-            )
+            if self._shard is not None:
+                cur_np = np.asarray(cur)  # global gather of the tiles
+                fill_np = np.broadcast_to(
+                    np.asarray(row_default).astype(cur_np.dtype, copy=False),
+                    (capacity - cur_len,) + tuple(np.shape(row_default)),
+                )
+                setattr(
+                    self,
+                    name,
+                    np.concatenate([cur_np, fill_np], axis=0),
+                )
+            else:
+                fill = jnp.broadcast_to(
+                    jnp.asarray(row_default),
+                    (capacity - cur_len,) + tuple(np.shape(row_default)),
+                )
+                setattr(
+                    self,
+                    name,
+                    jnp.concatenate([jnp.asarray(cur), fill], axis=0),
+                )
             self._state_name_to_default[name] = np.broadcast_to(
                 np.asarray(row_default), (capacity,) + np.shape(row_default)
             ).copy()
+        self._place_sliced_states()
         self._refit_params()
 
     # ------------------------------------------------------- id-lane sync
@@ -474,9 +636,15 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
         ids = np.zeros(t.capacity, np.int64)
         ids[: t.count] = t.ids[: t.count]
         hi, lo = _pack_ids(ids)
-        self.slice_ids_hi = jnp.asarray(hi)
-        self.slice_ids_lo = jnp.asarray(lo)
-        self.slice_count = jnp.asarray(np.int32(t.count))
+        if self._shard is not None:
+            # replicate onto the member's mesh: one device set per program
+            self.slice_ids_hi = jax.device_put(hi, self._device)
+            self.slice_ids_lo = jax.device_put(lo, self._device)
+            self.slice_count = jax.device_put(np.int32(t.count), self._device)
+        else:
+            self.slice_ids_hi = jnp.asarray(hi)
+            self.slice_ids_lo = jnp.asarray(lo)
+            self.slice_count = jnp.asarray(np.int32(t.count))
         self._table_version = t.version
 
     def _adopt_state_shapes(self) -> None:
@@ -487,9 +655,29 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
         members of one collection (they install identical content into the
         shared table)."""
         hi = np.asarray(self.slice_ids_hi)
+        lo = np.asarray(self.slice_ids_lo)
         count = int(np.asarray(self.slice_count))
         capacity = int(hi.shape[0])
-        ids = _unpack_ids(hi, np.asarray(self.slice_ids_lo))
+        padded = self._table.round_capacity(capacity)
+        if padded != capacity:
+            # sharded per-shard align: an installed union capacity (any
+            # ragged per-rank cohort count) pads up to the shard multiple
+            # so the leading axis keeps dividing into the block-range tiles
+            pad = padded - capacity
+            hi = np.concatenate([hi, np.zeros(pad, np.int32)])
+            lo = np.concatenate([lo, np.zeros(pad, np.int32)])
+            self.slice_ids_hi = hi
+            self.slice_ids_lo = lo
+            for name in self._sliced_state_names:
+                arr = np.asarray(getattr(self, name))
+                row_default = np.asarray(self._row_defaults[name])
+                fill = np.broadcast_to(
+                    row_default.astype(arr.dtype, copy=False),
+                    (pad,) + arr.shape[1:],
+                )
+                setattr(self, name, np.concatenate([arr, fill], axis=0))
+            capacity = padded
+        ids = _unpack_ids(hi, lo)
         self._table.replace(ids[:count], capacity)
         for name in self._sliced_state_names:
             row_default = self._row_defaults[name]
@@ -502,6 +690,7 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
         self._state_name_to_default["slice_ids_lo"] = np.zeros(
             capacity, np.int32
         )
+        self._place_sliced_states()
         self._table_version = self._table.version
         self._refit_params()
 
@@ -534,6 +723,12 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
         rows). Appends one chunk ``(rows, *args)``."""
         self._defer(self._input(rows), *(self._input(a) for a in args))
         return self
+
+    def reset(self):
+        out = super().reset()
+        # default states land replicated via _device; re-pin the tiles
+        self._place_sliced_states()
+        return out
 
     def compute(self):
         return self._deferred_compute()
@@ -577,7 +772,11 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
                     self._table.rollback(mark)
                     raise
                 self._grow_to(self._table.capacity)
-            rows = jnp.asarray(rows_np)
+            rows = (
+                jax.device_put(rows_np, self._device)
+                if self._shard is not None
+                else jnp.asarray(rows_np)
+            )
             for name in self._sliced_state_names:
                 # per-STATE declared reduction (review finding): a member
                 # whose fold-reduce is sum can still carry MAX/MIN states
@@ -595,6 +794,9 @@ class _SlicedMemberBase(DeferredFoldMixin, Metric):
                 else:  # Reduction.MIN (check_sliceable admits no others)
                     merged = mine.at[rows].min(theirs)
                 setattr(self, name, merged)
+        # the scatter-combine output's sharding follows GSPMD inference;
+        # re-pin so merged state never lingers replicated on a sharded member
+        self._place_sliced_states()
         return self
 
 
@@ -607,9 +809,13 @@ class _SlicedFoldMember(_SlicedMemberBase):
     _compute_fn = staticmethod(_sliced_compute)
 
     def __init__(
-        self, template: Metric, table: SliceTable, device: DeviceLike = None
+        self,
+        template: Metric,
+        table: SliceTable,
+        device: DeviceLike = None,
+        shard: Optional[Tuple[Mesh, str]] = None,
     ) -> None:
-        super().__init__(table, device=device)
+        super().__init__(table, device=device, shard=shard)
         tcls = type(template)
         self._template_cls = tcls.__qualname__
         self._base_fold = tcls._fold_fn
@@ -636,6 +842,7 @@ class _SlicedFoldMember(_SlicedMemberBase):
             self._base_fold_params,
             self._table.capacity,
             self._reduce_kind,
+            self._shard,
         )
         self._compute_params = (
             self._base_compute,
@@ -695,10 +902,11 @@ class _SlicedScoreSketchMember(_SlicedMemberBase):
         *,
         curve_bucket_bits: Optional[int] = None,
         device: DeviceLike = None,
+        shard: Optional[Tuple[Mesh, str]] = None,
     ) -> None:
         from torcheval_tpu.sketch.cache import check_sliced_bucket_bits
 
-        super().__init__(table, device=device)
+        super().__init__(table, device=device, shard=shard)
         self._template_cls = type(template).__qualname__
         self._kind = (
             "auroc" if "AUROC" in self._template_cls else "auprc"
@@ -726,14 +934,18 @@ class _SlicedScoreSketchMember(_SlicedMemberBase):
     def _check_capacity(self, capacity: int) -> None:
         from torcheval_tpu.sketch.cache import check_sliced_sketch_extent
 
-        check_sliced_sketch_extent(self._bits, capacity)
+        # PER-SHARD bound: each shard's combined index runs over its own
+        # capacity/shards tile, so sharding over N devices multiplies the
+        # admissible cohort count by N — 100M+ cohorts is a capacity
+        # statement, not an error
+        check_sliced_sketch_extent(self._bits, capacity, shards=self._shards)
 
     def _refit_params(self) -> None:
         # fail closed BEFORE the int32 combined index can wrap (runs at
         # construction, every capacity growth, restore-adopt and sync-
         # union install, so the bound holds for the life of the member)
         self._check_capacity(self._table.capacity)
-        self._fold_params = (self._bits, self._table.capacity)
+        self._fold_params = (self._bits, self._table.capacity, self._shard)
         self._compute_params = (self._bits, self._kind)
 
     def _schema_extra_tail(self) -> Tuple:
@@ -883,14 +1095,15 @@ def _build_member(
     table: SliceTable,
     *,
     curve_bucket_bits: Optional[int] = None,
+    shard: Optional[Tuple[Mesh, str]] = None,
 ) -> _SlicedMemberBase:
     check_sliceable(template)
     if _is_sketch_curve(template):
         return _SlicedScoreSketchMember(
-            template, table, curve_bucket_bits=curve_bucket_bits
+            template, table, curve_bucket_bits=curve_bucket_bits, shard=shard
         )
     kind = _REDUCE_KINDS[type(template)._fold_reduce]
-    return _FOLD_MEMBER_BY_KIND[kind](template, table)
+    return _FOLD_MEMBER_BY_KIND[kind](template, table, shard=shard)
 
 
 # --------------------------------------------------------------- collection
@@ -914,6 +1127,17 @@ class SlicedMetricCollection(MetricCollection):
     ``curve_bucket_bits`` optionally re-buckets sketch members coarser than
     the standalone floor (see ``sketch/cache.py::SLICED_MIN_BUCKET_BITS``).
 
+    ``mesh_axis`` (optionally with an explicit ``mesh``) shards the leading
+    slice axis of every member state across that named mesh axis: shard
+    ``s`` of N owns the contiguous block-range row tile
+    ``[s*cap/N, (s+1)*cap/N)``, the fold applies each shard's deltas
+    in-program with no state-sized collective, and both the per-device HBM
+    footprint and the sketch's int32 extent bound shrink by N (see
+    docs/performance.md, "Sliced metrics"). Results, sync, checkpoints and
+    merges are BIT-identical to the unsharded collection on the same rows
+    (integer lanes exact; float sums under the documented f32 associativity
+    contract).
+
     Everything downstream of ``update`` is the plain
     :class:`MetricCollection` machinery — the shared
     :class:`~torcheval_tpu.metrics.deferred.EvalWindow`, the one donated
@@ -933,15 +1157,43 @@ class SlicedMetricCollection(MetricCollection):
         *,
         capacity: int = _DEFAULT_CAPACITY,
         curve_bucket_bits: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        mesh_axis: Optional[str] = None,
     ) -> None:
         if isinstance(metrics, Metric):
             metrics = {"metric": metrics}
-        self.slice_table = SliceTable(capacity)
+        if mesh is not None and mesh_axis is None:
+            raise ValueError(
+                "mesh requires mesh_axis: name the mesh axis the slice "
+                "axis shards over."
+            )
+        if mesh_axis is not None and mesh is None:
+            # the serve-wire spelling (slices={"mesh_axis": ...}): an axis
+            # NAME alone shards over all local devices in one flat mesh
+            mesh = Mesh(np.asarray(jax.devices()), (str(mesh_axis),))
+        if mesh is not None:
+            mesh_axis = str(mesh_axis)
+            if mesh_axis not in mesh.shape:
+                raise ValueError(
+                    f"mesh_axis {mesh_axis!r} not in mesh axes "
+                    f"{tuple(mesh.shape)}."
+                )
+            shard: Optional[Tuple[Mesh, str]] = (mesh, mesh_axis)
+            shards = int(mesh.shape[mesh_axis])
+        else:
+            shard = None
+            shards = 1
+        self._slice_shard = shard
+        # capacity stays a multiple of the shard count forever (block-range
+        # tiles must divide the leading axis evenly); granularity 1 keeps
+        # the unsharded schedule byte-identical to before
+        self.slice_table = SliceTable(capacity, granularity=shards)
         members = {
             name: _build_member(
                 template,
                 self.slice_table,
                 curve_bucket_bits=curve_bucket_bits,
+                shard=shard,
             )
             for name, template in dict(metrics).items()
         }
@@ -1016,11 +1268,9 @@ class SlicedMetricCollection(MetricCollection):
         union = self.slice_table.registered_ids()
         for other in others:
             union = np.union1d(union, other.slice_table.registered_ids())
-        # mirror SliceTable.intern's geometric growth so the predicted
-        # capacity is exactly what the merge's interns will settle on
-        cap = max(self.slice_table.capacity, 1)
-        while cap < int(union.shape[0]):
-            cap *= 2
+        # SliceTable.predict_growth IS intern's growth schedule, so the
+        # predicted capacity is exactly what the merge's interns settle on
+        cap = self.slice_table.predict_growth(int(union.shape[0]))
         for m in self.metrics.values():
             m._check_capacity(cap)
         if self._window is not None:
@@ -1038,6 +1288,21 @@ class SlicedMetricCollection(MetricCollection):
         super().reset()
         self.slice_table.clear()
         return self
+
+    def __deepcopy__(self, memo):
+        # share the mesh handle by reference (Device objects do not
+        # deepcopy); seeding the memo covers the collection's _slice_shard
+        # AND every member's _shard/_fold_params reference to the same mesh
+        import copy as _copy
+
+        if self._slice_shard is not None:
+            memo[id(self._slice_shard[0])] = self._slice_shard[0]
+            memo[id(self._slice_shard)] = self._slice_shard
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        new.__dict__.update(_copy.deepcopy(self.__dict__, memo))
+        return new
 
 
 # ------------------------------------------------------------ sync alignment

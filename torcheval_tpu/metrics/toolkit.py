@@ -728,7 +728,11 @@ def get_synced_metric(
         # rank's rows onto the deterministic sorted-union id table —
         # pure local post-gather work, zero extra collective rounds; the
         # fold below then treats the slices as the ordinary SUM/MAX/MIN
-        # lanes they are (with a leading axis).
+        # lanes they are (with a leading axis). Slice-axis-sharded states
+        # (ISSUE 17) arrive here as host rows already: the gather step
+        # reads per-shard blocks and concatenates them in block order, so
+        # the remap sees the same dense [S, ...] view either way and the
+        # synced clone re-installs shards on adoption.
         from torcheval_tpu.metrics.sliced import align_sliced_gathered
 
         gathered = align_sliced_gathered(metric, gathered)

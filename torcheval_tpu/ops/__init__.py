@@ -9,6 +9,11 @@ from torcheval_tpu.ops.curves import (
     multiclass_prc_points_kernel,
     prc_points_kernel,
 )
+from torcheval_tpu.ops.scatter import (
+    pallas_segment_sum,
+    segment_scatter,
+    sharded_pallas_segment_sum,
+)
 from torcheval_tpu.ops.topk import (
     label_sharding_of,
     pallas_topk,
@@ -26,10 +31,13 @@ __all__ = [
     "confusion_matrix_counts",
     "label_sharding_of",
     "multiclass_prc_points_kernel",
+    "pallas_segment_sum",
     "pallas_topk",
     "prc_points_kernel",
     "prune_topk",
+    "segment_scatter",
     "sharded_label_topk",
+    "sharded_pallas_segment_sum",
     "topk",
     "topk_indices",
     "topk_onehot",

@@ -1,0 +1,390 @@
+"""Segment scatter: per-slice delta application, tiled in VMEM or sharded.
+
+The sliced collection's fold (``metrics/sliced.py``) reduces per-sample
+delta rows into a leading ``[num_segments]`` slice axis. XLA lowers
+``jax.ops.segment_sum`` to a scatter-add that is SERIAL per update row on
+CPU and still row-at-a-time on TPU's scatter unit — the documented 0.32x
+gap of ``bench.py::config11_sliced``. Two remedies live here:
+
+* :func:`pallas_segment_sum` — the ``pallas_hist``/``pallas_topk``
+  accumulate-in-VMEM pattern (PR 3): grid = (segment tiles, sample
+  blocks) with the sample stream INNERMOST, so each segment tile's
+  ``(seg_tile, d)`` accumulator stays resident in VMEM while every sample
+  block streams past it; per step one MXU contraction
+  ``one_hot(rows).T @ vals`` replaces N serial scatter rows.
+* the ``mesh``/``axis`` route of :func:`segment_scatter` — the slice axis
+  block-range-sharded over a named mesh axis (the ``sharded_label_topk``
+  playbook, PR 14): shard ``s`` owns global rows ``[s*w, (s+1)*w)``, each
+  shard masks the replicated row column into its own range and scatters
+  into its LOCAL ``(w, ...)`` tile. No all_to_all is needed — out-of-range
+  rows drop by segment-op semantics — and no collective touches
+  state-sized operands: the output is born ``P(axis)``-sharded and every
+  per-device segment extent is ``num_segments / shards``.
+
+Exactness: the Pallas kernel accumulates in f32 (one-hot matmul), exact
+for integer counts while any single segment's total stays <= 2**24 (every
+integer up to 2**24 inclusive is float32-exact — the ``pallas_hist``
+bound); float sums fall under the documented f32 associativity contract
+(docs/performance.md). The XLA path keeps native dtypes. The auto-pick
+therefore only swaps in the kernel on TPU backends for "sum" over
+narrow (<= 4-byte) lanes within :data:`_PALLAS_MAX_SEGMENTS` — which is a
+PER-SHARD bound: sharding is what shrinks a million-cohort extent back
+into the kernel's envelope. ``method="pallas"`` forces it anywhere
+(interpret mode off-TPU); the CPU test suite proves parity that way.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as _P
+
+from torcheval_tpu.obs import registry as _obs
+from torcheval_tpu.obs.recompile import watched_jit
+from torcheval_tpu.ops.topk import (
+    _SHARD_MAP_KWARGS,
+    _round_up,
+    _shard_map,
+    mesh_platform_of,
+    shard_tile_width,
+)
+
+__all__ = [
+    "segment_scatter",
+    "pallas_segment_sum",
+    "sharded_pallas_segment_sum",
+]
+
+_METHODS = ("auto", "pallas", "xla")
+_REDUCES = ("sum", "max", "min")
+
+_SEGMENT_OPS = {
+    "sum": jax.ops.segment_sum,
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+# byte budget for the per-step VMEM working set (vals block + one-hot
+# intermediate + resident accumulator) — well under VMEM (~16 MB/core)
+_VMEM_BUDGET_BYTES = 8 * 2**20
+# segment rows tiled across the accumulator's sublane dim per grid step
+_MAX_SEG_TILE = 512
+# delta lanes past this width leave the kernel's envelope (the accumulator
+# row stops fitting the tile plan) — auto falls back to XLA
+_MAX_TAIL_LANES = 512
+# auto-pick ceiling on the (per-shard) segment extent: the one-hot
+# contraction is O(N * seg_pad) VPU/MXU work, so past this the serial
+# scatter it replaces is no longer the bottleneck being bought back
+_PALLAS_MAX_SEGMENTS = 65_536
+
+
+def _tile_plan(d_pad: int, seg_pad: int):
+    """(sample_rows, seg_tile): 128-lane sample rows per grid step and the
+    segment-tile height, sized so the ``(rows*128, d_pad)`` vals block plus
+    the ``(rows*128, seg_tile)`` one-hot stay inside the VMEM budget with
+    rows a multiple of 8 (the f32 sublane count)."""
+    seg_tile = min(seg_pad, _MAX_SEG_TILE)
+    rows = _VMEM_BUDGET_BYTES // (128 * 4 * (d_pad + seg_tile))
+    return max(rows // 8 * 8, 8), seg_tile
+
+
+def _scatter_kernel(rows_ref, vals_ref, out_ref, *, seg_tile: int):
+    # grid = (segment tiles, sample blocks): sample stream INNERMOST, so
+    # segment tile j's accumulator stays resident in VMEM across the whole
+    # stream instead of round-tripping HBM every step
+    j = pl.program_id(0)  # segment-tile index
+    i = pl.program_id(1)  # sample-block index
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    rows = rows_ref[:]  # (m, 128) int32 — samples fill whole lane tiles
+    vals = vals_ref[:]  # (m, 128, d_pad) f32 — same flat sample order
+    # segments of THIS tile: [j*seg_tile, (j+1)*seg_tile)
+    segs = j * seg_tile + jax.lax.broadcasted_iota(
+        jnp.int32, (1, 1, seg_tile), 2
+    )
+    onehot = (rows[:, :, None] == segs).astype(jnp.float32)  # (m, 128, s)
+    # collapse the leading sample dims (layout-preserving: the lane dim is
+    # untouched) and contract them on the MXU: (n, s)^T-free dot_general
+    n = rows.shape[0] * rows.shape[1]
+    out_ref[:] += jax.lax.dot_general(
+        onehot.reshape(n, seg_tile),
+        vals.reshape(n, vals.shape[-1]),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(watched_jit, static_argnames=("num_segments", "interpret"))
+def pallas_segment_sum(
+    vals: jax.Array,
+    rows: jax.Array,
+    num_segments: int,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """``jax.ops.segment_sum(vals, rows, num_segments)`` for 2-D f32
+    ``vals`` as a Pallas kernel: one-hot MXU contraction per (segment tile,
+    sample block) with the accumulator resident in VMEM. Rows outside
+    ``[0, num_segments)`` contribute nothing (segment-op drop semantics —
+    they match no tile's iota, negative or past the padded extent).
+
+    Layout note: the row column feeds in as ``(n/128, 128)`` — samples fill
+    whole (8, 128) tiles. A ``(N, 1)`` operand would be tiled with 128x
+    padding (the 8 GB HBM "copy" trap documented in ``pallas_hist``); the
+    vals block rides the same flat order as ``(n/128, 128, d)``.
+    """
+    if vals.ndim != 2 or rows.ndim != 1 or vals.shape[0] != rows.shape[0]:
+        raise ValueError(
+            "pallas_segment_sum wants vals (N, D) with rows (N,), got "
+            f"{vals.shape} / {rows.shape}."
+        )
+    n, d = vals.shape
+    d_pad = _round_up(max(d, 1), 128)
+    seg_pad = _round_up(max(num_segments, 1), 8)
+    m, seg_tile = _tile_plan(d_pad, seg_pad)
+    seg_pad = _round_up(seg_pad, seg_tile)
+    block_n = m * 128
+    n_pad = _round_up(max(n, 1), block_n)
+    # pad with an out-of-range sentinel so padding matches no segment row
+    # (negative rows likewise match no iota; rows in [num_segments,
+    # seg_pad) land in dead padding rows sliced away below)
+    rows_p = jnp.full((n_pad,), seg_pad, jnp.int32)
+    vals_p = jnp.zeros((n_pad, d_pad), jnp.float32)
+    if n:
+        rows_p = rows_p.at[:n].set(rows.astype(jnp.int32))
+        vals_p = vals_p.at[:n, :d].set(vals.astype(jnp.float32))
+    rows_p = rows_p.reshape(n_pad // 128, 128)
+    vals_p = vals_p.reshape(n_pad // 128, 128, d_pad)
+    out = pl.pallas_call(
+        functools.partial(_scatter_kernel, seg_tile=seg_tile),
+        grid=(seg_pad // seg_tile, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((m, 128), lambda j, i: (i, 0)),
+            pl.BlockSpec((m, 128, d_pad), lambda j, i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((seg_tile, d_pad), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((seg_pad, d_pad), jnp.float32),
+        interpret=interpret,
+    )(rows_p, vals_p)
+    return out[:num_segments, :d]
+
+
+def _tail_lanes(shape) -> int:
+    out = 1
+    for s in shape[1:]:
+        out *= int(s)
+    return out
+
+
+def _resolve_method(
+    method: str, reduce: str, num_segments: int, vals, platform: str
+) -> str:
+    """The auto-pick, per backend: the kernel engages only where its
+    exactness story holds (sum over <= 4-byte lanes, per-segment totals
+    documented f32-exact to 2**24) and its O(N * segments) one-hot work is
+    the winning trade (TPU, segment extent inside the envelope). Sharding
+    shrinks the PER-SHARD extent, which is how million-cohort capacities
+    re-enter this envelope."""
+    if method != "auto":
+        return method
+    eligible = (
+        reduce == "sum"
+        and platform == "tpu"
+        and num_segments <= _PALLAS_MAX_SEGMENTS
+        and _tail_lanes(vals.shape) <= _MAX_TAIL_LANES
+        and jnp.result_type(vals).itemsize <= 4
+    )
+    return "pallas" if eligible else "xla"
+
+
+def _apply_local(vals, rows, num_segments, reduce, resolved, interpret):
+    """One local (per-device or per-shard) segment reduction."""
+    if resolved == "pallas":
+        tail = vals.shape[1:]
+        flat = vals.reshape(vals.shape[0], -1)
+        out = pallas_segment_sum(
+            flat, rows, num_segments, interpret=bool(interpret)
+        )
+        return out.reshape((num_segments,) + tail).astype(
+            jnp.result_type(vals)
+        )
+    return _SEGMENT_OPS[reduce](vals, rows, num_segments=num_segments)
+
+
+def _emit_obs(path: str, num_segments: int, vals, shards: int = 1) -> None:
+    # counter semantics: one bump per program BUILD when called under a
+    # trace (the steady window loop replays the compiled program), one per
+    # call when used eagerly — i.e. it proves which path engaged, like
+    # ops.topk.calls. The gauge is the capacity observable: resident state
+    # bytes PER DEVICE for this scatter's segment extent (~1/shards of the
+    # global extent on the sharded path — bench-asserted).
+    _obs.counter("ops.scatter.calls", path=path)
+    if _obs._enabled:
+        per_device_rows = num_segments // max(shards, 1)
+        _obs.gauge(
+            "ops.scatter.state_bytes_per_device",
+            float(
+                per_device_rows
+                * _tail_lanes(vals.shape)
+                * jnp.result_type(vals).itemsize
+            ),
+            path=path,
+        )
+
+
+def segment_scatter(
+    vals: jax.Array,
+    rows: jax.Array,
+    num_segments: int,
+    *,
+    reduce: str = "sum",
+    method: str = "auto",
+    interpret=None,
+    mesh: Mesh = None,
+    axis: str = None,
+):
+    """Reduce per-sample delta rows ``vals[i]`` into segment ``rows[i]`` of
+    a leading ``[num_segments]`` axis — the ONE entry point the sliced fold
+    scatters through, local or sharded.
+
+    Without ``mesh``: ``jax.ops.segment_{sum,max,min}`` (``method="xla"``)
+    or the VMEM-tiled kernel (``method="pallas"``, sum only; ``auto``
+    engages it on TPU inside the documented envelope). With ``mesh`` +
+    ``axis``: ONE ``shard_map`` enters with ``vals``/``rows`` replicated
+    and returns the scatter result ``P(axis)``-sharded on its leading
+    axis — shard ``s`` masks the row column into its block range
+    ``[s*w, (s+1)*w)`` and reduces into its local ``(w, ...)`` tile, so no
+    state-sized operand is ever gathered and the per-shard segment extent
+    (what the kernel and the int32 index see) is ``num_segments/shards``.
+    ``num_segments`` must divide evenly by the axis size (the sliced
+    collection keeps its capacity a multiple of the shard count).
+
+    ``interpret=None`` resolves per backend (interpret mode anywhere
+    Mosaic isn't, i.e. off-TPU). Rows outside ``[0, num_segments)`` are
+    dropped on every path.
+    """
+    if reduce not in _REDUCES:
+        raise ValueError(f"reduce must be one of {_REDUCES}, got {reduce!r}.")
+    if method not in _METHODS:
+        raise ValueError(f"method must be one of {_METHODS}, got {method!r}.")
+    if method == "pallas" and reduce != "sum":
+        raise ValueError(
+            "method='pallas' supports reduce='sum' only (the one-hot "
+            f"contraction has no {reduce!r} form); use method='xla'."
+        )
+    if (mesh is None) != (axis is None):
+        raise ValueError("mesh and axis must be passed together.")
+    if mesh is None:
+        platform = jax.default_backend()
+        resolved = _resolve_method(method, reduce, num_segments, vals, platform)
+        interp = (platform != "tpu") if interpret is None else bool(interpret)
+        _emit_obs(resolved, num_segments, vals)
+        return _apply_local(vals, rows, num_segments, reduce, resolved, interp)
+
+    shards = int(mesh.shape[axis])
+    if num_segments % shards:
+        raise ValueError(
+            f"num_segments {num_segments} is not a multiple of mesh axis "
+            f"{axis!r} size {shards}: the block-range route needs equal "
+            "per-shard tiles (the sliced collection rounds its capacity up)."
+        )
+    w = shard_tile_width(num_segments, shards)
+    platform = mesh_platform_of(mesh)
+    resolved = _resolve_method(method, reduce, w, vals, platform)
+    interp = (platform != "tpu") if interpret is None else bool(interpret)
+    _emit_obs("sharded", num_segments, vals, shards=shards)
+
+    def body(rows_l, vals_l):
+        s = jax.lax.axis_index(axis)
+        local = rows_l.astype(jnp.int32) - s * w
+        # rows owned by other shards leave [0, w); route them to an
+        # explicit dead segment rather than leaning on scatter OOB modes
+        # (negative indices would WRAP under gather-style clamping)
+        local = jnp.where((local >= 0) & (local < w), local, w)
+        out = _apply_local(vals_l, local, w + 1, reduce, resolved, interp)
+        return out[:w]
+
+    return _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_P(), _P()),
+        out_specs=_P(axis),
+        **_SHARD_MAP_KWARGS,
+    )(rows, vals)
+
+
+# --------------------------------------------------------------- GSPMD rule
+# ``pallas_call`` has no partitioning rule, so under GSPMD a sample-sharded
+# operand would be all-gathered onto every device before the kernel runs.
+# As with ``sharded_pallas_class_counts``: a segment SUM is a pure
+# sample-axis reduction, so each shard runs the VMEM kernel on its local
+# samples and the per-shard partials fold with one ``psum`` over exactly
+# the mesh axes the sample axis is sharded on — sharded and unsharded
+# callers share this one entry point and the partitioner supplies the rest.
+
+
+def _sample_axes(sharding) -> tuple:
+    spec = getattr(sharding, "spec", None)
+    spec0 = spec[0] if spec else None
+    if spec0 is None:
+        return ()
+    return tuple(spec0) if isinstance(spec0, tuple) else (spec0,)
+
+
+def _seg_infer(num_segments, interpret, mesh, arg_shapes, result_shape):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, _P())  # (num_segments, d): replicated
+
+
+def _seg_partition(num_segments, interpret, mesh, arg_shapes, result_shape):
+    from jax.sharding import NamedSharding
+
+    axes = _sample_axes(arg_shapes[0].sharding)
+    arg_shardings = (
+        NamedSharding(mesh, _P(axes if axes else None, None)),
+        NamedSharding(mesh, _P(axes if axes else None)),
+    )
+    result_sharding = NamedSharding(mesh, _P())
+
+    def lower_fn(vals, rows):
+        local = pallas_segment_sum(
+            vals, rows, num_segments, interpret=interpret
+        )
+        return jax.lax.psum(local, axes) if axes else local
+
+    return mesh, lower_fn, result_sharding, arg_shardings
+
+
+from jax.experimental.custom_partitioning import custom_partitioning  # noqa: E402
+
+
+@functools.partial(custom_partitioning, static_argnums=(2, 3))
+def sharded_pallas_segment_sum(vals, rows, num_segments, interpret=False):
+    """``pallas_segment_sum`` with a GSPMD partitioning rule: on a mesh,
+    each shard's deltas reduce in VMEM locally and the partials fold with
+    one ``psum``; on one device it is exactly ``pallas_segment_sum``."""
+    return pallas_segment_sum(vals, rows, num_segments, interpret=interpret)
+
+
+# Shardy rule: the sample factor i is contracted on both operands; the
+# segment-axis factor k and the lane factor j appear replicated in the
+# result (the partition callback psums). Older jax predates Shardy — the
+# GSPMD callbacks alone are the complete rule there.
+_def_partition_kwargs = {}
+if "sharding_rule" in inspect.signature(
+    sharded_pallas_segment_sum.def_partition
+).parameters:
+    _def_partition_kwargs["sharding_rule"] = "i j, i -> k j"
+sharded_pallas_segment_sum.def_partition(
+    infer_sharding_from_operands=_seg_infer,
+    partition=_seg_partition,
+    **_def_partition_kwargs,
+)

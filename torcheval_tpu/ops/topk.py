@@ -139,6 +139,31 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+# ----------------------------------------------- shared shard-routing helpers
+# The block-range decomposition used by every sharded-state engine in ops/:
+# shard ``s`` of ``shards`` owns the contiguous global tile
+# ``[s*w, (s+1)*w)`` with ``w = shard_tile_width(total, shards)``. Keeping
+# the route a contiguous range (rather than ``idx % shards``) means the
+# GLOBAL layout of a sharded axis is identical to the unsharded layout —
+# checkpoints, sync alignment and result slicing never see the owner
+# permutation a mod-route would impose. ``ops/scatter.py`` routes the sliced
+# slice axis with the same helpers ``_sharded_label_program`` routes labels.
+
+
+def shard_tile_width(total: int, shards: int) -> int:
+    """Per-shard tile width of the block-range decomposition of ``total``
+    elements over ``shards`` devices (the last tile may be ragged; in-shard
+    masking against ``total`` retires padded lanes)."""
+    return _round_up(total, shards) // shards
+
+
+def mesh_platform_of(mesh: Mesh) -> str:
+    """The platform a mesh's kernels lower for — resolved from the mesh's
+    own devices, not the default backend (``lax.platform_dependent`` cannot
+    prune branches inside shard_map, and a mesh names its platform)."""
+    return next(iter(mesh.devices.flat)).platform
+
+
 # ------------------------------------------------------------- path picking
 def _prune_plan(l: int, k: int):
     """(group_w, n_groups, survivor_budget, ok). ``ok`` requires enough
@@ -431,9 +456,9 @@ def _sharded_label_program(
     keeps the gather local so the relevance matrix is never replicated
     either."""
     shards = int(mesh.shape[label_axis])
-    w = _round_up(l_total, shards) // shards  # local label-tile width
+    w = shard_tile_width(l_total, shards)  # local label-tile width
     k_local = min(k, w)
-    mesh_platform = next(iter(mesh.devices.flat)).platform
+    mesh_platform = mesh_platform_of(mesh)
     row_spec = batch_axes if batch_axes else None
     in_spec = _P(row_spec, label_axis)
     out_spec = _P(row_spec, None)

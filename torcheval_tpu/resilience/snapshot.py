@@ -594,15 +594,25 @@ def _check_mesh_portability(entry: dict, metric, mkey: str) -> None:
     current = (
         {str(k): int(v) for k, v in dict(shape).items()} if shape else None
     )
-    if current != dict(saved_mesh):
-        raise CheckpointError(
-            "unsupported",
-            f"state {entry['state']!r} of metric {mkey!r} was sharded "
-            f"across mesh {dict(saved_mesh)!r} at save time but the "
-            f"restore target's placement mesh is {current!r} — sharded "
-            "state requires an equal mesh axis (replicated state restores "
-            "anywhere; see docs/robustness.md, 'Checkpoint portability').",
-        )
+    if current == dict(saved_mesh):
+        return
+    if current is None and getattr(metric, "_sliced_sync", False):
+        # slice-axis-sharded state (ISSUE 17): the payload is the GLOBAL
+        # value and the slice layout is mesh-independent (block-range
+        # tiles of one logical leading axis), so an UNSHARDED target may
+        # restore it replicated — e.g. a single-device debug host reading
+        # an 8-shard production checkpoint. A sharded target still
+        # requires the equal mesh above: re-tiling onto a topology the
+        # saver never validated stays an explicit failure.
+        return
+    raise CheckpointError(
+        "unsupported",
+        f"state {entry['state']!r} of metric {mkey!r} was sharded "
+        f"across mesh {dict(saved_mesh)!r} at save time but the "
+        f"restore target's placement mesh is {current!r} — sharded "
+        "state requires an equal mesh axis (replicated state restores "
+        "anywhere; see docs/robustness.md, 'Checkpoint portability').",
+    )
 
 
 def _coalesce_restore_h2d(

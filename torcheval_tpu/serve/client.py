@@ -583,11 +583,14 @@ class EvalClient:
         server-side and answered with the ORIGINAL success instead of
         ``duplicate_tenant`` — attach is idempotent per call, like
         submit. ``slices`` threads the per-cohort config (ISSUE 15:
-        ``True`` / capacity int / ``{"capacity":, "curve_bucket_bits":}``)
-        — every ``submit`` for a sliced tenant must then carry the
-        ``slice_ids`` integer column as its FIRST argument, and
-        ``compute`` returns per-slice ``{"slice_ids": ..., "values": ...}``
-        results per member."""
+        ``True`` / capacity int / ``{"capacity":, "curve_bucket_bits":}``;
+        ISSUE 17 adds ``"mesh_axis": str`` — a plain axis-name string the
+        DAEMON turns into a slice-axis-sharded collection over its own
+        local devices, so no device handle ever crosses the wire) — every
+        ``submit`` for a sliced tenant must then carry the ``slice_ids``
+        integer column as its FIRST argument, and ``compute`` returns
+        per-slice ``{"slice_ids": ..., "values": ...}`` results per
+        member."""
         req = {
             "tenant": tenant_id,
             "spec": spec,

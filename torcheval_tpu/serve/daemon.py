@@ -313,11 +313,16 @@ class EvalDaemon:
         restore into the changed state schema — use ``resume="never"`` to
         start it clean. ``slices`` (ISSUE 15) opts this tenant into
         per-cohort eval: ``True`` (defaults), an int (initial dense
-        capacity), or ``{"capacity": int, "curve_bucket_bits": int}`` —
-        the tenant's metrics become a
+        capacity), or ``{"capacity": int, "curve_bucket_bits": int,
+        "mesh_axis": str}`` — the tenant's metrics become a
         :class:`~torcheval_tpu.metrics.SlicedMetricCollection`, every
         ``submit`` must carry the ``slice_ids`` integer column FIRST, and
-        ``compute`` returns per-slice results keyed by original ids. The
+        ``compute`` returns per-slice results keyed by original ids.
+        ``slices={"mesh_axis": ...}`` (ISSUE 17) additionally shards the
+        slice axis of every member state across that named axis of a flat
+        all-local-devices mesh — per-device slice state and the sketch's
+        int32 extent bound both shrink by the device count (the axis name
+        is a plain wire string; device handles never cross the wire). The
         sliceability of every member is validated BEFORE the ``approx``
         knob commits (validate-then-commit covers slice expansion too): a
         spec with an unsliceable member rejects as ``bad_metrics`` without
@@ -581,9 +586,12 @@ class EvalDaemon:
     def _normalize_slices(slices) -> Optional[dict]:
         """``slices`` knob → SlicedMetricCollection kwargs (or ``None`` =
         unsliced). ``True`` = defaults, an int = initial dense capacity, a
-        dict allows ``capacity`` / ``curve_bucket_bits``. Validated at the
-        admission boundary so a typo'd config rejects the attach instead
-        of surfacing later as tenant poison."""
+        dict allows ``capacity`` / ``curve_bucket_bits`` / ``mesh_axis``
+        (a string axis NAME — it travels the wire as plain JSON and the
+        daemon's collection builds the flat all-local-devices mesh, so a
+        client never ships device handles). Validated at the admission
+        boundary so a typo'd config rejects the attach instead of
+        surfacing later as tenant poison."""
         if slices is None or slices is False:
             return None
         if slices is True:
@@ -591,14 +599,25 @@ class EvalDaemon:
         if isinstance(slices, int):
             return {"capacity": slices}
         if isinstance(slices, dict):
-            allowed = {"capacity", "curve_bucket_bits"}
+            allowed = {"capacity", "curve_bucket_bits", "mesh_axis"}
             unknown = set(slices) - allowed
             if unknown:
                 raise ValueError(
                     f"unknown slices config keys {sorted(unknown)}; "
                     f"allowed: {sorted(allowed)}."
                 )
-            return {k: int(v) for k, v in slices.items()}
+            out = {}
+            for k, v in slices.items():
+                if k == "mesh_axis":
+                    if not isinstance(v, str) or not v:
+                        raise ValueError(
+                            "slices['mesh_axis'] must be a non-empty "
+                            f"axis-name string, got {v!r}."
+                        )
+                    out[k] = v
+                else:
+                    out[k] = int(v)
+            return out
         raise ValueError(
             "slices must be True, an int capacity, or a config dict, "
             f"got {slices!r}."

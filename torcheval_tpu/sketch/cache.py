@@ -435,30 +435,40 @@ def check_sliced_bucket_bits(bucket_bits: int) -> int:
     return bucket_bits
 
 
-def check_sliced_sketch_extent(bucket_bits: int, num_slices: int) -> None:
+def check_sliced_sketch_extent(
+    bucket_bits: int, num_slices: int, shards: int = 1
+) -> None:
     """Fail closed at the sliced sketch's addressing edge (review finding):
     the combined segment index is ``rows * planes + plane`` in int32, so
-    ``num_slices * (2^(bits+1) + 1)`` must stay <= 2^31 - 1 — past it the
-    index silently WRAPS and per-slice counts corrupt (and the flat
-    histogram's memory explodes long before that helps anyone). Raised at
-    member registration / capacity growth, never inside the program, with
-    the two remedies named. Default 16-bit buckets cap out at ~16k slices;
-    a million cohorts need <= 14 planes' worth, i.e. coarse widths
+    the PER-SHARD extent ``ceil(num_slices/shards) * (2^(bits+1) + 1)``
+    must stay <= 2^31 - 1 — past it the index silently WRAPS and per-slice
+    counts corrupt (and the flat histogram's memory explodes long before
+    that helps anyone). Raised at member registration / capacity growth,
+    never inside the program, with the two remedies named. The bound is
+    per shard because the sharded fold builds each shard's combined index
+    over its own block-range row tile: sharding over N devices multiplies
+    the admissible cohort count by N. Default 16-bit buckets cap out at
+    ~16k slices per shard; a million unsharded cohorts need coarse widths
     (``curve_bucket_bits`` 4-6) or a sharded slice axis
     (docs/performance.md "Sliced metrics")."""
     planes = 2 * (1 << bucket_bits) + 1
-    if num_slices * planes > 2**31 - 1:
+    shards = max(int(shards), 1)
+    per_shard = -(-int(num_slices) // shards)
+    if per_shard * planes > 2**31 - 1:
         raise ValueError(
-            f"sliced sketch extent {num_slices} slices x {planes} planes "
-            f"(curve_bucket_bits={bucket_bits}) exceeds the int32 segment-"
-            "index range (2^31-1): per-slice histogram counts would "
-            "silently corrupt. Use a coarser curve_bucket_bits (each bit "
-            "halves the slice headroom) or shard the slice axis across "
-            "hosts (docs/performance.md, 'Sliced metrics')."
+            f"sliced sketch extent {per_shard} slices/shard x {planes} "
+            f"planes (curve_bucket_bits={bucket_bits}, {num_slices} slices "
+            f"over {shards} shard(s)) exceeds the int32 segment-index "
+            "range (2^31-1): per-slice histogram counts would silently "
+            "corrupt. Use a coarser curve_bucket_bits (each bit halves the "
+            "slice headroom) or shard the slice axis over more devices "
+            'with slices={"mesh_axis": ...} (SlicedMetricCollection('
+            "mesh_axis=...)) — the extent bound is per shard "
+            "(docs/performance.md, 'Sliced metrics')."
         )
 
 
-def sliced_score_hist_fold(rows, scores, targets, bits, num_slices):
+def sliced_score_hist_fold(rows, scores, targets, bits, num_slices, shard=None):
     """Fold one ``(N,)`` binary score/target batch into per-slice
     ``(num_slices, B)`` ``(tp, fp)`` int32 histograms plus a per-slice NaN
     lane, routed by the dense ``rows`` column. Additive and integer-exact:
@@ -470,7 +480,15 @@ def sliced_score_hist_fold(rows, scores, targets, bits, num_slices):
     (NaN samples in the last plane), so the fold pays a single
     segment_sum pass over the batch however many count lanes the sketch
     keeps — XLA:CPU's scatter is serial per update, so pass count, not
-    lane count, is the cost (docs/performance.md "Sliced metrics")."""
+    lane count, is the cost (docs/performance.md "Sliced metrics").
+
+    With ``shard=(mesh, axis)`` the scatter runs per block-range shard:
+    each shard localizes the row column into its own ``num_slices/N`` tile
+    and builds the combined index over THAT extent only — which is exactly
+    why the int32 bound (:func:`check_sliced_sketch_extent`) is per shard —
+    and the histogram is born ``P(axis)``-sharded with no state-sized
+    collective. A global combined index would re-wrap int32 at the same
+    edge, so the localization must happen before the multiply."""
     check_sliced_bucket_bits(bits)
     rows = rows.astype(jnp.int32)
     nan = jnp.isnan(scores.astype(jnp.float32))
@@ -479,10 +497,41 @@ def sliced_score_hist_fold(rows, scores, targets, bits, num_slices):
     num_buckets = 1 << bits
     planes = 2 * num_buckets + 1
     plane = jnp.where(nan, 2 * num_buckets, 2 * b + (1 - t))
-    idx = rows * planes + plane
-    hist = jax.ops.segment_sum(
-        jnp.ones_like(rows), idx, num_segments=num_slices * planes
-    ).reshape(num_slices, planes)
+    if shard is None:
+        idx = rows * planes + plane
+        hist = jax.ops.segment_sum(
+            jnp.ones_like(rows), idx, num_segments=num_slices * planes
+        ).reshape(num_slices, planes)
+    else:
+        from jax.sharding import PartitionSpec as _P
+
+        from torcheval_tpu.ops.topk import (
+            _SHARD_MAP_KWARGS,
+            _shard_map,
+            shard_tile_width,
+        )
+
+        mesh, axis = shard
+        w = shard_tile_width(num_slices, int(mesh.shape[axis]))
+
+        def _body(rows_l, plane_l):
+            s = jax.lax.axis_index(axis)
+            local = rows_l - s * w
+            ok = (local >= 0) & (local < w)
+            # rows owned by other shards route to one dead trailing segment
+            idx = jnp.where(ok, local * planes + plane_l, w * planes)
+            h = jax.ops.segment_sum(
+                jnp.ones_like(rows_l), idx, num_segments=w * planes + 1
+            )
+            return h[: w * planes].reshape(w, planes)
+
+        hist = _shard_map(
+            _body,
+            mesh=mesh,
+            in_specs=(_P(), _P()),
+            out_specs=_P(axis),
+            **_SHARD_MAP_KWARGS,
+        )(rows, plane)
     return {
         "sketch_tp": hist[:, 0 : 2 * num_buckets : 2],
         "sketch_fp": hist[:, 1 : 2 * num_buckets : 2],
