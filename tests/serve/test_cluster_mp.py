@@ -188,6 +188,10 @@ class _ClusterDrillMixin:
             max_attempts=2,
             backoff_base_s=0.05,
             backoff_cap_s=0.2,
+            # ISSUE 18: the whole drill rides the deferred-ack pipeline
+            # (every submit streams on a channel socket, acks fold
+            # asynchronously) — the exactness bar below is unchanged
+            pipeline_depth=3,
         )
         cls.tenants = _pick_spread_ids(
             [cls.ep_a, cls.ep_b], TENANTS_PER_HOST
@@ -417,13 +421,15 @@ class _ClusterDrillMixin:
         )
         # the interrupted in-flight batches are the only un-durable
         # entries (everything earlier was flushed): at least the one that
-        # detected the death, at most one per B tenant — producers keep
-        # booking fast-failing submits for other B tenants in the window
-        # between the death and the migration completing, and every one
-        # of those is delivered by replay (never resubmitted; the
-        # zero-duplicate test above proves the arithmetic)
+        # detected the death; with the deferred-ack pipeline up to a full
+        # window of booked-but-unacked phase-2 batches per B tenant can
+        # be in flight when the host dies, and every one is delivered by
+        # replay (never resubmitted; the zero-duplicate test above
+        # proves the arithmetic)
         self.assertGreaterEqual(replay_total, 1.0)
-        self.assertLessEqual(replay_total, float(len(self.b_tenants)))
+        self.assertLessEqual(
+            replay_total, float(PHASE2 * len(self.b_tenants))
+        )
         with open(os.path.join(self.outdir, "router.trace.json")) as f:
             trace = json.load(f)
         names = [e["name"] for e in trace["traceEvents"]]

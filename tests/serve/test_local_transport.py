@@ -1,0 +1,292 @@
+"""Shared-memory same-host transport (ISSUE 18): the staging-pool slot
+(or the client's own payload bytes) IS the buffer the daemon decodes —
+no socket write+read copy pair.
+
+Contracts pinned here:
+
+* **Zero-copy decode** — a ``bytes`` submit payload crosses as the
+  decode buffer itself: the daemon-side npz leaves are VIEWS
+  (``owndata=False``, no staging slot), and repeated ``local_request``
+  dispatch allocates ~nothing per call (tracemalloc, mirroring the
+  PR 11 ``unpack_tree`` pin). A scatter-gather ``submit_many`` payload
+  is assembled ONCE into a ``HostBufferPool`` slot whose memory the
+  decoded leaves share.
+* **Byte-identical semantics** — the same batches through the local
+  path and the forced-TCP path produce identical metric results, and
+  structured rejects surface identically (same dispatch).
+* **Automatic selection + fallback** — the in-process endpoint registry
+  picks the local path only while the server lives there; deregistered
+  (closed, or a genuinely remote endpoint), the SAME client falls back
+  to the TCP wire transparently.
+* **Accounting** — ``serve.ingest.local_copies_avoided_bytes`` counts
+  exactly the payload bytes that skipped the socket copy pair.
+
+All sockets bind port 0 (OS-assigned).
+"""
+
+import tracemalloc
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.serve import (
+    EvalClient,
+    EvalDaemon,
+    EvalServer,
+    metric_spec,
+)
+from torcheval_tpu.serve.wire import local_server, pack_tree
+
+NUM_CLASSES = 5
+SPEC = {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)}
+
+
+def _batch(seed=0, n=256):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, n),
+    )
+
+
+def _oracle(n_batches, n=256):
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for i in range(n_batches):
+        m.update(*_batch(seed=i, n=n))
+    return float(np.asarray(m.compute()))
+
+
+class _SpyHandle:
+    """Stands in for the server's TenantHandle: captures the decoded
+    args + stage the dispatch hands over (releasing the stage like the
+    daemon would) so the test can inspect the aliasing directly."""
+
+    def __init__(self):
+        self.captured = []
+        self._tenant = type(
+            "T", (), {"durable_seq": 0, "last_seq": 0}
+        )()
+
+    def submit(self, *args, seq=None, stage=None, **kw):
+        self.captured.append((args, stage))
+        if stage is not None:
+            stage.release()
+        return True
+
+
+class _PairMixin:
+    def _pair(self, **client_kw):
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(server.endpoint, **client_kw)
+        self.addCleanup(client.close)
+        return daemon, server, client
+
+
+class TestEndpointRegistry(_PairMixin, unittest.TestCase):
+    def test_registered_while_running_gone_after_close(self):
+        daemon = EvalDaemon().start()
+        self.addCleanup(daemon.stop)
+        server = EvalServer(daemon)
+        self.assertIs(local_server(server.endpoint), server)
+        server.close()
+        self.assertIsNone(local_server(server.endpoint))
+
+    def test_closed_server_raises_oserror_locally(self):
+        daemon = EvalDaemon().start()
+        self.addCleanup(daemon.stop)
+        server = EvalServer(daemon)
+        server.close()
+        with self.assertRaises(OSError):
+            server.local_request({"op": "submit", "tenant": "t"}, b"")
+
+
+class TestZeroCopyLocalDecode(_PairMixin, unittest.TestCase):
+    def test_bytes_payload_decodes_as_views_no_stage(self):
+        _, server, client = self._pair()
+        client.attach("t", SPEC)
+        spy = _SpyHandle()
+        with server._lock:
+            server._handles["t"] = spy
+        scores, labels = _batch()
+        self.assertTrue(client.submit("t", scores, labels))
+        (args, stage), = spy.captured
+        # immutable bytes cross AS the decode buffer: leaf views, no
+        # staging slot to recycle
+        self.assertIsNone(stage)
+        for leaf in args:
+            self.assertFalse(leaf.flags.owndata, "leaf was copied")
+        np.testing.assert_array_equal(args[0], scores)
+        np.testing.assert_array_equal(args[1], labels)
+
+    def test_scatter_gather_payload_lands_in_one_pool_slot(self):
+        # the coalesced client ships (parts, total): local transport
+        # assembles the parts ONCE into a staging-pool slot, and the
+        # decoded leaves alias that slot's memory — the slot IS the
+        # buffer the daemon decodes
+        _, server, client = self._pair(submit_buffer=4)
+        client.attach("t", SPEC)
+        spy = _SpyHandle()
+        with server._lock:
+            server._handles["t"] = spy
+        batches = [_batch(seed=i) for i in range(4)]
+        for scores, labels in batches:
+            self.assertTrue(client.submit("t", scores, labels))
+        self.assertEqual(len(spy.captured), 4)
+        from torcheval_tpu.serve.ingest import SharedStage
+
+        stages = {id(stage) for _args, stage in spy.captured}
+        self.assertEqual(len(stages), 1, "one slot shared by the group")
+        shared = spy.captured[0][1]
+        self.assertIsInstance(shared, SharedStage)
+        for (args, _stage), (scores, labels) in zip(
+            spy.captured, batches
+        ):
+            np.testing.assert_array_equal(args[0], scores)
+            np.testing.assert_array_equal(args[1], labels)
+            for leaf in args:
+                self.assertFalse(leaf.flags.owndata, "leaf was copied")
+
+    def test_local_dispatch_allocates_nothing_per_call(self):
+        # the PR 11 pin, moved to the transport seam: dispatching a
+        # pre-packed ~160 KB bytes payload through local_request must
+        # not allocate per-leaf buffers — the decode is views over the
+        # caller's own bytes. Generous 8 KB/call bound vs the ~80 KB a
+        # single leaf copy (or a socket round trip's recv buffer)
+        # would show.
+        _, server, client = self._pair()
+        client.attach("t", SPEC)
+        spy = _SpyHandle()
+        with server._lock:
+            server._handles["t"] = spy
+        scores, labels = _batch(n=8192)
+        spec, blob = pack_tree([scores, labels])
+        header = {"op": "submit", "tenant": "t", "seq": 1, "args": spec}
+        for _ in range(3):
+            server.local_request(dict(header), blob)  # warm caches
+        spy.captured.clear()
+        n_iters = 20
+        tracemalloc.start()
+        try:
+            snap0 = tracemalloc.take_snapshot()
+            for _ in range(n_iters):
+                server.local_request(dict(header), blob)
+            snap1 = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        grown = sum(
+            d.size_diff
+            for d in snap1.compare_to(snap0, "filename")
+            if d.size_diff > 0
+        )
+        self.assertEqual(len(spy.captured), n_iters)
+        self.assertLess(
+            grown / n_iters,
+            8192,
+            f"local dispatch allocated ~{grown // n_iters} B/call — the "
+            "payload is being copied on the same-host path",
+        )
+
+
+class TestLocalVsTcpSemantics(unittest.TestCase):
+    def _run_stream(self, client, tenant, n=6):
+        client.attach(tenant, SPEC)
+        for i in range(n):
+            self.assertTrue(client.submit(tenant, *_batch(seed=i)))
+        return float(np.asarray(client.compute(tenant)["acc"]))
+
+    def test_bit_identical_results_and_accounting(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.disable)
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        local = EvalClient(server.endpoint)  # local_transport defaults on
+        tcp = EvalClient(server.endpoint, local_transport=False)
+        self.addCleanup(local.close)
+        self.addCleanup(tcp.close)
+        n = 6
+        got_local = self._run_stream(local, "t-local", n)
+        avoided = obs.snapshot()["counters"].get(
+            "serve.ingest.local_copies_avoided_bytes", 0.0
+        )
+        self.assertGreater(avoided, 0.0, "local path never selected")
+        got_tcp = self._run_stream(tcp, "t-tcp", n)
+        self.assertEqual(got_local, got_tcp)
+        self.assertEqual(got_local, _oracle(n))
+        # the forced-TCP stream moved no additional local bytes
+        self.assertEqual(
+            obs.snapshot()["counters"].get(
+                "serve.ingest.local_copies_avoided_bytes", 0.0
+            ),
+            avoided,
+        )
+        # both streams applied fully, exactly once
+        tenants = local.health()["tenants"]
+        for tid in ("t-local", "t-tcp"):
+            self.assertEqual(tenants[tid]["processed"], n)
+            self.assertEqual(tenants[tid]["dupes"], 0)
+
+    def test_structured_rejects_identical_across_transports(self):
+        from torcheval_tpu.serve import ServeError
+
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        for kw in ({}, {"local_transport": False}):
+            client = EvalClient(server.endpoint, max_attempts=1, **kw)
+            self.addCleanup(client.close)
+            with self.assertRaises(ServeError) as ctx:
+                client.submit("ghost", *_batch())
+            self.assertEqual(ctx.exception.reason, "unknown_tenant", kw)
+
+    def test_tcp_fallback_when_endpoint_not_local(self):
+        # deregister the endpoint (a genuinely remote server's shape):
+        # the SAME client must silently use the TCP wire and produce
+        # identical results — then pick the local path back up
+        from torcheval_tpu.serve import wire as _wire
+
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.disable)
+        daemon = EvalDaemon().start()
+        server = EvalServer(daemon)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        client = EvalClient(server.endpoint)
+        self.addCleanup(client.close)
+        client.attach("t", SPEC)
+        with _wire._LOCAL_SERVERS_LOCK:
+            del _wire._LOCAL_SERVERS[server.endpoint]
+        try:
+            self.assertTrue(client.submit("t", *_batch(seed=0)))
+            self.assertEqual(
+                obs.snapshot()["counters"].get(
+                    "serve.ingest.local_copies_avoided_bytes", 0.0
+                ),
+                0.0,
+                "local path used while endpoint was deregistered",
+            )
+        finally:
+            with _wire._LOCAL_SERVERS_LOCK:
+                _wire._LOCAL_SERVERS[server.endpoint] = server
+        self.assertTrue(client.submit("t", *_batch(seed=1)))
+        self.assertGreater(
+            obs.snapshot()["counters"].get(
+                "serve.ingest.local_copies_avoided_bytes", 0.0
+            ),
+            0.0,
+        )
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(2))
+
+
+if __name__ == "__main__":
+    unittest.main()

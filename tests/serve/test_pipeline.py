@@ -1,0 +1,380 @@
+"""Deferred-ack submit pipelining (ISSUE 18): negotiation, exactly-once
+edges, and the chaos ack actions.
+
+Contracts pinned here:
+
+* **Negotiation degrades, never breaks** — the attach-time ``pipeline``
+  ask rides the PR 12 codec-handshake exchange: a server that never
+  grants (``pipeline_depth=0``, the old-peer model) leaves the client
+  lock-step with no protocol error; a granted-then-rejected
+  ``pipeline_open`` flips the client's remembered ``unsupported`` flag,
+  and ``WireError("protocol")`` NEVER surfaces to the producer.
+* **Exactly-once under overlap** — out-of-order acks fold through the
+  same ``acked_seq`` max/prune arithmetic the lock-step path uses; a
+  full replay buffer triggers the server-side ``flush`` valve
+  mid-pipeline; a migration exports the deep un-acked in-flight tail
+  and the adopting host replays it without duplicates; the daemon's
+  gapless admission refuses a seq past a still-unadmitted hole so the
+  dedup watermark can never ratchet over a shed batch.
+* **Chaos ack actions** — ``ack_delay`` / ``ack_reorder`` fire at the
+  server's deferred-ack writer (the exact surface a slow or reordered
+  ack presents) and the stream stays bit-identical with zero duplicate
+  application.
+
+All sockets bind port 0 (OS-assigned).
+"""
+
+import os
+import threading
+import time
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.resilience import chaos
+from torcheval_tpu.serve import (
+    BackpressureError,
+    EvalClient,
+    EvalDaemon,
+    EvalServer,
+    WireError,
+    metric_spec,
+)
+from torcheval_tpu.serve.client import _ClientTenant, _PipelinedChannel
+
+NUM_CLASSES = 5
+SPEC = {"acc": metric_spec("MulticlassAccuracy", num_classes=NUM_CLASSES)}
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, n),
+    )
+
+
+def _oracle(n_batches):
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for i in range(n_batches):
+        m.update(*_batch(seed=i))
+    return float(np.asarray(m.compute()))
+
+
+class _PairMixin:
+    def _pair(self, *, server_kw=None, daemon_kw=None, **client_kw):
+        daemon = EvalDaemon(**(daemon_kw or {})).start()
+        server = EvalServer(daemon, **(server_kw or {}))
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        # local_transport=False: these tests pin the CHANNEL (the
+        # in-process local fast path would bypass it by design)
+        client_kw.setdefault("local_transport", False)
+        client = EvalClient(server.endpoint, **client_kw)
+        self.addCleanup(client.close)
+        return daemon, server, client
+
+
+class TestNegotiation(_PairMixin, unittest.TestCase):
+    def test_grant_is_min_of_ask_and_server_cap(self):
+        _, _, client = self._pair(
+            server_kw={"pipeline_depth": 4}, pipeline_depth=8
+        )
+        client.attach("t", SPEC)
+        self.assertEqual(client._pipeline_granted, 4)
+        self.assertTrue(client.submit("t", *_batch()))
+        ch = client._channel
+        self.assertIsNotNone(ch)
+        self.assertEqual(ch.depth, 4)
+
+    def test_never_granting_server_degrades_to_lock_step(self):
+        # the old-peer model: a server that does not speak pipelining
+        # ignores the attach ask; the wire silently stays lock-step and
+        # per-batch applied verdicts keep their request-response meaning
+        _, _, client = self._pair(
+            server_kw={"pipeline_depth": 0}, pipeline_depth=8
+        )
+        client.attach("t", SPEC)
+        self.assertEqual(client._pipeline_granted, 0)
+        for i in range(3):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        self.assertIsNone(client._channel)
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(3))
+
+    def test_pipeline_open_protocol_reject_never_surfaces(self):
+        # a peer that granted at attach but rejects the channel open
+        # (rolled back mid-deploy): the client remembers `unsupported`,
+        # runs lock-step, and WireError("protocol") never reaches the
+        # producer
+        _, _, client = self._pair(
+            server_kw={"pipeline_depth": 0}, pipeline_depth=8
+        )
+        client.attach("t", SPEC)
+        client._pipeline_granted = 8  # simulate the stale grant
+        for i in range(3):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        self.assertTrue(client._pipeline_unsupported)
+        self.assertIsNone(client._channel)
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(3))
+
+    def test_depth_knob_validated(self):
+        for bad in (0, -1, 1.5, "4"):
+            with self.assertRaises(ValueError):
+                EvalClient("127.0.0.1:1", pipeline_depth=bad)
+        # server-side knob: negative rejected, 0 = never grant
+        daemon = EvalDaemon().start()
+        self.addCleanup(daemon.stop)
+        with self.assertRaises(ValueError):
+            EvalServer(daemon, pipeline_depth=-1)
+
+
+class TestPipelinedExactlyOnce(_PairMixin, unittest.TestCase):
+    def test_stream_matches_oracle_with_deferred_acks(self):
+        obs.reset()
+        obs.enable()
+        self.addCleanup(obs.disable)
+        _, server, client = self._pair(pipeline_depth=8)
+        client.attach("t", SPEC)
+        n = 20
+        for i in range(n):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(n))
+        health = client.health()["tenants"]["t"]
+        self.assertEqual(health["processed"], n)
+        self.assertEqual(health["dupes"], 0)
+        snap = obs.snapshot()
+        self.assertGreaterEqual(
+            snap["counters"].get("serve.wire.acks_deferred", 0), n
+        )
+        self.assertTrue(
+            any(
+                k.startswith("serve.client.inflight{")
+                for k in snap["histograms"]
+            ),
+            sorted(snap["histograms"]),
+        )
+
+    def test_out_of_order_acks_fold_through_the_watermark(self):
+        # acks are matched by (tenant, seqs) echo, not arrival order: a
+        # shuffled batch of ok acks must fold to the max durable
+        # watermark and prune exactly the covered prefix
+        state = _ClientTenant(0)
+        for seq in range(1, 8):
+            state.replay.append((seq, ("b%d" % seq,)))
+        acks = [
+            {"ok": True, "acked_seq": 5},
+            {"ok": True, "acked_seq": 2},
+            {"ok": True, "acked_seq": 7},
+            {"ok": True, "acked_seq": 3},
+        ]
+        _PipelinedChannel._fold_acks(state, acks, dirty=False)
+        self.assertEqual(state.durable_seq, 7)
+        self.assertEqual(list(state.replay), [])
+        self.assertFalse(state.needs_resend)
+        # an error ack anywhere in the pile flags the resend catch-up;
+        # a dirty (channel-death) fold does the same
+        state2 = _ClientTenant(0)
+        state2.replay.append((1, ("b1",)))
+        _PipelinedChannel._fold_acks(
+            state2,
+            [{"ok": False, "error": {"reason": "queue_full"}}],
+            dirty=False,
+        )
+        self.assertTrue(state2.needs_resend)
+        state3 = _ClientTenant(0)
+        _PipelinedChannel._fold_acks(state3, [], dirty=True)
+        self.assertTrue(state3.needs_resend)
+
+    def test_full_replay_buffer_flushes_mid_pipeline(self):
+        import tempfile
+
+        root = tempfile.mkdtemp(prefix="tpu_pipeline_flush_")
+        _, _, client = self._pair(
+            daemon_kw={"evict_dir": root},
+            pipeline_depth=4,
+            replay_capacity=4,
+        )
+        client.attach("t", SPEC)
+        n = 12
+        for i in range(n):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        state = client._tenant_state("t")
+        # the valve fired: the durable watermark moved off zero (flush
+        # published checkpoints) and the buffer never exceeded capacity
+        self.assertGreater(state.durable_seq, 0)
+        self.assertLessEqual(len(state.replay), 4)
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(n))
+        self.assertEqual(client.health()["tenants"]["t"]["dupes"], 0)
+
+    def test_migration_replays_deep_unacked_tail(self):
+        # nothing was flushed, so every streamed batch is un-durable:
+        # the export carries the WHOLE pipelined tail and the adopting
+        # host replays it in order, exactly once
+        _, _, client_a = self._pair(pipeline_depth=8)
+        client_a.attach("t", SPEC)
+        n = 10
+        for i in range(n):
+            self.assertTrue(client_a.submit("t", *_batch(seed=i)))
+        exported = client_a.export_tenant("t")
+        self.assertEqual(exported["durable_seq"], 0)
+        self.assertEqual(len(exported["replay"]), n)
+        _, _, client_b = self._pair(pipeline_depth=8)
+        attach_b = client_b.attach("t", SPEC)
+        replayed = client_b.adopt_tenant(
+            "t", exported, restored_seq=attach_b["last_seq"]
+        )
+        self.assertEqual(replayed, n)
+        got = float(np.asarray(client_b.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(n))
+        self.assertEqual(client_b.health()["tenants"]["t"]["dupes"], 0)
+
+    def test_gapless_admission_refuses_seq_past_a_hole(self):
+        # the ONE new invariant pipelining rides on: a pipelined frame
+        # sequenced past a still-unadmitted hole (an earlier shed) must
+        # reject retryably instead of ratcheting the dedup watermark
+        # over the lost batch
+        daemon = EvalDaemon().start()
+        self.addCleanup(daemon.stop)
+        handle = daemon.attach(
+            "t", MulticlassAccuracy(num_classes=NUM_CLASSES)
+        )
+        scores, labels = _batch()
+        self.assertTrue(handle.submit(scores, labels, seq=1, gapless=True))
+        with self.assertRaises(BackpressureError) as ctx:
+            handle.submit(scores, labels, seq=3, gapless=True)
+        self.assertEqual(ctx.exception.reason, "seq_gap")
+        self.assertTrue(ctx.exception.retryable)
+        # in-order redelivery heals the hole
+        self.assertTrue(handle.submit(scores, labels, seq=2, gapless=True))
+        self.assertTrue(handle.submit(scores, labels, seq=3, gapless=True))
+        # the non-gapless path keeps its lenient contract (migration
+        # replays against a fresh daemon start above last_seq+1)
+        self.assertTrue(handle.submit(scores, labels, seq=9))
+
+    def test_channel_death_falls_back_and_resends(self):
+        # sever the channel socket mid-stream: the next submit folds the
+        # dirty flag into needs_resend, replays lock-step, and the
+        # stream stays exactly-once
+        _, _, client = self._pair(pipeline_depth=8)
+        client.attach("t", SPEC)
+        n_before = 5
+        for i in range(n_before):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        ch = client._channel
+        self.assertIsNotNone(ch)
+        ch._fail(WireError("transport", "test-severed"))
+        for i in range(n_before, n_before + 3):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(n_before + 3))
+        health = client.health()["tenants"]["t"]
+        # every batch applied exactly once; the dedup counter absorbing
+        # the lock-step resend of already-landed frames IS the recovery
+        # working (dupes counts deduped resends, not double application)
+        self.assertEqual(health["processed"], n_before + 3)
+
+
+class _AckChaosMixin(_PairMixin):
+    ACTION = "ack_delay"
+    EXTRA_ENV = {}
+
+    def setUp(self):
+        chaos.reset_for_tests()
+        self._saved = {
+            k: os.environ.get(k)
+            for k in (
+                "TORCHEVAL_TPU_CHAOS",
+                "TORCHEVAL_TPU_CHAOS_ACTION",
+                "TORCHEVAL_TPU_CHAOS_TENANT",
+                "TORCHEVAL_TPU_CHAOS_STEP",
+                "TORCHEVAL_TPU_CHAOS_DELAY_S",
+            )
+        }
+        os.environ.update(
+            {
+                "TORCHEVAL_TPU_CHAOS": "1",
+                "TORCHEVAL_TPU_CHAOS_ACTION": self.ACTION,
+                "TORCHEVAL_TPU_CHAOS_TENANT": "*",
+                "TORCHEVAL_TPU_CHAOS_STEP": "2",
+                **self.EXTRA_ENV,
+            }
+        )
+
+    def tearDown(self):
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        chaos.reset_for_tests()
+
+    def test_stream_survives_the_ack_fault_bit_identically(self):
+        _, _, client = self._pair(pipeline_depth=4)
+        client.attach("t", SPEC)
+        n = 8
+        for i in range(n):
+            self.assertTrue(client.submit("t", *_batch(seed=i)))
+        got = float(np.asarray(client.compute("t")["acc"]))
+        self.assertEqual(got, _oracle(n))
+        health = client.health()["tenants"]["t"]
+        self.assertEqual(health["processed"], n)
+        self.assertEqual(health["dupes"], 0)
+        self.assertTrue(chaos._ack_fired, "chaos ack action never fired")
+
+
+class TestAckDelayChaos(_AckChaosMixin, unittest.TestCase):
+    """One ack stalls for the delay while LATER frames keep streaming —
+    the client's window, not the ack latency, paces the producer."""
+
+    ACTION = "ack_delay"
+    EXTRA_ENV = {"TORCHEVAL_TPU_CHAOS_DELAY_S": "0.3"}
+
+
+class TestAckReorderChaos(_AckChaosMixin, unittest.TestCase):
+    """Two consecutive acks swap on the wire: folding is keyed by the
+    seq echo and a max over ``acked_seq``, so order cannot matter."""
+
+    ACTION = "ack_reorder"
+
+
+class TestPipelinedConcurrency(_PairMixin, unittest.TestCase):
+    def test_many_producers_one_channel(self):
+        # the Podracer shape: several producer threads, disjoint
+        # tenants, ONE shared channel window — per-tenant ack folding
+        # under each tenant's own lock must not cross wires
+        _, _, client = self._pair(pipeline_depth=8)
+        tenants = [f"t{i}" for i in range(3)]
+        for t in tenants:
+            client.attach(t, SPEC)
+        n = 10
+        errors = []
+
+        def producer(t):
+            try:
+                for i in range(n):
+                    client.submit(t, *_batch(seed=i))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=producer, args=(t,)) for t in tenants
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        self.assertEqual(errors, [])
+        for t in tenants:
+            got = float(np.asarray(client.compute(t)["acc"]))
+            self.assertEqual(got, _oracle(n), t)
+            self.assertEqual(client.health()["tenants"][t]["dupes"], 0, t)
+
+
+if __name__ == "__main__":
+    unittest.main()

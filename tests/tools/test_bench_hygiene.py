@@ -191,6 +191,21 @@ class TestBenchHygiene(unittest.TestCase):
                 "with the unsliced ratio on the same run) loses its "
                 "regression pin",
             )
+        for row in (
+            "config8_cluster_wire_pipelined_1host",
+            "config8_cluster_wire_pipelined_ratio",
+            "config8_cluster_wire_local_transport",
+            "config8_cluster_wire_local_transport_ratio",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the hardware-"
+                "speed wire contract (ISSUE 18 — deferred-ack pipelining "
+                "vs the lock-step wire, and the same-process shared-"
+                "memory transport vs the TCP socket, each paired on the "
+                "same run) loses its regression pin",
+            )
 
     def test_loopback_rows_carry_machine_readable_sandbox_caveat(self):
         # ISSUE 15 satellite (ROADMAP 1a/6): the 1-core loopback artifacts
@@ -206,6 +221,9 @@ class TestBenchHygiene(unittest.TestCase):
         for row in (
             "config8_cluster_wire_codec_gain",
             "config8_cluster_wire_1host_ratio",
+            "config8_cluster_wire_pipelined_ratio",
+            "config6_retrieval_L1M_sharded_ratio",
+            "config11_sliced_1m",
             "config11_sliced_ratio",
             "config11_sliced_1m_sharded_ratio",
             "config12_obs_stream_overhead",
@@ -230,6 +248,47 @@ class TestBenchHygiene(unittest.TestCase):
         )
         self.assertIn("sandbox_caveat", caveated)
         self.assertNotIn("sandbox_caveat", plain)
+
+    def test_caveats_are_consolidated_and_name_remeasurement(self):
+        # ISSUE 18 satellite: ONE registry owns every caveat (both
+        # emitters consult it — no stringly caveat text at emit sites),
+        # rows with run-shape name suffixes match by longest prefix, and
+        # every caveat states the condition under which the number
+        # should be re-measured (otherwise the caveat is an excuse, not
+        # a claim)
+        import importlib.util
+        import io
+        import json
+        from contextlib import redirect_stdout
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_under_test3", os.path.join(_REPO, "bench.py")
+        )
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        for row, text in bench._SANDBOX_CAVEAT_ROWS.items():
+            self.assertIn(
+                "re-measure",
+                text,
+                f"{row}'s sandbox caveat does not name its "
+                "re-measurement condition",
+            )
+        # prefix matching: the suffixed sliced row and the _emit-emitted
+        # (not just _emit_row-emitted) rows both carry the field
+        self.assertIsNotNone(
+            bench._sandbox_caveat("config11_sliced_1m_4096slices")
+        )
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            bench._emit("config11_sliced_1m_4096slices", 100, 1.0, None)
+        self.assertIn(
+            "sandbox_caveat", json.loads(buf.getvalue().splitlines()[0])
+        )
+        # longest prefix wins: the sharded-ratio row keeps its own text
+        self.assertIn(
+            "state_bytes_per_device",
+            bench._sandbox_caveat("config11_sliced_1m_sharded_ratio"),
+        )
 
 
 if __name__ == "__main__":

@@ -55,6 +55,25 @@ families inject here, armed through the environment before launch:
     server), fire once per process, and ignore
     ``TORCHEVAL_TPU_CHAOS_RANK`` (the drill arms each host process with
     its own environment).
+
+    **Ack actions** (fire in ``on_host_ack``, at the eval wire server's
+    *deferred* ack-writer path — the asynchronous acks of the pipelined
+    wire, ISSUE 18; neither kills the process):
+
+    * ``"ack_delay"`` — hold the chosen submit's ack for
+      ``TORCHEVAL_TPU_CHAOS_DELAY_S`` seconds before writing it: the
+      batch is long applied, the producer's in-flight window stays
+      occupied — a slow ack must stall only the window, never corrupt
+      the watermark.
+    * ``"ack_reorder"`` — write the chosen submit's ack AFTER the next
+      ack on the same connection: acks arrive out of seq order, which
+      the client's order-independent ack matching and monotonic
+      durable-watermark fold must absorb bit-identically.
+
+    Ack actions select their ack with ``TORCHEVAL_TPU_CHAOS_TENANT`` and
+    ``TORCHEVAL_TPU_CHAOS_STEP`` (the 1-based index among *submit* acks
+    for the matching tenant, counted process-wide), exactly like host
+    actions, and fire once per process.
 ``TORCHEVAL_TPU_CHAOS_RANK``
     Global process index the fault targets. Required for sync-funnel
     actions (other ranks never act); optional for ingestion actions (when
@@ -119,6 +138,7 @@ _ENV_POISON = "TORCHEVAL_TPU_CHAOS_POISON"
 _SYNC_ACTIONS = ("kill", "delay")
 _INGEST_ACTIONS = ("poison", "ingest_delay")
 _HOST_ACTIONS = ("host_kill", "host_partition", "ack_drop")
+_ACK_ACTIONS = ("ack_delay", "ack_reorder")
 _POISON_KINDS = ("nan", "shape")
 
 
@@ -162,6 +182,8 @@ _rounds_seen = 0
 _ingest_fired = False
 _host_fired = False
 _host_submits_seen: dict = {}  # tenant_id -> submit requests observed
+_ack_fired = False
+_acks_seen: dict = {}  # tenant_id -> submit acks observed
 _lock = threading.Lock()
 
 
@@ -205,6 +227,13 @@ def _resolve() -> object:
                 tenant=os.environ[_ENV_TENANT],
                 step=int(os.environ[_ENV_STEP]),
             )
+        elif action in _ACK_ACTIONS:
+            _config = _ChaosConfig(
+                action,
+                delay_s=delay_s,
+                tenant=os.environ[_ENV_TENANT],
+                step=int(os.environ[_ENV_STEP]),
+            )
         else:
             raise ValueError(f"unknown chaos action {action!r}")
     except (KeyError, ValueError) as e:
@@ -216,13 +245,15 @@ def _resolve() -> object:
 def reset_for_tests() -> None:
     """Re-read the environment and restart the round/step bookkeeping
     (test hook)."""
-    global _config, _rounds_seen, _ingest_fired, _host_fired
+    global _config, _rounds_seen, _ingest_fired, _host_fired, _ack_fired
     with _lock:
         _config = None
         _rounds_seen = 0
         _ingest_fired = False
         _host_fired = False
         _host_submits_seen.clear()
+        _ack_fired = False
+        _acks_seen.clear()
 
 
 def on_sync_round() -> None:
@@ -368,6 +399,65 @@ def on_host_request(op: str, tenant_id: Optional[str]) -> Optional[str]:
         )
         return "partition"
     return "ack_drop"
+
+
+def ack_armed() -> bool:
+    """True when an ack action is armed for this process — the pipelined
+    ack writer's cheap gate (when False, the deferred-ack path never
+    calls :func:`on_host_ack` at all)."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    return cfg is not False and cfg.action in _ACK_ACTIONS
+
+
+def on_host_ack(op: str, tenant_id: Optional[str]) -> Optional[str]:
+    """Called by the eval wire server's deferred ack writer before each
+    pipelined ack leaves. Counts *submit*/*submit_many* acks per tenant
+    (process-wide, under the lock). At the armed tenant's armed step it
+    returns the armed action (``"ack_delay"`` / ``"ack_reorder"``) for
+    the writer to enact — the hook itself never sleeps or kills, so the
+    batch's application is already committed either way. Fires once per
+    process."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    if cfg is False or cfg.action not in _ACK_ACTIONS:
+        return None
+    global _ack_fired
+    if (
+        _ack_fired
+        or op not in ("submit", "submit_many")
+        or tenant_id is None
+    ):
+        return None
+    with _lock:
+        if _ack_fired:
+            return None
+        seen = _acks_seen.get(tenant_id, 0) + 1
+        _acks_seen[tenant_id] = seen
+        if seen != cfg.step or cfg.tenant not in ("*", tenant_id):
+            return None
+        _ack_fired = True
+    if _obs_registry._enabled:
+        _obs_trace.instant(
+            "resilience.chaos",
+            kind="chaos",
+            action=cfg.action,
+            tenant=tenant_id,
+            step=seen,
+        )
+    _logger.warning(
+        "chaos: %s at tenant %r submit ack %d", cfg.action, tenant_id, seen
+    )
+    return cfg.action
+
+
+def ack_delay_s() -> float:
+    """The armed ``ack_delay`` hold, seconds (the writer sleeps, not the
+    hook — see :func:`on_host_ack`)."""
+    cfg = _config
+    return cfg.delay_s if isinstance(cfg, _ChaosConfig) else 30.0
 
 
 def host_die(action: str) -> None:

@@ -31,7 +31,27 @@ structured-error surface a local :class:`TenantHandle` gives:
   ``flush`` (checkpoint-without-evicting) to advance the durable
   watermark and prune. The router migrates a dead host's tenants by
   restoring their checkpoints elsewhere and replaying exactly this
-  buffer's un-durable tail.
+  buffer's un-durable tail;
+* **deferred-ack pipelining** (ISSUE 18) — with ``pipeline_depth > 1``
+  (and a server that granted it at attach), submits stream on a
+  dedicated channel socket up to that many frames ahead of their acks,
+  so producer throughput is bounded by bandwidth instead of round-trip
+  latency. Exactly-once needs no new client invariants: every streamed
+  frame is already booked in the replay buffer, acks ride back
+  asynchronously carrying the same ``acked_seq`` watermark, and any
+  failure (error ack, dead channel, timeout) flags the existing
+  ``needs_resend`` catch-up — the lock-step replay path settles
+  delivery. The server admits pipelined frames *gaplessly* (a seq past
+  a shed hole is rejected retryably), so the dedup watermark can never
+  ratchet over an unapplied batch. Old servers never grant, so mixed
+  versions silently run lock-step — degrade, never break;
+* **shared-memory local transport** (ISSUE 18) — when the server lives
+  in this process, ``submit``/``submit_many`` payloads are handed to it
+  directly: the staging-pool slot (or the immutable payload bytes) IS
+  the buffer the daemon's zero-copy npz views decode from, skipping
+  the socket write+read copy pair. Byte-identical semantics to TCP
+  (same dispatch, same structured errors); TCP is the automatic
+  fallback the moment the endpoint is not locally registered.
 """
 
 from __future__ import annotations
@@ -50,6 +70,7 @@ from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.serve.errors import ServeError, WireError
 from torcheval_tpu.serve.wire import (
     decode_error,
+    local_server,
     pack_tree,
     pack_tree_parts,
     recv_frame,
@@ -174,6 +195,301 @@ class ObsSubscription:
             thread.join(timeout=5.0)
 
 
+class _PipelinedChannel:
+    """One deferred-ack submit stream to a host (ISSUE 18).
+
+    A dedicated socket (outside the request pool) carries up to
+    ``depth`` un-acked ``submit``/``submit_many`` frames; a reader
+    thread parks each ack under the channel condition and holders of a
+    TENANT's state lock fold their own parked acks in
+    (:meth:`fold_locked`). The reader never takes a tenant lock, so the
+    ack path and the submit path have no lock-order coupling — a
+    submitter blocked on the window cannot deadlock the reader that
+    would free it.
+
+    Failure model: any socket error, EOF, or window-wait timeout kills
+    the WHOLE channel (``_fail``) — every tenant with frames still in
+    flight is marked dirty and folds into ``needs_resend`` on its next
+    ``fold_locked``, after which the lock-step replay path settles
+    delivery exactly-once (server-side gapless admission guarantees the
+    dedup watermark never passed the hole). The owning client just
+    opens a fresh channel on the next submit.
+    """
+
+    def __init__(
+        self, sock: socket.socket, depth: int, endpoint: str
+    ) -> None:
+        self._sock = sock
+        self.depth = depth
+        self.endpoint = endpoint
+        self._cv = threading.Condition()
+        self._send_lock = threading.Lock()
+        # (tenant_id, seq-tuple) -> True for every streamed, un-acked
+        # frame; the dict size is the window occupancy
+        self._inflight: Dict[Tuple[str, tuple], bool] = {}
+        # tenant_id -> parked ack headers, folded by state.lock holders
+        self._pending: Dict[str, List[Dict[str, Any]]] = {}
+        self._dead: Optional[BaseException] = None
+        # tenants that had frames in flight when the channel died: their
+        # next fold flags needs_resend
+        self._dirty: set = set()
+        self._reader = threading.Thread(
+            target=self._read_loop,
+            name="torcheval-tpu-pipeline-acks",
+            daemon=True,
+        )
+        self._reader.start()
+
+    @property
+    def alive(self) -> bool:
+        with self._cv:
+            return self._dead is None
+
+    # ---------------------------------------------------------- reader side
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                frame = recv_frame(self._sock)
+            except (OSError, WireError) as e:
+                self._fail(e)
+                return
+            if frame is None:
+                self._fail(
+                    WireError(
+                        "transport",
+                        f"{self.endpoint} closed the pipeline channel.",
+                        endpoint=self.endpoint,
+                    )
+                )
+                return
+            header, _payload = frame
+            tenant = str(header.get("tenant"))
+            seqs = header.get("seqs")
+            if seqs is None:
+                seqs = [header.get("seq")]
+            try:
+                key = (tenant, tuple(int(s) for s in seqs))
+            except (TypeError, ValueError):
+                key = (tenant, ())
+            with self._cv:
+                self._inflight.pop(key, None)
+                self._pending.setdefault(tenant, []).append(header)
+                self._cv.notify_all()
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._cv:
+            self._fail_locked(exc)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _fail_locked(self, exc: BaseException) -> None:
+        if self._dead is None:
+            self._dead = exc
+        for tenant, _seqs in self._inflight:
+            self._dirty.add(tenant)
+        self._inflight.clear()
+        self._cv.notify_all()
+        try:
+            # wake the reader if it is parked in recv
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    # -------------------------------------------------------- tenant folding
+    @staticmethod
+    def _fold_acks(
+        state: "_ClientTenant", acks: List[Dict[str, Any]], dirty: bool
+    ) -> None:
+        for header in acks:
+            if header.get("ok"):
+                state.durable_seq = max(
+                    state.durable_seq, int(header.get("acked_seq", 0))
+                )
+            else:
+                # a structured reject mid-pipeline: the frame's batches
+                # (and, through gapless admission, everything streamed
+                # after them) stay booked — lock-step replay settles it
+                state.needs_resend = True
+        if dirty:
+            state.needs_resend = True
+        while state.replay and state.replay[0][0] <= state.durable_seq:
+            state.replay.popleft()
+
+    def fold_locked(self, tenant_id: str, state: "_ClientTenant") -> None:
+        """Fold this tenant's parked acks into its wire state (caller
+        holds ``state.lock``). Never raises and never blocks on the
+        socket: an error ack or a dead channel just flags
+        ``needs_resend`` for the caller's catch-up path."""
+        with self._cv:
+            acks = self._pending.pop(tenant_id, [])
+            dirty = tenant_id in self._dirty
+            self._dirty.discard(tenant_id)
+        self._fold_acks(state, acks, dirty)
+
+    # ---------------------------------------------------------- submit side
+    def send(
+        self,
+        tenant_id: str,
+        state: "_ClientTenant",
+        header: Dict[str, Any],
+        payload: Any,
+        timeout_s: Optional[float],
+    ) -> None:
+        """Stream one already-BOOKED frame, waiting (bounded by
+        ``timeout_s``) for window space. Caller holds ``state.lock``.
+        Raises ``WireError`` with ``request_sent=True`` on channel
+        death/timeout — the caller marks ``needs_resend`` and
+        ``batch_booked`` exactly like an ambiguous lock-step submit."""
+        seqs = header.get("seqs")
+        key = (
+            tenant_id,
+            tuple(seqs) if seqs is not None else (header["seq"],),
+        )
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        with self._cv:
+            while (
+                self._dead is None and len(self._inflight) >= self.depth
+            ):
+                remaining = (
+                    None
+                    if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    # a window that never frees means the host stopped
+                    # acking: poison the channel so every tenant's next
+                    # fold goes through the resend path
+                    self._fail_locked(
+                        WireError(
+                            "request_timeout",
+                            f"pipeline window to {self.endpoint} did not "
+                            f"free within {timeout_s}s.",
+                            endpoint=self.endpoint,
+                        )
+                    )
+                    break
+                self._cv.wait(
+                    timeout=0.5 if remaining is None else min(remaining, 0.5)
+                )
+            if self._dead is not None:
+                err = WireError(
+                    "transport",
+                    f"pipeline channel to {self.endpoint} is down: "
+                    f"{self._dead}",
+                    endpoint=self.endpoint,
+                )
+                err.request_sent = True
+                raise err
+            self._inflight[key] = True
+            if _obs._enabled:
+                occupancy = sum(
+                    1 for t, _s in self._inflight if t == tenant_id
+                )
+                _obs.histo(
+                    "serve.client.inflight",
+                    float(occupancy),
+                    tenant=tenant_id,
+                )
+        try:
+            with self._send_lock:
+                if isinstance(payload, tuple):
+                    send_frame_parts(self._sock, header, *payload)
+                else:
+                    send_frame(self._sock, header, payload)
+        except OSError as e:
+            with self._cv:
+                self._inflight.pop(key, None)
+            self._fail(e)
+            err = WireError(
+                "transport",
+                f"pipelined {header.get('op')} to {self.endpoint} "
+                f"failed: {e}",
+                endpoint=self.endpoint,
+            )
+            err.request_sent = True
+            raise err from e
+
+    def wait_idle(
+        self,
+        tenant_id: str,
+        state: "_ClientTenant",
+        timeout_s: Optional[float],
+    ) -> None:
+        """Block until no frames for ``tenant_id`` are in flight, then
+        fold its parked acks (caller holds ``state.lock``). Never
+        raises: a timeout poisons the channel, which the fold turns
+        into ``needs_resend``."""
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        with self._cv:
+            while self._dead is None and any(
+                t == tenant_id for t, _s in self._inflight
+            ):
+                remaining = (
+                    None
+                    if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    self._fail_locked(
+                        WireError(
+                            "request_timeout",
+                            f"pipelined tail for tenant {tenant_id!r} was "
+                            f"not acked within {timeout_s}s.",
+                            endpoint=self.endpoint,
+                        )
+                    )
+                    break
+                self._cv.wait(
+                    timeout=0.5 if remaining is None else min(remaining, 0.5)
+                )
+        self.fold_locked(tenant_id, state)
+
+    def forget(self, tenant_id: str) -> None:
+        """Drop every record of ``tenant_id`` (export/migration: the
+        replay buffer travels; stale acks and window slots must not)."""
+        with self._cv:
+            self._pending.pop(tenant_id, None)
+            self._dirty.discard(tenant_id)
+            stale = [k for k in self._inflight if k[0] == tenant_id]
+            for k in stale:
+                del self._inflight[k]
+            if stale:
+                self._cv.notify_all()
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Give in-flight frames a bounded grace to drain, then sever.
+        Un-acked frames stay booked in their replay buffers — the safe
+        state for a closing client (a future adopt replays them)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._dead is None and self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=min(remaining, 0.5))
+            if self._dead is None:
+                self._dead = ServeError(
+                    "client_closed", "EvalClient is closed."
+                )
+            self._cv.notify_all()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=2.0)
+
+
 class EvalClient:
     """Wire client for one eval-service host. See module doc.
 
@@ -197,6 +513,8 @@ class EvalClient:
         replay_capacity: int = 64,
         submit_buffer: int = 1,
         codec: Optional[str] = None,
+        pipeline_depth: int = 1,
+        local_transport: bool = True,
     ) -> None:
         from torcheval_tpu.metrics.toolkit import _check_timeout_s
 
@@ -217,6 +535,7 @@ class EvalClient:
             ("breaker_threshold", breaker_threshold, 1),
             ("replay_capacity", replay_capacity, 1),
             ("submit_buffer", submit_buffer, 1),
+            ("pipeline_depth", pipeline_depth, 1),
         ):
             if not isinstance(value, int) or value < floor:
                 raise ValueError(
@@ -268,6 +587,21 @@ class EvalClient:
         # buffer at submit() time, so the reliability story is unchanged:
         # anything unsent or unacked is redelivered by replay + dedup.
         self.submit_buffer = min(submit_buffer, replay_capacity)
+        # deferred-ack pipelining (ISSUE 18): >1 ASKS the server at
+        # attach for a streamed-submit window this deep; the grant (the
+        # min of both sides, PR 12 negotiation discipline) drives a
+        # dedicated channel socket opened lazily on the first submit.
+        # 1 keeps today's lock-step request-response wire.
+        self.pipeline_depth = min(pipeline_depth, replay_capacity)
+        # same-host fast path (ISSUE 18): hand submit payloads to an
+        # in-process server directly instead of round-tripping the
+        # loopback socket. Auto-selected per call; False forces TCP
+        # (benchmarks measuring the socket path want the real wire).
+        self._local_transport = bool(local_transport)
+        self._pipeline_granted = 0
+        self._pipeline_unsupported = False
+        self._channel: Optional[_PipelinedChannel] = None
+        self._channel_lock = threading.Lock()
         self._inflight = threading.BoundedSemaphore(max_in_flight)
         self._lock = threading.Lock()
         self._pool: List[socket.socket] = []
@@ -329,6 +663,12 @@ class EvalClient:
             self._closed = True
             pool, self._pool = self._pool, []
             subs, self._subscriptions = self._subscriptions, []
+        with self._channel_lock:
+            ch, self._channel = self._channel, None
+        if ch is not None:
+            # bounded grace for the in-flight tail; anything un-acked
+            # stays booked in its replay buffer (adopt replays it)
+            ch.close()
         for sub in subs:
             sub.stop()
         for sock in pool:
@@ -459,6 +799,32 @@ class EvalClient:
         payload: bytes,
         timeout_s: Optional[float],
     ) -> Tuple[Dict[str, Any], bytes]:
+        if self._local_transport and header.get("op") in (
+            "submit",
+            "submit_many",
+        ):
+            server = local_server(self.endpoint)
+            if server is not None:
+                # same-host fast path: the payload (or the staging slot
+                # it is assembled into) IS the buffer the daemon
+                # decodes — no socket, no frame codec, no copy pair.
+                # Structured rejects come back as the same ok=False
+                # response frames, so the caller's retry/un-book logic
+                # is transport-agnostic.
+                with self._inflight:
+                    try:
+                        return server.local_request(dict(header), payload)
+                    except OSError as e:
+                        err = WireError(
+                            "transport",
+                            f"local transport to {self.endpoint} "
+                            f"failed: {e}",
+                            endpoint=self.endpoint,
+                        )
+                        # the dispatch may have run before a partition
+                        # tripped; ambiguous, like any failed send
+                        err.request_sent = True
+                        raise err from e
         with self._inflight:
             try:
                 sock = self._checkout()
@@ -612,9 +978,25 @@ class EvalClient:
                 if self._codec_pref == "qblk"
                 else ["delta"]
             )
+        if self.pipeline_depth >= 2:
+            # same handshake discipline as the codec offer: the server
+            # grants min(ask, its own cap) in the response, an old
+            # server ignores the field entirely — either way the wire
+            # degrades to lock-step with no protocol error
+            req["pipeline"] = self.pipeline_depth
         header, _ = self._call("attach", req, timeout_s=timeout_s)
         last_seq = int(header.get("last_seq", 0))
         codec = str(header.get("codec") or "raw")
+        granted = header.get("pipeline")
+        if (
+            isinstance(granted, int)
+            and not isinstance(granted, bool)
+            and granted >= 2
+        ):
+            with self._channel_lock:
+                self._pipeline_granted = max(
+                    self._pipeline_granted, granted
+                )
         with self._lock:
             self._tenants[tenant_id] = _ClientTenant(last_seq, codec)
         return {"last_seq": last_seq, "codec": codec}
@@ -629,6 +1011,109 @@ class EvalClient:
             )
         return state
 
+    # ------------------------------------------------------ pipeline channel
+    def _pipeline_channel(
+        self, timeout_s: Any
+    ) -> Optional[_PipelinedChannel]:
+        """The live deferred-ack channel, opening one lazily. ``None``
+        means this call runs lock-step: pipelining was never granted at
+        attach, the peer rejected ``pipeline_open`` (an old or
+        pipeline-disabled server — remembered, never re-probed), the
+        endpoint is served in-process (the local transport already
+        skips the round trip a window would overlap), or the open
+        itself hit transport trouble (the lock-step path owns the
+        breaker/retry story)."""
+        if self._pipeline_granted < 2 or self._pipeline_unsupported:
+            return None
+        if (
+            self._local_transport
+            and local_server(self.endpoint) is not None
+        ):
+            return None
+        with self._channel_lock:
+            old = self._channel
+            if old is not None and old.alive:
+                return old
+            # a dead channel STAYS registered until a live replacement
+            # exists: its parked acks and dirty flags must keep feeding
+            # sync-point folds if this open attempt fails
+            try:
+                sock = socket.create_connection(
+                    self._addr, timeout=self._connect_timeout_s
+                )
+            except OSError:
+                return None
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            try:
+                sock.settimeout(self._effective_timeout(timeout_s))
+                send_frame(
+                    sock,
+                    {
+                        "op": "pipeline_open",
+                        "depth": self._pipeline_granted,
+                    },
+                )
+                frame = recv_frame(sock)
+            except (OSError, WireError):
+                self._discard(sock)
+                return None
+            if frame is None:
+                self._discard(sock)
+                return None
+            header, _payload = frame
+            if not header.get("ok"):
+                self._discard(sock)
+                err = decode_error(header.get("error", {}))
+                if (
+                    isinstance(err, WireError)
+                    and getattr(err, "reason", None) == "protocol"
+                ):
+                    # PR 12 discipline: an old peer degrades the wire to
+                    # lock-step for the client's lifetime, never breaks
+                    self._pipeline_unsupported = True
+                return None
+            try:
+                depth = int(header.get("depth", 0))
+            except (TypeError, ValueError):
+                depth = 0
+            if depth < 2:
+                self._discard(sock)
+                self._pipeline_unsupported = True
+                return None
+            sock.settimeout(None)  # acks arrive on the server's schedule
+            ch = _PipelinedChannel(sock, depth, self.endpoint)
+            if old is not None:
+                # carry the dead channel's unfolded bookkeeping over:
+                # parked acks and needs-resend flags must survive the
+                # swap, or a tenant that never submits again (compute
+                # only) would miss its error acks at the sync point
+                with old._cv:
+                    pend, old._pending = old._pending, {}
+                    dirty, old._dirty = set(old._dirty), set()
+                with ch._cv:
+                    for t, acks in pend.items():
+                        ch._pending.setdefault(t, []).extend(acks)
+                    ch._dirty |= dirty
+            self._channel = ch
+            return ch
+
+    def _channel_quiesce_locked(
+        self, tenant_id: str, state: _ClientTenant, timeout_s: Any
+    ) -> None:
+        """Drain + fold this tenant's pipelined in-flight tail (no-op
+        without a channel; caller holds ``state.lock``). Leaves
+        ``needs_resend`` set when an ack reported an error or the
+        channel died — the caller's resend path settles delivery."""
+        with self._channel_lock:
+            ch = self._channel
+        if ch is not None:
+            ch.wait_idle(
+                tenant_id, state, self._effective_timeout(timeout_s)
+            )
+
     def submit(
         self, tenant_id: str, *args: Any, timeout_s: Any = _UNSET
     ) -> bool:
@@ -637,11 +1122,11 @@ class EvalClient:
         and retries transparently (dedup makes resends exactly-once).
         Returns ``True`` if this call's send was applied, ``False`` if
         the server had it already (a prior ambiguous attempt landed).
-        Under ``submit_buffer > 1`` the return is always ``True`` (the
-        batch is BOOKED; the server's per-batch dedup verdicts ride the
-        coalesced frame's ack and are not reported per call) — callers
-        that need the per-batch applied signal use an unbuffered
-        client."""
+        Under ``submit_buffer > 1`` or an active pipeline channel the
+        return is always ``True`` (the batch is BOOKED; the server's
+        per-batch dedup verdicts ride the coalesced or deferred ack and
+        are not reported per call) — callers that need the per-batch
+        applied signal use an unbuffered lock-step client."""
         state = self._tenant_state(tenant_id)
         np_args = tuple(np.asarray(a) for a in args)
         with state.lock:
@@ -652,14 +1137,30 @@ class EvalClient:
                     "mid-call; re-route and resubmit (the batch was not "
                     "booked).",
                 )
+            ch = self._pipeline_channel(timeout_s)
             try:
+                if ch is not None:
+                    # fold parked acks first: an error ack must flip
+                    # needs_resend BEFORE this call sequences past it
+                    ch.fold_locked(tenant_id, state)
                 if state.needs_resend:
+                    self._channel_quiesce_locked(
+                        tenant_id, state, timeout_s
+                    )
                     self._resend_locked(tenant_id, state, timeout_s)
                 if len(state.replay) >= self.replay_capacity:
-                    # replay valve: checkpoint server-side to advance the
-                    # durable watermark, then prune — the buffer stays
-                    # bounded without ever dropping a non-durable batch
-                    self._flush_locked(tenant_id, state, timeout_s)
+                    # replay valve: drain the pipelined tail first (its
+                    # acks alone may free the buffer), then checkpoint
+                    # server-side to advance the durable watermark and
+                    # prune — the buffer stays bounded without ever
+                    # dropping a non-durable batch
+                    self._channel_quiesce_locked(
+                        tenant_id, state, timeout_s
+                    )
+                    if state.needs_resend:
+                        self._resend_locked(tenant_id, state, timeout_s)
+                    if len(state.replay) >= self.replay_capacity:
+                        self._flush_locked(tenant_id, state, timeout_s)
             except (WireError, ServeError) as e:
                 # pre-booking failure: earlier BOOKED entries redeliver
                 # through replay, but THIS call's batch was never booked —
@@ -691,6 +1192,33 @@ class EvalClient:
             seq = state.next_seq
             state.next_seq += 1
             state.replay.append((seq, np_args))
+            if ch is not None:
+                wire_header = self._submit_header(
+                    tenant_id, state.codec, seq=seq, args=spec
+                )
+                wire_header["op"] = "submit"
+                # the bound the server's gapless admission blocks under
+                wire_header["timeout"] = self._effective_timeout(
+                    timeout_s
+                )
+                try:
+                    ch.send(
+                        tenant_id,
+                        state,
+                        wire_header,
+                        blob,
+                        self._effective_timeout(timeout_s),
+                    )
+                except WireError as e:
+                    # ambiguous, exactly like the lock-step transport
+                    # branch: the frame may be on the wire — booked +
+                    # needs_resend settle it at the next call
+                    state.needs_resend = True
+                    e.batch_booked = True
+                    raise
+                # streamed: the ack rides back asynchronously and folds
+                # at the next submit/flush/compute; True means BOOKED
+                return True
             ambiguity: dict = {}
             try:
                 header, _ = self._call(
@@ -811,6 +1339,26 @@ class EvalClient:
         self._account_payload(
             state.codec, [args for _seq, args in take], total
         )
+        ch = self._pipeline_channel(timeout_s)
+        if ch is not None:
+            wire_header = self._submit_header(
+                tenant_id, state.codec, seqs=seqs, args=spec
+            )
+            wire_header["op"] = "submit_many"
+            wire_header["timeout"] = self._effective_timeout(timeout_s)
+            try:
+                ch.send(
+                    tenant_id,
+                    state,
+                    wire_header,
+                    (parts, total),
+                    self._effective_timeout(timeout_s),
+                )
+            except WireError as e:
+                state.needs_resend = True
+                e.batch_booked = True
+                raise
+            return  # the deferred ack folds at the next sync point
         try:
             header, _ = self._call(
                 "submit_many",
@@ -836,11 +1384,15 @@ class EvalClient:
         FIRST: a failed coalesced drain empties the send tail but leaves
         its batches booked in the replay buffer, and those must redeliver
         too — a ``submit()`` that returned ``True`` may never silently
-        miss a compute. Buffered clients only (``submit_buffer > 1``):
-        the unbuffered client's long-standing semantics — a FAILED
-        submit's hole redelivers at the next submit/flush, not at
-        compute — stay exactly as they were."""
-        if self.submit_buffer <= 1:
+        miss a compute. Buffered (``submit_buffer > 1``) and pipelined
+        (a channel was opened) clients only: both return ``True`` for
+        batches still on their way, so the sync point must land them.
+        The unbuffered lock-step client's long-standing semantics — a
+        FAILED submit's hole redelivers at the next submit/flush, not
+        at compute — stay exactly as they were."""
+        with self._channel_lock:
+            pipelined = self._channel is not None
+        if self.submit_buffer <= 1 and not pipelined:
             return
         with self._lock:
             state = self._tenants.get(tenant_id)
@@ -849,10 +1401,15 @@ class EvalClient:
         with state.lock:
             if state.migrated:
                 return
+            self._channel_quiesce_locked(tenant_id, state, timeout_s)
             if state.needs_resend:
                 self._resend_locked(tenant_id, state, timeout_s)
-            elif state.sendbuf:
+            if state.sendbuf:
                 self._drain_sendbuf_locked(tenant_id, state, timeout_s)
+                # a pipelined drain only STREAMS the tail; land it
+                self._channel_quiesce_locked(tenant_id, state, timeout_s)
+                if state.needs_resend:
+                    self._resend_locked(tenant_id, state, timeout_s)
 
     def flush(self, tenant_id: str, *, timeout_s: Any = _UNSET) -> dict:
         """Checkpoint the tenant server-side (no eviction), advance the
@@ -866,6 +1423,7 @@ class EvalClient:
                     f"tenant {tenant_id!r} was migrated off this host "
                     "mid-call; re-route.",
                 )
+            self._channel_quiesce_locked(tenant_id, state, timeout_s)
             if state.needs_resend:
                 self._resend_locked(tenant_id, state, timeout_s)
             return self._flush_locked(tenant_id, state, timeout_s)
@@ -915,8 +1473,14 @@ class EvalClient:
         self, tenant_id: str, state: _ClientTenant, timeout_s: Any
     ) -> dict:
         # the durable watermark a flush advances must cover the booked
-        # tail: ship any coalesced unsent entries first
+        # tail: ship any coalesced unsent entries, then land the
+        # pipelined in-flight window (gapless admission keeps pruning
+        # safe regardless — the server watermark can never pass a hole
+        # — but the replay-valve caller needs the watermark to MOVE)
         self._drain_sendbuf_locked(tenant_id, state, timeout_s)
+        self._channel_quiesce_locked(tenant_id, state, timeout_s)
+        if state.needs_resend:
+            self._resend_locked(tenant_id, state, timeout_s)
         header, _ = self._call(
             "flush",
             {
@@ -1203,8 +1767,18 @@ class EvalClient:
                 "unknown_tenant",
                 f"tenant {tenant_id!r} is not attached through this client.",
             )
+        with self._channel_lock:
+            ch = self._channel
         with state.lock:
             state.migrated = True
+            if ch is not None:
+                # parked acks tighten the exported watermark (less to
+                # replay); then drop the channel's window slots so a
+                # deep un-acked tail cannot hold the window hostage —
+                # the tail is booked in the replay buffer and the NEW
+                # host's adopt replays it (old-host acks are moot)
+                ch.fold_locked(tenant_id, state)
+                ch.forget(tenant_id)
             # coalesced unsent entries are booked in the replay buffer,
             # so the export carries them; the new host's replay delivers
             state.sendbuf.clear()

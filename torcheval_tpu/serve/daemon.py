@@ -681,6 +681,7 @@ class EvalDaemon:
         timeout: Optional[float],
         seq: Optional[int] = None,
         stage: Any = None,
+        gapless: bool = False,
     ) -> bool:
         """Admit one batch. ``seq`` is the wire client's per-tenant
         monotonic sequence number: a submit at or below the tenant's
@@ -697,7 +698,20 @@ class EvalDaemon:
         is released after the worker's device placement, or released
         RIGHT HERE on every path that does not enqueue (dedup, shed,
         drain reject, dead tenant) — a shed batch must never leak its
-        staging slot."""
+        staging slot.
+
+        ``gapless`` (ISSUE 18, set by the pipelined wire path) enforces
+        contiguous per-tenant admission: a ``seq`` ABOVE ``last admitted
+        + 1`` is refused with a retryable ``seq_gap`` reject instead of
+        admitted. With several frames of one tenant in flight at once,
+        admitting past a hole (an earlier seq that shed) would ratchet
+        the dedup watermark over it — the eventual replay of the missing
+        seq would then read as a duplicate and be silently swallowed.
+        The refusal makes every out-of-order interleaving self-healing:
+        nothing lands past the hole, the client's resend redelivers the
+        tail in order. Lock-step submits never set it (they are
+        contiguous by construction, and migration tests drive fresh
+        daemons at restored watermarks the daemon never saw)."""
         t0 = time.perf_counter()
         deadline = (
             time.monotonic() + timeout
@@ -721,6 +735,24 @@ class EvalDaemon:
                                 "serve.ingest.dupes", tenant=tenant.id
                             )
                         return False
+                    if (
+                        gapless
+                        and seq is not None
+                        and seq > tenant.last_seq + 1
+                    ):
+                        # pipelined out-of-order arrival (docstring):
+                        # refuse rather than ratchet the watermark over
+                        # the hole; no capacity consumed, no shed counted
+                        # against the tenant — the earlier seq's failure
+                        # already was
+                        raise BackpressureError(
+                            "seq_gap",
+                            f"tenant {tenant.id!r}: seq {seq} arrived with "
+                            f"seq {tenant.last_seq + 1} still unadmitted; "
+                            "redeliver in order (an earlier pipelined "
+                            "frame shed or failed).",
+                            tenant=tenant.id,
+                        )
                     if self._draining:
                         raise ServeError(
                             "draining",

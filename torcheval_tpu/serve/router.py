@@ -28,6 +28,14 @@ stance: host loss and draining are absorbed, not outages):
   then migrates them the same way; use it before planned maintenance so
   the "un-acked tail" is empty and the blackout is one restore long.
 
+Transport knobs ride through ``**client_kwargs`` to every per-host
+client: ``pipeline_depth=`` turns on ISSUE 18's deferred-ack submit
+pipelining against hosts that grant it (a migrated tenant's replay
+drains through the ordinary lock-step path first, then new submits
+pipeline to the survivor), and ``local_transport=False`` forces TCP
+even when a fronted server shares this process (the bench's migration
+leg pins it off so the blackout measured is the wire's).
+
 Observability: ``serve.router.migrations{reason=}``,
 ``serve.router.replays{tenant=}`` (counted at the replaying client),
 ``serve.router.probe_failures{endpoint=}``, plus a

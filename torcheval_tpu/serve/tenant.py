@@ -176,6 +176,7 @@ class TenantHandle:
         timeout: Optional[float] = None,
         seq: Optional[int] = None,
         stage: Any = None,
+        gapless: bool = False,
     ) -> bool:
         """Enqueue one update batch (the metric ``update`` positional
         args). Returns once queued; the device work happens on the daemon
@@ -191,10 +192,13 @@ class TenantHandle:
         daemon, which releases it on EVERY path — after the batch's
         device placement, or immediately when the batch is deduplicated,
         shed, or dropped with a quarantined tenant. Returns ``True`` when
-        the batch was admitted."""
+        the batch was admitted. ``gapless`` (the pipelined wire path,
+        ISSUE 18) additionally refuses a ``seq`` past a still-unadmitted
+        hole with a retryable ``seq_gap`` reject — see
+        ``EvalDaemon._submit``."""
         return self._daemon._submit(
             self._tenant, args, block=block, timeout=timeout, seq=seq,
-            stage=stage,
+            stage=stage, gapless=gapless,
         )
 
     def flush(self, *, timeout: Optional[float] = None) -> dict:

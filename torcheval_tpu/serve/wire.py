@@ -57,6 +57,20 @@ discipline). Slow subscribers are bounded by the socket send buffer
 plus a send timeout: a push that cannot be written in time is dropped
 WITH the subscriber (counted in ``obs.stream.dropped``) — a wedged
 scraper can never grow daemon-side memory or block a drain.
+
+**Deferred-ack pipelining + local transport (ISSUE 18).** A client that
+negotiated a pipeline window at attach opens a dedicated channel with
+``pipeline_open``; the ack flips that connection to deferred-ack service
+(:meth:`EvalServer._serve_pipelined`): the connection's reader thread
+keeps draining frames into a bounded queue while a writer thread
+dispatches them and ships acks as batches commit — up to the granted
+``depth`` submit frames ride the wire un-acked, each ack echoing the
+frame's ``tenant`` + ``seq``/``seqs`` plus the durable watermark.
+Lock-step request-response is unchanged and remains the path for every
+non-submit op. Same-process clients skip sockets entirely:
+:meth:`EvalServer.local_request` hands the payload across as host
+memory (the staging-pool slot IS the buffer the daemon decodes — see
+the method doc for the aliasing contract).
 """
 
 from __future__ import annotations
@@ -129,6 +143,25 @@ _MAGIC = b"TEW1"
 _HEAD = struct.Struct(">4sIQ")
 _MAX_HEADER_BYTES = 16 << 20
 _MAX_PAYLOAD_BYTES = 1 << 31
+
+# ---------------------------------------------------------- local transport
+# Same-process server registry (ISSUE 18): an EvalServer registers its
+# endpoint at bind time so an EvalClient constructed in the SAME process
+# can hand request payloads across as host memory (EvalServer.local_request)
+# instead of copying them through the loopback socket. Registration is
+# keyed by the exact "host:port" endpoint string the client dials, and a
+# closed server deregisters — a client that finds nothing here (or races
+# a close) simply speaks TCP, byte-identical.
+_LOCAL_SERVERS: Dict[str, "EvalServer"] = {}
+_LOCAL_SERVERS_LOCK = threading.Lock()
+
+
+def local_server(endpoint: str) -> Optional["EvalServer"]:
+    """The same-process :class:`EvalServer` bound at ``endpoint``, or
+    ``None`` — the client's per-request gate for the shared-memory local
+    transport."""
+    with _LOCAL_SERVERS_LOCK:
+        return _LOCAL_SERVERS.get(endpoint)
 
 
 # ------------------------------------------------------------------ framing
@@ -828,6 +861,7 @@ class EvalServer:
         port: int = 0,
         backlog: int = 32,
         codecs: Tuple[str, ...] = WIRE_CODECS,
+        pipeline_depth: int = 32,
     ) -> None:
         from torcheval_tpu.serve.ingest import HostBufferPool
 
@@ -836,6 +870,17 @@ class EvalServer:
         # attach; ``codecs=()`` models a raw-only peer — used by the
         # mixed-version interop tests, and a safe rollback knob)
         self._codecs = tuple(codecs)
+        # max in-flight submit frames this server grants per pipelined
+        # connection (ISSUE 18). The grant at attach is
+        # min(client ask, this); ``pipeline_depth < 2`` never grants and
+        # rejects ``pipeline_open`` as an unknown op — exactly how an
+        # old server answers, so it doubles as the mixed-version rollback
+        # knob (clients silently stay lock-step)
+        if not isinstance(pipeline_depth, int) or pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be an int >= 0, got {pipeline_depth!r}."
+            )
+        self._pipeline_depth = pipeline_depth
         # shared staging pool: frame payloads land here and decode as
         # zero-copy views; slots recycle under the ingest aliasing
         # contract (serve/ingest.py)
@@ -858,6 +903,10 @@ class EvalServer:
             daemon=True,
         )
         self._accept_thread.start()
+        # same-process shared-memory transport (module comment at
+        # _LOCAL_SERVERS): visible to clients only once fully constructed
+        with _LOCAL_SERVERS_LOCK:
+            _LOCAL_SERVERS[self.endpoint] = self
 
     @property
     def endpoint(self) -> str:
@@ -869,6 +918,9 @@ class EvalServer:
         sockets, not a listener that answers on old connections). Obs
         subscribers get a best-effort final push first."""
         self._running = False
+        with _LOCAL_SERVERS_LOCK:
+            if _LOCAL_SERVERS.get(self.endpoint) is self:
+                del _LOCAL_SERVERS[self.endpoint]
         try:
             self._sock.close()
         except OSError:
@@ -976,6 +1028,14 @@ class EvalServer:
                     handed_over = True
                     pub.start()
                     return
+                if response[0].get("ok") and response[0].get("pipelined"):
+                    # ack sent: the connection switches to deferred-ack
+                    # service (ISSUE 18) — this thread keeps reading
+                    # frames while a writer thread acks them as they
+                    # commit. Returns when the peer goes away; the
+                    # finally below closes the socket as usual.
+                    self._serve_pipelined(conn, int(response[0]["depth"]))
+                    return
         finally:
             if not handed_over:
                 with self._lock:
@@ -984,6 +1044,165 @@ class EvalServer:
                     conn.close()
                 except OSError:
                     pass
+
+    def _serve_pipelined(self, conn: socket.socket, depth: int) -> None:
+        """Deferred-ack service for one connection (ISSUE 18): this
+        thread keeps READING frames while a writer thread dispatches
+        them and sends acks back as batches commit — frame-receive and
+        ack-send are decoupled, so up to ``depth`` frames ride the
+        connection at once. The queue bound IS the server-side window:
+        a slow dispatcher fills it, the reader stops draining the
+        socket, and TCP backpressure holds the client's window — bounded
+        memory per connection with no extra protocol machinery. Each ack
+        echoes the frame's ``tenant`` and ``seq``/``seqs`` so the client
+        matches order-independently; chaos ack actions (ack_delay /
+        ack_reorder) inject at the ack write, the exact surface a real
+        slow or reordered ack presents."""
+        import queue as _queue
+
+        frames: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        dead = threading.Event()
+
+        def _ack_writer() -> None:
+            held: Optional[Tuple[Dict[str, Any], bytes]] = None
+            while True:
+                item = frames.get()
+                if item is None:
+                    break
+                header, payload, stage = item
+                if dead.is_set() or self._partitioned:
+                    if stage is not None:
+                        stage.release()
+                    continue
+                # pipelined admission is gapless (EvalDaemon._submit):
+                # with several frames of one tenant in flight, a seq
+                # admitted past a shed hole would ratchet the dedup
+                # watermark over it — tag every frame so the daemon
+                # refuses out-of-order admission instead
+                header = dict(header)
+                header["gapless"] = True
+                response = self._dispatch(header, payload, stage)
+                if response is None:
+                    continue  # partition tripped ON this request
+                ack = dict(response[0])
+                for key in ("tenant", "seq", "seqs"):
+                    if key in header:
+                        ack[key] = header[key]
+                directive = None
+                if _chaos.ack_armed():
+                    directive = _chaos.on_host_ack(
+                        str(header.get("op", "?")), header.get("tenant")
+                    )
+                if directive == "ack_delay":
+                    time.sleep(_chaos.ack_delay_s())
+                try:
+                    if directive == "ack_reorder" and held is None:
+                        held = (ack, response[1])
+                        continue
+                    self._write_ack(conn, ack, response[1])
+                    if held is not None:
+                        (ack, blob), held = held, None
+                        self._write_ack(conn, ack, blob)
+                except OSError:
+                    # peer gone: stop answering, sever the socket so the
+                    # reader wakes, and KEEP draining the queue (frames
+                    # already read must still release their stages, and
+                    # the reader must never block on a full window)
+                    dead.set()
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+            if held is not None and not dead.is_set():
+                try:
+                    self._write_ack(conn, *held)
+                except OSError:
+                    pass
+
+        writer = threading.Thread(
+            target=_ack_writer,
+            name="torcheval-tpu-eval-server-ack",
+            daemon=True,
+        )
+        writer.start()
+        try:
+            while self._running and not dead.is_set():
+                frame = recv_frame_into(conn, self._pool)
+                if frame is None:
+                    break
+                frames.put(frame)
+        except (WireError, OSError):
+            pass
+        finally:
+            frames.put(None)
+            writer.join(timeout=5.0)
+
+    def _write_ack(
+        self, conn: socket.socket, header: Dict[str, Any], payload: bytes
+    ) -> None:
+        if _obs._enabled:
+            # every ack the deferred writer ships (vs the lock-step
+            # request-response path, which never counts here)
+            _obs.counter("serve.wire.acks_deferred")
+        send_frame(conn, header, payload)
+
+    # ------------------------------------------------------ local transport
+    def local_request(
+        self, header: Dict[str, Any], payload: Any
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """Same-process request dispatch (ISSUE 18's shared-memory local
+        transport): no socket, no frame codec. A ``bytes`` payload
+        crosses AS the decode buffer — it is immutable, so the daemon's
+        zero-copy npz views alias it safely for as long as they live
+        (``stage=None``: nothing to recycle). A scatter-gather
+        ``(parts, total)`` payload is assembled once into a staging-pool
+        slot — the slot IS the buffer the daemon decodes, replacing the
+        socket path's user→kernel→user round trip, and recycles under
+        the same anchor-guarded aliasing contract as a socket-landed
+        frame. Raises ``OSError`` when the server is closed or
+        chaos-partitioned, so the client's transport-retry ladder treats
+        a vanished local server exactly like a dead socket (and falls
+        back to TCP once the endpoint deregisters)."""
+        if not self._running:
+            raise OSError("local transport: server is closed")
+        total = (
+            payload[1] if isinstance(payload, tuple) else len(payload)
+        )
+        stage: Any = None
+        view: Any = b""
+        if total:
+            t0 = time.perf_counter()
+            if not isinstance(payload, tuple):
+                view = payload
+            else:
+                stage = self._pool.acquire(total)
+                mv = stage.view(total)
+                off = 0
+                for part in payload[0]:
+                    flat = (
+                        part
+                        if isinstance(part, (bytes, bytearray))
+                        else memoryview(part).cast("B")
+                    )
+                    mv[off : off + len(flat)] = flat
+                    off += len(flat)
+                view = mv
+            if _obs._enabled:
+                # bytes that skipped the socket write+read copy pair
+                _obs.counter(
+                    "serve.ingest.local_copies_avoided_bytes", float(total)
+                )
+                _trace.complete(
+                    "serve.ingest.stage",
+                    t0,
+                    time.perf_counter() - t0,
+                    kind="serve",
+                    bytes=total,
+                )
+        response = self._dispatch(header, view, stage)
+        if response is None:
+            raise OSError("local transport: host partitioned")
+        return response
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(
@@ -1087,6 +1306,29 @@ class EvalServer:
             # sees "subscribed" in the ok response and hands the socket
             # to a publisher thread instead of reading another request
             return {"subscribed": True, "interval_s": interval_s}, b""
+        if op == "pipeline_open":
+            if self._pipeline_depth < 2:
+                # answer exactly like a server that predates the op: the
+                # client swallows the structural reject and stays
+                # lock-step (mixed versions degrade, never break) — and
+                # pipeline_depth=0 thereby models the old peer in tests
+                raise WireError("protocol", f"unknown wire op {op!r}.")
+            depth = header.get("depth")
+            if not isinstance(depth, int) or isinstance(depth, bool) or (
+                depth < 2
+            ):
+                raise WireError(
+                    "bad_request",
+                    f"pipeline_open depth must be an int >= 2, got "
+                    f"{depth!r}.",
+                )
+            # the ack doubles as the handover signal, like subscribe_obs:
+            # _serve_connection switches this connection to deferred-ack
+            # service at the granted window
+            return {
+                "pipelined": True,
+                "depth": min(depth, self._pipeline_depth),
+            }, b""
         if op not in (
             "submit",
             "submit_many",
@@ -1111,7 +1353,9 @@ class EvalServer:
             # (even when submit raises) and, for admitted batches, after
             # the worker has placed the views on device
             stage, stage_box[0] = stage_box[0], None
-            applied = handle.submit(*args, seq=seq, stage=stage)
+            applied = handle.submit(
+                *args, seq=seq, stage=stage, **self._admission(header)
+            )
             return {
                 "applied": applied,
                 "acked_seq": handle._tenant.durable_seq,
@@ -1186,11 +1430,12 @@ class EvalServer:
         )
         if shared is None and stage is not None:
             stage.release()  # a payload-bearing frame with zero batches
+        admission = self._admission(header)
         applied = []
         try:
             for seq, args in zip(seqs, batches):
                 applied.append(
-                    handle.submit(*args, seq=seq, stage=shared)
+                    handle.submit(*args, seq=seq, stage=shared, **admission)
                 )
         except BaseException:
             if shared is not None:
@@ -1204,6 +1449,24 @@ class EvalServer:
             "applied": applied,
             "acked_seq": handle._tenant.durable_seq,
         }, b""
+
+    @staticmethod
+    def _admission(header: Dict[str, Any]) -> Dict[str, Any]:
+        """Submit kwargs for the frame's transport mode. Pipelined frames
+        (tagged ``gapless`` by ``_serve_pipelined``) admit gaplessly — a
+        seq past a still-unadmitted hole is rejected retryably so the
+        dedup watermark can never ratchet past a shed batch — and block
+        briefly for queue space instead of shedding, because with a deep
+        in-flight window a shed error ack forces the client into a full
+        resend catch-up. Lock-step frames keep today's shed-immediately
+        contract."""
+        if not header.get("gapless"):
+            return {}
+        try:
+            timeout = float(header.get("timeout") or 30.0)
+        except (TypeError, ValueError):
+            timeout = 30.0
+        return {"gapless": True, "block": True, "timeout": timeout}
 
     def _negotiate_codec(self, header: Dict[str, Any]) -> Optional[str]:
         """Capability exchange: the first offered codec this server
@@ -1225,6 +1488,20 @@ class EvalServer:
         nonce = header.get("nonce")
         codec = self._negotiate_codec(header)
         codec_fields = {"codec": codec} if codec else {}
+        # pipeline negotiation rides the same capability exchange as the
+        # codec (ISSUE 18): the client asks for a window, the server
+        # grants min(ask, its own cap), and the granted depth comes back
+        # in the attach ack. An old client never asks; an old server (or
+        # pipeline_depth<2) never answers — either way the field is
+        # absent and the wire stays lock-step with no protocol error.
+        asked = header.get("pipeline")
+        if (
+            isinstance(asked, int)
+            and not isinstance(asked, bool)
+            and asked >= 2
+            and self._pipeline_depth >= 2
+        ):
+            codec_fields["pipeline"] = min(asked, self._pipeline_depth)
         metrics = build_metrics(header.get("spec"))
         kwargs: Dict[str, Any] = {}
         for knob in (
