@@ -279,6 +279,16 @@ _SANDBOX_CAVEAT_ROWS = {
         "re-measure on a multi-core host where acks ride back while "
         "producers keep encoding (docs/performance.md, Transport)"
     ),
+    "config9_elastic_p99": (
+        "loopback-1core: every scaled-out 'host' timeshares the SAME "
+        "core, so spreading tenants cannot buy submit latency here and "
+        "the scaled p99 mostly reads scheduler noise against the flat "
+        "(~1x) target; the sandbox-provable claims are the in-leg "
+        "observables — zero sheds, drained queues, >=1 live migration, "
+        "split merge exact — re-measure the p99 ratio on a fleet whose "
+        "hosts own their cores and NICs (docs/robustness.md, Elastic "
+        "fleet)"
+    ),
     "config6_retrieval_L1M_sharded_ratio": (
         "1core-1dev: at one CPU shard the sharded engine's candidate "
         "exchange + merge is pure overhead (0.71x post-ISSUE-18 smoke) "
@@ -1531,6 +1541,230 @@ def config8_cluster():
             d.stop()
 
 
+def config9_elastic():
+    """ISSUE 19: the elastic fleet's headline — offered tenant load beyond
+    one host's admission capacity, absorbed by SCALING rather than
+    shedding.
+
+    One in-process fleet over loopback TCP (``local_transport=False`` —
+    the elastic path under test is the wire one), one shared checkpoint
+    root. Phase 1 packs every tenant onto a single host sized exactly at
+    its ``max_tenants`` admission limit and measures per-submit wall
+    latency. Then the elastic machinery runs END TO END on real folded
+    load reports (the obs stream): the host's own report shows it
+    saturated, ``HeadroomScalingPolicy`` scales the fleet out through
+    ``autoscale_step`` (``provision()`` starts real daemon+server hosts),
+    ``rebalance`` live-migrates tenants off the hot host
+    (checkpoint + replay, bounded moves per pass), and the first tenant
+    is SPLIT across the fleet. Phase 2 replays the same offered stream
+    against the scaled fleet and re-measures p99.
+
+    Acceptance observables: zero sheds and drained queues after scale-up
+    (capacity absorbed the load), ≥1 live migration, and the split
+    tenant's merged ``compute()`` bit-identical to a single-stream
+    oracle. The p99 ratio is the caveated row: on the 1-core sandbox
+    every "host" timeshares one core, so spreading cannot buy latency
+    here — the flat-p99 claim re-measures on a fleet whose hosts own
+    their cores."""
+    import tempfile
+
+    from torcheval_tpu import obs as _obs_api
+    from torcheval_tpu.metrics import MulticlassAccuracy
+    from torcheval_tpu.obs import registry as _obs_reg
+    from torcheval_tpu.serve import (
+        EvalDaemon,
+        EvalRouter,
+        EvalServer,
+        HeadroomScalingPolicy,
+    )
+
+    n_tenants = 4 if _SMOKE else 8
+    n_batches = 6 if _SMOKE else 24  # per tenant per phase
+    batch = 256 if _SMOKE else 4096
+    spec = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+    tenants = [f"bench{i}" for i in range(n_tenants)]
+
+    def make(tenant, idx):
+        # distinct, seed-reproducible buffers: a real stream never
+        # re-submits one array object, and the split tenant's oracle
+        # below replays exactly these
+        rng = np.random.default_rng(9000 + 131 * hash(tenant) % 9973 + idx)
+        return (
+            rng.random((batch, NUM_CLASSES)).astype(np.float32),
+            rng.integers(0, NUM_CLASSES, batch),
+        )
+
+    def p99(samples):
+        ordered = sorted(samples)
+        return ordered[min(len(ordered) - 1, int(0.99 * (len(ordered) - 1)))]
+
+    def until(predicate, timeout_s=30.0):
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.05)
+        return predicate()
+
+    def sheds_total():
+        counters = _obs_reg.snapshot()["counters"]
+        return sum(
+            v
+            for k, v in counters.items()
+            if k.startswith("serve.ingest.sheds{")
+        )
+
+    was_enabled = _obs_reg._enabled
+    if not was_enabled:
+        # the scale-up is driven by the REAL telemetry stream (obs_push
+        # load reports), so the whole leg runs with obs on — both timed
+        # phases pay the same overhead, the ratio stays fair
+        _obs_api.enable()
+    sheds_before = sheds_total()
+    root = tempfile.mkdtemp(prefix="torcheval_tpu_bench_elastic_")
+    daemons, servers = [], []
+
+    def new_host(max_tenants=1024):
+        daemon = EvalDaemon(
+            evict_dir=root,
+            max_tenants=max_tenants,
+            queue_capacity=max(64, n_batches),
+        ).start()
+        server = EvalServer(daemon)
+        daemons.append(daemon)
+        servers.append(server)
+        return server.endpoint
+
+    router = EvalRouter(
+        # host 0 admits EXACTLY the offered tenant set: its own load
+        # report reads saturated (active == max_tenants), no synthetic
+        # load is injected anywhere
+        [new_host(max_tenants=n_tenants)],
+        request_timeout_s=300.0,
+        connect_timeout_s=5.0,
+        max_attempts=2,
+        backoff_base_s=0.02,
+        backoff_cap_s=0.1,
+        local_transport=False,
+    )
+    try:
+        router.subscribe_obs(0.2, stale_after_s=10.0)
+        for t in tenants:
+            router.attach(t, spec)
+        # warm every program the timed phases dispatch
+        for t in tenants:
+            router.submit(t, *make(t, -1))
+            router.flush(t)
+
+        # phase 1: the whole offered stream into ONE saturated host
+        lat1 = []
+        for i in range(n_batches):
+            for t in tenants:
+                s_, l_ = make(t, i)
+                t0 = time.perf_counter()
+                router.submit(t, s_, l_)
+                lat1.append(time.perf_counter() - t0)
+        for t in tenants:
+            router.flush(t)
+        _emit_row(
+            "config9_elastic_p99_submit_1host_ms", p99(lat1) * 1e3, "ms"
+        )
+
+        hot_ep = router.endpoints[0]
+        until(
+            lambda: (
+                router.fleet_status()["hosts"][hot_ep].get("load") or 0.0
+            )
+            > 0.9
+        )
+        # autoscale: the policy reads the starved fleet headroom and
+        # provisions real hosts until the band or max_hosts quiets it
+        policy = HeadroomScalingPolicy(
+            scale_up_below=0.5, cooldown_s=0.0, max_hosts=4
+        )
+        for _ in range(3):
+            router.autoscale_step(policy, provision=new_host)
+        until(
+            lambda: all(
+                not h["stale"] and h.get("load") is not None
+                for h in router.fleet_status()["hosts"].values()
+            )
+        )
+        moved = []
+        for _ in range(n_tenants):
+            migrated = router.rebalance(
+                hot_load=0.5,
+                improvement=0.2,
+                min_dwell_s=0.0,
+                max_moves=2,
+            )
+            if not migrated:
+                break
+            moved.extend(migrated)
+            time.sleep(0.25)  # let the drained host's next report land
+        router.split_tenant(tenants[0], replicas=2)
+
+        # phase 2: the SAME offered stream against the scaled fleet
+        lat2 = []
+        for i in range(n_batches, 2 * n_batches):
+            for t in tenants:
+                s_, l_ = make(t, i)
+                t0 = time.perf_counter()
+                router.submit(t, s_, l_)
+                lat2.append(time.perf_counter() - t0)
+        for t in tenants:
+            router.flush(t)
+        _emit_row(
+            "config9_elastic_p99_submit_scaled_ms", p99(lat2) * 1e3, "ms"
+        )
+        _emit_row(
+            "config9_elastic_p99_ratio",
+            p99(lat2) / p99(lat1),
+            "x of 1-host p99 (target ~1: flat as hosts join)",
+        )
+        _emit_row(
+            "config9_elastic_hosts_after_scaleup",
+            float(len(router.alive)),
+            "hosts (policy grew the fleet from 1)",
+        )
+        _emit_row(
+            "config9_elastic_migrations", float(len(moved)), "tenants moved"
+        )
+        _emit_row(
+            "config9_elastic_queue_depth_after_scaleup",
+            float(
+                sum(d.load_report()["queue"]["depth"] for d in daemons)
+            ),
+            "queued batches fleet-wide after flush (must be 0)",
+        )
+        _emit_row(
+            "config9_elastic_sheds_after_scaleup",
+            sheds_total() - sheds_before,
+            "shed batches (must be 0: scaling absorbed the load)",
+        )
+        # the split tenant's merged compute vs a single-stream oracle —
+        # count-valued states merge exactly, whichever replica saw
+        # which batch and through however many live migrations
+        oracle = MulticlassAccuracy(num_classes=NUM_CLASSES)
+        for i in range(-1, 2 * n_batches):
+            oracle.update(*make(tenants[0], i))
+        merged = float(np.asarray(router.compute(tenants[0])["acc"]))
+        _emit_row(
+            "config9_elastic_split_merge_exact",
+            1.0 if merged == float(np.asarray(oracle.compute())) else 0.0,
+            "1 = split tenant's merged compute == single-stream oracle",
+        )
+    finally:
+        router.close()
+        for s in servers:
+            s.close()
+        for d in daemons:
+            if d._running:
+                d.stop()
+        if not was_enabled:
+            _obs_api.disable()
+
+
 def config6_retrieval():
     """ISSUE 14: the retrieval family at extreme vocabulary — NDCG@k over
     L=1M labels (4096 at smoke), k ∈ {10, 100}, through the streaming
@@ -2160,6 +2394,14 @@ _EXPECTED_ROW_PREFIXES = (
     "config8_cluster_wire_local_transport_ratio",
     "config8_cluster_wire_2host_migration",
     "config8_ingest_overlap_ms",
+    "config9_elastic_p99_submit_1host_ms",
+    "config9_elastic_p99_submit_scaled_ms",
+    "config9_elastic_p99_ratio",
+    "config9_elastic_hosts_after_scaleup",
+    "config9_elastic_migrations",
+    "config9_elastic_queue_depth_after_scaleup",
+    "config9_elastic_sheds_after_scaleup",
+    "config9_elastic_split_merge_exact",
     "config10_sketch_accuracy_vs_exact",
     "config10_sketch_bytes_ratio",
     "config10_sketch_1b_rows",
@@ -2209,6 +2451,7 @@ def main() -> None:
         checkpoint_overhead,
         config7_serve_tenants,
         config8_cluster,
+        config9_elastic,
         config10_sketch,
         config11_sliced,
         config11_sliced_sharded,

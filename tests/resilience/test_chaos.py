@@ -148,6 +148,41 @@ class TestIngestHooks(unittest.TestCase):
             # a delay never corrupts
             self.assertEqual(out[0].shape, (4, 3))
 
+    def test_load_spike_fires_on_every_batch_from_step(self):
+        # NOT one-shot: a load spike models sustained pressure (ISSUE
+        # 19's rebalance/split driver), so every admitted batch at/after
+        # the armed step pays the delay
+        with self._arm(
+            TORCHEVAL_TPU_CHAOS_ACTION="load_spike",
+            TORCHEVAL_TPU_CHAOS_DELAY_S="0.15",
+        ):
+            chaos.reset_for_tests()
+            t0 = time.monotonic()
+            chaos.on_ingest("t", 1, self._batch())
+            self.assertLess(time.monotonic() - t0, 0.1)
+            for step in (2, 3):
+                t0 = time.monotonic()
+                out = chaos.on_ingest("t", step, self._batch())
+                self.assertGreaterEqual(
+                    time.monotonic() - t0, 0.15, f"step {step}"
+                )
+            # a load spike never corrupts the batch
+            self.assertEqual(out[0].shape, (4, 3))
+
+    def test_hot_tenant_alias_targets_tenant_and_arms_ingest(self):
+        with self._arm(
+            TORCHEVAL_TPU_CHAOS_ACTION="hot_tenant",
+            TORCHEVAL_TPU_CHAOS_DELAY_S="0.15",
+        ):
+            chaos.reset_for_tests()
+            self.assertTrue(chaos.ingest_armed())
+            t0 = time.monotonic()
+            chaos.on_ingest("someone-else", 2, self._batch())
+            self.assertLess(time.monotonic() - t0, 0.1)
+            t0 = time.monotonic()
+            chaos.on_ingest("t", 2, self._batch())
+            self.assertGreaterEqual(time.monotonic() - t0, 0.15)
+
     def test_wildcard_tenant_and_fires_once(self):
         import numpy as np
 

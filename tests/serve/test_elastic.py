@@ -1,0 +1,429 @@
+"""Elastic eval fleet (ISSUE 19): load-aware placement, the hysteretic
+rebalancer, runtime ``add_host``/``remove_host``, and the pluggable
+scaling policy. The real multi-process scale-up drill lives in
+``test_elastic_mp.py``; here the hosts are in-process servers and load
+reports are injected directly into the router's folded fleet state, so
+every decision path runs deterministically. All sockets bind port 0.
+"""
+
+import tempfile
+import time
+import unittest
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.serve import (
+    EvalDaemon,
+    EvalRouter,
+    EvalServer,
+    HeadroomScalingPolicy,
+    ScalingPolicy,
+    ServeError,
+)
+
+NUM_CLASSES = 5
+SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, n),
+    )
+
+
+def _oracle(batches):
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for s, l in batches:
+        m.update(s, l)
+    return float(np.asarray(m.compute()))
+
+
+def _report(p99_s=0.0, draining=False):
+    """A minimal schema-1 load report carrying one latency pressure."""
+    return {
+        "schema": 1,
+        "draining": draining,
+        "capacity": {"max_tenants": 0, "active_tenants": 0},
+        "queue": {"depth": 0, "capacity": 0},
+        "latency": {"submit_p99_s": p99_s, "submit_ewma_s": p99_s},
+        "hbm": {},
+    }
+
+
+def _inject(router, endpoint, report, *, age_s=0.0):
+    """Plant a folded load report for ``endpoint`` as if the obs stream
+    delivered it ``age_s`` seconds ago."""
+    with router._fleet_lock:
+        router._fleet[endpoint] = {
+            "acc": None,
+            "events": [],
+            "events_trimmed": 0,
+            "report": report,
+            "received_at": time.monotonic() - age_s,
+            "mode": "push",
+            "pushes": 1,
+        }
+
+
+class _ClusterMixin:
+    N_HOSTS = 2
+
+    def setUp(self):
+        obs.reset()
+        self.root = tempfile.mkdtemp(prefix="tpu_elastic_test_")
+        self.daemons, self.servers = [], []
+        for _ in range(self.N_HOSTS):
+            self._start_host()
+        self.router = EvalRouter(
+            [s.endpoint for s in self.servers],
+            request_timeout_s=10.0,
+            connect_timeout_s=1.0,
+            max_attempts=2,
+            backoff_base_s=0.01,
+        )
+        self.addCleanup(self.router.close)
+
+    def _start_host(self):
+        daemon = EvalDaemon(evict_dir=self.root).start()
+        server = EvalServer(daemon)
+        self.daemons.append(daemon)
+        self.servers.append(server)
+        self.addCleanup(daemon.stop)
+        self.addCleanup(server.close)
+        return server.endpoint
+
+
+class TestWeightedPlacement(_ClusterMixin, unittest.TestCase):
+    def test_no_load_signal_is_classic_rendezvous(self):
+        # with every weight equal the -w/ln(u) argmax is a monotone
+        # transform of the classic highest-random-weight draw: a fresh
+        # router (no fleet state at all) must agree with a loaded one
+        # that has heard nothing
+        router2 = EvalRouter([s.endpoint for s in self.servers])
+        self.addCleanup(router2.close)
+        for i in range(64):
+            tid = f"t{i}"
+            self.assertEqual(
+                self.router._place(tid), router2._place(tid), tid
+            )
+
+    def test_hot_host_repels_new_tenants(self):
+        eps = self.router.endpoints
+        hot, cold = eps[0], eps[1]
+        _inject(self.router, hot, _report(p99_s=10.0))  # load -> 0.999
+        _inject(self.router, cold, _report(p99_s=0.0))
+        placed = [self.router._place(f"t{i}") for i in range(100)]
+        on_hot = sum(1 for ep in placed if ep == hot)
+        # weight ratio 1e-3 : 1 — essentially everything goes cold-side
+        self.assertLessEqual(on_hot, 5, f"{on_hot}/100 landed hot")
+        # and the skewed placement is still deterministic
+        self.assertEqual(
+            placed, [self.router._place(f"t{i}") for i in range(100)]
+        )
+
+    def test_stale_report_carries_no_weight(self):
+        eps = self.router.endpoints
+        _inject(self.router, eps[0], _report(p99_s=10.0), age_s=999.0)
+        router2 = EvalRouter([s.endpoint for s in self.servers])
+        self.addCleanup(router2.close)
+        for i in range(32):
+            tid = f"t{i}"
+            self.assertEqual(
+                self.router._place(tid), router2._place(tid), tid
+            )
+
+    def test_draining_host_ineligible_for_new_tenants(self):
+        eps = self.router.endpoints
+        _inject(self.router, eps[0], _report(draining=True))
+        for i in range(32):
+            self.assertEqual(self.router._place(f"t{i}"), eps[1])
+        # unless that would empty the candidate set entirely
+        _inject(self.router, eps[1], _report(draining=True))
+        self.assertIn(self.router._place("t0"), eps)
+
+    def test_silent_subscribed_host_is_suspect(self):
+        eps = self.router.endpoints
+        # a host whose subscribed stream delivered then went quiet past
+        # the horizon is suspect -> ineligible for NEW tenants
+        _inject(self.router, eps[0], _report(), age_s=999.0)
+        with self.router._fleet_lock:
+            self.router._obs_subs[eps[0]] = object()
+        try:
+            for i in range(32):
+                self.assertEqual(self.router._place(f"t{i}"), eps[1])
+        finally:
+            with self.router._fleet_lock:
+                self.router._obs_subs.pop(eps[0], None)
+
+
+class TestFleetHeadroom(_ClusterMixin, unittest.TestCase):
+    def test_headroom_none_without_reports(self):
+        status = self.router.fleet_status()
+        self.assertEqual(status["schema"], 1)
+        self.assertIsNone(status["headroom"])
+        for host in status["hosts"].values():
+            self.assertIn("load", host)
+
+    def test_headroom_folds_fresh_loads(self):
+        eps = self.router.endpoints
+        _inject(self.router, eps[0], _report(p99_s=0.6))
+        _inject(self.router, eps[1], _report(p99_s=0.2))
+        status = self.router.fleet_status()
+        self.assertAlmostEqual(status["headroom"], 1.0 - 0.4, places=6)
+        self.assertAlmostEqual(
+            status["hosts"][eps[0]]["load"], 0.6, places=6
+        )
+
+    def test_headroom_gauge_emitted(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        _inject(self.router, self.router.endpoints[0], _report(p99_s=0.5))
+        self.router.fleet_status()
+        snap = obs.snapshot()
+        self.assertIn("serve.fleet.headroom", snap["gauges"])
+
+
+class TestRebalance(_ClusterMixin, unittest.TestCase):
+    def _load_skew(self, hot_ep, cold_ep, hot=0.9, cold=0.1):
+        _inject(self.router, hot_ep, _report(p99_s=hot))
+        _inject(self.router, cold_ep, _report(p99_s=cold))
+
+    def test_rebalance_moves_off_hot_host_exactly_once(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        self.router.attach("ten", SPEC)
+        src = self.router.placement()["ten"]
+        dst = next(ep for ep in self.router.endpoints if ep != src)
+        stream = [_batch(1), _batch(2), _batch(3)]
+        self.router.submit("ten", *stream[0])
+        self.router.flush("ten")  # durable
+        self.router.submit("ten", *stream[1])  # un-durable tail
+        self._load_skew(src, dst)
+        moved = self.router.rebalance(min_dwell_s=0.0)
+        self.assertEqual(moved, ["ten"])
+        self.assertEqual(self.router.placement()["ten"], dst)
+        # exactly-once across the live move: durable batch via the
+        # flushed checkpoint, the tail via the adopt replay, nothing
+        # doubled
+        self.router.submit("ten", *stream[2])
+        got = float(np.asarray(self.router.compute("ten")["acc"]))
+        self.assertEqual(got, _oracle(stream))
+        health = self.daemons[
+            [s.endpoint for s in self.servers].index(dst)
+        ].health()
+        self.assertEqual(health["tenants"]["ten"]["dupes"], 0)
+        snap = obs.snapshot()
+        self.assertEqual(
+            snap["counters"].get(
+                "serve.router.migrations{reason=rebalance}"
+            ),
+            1.0,
+        )
+        self.assertEqual(
+            snap["counters"].get(
+                "serve.router.rebalances{endpoint=%s}" % src
+            ),
+            1.0,
+        )
+        # hysteresis: the dwell clock restarted at the move, the load
+        # picture is unchanged — repeated passes must NOT bounce it back
+        for _ in range(5):
+            self.assertEqual(
+                self.router.rebalance(min_dwell_s=60.0), []
+            )
+        self.assertEqual(self.router.placement()["ten"], dst)
+
+    def test_improvement_threshold_blocks_marginal_moves(self):
+        self.router.attach("ten", SPEC)
+        src = self.router.placement()["ten"]
+        dst = next(ep for ep in self.router.endpoints if ep != src)
+        self._load_skew(src, dst, hot=0.8, cold=0.7)
+        self.assertEqual(
+            self.router.rebalance(min_dwell_s=0.0, improvement=0.15), []
+        )
+        self.assertEqual(self.router.placement()["ten"], src)
+
+    def test_max_moves_bounds_one_pass(self):
+        counts = {ep: 0 for ep in self.router.endpoints}
+        for i in range(256):
+            if min(counts.values()) >= 3:
+                break
+            tid = f"t{i}"
+            ep = self.router._place(tid)
+            if counts[ep] >= 3:
+                continue
+            self.router.attach(tid, SPEC)
+            counts[ep] += 1
+        src = self.router.endpoints[0]
+        dst = self.router.endpoints[1]
+        self._load_skew(src, dst)
+        moved = self.router.rebalance(min_dwell_s=0.0, max_moves=2)
+        self.assertLessEqual(len(moved), 2)
+        self.assertGreaterEqual(len(moved), 1)
+
+    def test_no_fresh_loads_means_no_moves(self):
+        self.router.attach("ten", SPEC)
+        self.assertEqual(self.router.rebalance(min_dwell_s=0.0), [])
+
+    def test_background_rebalancer_thread_lifecycle(self):
+        import threading
+
+        self.router.start_rebalancer(interval_s=0.05, min_dwell_s=0.0)
+        names = [t.name for t in threading.enumerate()]
+        self.assertIn("torcheval-tpu-router-rebalance", names)
+        # a running pass with zero load data is a no-op, not a crash
+        time.sleep(0.2)
+        self.router.stop_rebalancer()
+        time.sleep(0.05)
+        names = [t.name for t in threading.enumerate()]
+        self.assertNotIn("torcheval-tpu-router-rebalance", names)
+
+
+class TestElasticHosts(_ClusterMixin, unittest.TestCase):
+    def test_add_host_joins_placement(self):
+        new_ep = self._start_host()
+        self.assertNotIn(new_ep, self.router.endpoints)
+        self.router.add_host(new_ep)
+        self.assertIn(new_ep, self.router.alive)
+        # the joined host is immediately placeable: some tenant ids must
+        # rendezvous onto it (1/3 of draws in expectation)
+        landed = any(
+            self.router._place(f"j{i}") == new_ep for i in range(64)
+        )
+        self.assertTrue(landed)
+        # and it actually serves
+        for i in range(64):
+            tid = f"j{i}"
+            if self.router._place(tid) == new_ep:
+                self.assertEqual(self.router.attach(tid, SPEC), new_ep)
+                b = _batch(i)
+                self.router.submit(tid, *b)
+                got = float(
+                    np.asarray(self.router.compute(tid)["acc"])
+                )
+                self.assertEqual(got, _oracle([b]))
+                break
+
+    def test_add_live_host_twice_rejected(self):
+        with self.assertRaisesRegex(ValueError, "already in the fleet"):
+            self.router.add_host(self.router.endpoints[0])
+
+    def test_remove_host_drains_and_forgets(self):
+        self.router.attach("ten", SPEC)
+        src = self.router.placement()["ten"]
+        b1, b2 = _batch(1), _batch(2)
+        self.router.submit("ten", *b1)
+        out = self.router.remove_host(src)
+        self.assertIn("ten", out["migrated"])
+        self.assertNotIn(src, self.router.endpoints)
+        self.assertNotIn(src, self.router.alive)
+        self.router.submit("ten", *b2)
+        got = float(np.asarray(self.router.compute("ten")["acc"]))
+        self.assertEqual(got, _oracle([b1, b2]))
+
+    def test_autoscale_scales_up_on_low_headroom(self):
+        for ep in self.router.endpoints:
+            _inject(self.router, ep, _report(p99_s=0.95))
+        policy = HeadroomScalingPolicy(
+            scale_up_below=0.2, cooldown_s=0.0
+        )
+        provisioned = []
+
+        def provision():
+            ep = self._start_host()
+            provisioned.append(ep)
+            return ep
+
+        delta = self.router.autoscale_step(policy, provision=provision)
+        self.assertEqual(delta, 1)
+        self.assertEqual(len(provisioned), 1)
+        self.assertIn(provisioned[0], self.router.alive)
+
+    def test_autoscale_scales_down_on_high_headroom(self):
+        for ep in self.router.endpoints:
+            _inject(self.router, ep, _report(p99_s=0.01))
+        policy = HeadroomScalingPolicy(
+            scale_down_above=0.8, min_hosts=1, cooldown_s=0.0
+        )
+        removed = []
+        delta = self.router.autoscale_step(
+            policy, decommission=removed.append
+        )
+        self.assertEqual(delta, -1)
+        self.assertEqual(len(removed), 1)
+        self.assertNotIn(removed[0], self.router.endpoints)
+        self.assertEqual(len(self.router.alive), 1)
+
+
+class TestScalingPolicy(unittest.TestCase):
+    def test_base_policy_is_abstract(self):
+        with self.assertRaises(NotImplementedError):
+            ScalingPolicy().decide({})
+
+    def test_knob_validation(self):
+        with self.assertRaisesRegex(ValueError, "dead band"):
+            HeadroomScalingPolicy(
+                scale_up_below=0.8, scale_down_above=0.2
+            )
+        with self.assertRaisesRegex(ValueError, "min_hosts"):
+            HeadroomScalingPolicy(min_hosts=0)
+        with self.assertRaisesRegex(ValueError, "max_hosts"):
+            HeadroomScalingPolicy(min_hosts=3, max_hosts=2)
+        with self.assertRaisesRegex(ValueError, "cooldown_s"):
+            HeadroomScalingPolicy(cooldown_s=-1)
+
+    def test_no_signal_holds(self):
+        policy = HeadroomScalingPolicy(cooldown_s=0.0)
+        self.assertEqual(
+            policy.decide({"headroom": None, "alive": ["a"]}), 0
+        )
+
+    def test_band_and_bounds(self):
+        policy = HeadroomScalingPolicy(
+            scale_up_below=0.2,
+            scale_down_above=0.8,
+            min_hosts=1,
+            max_hosts=2,
+            cooldown_s=0.0,
+        )
+        self.assertEqual(
+            policy.decide({"headroom": 0.1, "alive": ["a"]}), 1
+        )
+        self.assertEqual(  # at max_hosts: hold even when starved
+            policy.decide({"headroom": 0.1, "alive": ["a", "b"]}), 0
+        )
+        self.assertEqual(  # inside the dead band: hold
+            policy.decide({"headroom": 0.5, "alive": ["a", "b"]}), 0
+        )
+        self.assertEqual(
+            policy.decide({"headroom": 0.9, "alive": ["a", "b"]}), -1
+        )
+        self.assertEqual(  # at min_hosts: hold even when idle
+            policy.decide({"headroom": 0.9, "alive": ["a"]}), 0
+        )
+
+    def test_cooldown_quiets_consecutive_decisions(self):
+        policy = HeadroomScalingPolicy(cooldown_s=60.0)
+        self.assertEqual(
+            policy.decide({"headroom": 0.1, "alive": ["a"]}), 1
+        )
+        self.assertEqual(
+            policy.decide({"headroom": 0.1, "alive": ["a"]}), 0
+        )
+
+
+class TestSyncComputeOnSplit(_ClusterMixin, unittest.TestCase):
+    def test_sync_compute_refused_for_split_tenant(self):
+        self.router.attach("ten", SPEC)
+        self.router.split_tenant("ten", replicas=2)
+        with self.assertRaises(ServeError) as ctx:
+            self.router.sync_compute("ten")
+        self.assertEqual(ctx.exception.reason, "split_tenant")
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,431 @@
+"""Elastic scale-up drill: REAL multi-process hosts, chaos mid-scale-up
+(ISSUE 19 acceptance).
+
+One world: this test process runs the ``EvalRouter``; host processes
+(``mp_cluster_host.py``) each own an ``EvalDaemon`` + ``EvalServer``
+sharing ONE checkpoint root. The fleet starts at a single host A whose
+environment arms a ``load_spike`` chaos on the "hot" tenant — every hot
+batch pays a real ingest delay, so A's OWN load report (submit p99
+against the router's latency target) reads saturated through the obs
+stream, with no synthetic numbers injected anywhere. Then, end to end:
+
+* the ``HeadroomScalingPolicy`` sees the starved headroom and scales up
+  — ``provision()`` launches a REAL host B process and ``add_host``
+  joins it into placement and the telemetry stream;
+* one ``rebalance`` pass migrates load off hot A onto cold B using the
+  live checkpoint+replay move (bounded by ``max_moves``);
+* the hot tenant is SPLIT across the fleet and keeps streaming through
+  the fan-out;
+* chaos strikes mid-scale-up: a third host C joins armed with
+  ``host_kill`` at its first submit — the router absorbs the death via
+  failure migration and the interrupted batch arrives by replay;
+* every tenant (including the split one, merged at compute) finishes
+  BIT-IDENTICAL to its fault-free oracle, with zero sheds and drained
+  queues — offered load beyond one host's capacity was absorbed by
+  scaling, not by dropping.
+
+Artifacts (fleet status/trace, router obs/trace, a drill summary) land
+in test-artifacts. All sockets bind port 0 (OS-assigned).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import unittest
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_HOST = os.path.join(_HERE, "mp_cluster_host.py")
+
+NUM_CLASSES = 5
+BATCH = 32
+PHASE1, PHASE2 = 2, 3
+HOT_DELAY_S = 0.4
+LATENCY_TARGET_S = 0.5
+CHAOS_EXIT_CODE = 43
+SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+COLD_TENANTS = ("t0", "t1")
+
+
+def _make_batch(tenant: str, idx: int):
+    seed = 1000 * (hash(tenant) % 97) + idx
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((BATCH, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, BATCH),
+    )
+
+
+def _oracle(tenant: str, n: int) -> float:
+    from torcheval_tpu.metrics import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for i in range(n):
+        m.update(*_make_batch(tenant, i))
+    return float(np.asarray(m.compute()))
+
+
+def _wait(predicate, timeout_s=15.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _artifact_dir() -> str:
+    configured = os.environ.get("TORCHEVAL_TPU_TEST_ARTIFACT_DIR")
+    if configured:
+        out = os.path.join(configured, "elastic_drill")
+        os.makedirs(out, exist_ok=True)
+        return out
+    return tempfile.mkdtemp(prefix="tpu_elastic_drill_")
+
+
+def _launch_host(outdir: str, tag: str, ckpt_root: str, chaos_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in list(env):
+        if k.startswith("TORCHEVAL_TPU_CHAOS"):
+            del env[k]
+    if chaos_env:
+        env.update(chaos_env)
+    return subprocess.Popen(
+        [sys.executable, _HOST, outdir, tag, ckpt_root],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def _wait_port(outdir: str, tag: str, timeout_s: float = 90.0) -> int:
+    path = os.path.join(outdir, f"{tag}.port")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return int(f.read())
+        time.sleep(0.05)
+    raise TimeoutError(f"host {tag} never published its port.")
+
+
+class TestElasticScaleUpDrill(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        cls.procs = {}
+        try:
+            cls._run_world()
+        except BaseException:
+            for proc in cls.procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            raise
+
+    @classmethod
+    def _launch(cls, tag, chaos_env=None):
+        cls.procs[tag] = _launch_host(
+            cls.outdir, tag, cls.ckpt_root, chaos_env=chaos_env
+        )
+        return f"127.0.0.1:{_wait_port(cls.outdir, tag)}"
+
+    @classmethod
+    def _run_world(cls):
+        from torcheval_tpu import obs
+        from torcheval_tpu.serve import (
+            EvalClient,
+            EvalRouter,
+            HeadroomScalingPolicy,
+        )
+
+        cls.outdir = _artifact_dir()
+        cls.ckpt_root = os.path.join(cls.outdir, "ckpt_root")
+        os.makedirs(cls.ckpt_root, exist_ok=True)
+
+        # host A: a REAL ingest stall on every "hot" batch — the load
+        # signal the whole drill scales on comes from A's own clocks
+        cls.ep_a = cls._launch(
+            "hostA",
+            chaos_env={
+                "TORCHEVAL_TPU_CHAOS": "1",
+                "TORCHEVAL_TPU_CHAOS_ACTION": "load_spike",
+                "TORCHEVAL_TPU_CHAOS_TENANT": "hot",
+                "TORCHEVAL_TPU_CHAOS_STEP": "1",
+                "TORCHEVAL_TPU_CHAOS_DELAY_S": str(HOT_DELAY_S),
+            },
+        )
+        obs.reset()
+        obs.enable()
+        cls.router = EvalRouter(
+            [cls.ep_a],
+            request_timeout_s=10.0,
+            connect_timeout_s=5.0,
+            max_attempts=2,
+            backoff_base_s=0.05,
+            backoff_cap_s=0.2,
+            latency_target_s=LATENCY_TARGET_S,
+        )
+        cls.fleet_modes = cls.router.subscribe_obs(
+            0.25, stale_after_s=2.0
+        )
+
+        for t in COLD_TENANTS + ("hot",):
+            cls.router.attach(t, SPEC)
+        for i in range(PHASE1):
+            for t in COLD_TENANTS + ("hot",):
+                cls.router.submit(t, *_make_batch(t, i))
+        for t in COLD_TENANTS + ("hot",):
+            cls.router.flush(t)
+
+        # the spike shows up in A's pushed load report: headroom starves
+        cls.headroom_starved = _wait(
+            lambda: (cls.router.fleet_status()["headroom"] or 1.0) < 0.55
+        )
+        cls.headroom_before = cls.router.fleet_status()["headroom"]
+
+        # autoscale: the policy decides +1, provision() starts a REAL
+        # host process and hands its endpoint to add_host
+        policy = HeadroomScalingPolicy(
+            scale_up_below=0.55, cooldown_s=0.0
+        )
+        cls.scale_delta = cls.router.autoscale_step(
+            policy, provision=lambda: cls._launch("hostB")
+        )
+        cls.ep_b = next(
+            ep for ep in cls.router.alive if ep != cls.ep_a
+        )
+        cls.b_fresh = _wait(
+            lambda: not cls.router.fleet_status()["hosts"]
+            .get(cls.ep_b, {"stale": True})["stale"]
+        )
+
+        # rebalance off the hot host (live move, bounded)
+        cls.rebalance_moved = cls.router.rebalance(
+            hot_load=0.5,
+            improvement=0.2,
+            min_dwell_s=0.0,
+            max_moves=2,
+        )
+        # hysteresis immediately after: dwell clocks just restarted
+        cls.rebalance_second_pass = cls.router.rebalance(
+            hot_load=0.5, improvement=0.2, min_dwell_s=60.0, max_moves=2
+        )
+
+        # split the hot tenant across the fleet and keep streaming
+        cls.split_placement = cls.router.split_tenant("hot", replicas=2)
+        for i in range(PHASE1, PHASE1 + PHASE2):
+            for t in COLD_TENANTS + ("hot",):
+                cls.router.submit(t, *_make_batch(t, i))
+
+        # chaos mid-scale-up: host C joins armed to die at its FIRST
+        # submit; the router must absorb it like any host death
+        cls.ep_c = cls._launch(
+            "hostC",
+            chaos_env={
+                "TORCHEVAL_TPU_CHAOS": "1",
+                "TORCHEVAL_TPU_CHAOS_ACTION": "host_kill",
+                "TORCHEVAL_TPU_CHAOS_TENANT": "*",
+                "TORCHEVAL_TPU_CHAOS_STEP": "1",
+                "TORCHEVAL_TPU_CHAOS_EXIT_CODE": str(CHAOS_EXIT_CODE),
+            },
+        )
+        cls.router.add_host(cls.ep_c)
+        cls.late_tenant = next(
+            tid
+            for tid in (f"late{i}" for i in range(256))
+            if cls.router._place(tid) == cls.ep_c
+        )
+        cls.router.attach(cls.late_tenant, SPEC)
+        for i in range(2):
+            cls.router.submit(
+                cls.late_tenant, *_make_batch(cls.late_tenant, i)
+            )
+
+        for t in COLD_TENANTS + ("hot", cls.late_tenant):
+            cls.router.flush(t)
+        cls.results = {
+            t: float(np.asarray(cls.router.compute(t)["acc"]))
+            for t in COLD_TENANTS + ("hot", cls.late_tenant)
+        }
+        cls.placement_after = cls.router.placement()
+        cls.alive_after = cls.router.alive
+
+        # post-scale-up invariants: queues drained, zero sheds anywhere
+        cls.host_counters = {}
+        cls.host_reports = {}
+        for ep in (cls.ep_a, cls.ep_b):
+            client = EvalClient(ep, request_timeout_s=30.0)
+            cls.host_counters[ep] = client.snapshot()["snapshot"][
+                "counters"
+            ]
+            cls.host_reports[ep] = client.load_report()
+            client.close()
+
+        cls.fleet_status_final = cls.router.fleet_status()
+        cls.router_snapshot = obs.snapshot()
+        with open(
+            os.path.join(cls.outdir, "fleet.status.json"), "w"
+        ) as f:
+            json.dump(cls.fleet_status_final, f, indent=2, default=str)
+        with open(
+            os.path.join(cls.outdir, "fleet.trace.json"), "w"
+        ) as f:
+            f.write(cls.router.fleet_chrome_trace())
+        with open(
+            os.path.join(cls.outdir, "router.obs.json"), "w"
+        ) as f:
+            json.dump(cls.router_snapshot, f, indent=2)
+        with open(
+            os.path.join(cls.outdir, "router.trace.json"), "w"
+        ) as f:
+            f.write(obs.chrome_trace())
+        with open(
+            os.path.join(cls.outdir, "elastic.summary.json"), "w"
+        ) as f:
+            json.dump(
+                {
+                    "headroom_before_scaleup": cls.headroom_before,
+                    "scale_delta": cls.scale_delta,
+                    "rebalance_moved": cls.rebalance_moved,
+                    "split_placement": cls.split_placement,
+                    "late_tenant": cls.late_tenant,
+                    "placement_after": cls.placement_after,
+                },
+                f,
+                indent=2,
+            )
+
+        for tag in list(cls.procs):
+            with open(os.path.join(cls.outdir, f"{tag}.stop"), "w"):
+                pass
+        for proc in cls.procs.values():
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        cls.router.close()
+        _wait(
+            lambda: not [
+                t
+                for t in threading.enumerate()
+                if "torcheval-tpu-obs-" in t.name
+                or t.name == "torcheval-tpu-router-rebalance"
+            ]
+        )
+        cls.leaked_threads = [
+            t.name
+            for t in threading.enumerate()
+            if "torcheval-tpu-obs-" in t.name
+            or t.name == "torcheval-tpu-router-rebalance"
+        ]
+        obs.disable()
+
+    def test_load_spike_starved_headroom(self):
+        self.assertTrue(
+            self.headroom_starved,
+            f"headroom never starved: {self.headroom_before}",
+        )
+        self.assertLess(self.headroom_before, 0.55)
+
+    def test_policy_scaled_up_one_real_host(self):
+        self.assertEqual(self.scale_delta, 1)
+        self.assertIn(self.ep_b, self.alive_after)
+        self.assertTrue(self.b_fresh, "host B never reported fresh")
+
+    def test_rebalance_moved_bounded_and_no_thrash(self):
+        self.assertGreaterEqual(len(self.rebalance_moved), 1)
+        self.assertLessEqual(len(self.rebalance_moved), 2)
+        for t in self.rebalance_moved:
+            self.assertEqual(self.placement_after[t], self.ep_b, t)
+        # the immediate second pass under dwell hysteresis moved nothing
+        self.assertEqual(self.rebalance_second_pass, [])
+
+    def test_hot_tenant_split_spans_hosts(self):
+        self.assertEqual(
+            sorted(self.split_placement), ["hot", "hot@r1"]
+        )
+        self.assertEqual(len(set(self.split_placement.values())), 2)
+
+    def test_chaos_killed_host_c_mid_scale_up(self):
+        self.assertEqual(
+            self.procs["hostC"].returncode, CHAOS_EXIT_CODE
+        )
+        self.assertNotIn(self.ep_c, self.alive_after)
+        self.assertNotEqual(
+            self.placement_after[self.late_tenant], self.ep_c
+        )
+
+    def test_results_bit_identical_to_fault_free_oracles(self):
+        for t in COLD_TENANTS:
+            self.assertEqual(
+                self.results[t], _oracle(t, PHASE1 + PHASE2), t
+            )
+        # the split tenant merges its replica shards back exactly
+        self.assertEqual(
+            self.results["hot"], _oracle("hot", PHASE1 + PHASE2)
+        )
+        self.assertEqual(
+            self.results[self.late_tenant],
+            _oracle(self.late_tenant, 2),
+        )
+
+    def test_zero_sheds_and_drained_queues_after_scale_up(self):
+        for ep, counters in self.host_counters.items():
+            sheds = {
+                k: v
+                for k, v in counters.items()
+                if k.startswith("serve.ingest.sheds{")
+            }
+            self.assertEqual(sheds, {}, ep)
+        for ep, report in self.host_reports.items():
+            self.assertEqual(report["queue"]["depth"], 0, ep)
+
+    def test_router_recorded_rebalance_and_split_instruments(self):
+        counters = self.router_snapshot["counters"]
+        self.assertGreaterEqual(
+            counters.get(
+                "serve.router.migrations{reason=rebalance}", 0.0
+            ),
+            1.0,
+        )
+        self.assertEqual(
+            counters.get("serve.router.splits{tenant=hot}"), 1.0
+        )
+        self.assertGreaterEqual(
+            sum(
+                v
+                for k, v in counters.items()
+                if k.startswith("serve.router.rebalances{")
+            ),
+            1.0,
+        )
+        gauges = self.router_snapshot["gauges"]
+        self.assertIn("serve.fleet.headroom", gauges)
+
+    def test_no_threads_leaked(self):
+        self.assertEqual(self.leaked_threads, [])
+
+    def test_artifacts_written(self):
+        for name in (
+            "fleet.status.json",
+            "fleet.trace.json",
+            "router.obs.json",
+            "router.trace.json",
+            "elastic.summary.json",
+        ):
+            self.assertTrue(
+                os.path.getsize(os.path.join(self.outdir, name)) > 0,
+                name,
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

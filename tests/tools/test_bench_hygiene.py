@@ -206,6 +206,24 @@ class TestBenchHygiene(unittest.TestCase):
                 "memory transport vs the TCP socket, each paired on the "
                 "same run) loses its regression pin",
             )
+        for row in (
+            "config9_elastic_p99_submit_1host_ms",
+            "config9_elastic_p99_submit_scaled_ms",
+            "config9_elastic_p99_ratio",
+            "config9_elastic_hosts_after_scaleup",
+            "config9_elastic_migrations",
+            "config9_elastic_queue_depth_after_scaleup",
+            "config9_elastic_sheds_after_scaleup",
+            "config9_elastic_split_merge_exact",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the elastic-"
+                "fleet contract (ISSUE 19 — autoscale + rebalance + split "
+                "absorbing over-capacity load with zero sheds and an "
+                "exactly-merged split tenant) loses its regression pin",
+            )
 
     def test_loopback_rows_carry_machine_readable_sandbox_caveat(self):
         # ISSUE 15 satellite (ROADMAP 1a/6): the 1-core loopback artifacts
@@ -227,6 +245,7 @@ class TestBenchHygiene(unittest.TestCase):
             "config11_sliced_ratio",
             "config11_sliced_1m_sharded_ratio",
             "config12_obs_stream_overhead",
+            "config9_elastic_p99",
         ):
             self.assertIn(
                 row,

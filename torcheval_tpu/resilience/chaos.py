@@ -31,6 +31,19 @@ families inject here, armed through the environment before launch:
     * ``"ingest_delay"`` — sleep ``TORCHEVAL_TPU_CHAOS_DELAY_S`` before
       queuing the chosen batch, modelling a stalled producer (the fault
       the serve watchdog's idle eviction exists for).
+    * ``"load_spike"`` (alias ``"hot_tenant"``) — the elastic-fleet
+      driver (ISSUE 19): from the chosen step ON, EVERY admitted batch
+      of the chosen tenant pays ``TORCHEVAL_TPU_CHAOS_DELAY_S`` of
+      synthetic service time before queuing. Unlike every other
+      ingestion action this fires REPEATEDLY, never consumes the
+      one-shot budget, and never corrupts the payload — metric results
+      stay bit-identical to a fault-free oracle while the host's
+      ``serve.submit.latency`` histogram and submit EWMA (and therefore
+      its ``load_report`` and the router's placement weight) read
+      deterministically hot, which is exactly what the rebalance and
+      hot-tenant-split paths need to trigger in tests and drills. Set
+      ``TORCHEVAL_TPU_CHAOS_DELAY_S`` explicitly — the 30 s default
+      models a straggler, not a cadence multiplier.
 
     **Host actions** (fire in ``on_host_request``, at the eval wire
     server's request dispatch — the surfaces a whole-host loss presents
@@ -136,7 +149,10 @@ _ENV_STEP = "TORCHEVAL_TPU_CHAOS_STEP"
 _ENV_POISON = "TORCHEVAL_TPU_CHAOS_POISON"
 
 _SYNC_ACTIONS = ("kill", "delay")
-_INGEST_ACTIONS = ("poison", "ingest_delay")
+# load actions fire REPEATEDLY (every matching admitted batch), the rest
+# of the ingest family one-shot; both share the ingest env contract
+_LOAD_ACTIONS = ("load_spike", "hot_tenant")
+_INGEST_ACTIONS = ("poison", "ingest_delay") + _LOAD_ACTIONS
 _HOST_ACTIONS = ("host_kill", "host_partition", "ack_drop")
 _ACK_ACTIONS = ("ack_delay", "ack_reorder")
 _POISON_KINDS = ("nan", "shape")
@@ -180,6 +196,7 @@ class _ChaosConfig:
 _config: Optional[object] = None
 _rounds_seen = 0
 _ingest_fired = False
+_load_logged = False  # load_spike: trace/log once, fire every batch
 _host_fired = False
 _host_submits_seen: dict = {}  # tenant_id -> submit requests observed
 _ack_fired = False
@@ -246,10 +263,12 @@ def reset_for_tests() -> None:
     """Re-read the environment and restart the round/step bookkeeping
     (test hook)."""
     global _config, _rounds_seen, _ingest_fired, _host_fired, _ack_fired
+    global _load_logged
     with _lock:
         _config = None
         _rounds_seen = 0
         _ingest_fired = False
+        _load_logged = False
         _host_fired = False
         _host_submits_seen.clear()
         _ack_fired = False
@@ -484,6 +503,43 @@ def on_ingest(tenant_id: str, step: int, args: Tuple) -> Tuple:
     if cfg is None:
         cfg = _resolve()
     if cfg is False or cfg.action not in _INGEST_ACTIONS:
+        return args
+    if cfg.action in _LOAD_ACTIONS:
+        # load_spike/hot_tenant (ISSUE 19): repeated-fire — every
+        # admitted batch of the armed tenant from the armed step ON pays
+        # delay_s of synthetic service time. Never one-shot, never
+        # corrupting: the elapsed submit (including this sleep) feeds
+        # the daemon's submit EWMA and serve.submit.latency histogram,
+        # so the host's load_report reads deterministically hot while
+        # every metric result stays bit-identical to a fault-free run.
+        global _load_logged
+        if step < cfg.step or cfg.tenant not in ("*", tenant_id):
+            return args
+        if cfg.rank is not None:
+            import jax
+
+            if jax.process_index() != cfg.rank:
+                return args
+        with _lock:
+            first = not _load_logged
+            _load_logged = True
+        if first:
+            if _obs_registry._enabled:
+                _obs_trace.instant(
+                    "resilience.chaos",
+                    kind="chaos",
+                    action=cfg.action,
+                    tenant=tenant_id,
+                    step=step,
+                )
+            _logger.warning(
+                "chaos: load spike on tenant %r from batch %d "
+                "(+%.3fs per batch)",
+                tenant_id,
+                step,
+                cfg.delay_s,
+            )
+        time.sleep(cfg.delay_s)
         return args
     global _ingest_fired
     if (

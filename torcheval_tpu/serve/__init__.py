@@ -58,6 +58,16 @@ against old peers), and the router folds the streams into
 ``EvalRouter.fleet_status()`` / ``fleet_chrome_trace()`` with staleness
 marking. See docs/observability.md ("Fleet telemetry").
 
+Since ISSUE 19 the fleet is *elastic*: placement weights the rendezvous
+draw by each host's folded load report (stale/draining hosts are
+ineligible for new tenants), a background rebalancer migrates tenants
+off hot hosts live with hysteresis (dwell time, improvement threshold,
+bounded moves per pass), a hot tenant's stream can be *split* across
+hosts as replica tenants (per-replica exactly-once; ``compute()`` merges
+bit-identically), and ``EvalRouter.add_host`` / ``remove_host`` plus a
+pluggable :class:`ScalingPolicy` (:class:`HeadroomScalingPolicy`) scale
+the fleet at runtime. See docs/robustness.md ("Elastic fleet").
+
 See docs/robustness.md ("Serving", "Cluster") for the tenant lifecycle,
 the failure-semantics table and the migration contract, and ``bench.py``'s
 ``config7_serve_tenants_*`` / ``config8_cluster_*`` rows for the
@@ -75,7 +85,11 @@ from torcheval_tpu.serve.errors import (
     TenantQuarantinedError,
     WireError,
 )
-from torcheval_tpu.serve.router import EvalRouter
+from torcheval_tpu.serve.router import (
+    EvalRouter,
+    HeadroomScalingPolicy,
+    ScalingPolicy,
+)
 from torcheval_tpu.serve.tenant import TenantHandle, TenantStatus
 from torcheval_tpu.serve.wire import EvalServer
 
@@ -86,7 +100,9 @@ __all__ = [
     "EvalDaemon",
     "EvalRouter",
     "EvalServer",
+    "HeadroomScalingPolicy",
     "ObsSubscription",
+    "ScalingPolicy",
     "ServeError",
     "TenantError",
     "TenantEvictedError",

@@ -1595,6 +1595,21 @@ class EvalClient:
         header, payload = self._call("snapshot", {}, timeout_s=timeout_s)
         return unpack_tree(header["result"], payload)
 
+    def load_report(self, *, timeout_s: Any = _UNSET) -> Dict[str, Any]:
+        """The host's structured ``daemon.load_report()`` (schema 1) over
+        a dedicated cheap wire op — the router rebalancer's pull path
+        when no obs push stream is subscribed (ISSUE 19). An old server
+        that predates the op rejects it as ``WireError("protocol")``;
+        degrade to the ``health()`` embed (same payload, heavier probe)
+        instead of failing — mixed versions degrade, never break."""
+        try:
+            header, _ = self._call("load_report", {}, timeout_s=timeout_s)
+        except WireError as e:
+            if e.reason != "protocol":
+                raise
+            return self.health(timeout_s=timeout_s)["load_report"]
+        return header["load_report"]
+
     # ------------------------------------------------------------ obs stream
     def subscribe_obs(
         self,
@@ -1787,6 +1802,38 @@ class EvalClient:
                 "durable_seq": state.durable_seq,
                 "replay": list(state.replay),
             }
+
+    def drop_tenant(
+        self,
+        tenant_id: str,
+        *,
+        checkpoint: bool = False,
+        timeout_s: Any = _UNSET,
+    ) -> Optional[str]:
+        """Server-side detach WITHOUT local wire state (ISSUE 19: a
+        rebalance move exports the wire state first — ``detach`` would
+        raise client-side ``unknown_tenant`` before ever reaching the
+        host, yet the source daemon's attach record must still be
+        released or the moved tenant keeps a capacity slot and its
+        queue-load signal forever). ``checkpoint=False`` by default: the
+        move's own ``flush`` already published the resume source, and a
+        second publish from the source would only add a stale manifest
+        to the shared root. Idempotent like :meth:`detach`."""
+        try:
+            header, _ = self._call(
+                "detach",
+                {
+                    "tenant": tenant_id,
+                    "checkpoint": bool(checkpoint),
+                    "timeout": self._effective_timeout(timeout_s),
+                },
+                timeout_s=timeout_s,
+            )
+        except ServeError as e:
+            if isinstance(e, WireError) or e.reason != "unknown_tenant":
+                raise
+            header = {}
+        return header.get("checkpoint")
 
     def adopt_tenant(
         self,

@@ -363,8 +363,6 @@ class EvalDaemon:
             raise ValueError(
                 f"window_chunks must be an int >= 1, got {window_chunks!r}."
             )
-        from torcheval_tpu.metrics.collection import MetricCollection
-
         with self._cond:
             if not self._running:
                 self._count_admission("rejected", "daemon_stopped")
@@ -397,92 +395,22 @@ class EvalDaemon:
                     f"rejecting {tenant_id!r} (load shedding at the front "
                     "door — retry after a detach/eviction).",
                 )
+            # a malformed slices config raises raw ValueError (knob
+            # validation, not spec rejection) exactly as before the
+            # builder extraction; build_collection re-normalizes inside
+            self._normalize_slices(slices)
             try:
-                collection = (
-                    metrics
-                    if isinstance(metrics, MetricCollection)
-                    else MetricCollection(metrics)
+                collection = self.build_collection(
+                    metrics,
+                    slices=slices,
+                    approx=approx,
+                    window_chunks=window_chunks,
                 )
-            except (TypeError, ValueError) as e:
+            except ValueError as e:
                 self._count_admission("rejected", "bad_metrics")
                 raise AdmissionError(
-                    "bad_metrics",
-                    f"tenant {tenant_id!r} metrics are not servable: {e}",
+                    "bad_metrics", f"tenant {tenant_id!r} {e}"
                 ) from e
-            slice_cfg = self._normalize_slices(slices)
-            from torcheval_tpu.metrics.sliced import (
-                SlicedMetricCollection,
-                check_sliceable,
-            )
-
-            if slice_cfg is not None and not isinstance(
-                collection, SlicedMetricCollection
-            ):
-                # sliceability dry pass BEFORE the approx knob commits:
-                # validate-then-commit must cover slice-expanded members
-                # too — a spec with one unsliceable member rejects here
-                # without any member having been switched to sketch state
-                try:
-                    for m in collection.metrics.values():
-                        check_sliceable(m, approx=approx)
-                except ValueError as e:
-                    self._count_admission("rejected", "bad_metrics")
-                    raise AdmissionError(
-                        "bad_metrics",
-                        f"tenant {tenant_id!r} cannot run slices="
-                        f"{slices!r}: {e}",
-                    ) from e
-            if approx is not None and approx is not False:
-                # per-tenant sketch opt-in (ROADMAP 4(c)): switch every
-                # approx-capable member at admission; reject when the spec
-                # has no capable member or a member cannot switch.
-                # Validate-then-commit: the dry pass runs EVERY member's
-                # checks before anything mutates, so a rejection never
-                # leaves a caller-held instance half-switched into a
-                # changed state schema.
-                from torcheval_tpu.sketch.cache import enable_metric_approx
-
-                try:
-                    capable = [
-                        enable_metric_approx(m, approx, dry_run=True)
-                        for m in collection.metrics.values()
-                    ]
-                except ValueError as e:
-                    self._count_admission("rejected", "bad_metrics")
-                    raise AdmissionError(
-                        "bad_metrics",
-                        f"tenant {tenant_id!r} cannot run approx={approx!r}: "
-                        f"{e}",
-                    ) from e
-                if not any(capable):
-                    self._count_admission("rejected", "bad_metrics")
-                    raise AdmissionError(
-                        "bad_metrics",
-                        f"tenant {tenant_id!r} asked for approx={approx!r} "
-                        "but no metric in its spec has an approx mode.",
-                    )
-                for m in collection.metrics.values():
-                    enable_metric_approx(m, approx)
-            if slice_cfg is not None and not isinstance(
-                collection, SlicedMetricCollection
-            ):
-                try:
-                    collection = SlicedMetricCollection(
-                        collection.metrics, **slice_cfg
-                    )
-                except ValueError as e:
-                    self._count_admission("rejected", "bad_metrics")
-                    raise AdmissionError(
-                        "bad_metrics",
-                        f"tenant {tenant_id!r} cannot run slices="
-                        f"{slices!r}: {e}",
-                    ) from e
-            if window_chunks is not None:
-                # per-instance valve override (the collection's budget
-                # check reads the probe member; each member's own 2x
-                # self-valve scales off the same attribute)
-                for m in getattr(collection, "_deferred", {}).values():
-                    m._DEFER_MAX_CHUNKS = window_chunks
             ckpt_dir = self._tenant_ckpt_dir(tenant_id, create=False)
             # reserve the id + a capacity slot, then RELEASE the lock for
             # the checkpoint I/O below: a migration restore can take long
@@ -581,6 +509,101 @@ class EvalDaemon:
             if _obs._enabled:
                 _obs.gauge("serve.tenants.active", float(len(self._tenants)))
         return TenantHandle(self, tenant)
+
+    @staticmethod
+    def build_collection(
+        metrics,
+        *,
+        slices=None,
+        approx=None,
+        window_chunks=None,
+    ):
+        """Construct the servable collection EXACTLY as attach admission
+        does — the ONE constructor shared by daemon admission and the
+        router's split-tenant merged compute (ISSUE 19: a replica's
+        flush checkpoint restores only into an identically-built
+        collection, so the merge path must never re-implement this).
+        Order matters and is the admission contract: sliceability dry
+        pass BEFORE the ``approx`` knob commits (validate-then-commit
+        covers slice-expanded members), then the sketch switch, then the
+        slice expansion, then the per-instance window valve. Raises
+        ``ValueError`` carrying the admission message tail; ``attach``
+        prefixes the tenant id and wraps it as
+        ``AdmissionError("bad_metrics")``."""
+        from torcheval_tpu.metrics.collection import MetricCollection
+
+        try:
+            collection = (
+                metrics
+                if isinstance(metrics, MetricCollection)
+                else MetricCollection(metrics)
+            )
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"metrics are not servable: {e}") from e
+        slice_cfg = EvalDaemon._normalize_slices(slices)
+        from torcheval_tpu.metrics.sliced import (
+            SlicedMetricCollection,
+            check_sliceable,
+        )
+
+        if slice_cfg is not None and not isinstance(
+            collection, SlicedMetricCollection
+        ):
+            # sliceability dry pass BEFORE the approx knob commits:
+            # validate-then-commit must cover slice-expanded members
+            # too — a spec with one unsliceable member rejects here
+            # without any member having been switched to sketch state
+            try:
+                for m in collection.metrics.values():
+                    check_sliceable(m, approx=approx)
+            except ValueError as e:
+                raise ValueError(
+                    f"cannot run slices={slices!r}: {e}"
+                ) from e
+        if approx is not None and approx is not False:
+            # per-tenant sketch opt-in (ROADMAP 4(c)): switch every
+            # approx-capable member at admission; reject when the spec
+            # has no capable member or a member cannot switch.
+            # Validate-then-commit: the dry pass runs EVERY member's
+            # checks before anything mutates, so a rejection never
+            # leaves a caller-held instance half-switched into a
+            # changed state schema.
+            from torcheval_tpu.sketch.cache import enable_metric_approx
+
+            try:
+                capable = [
+                    enable_metric_approx(m, approx, dry_run=True)
+                    for m in collection.metrics.values()
+                ]
+            except ValueError as e:
+                raise ValueError(
+                    f"cannot run approx={approx!r}: {e}"
+                ) from e
+            if not any(capable):
+                raise ValueError(
+                    f"asked for approx={approx!r} but no metric in its "
+                    "spec has an approx mode."
+                )
+            for m in collection.metrics.values():
+                enable_metric_approx(m, approx)
+        if slice_cfg is not None and not isinstance(
+            collection, SlicedMetricCollection
+        ):
+            try:
+                collection = SlicedMetricCollection(
+                    collection.metrics, **slice_cfg
+                )
+            except ValueError as e:
+                raise ValueError(
+                    f"cannot run slices={slices!r}: {e}"
+                ) from e
+        if window_chunks is not None:
+            # per-instance valve override (the collection's budget
+            # check reads the probe member; each member's own 2x
+            # self-valve scales off the same attribute)
+            for m in getattr(collection, "_deferred", {}).values():
+                m._DEFER_MAX_CHUNKS = window_chunks
+        return collection
 
     @staticmethod
     def _normalize_slices(slices) -> Optional[dict]:

@@ -53,12 +53,60 @@ push intervals — is ``stale`` BEFORE the failure detector evicts it);
 ``fleet_chrome_trace()`` merges every host's pushed timeline events into
 one Chrome trace, pid per host. None of it adds collective rounds: the
 stream rides the serve wire, not the toolkit funnel.
+
+Elastic fleet (ISSUE 19) — the Podracer stance: the fleet grows, shrinks
+and rebalances under load instead of capping throughput at one hot host:
+
+* **load-aware placement** — ``_place`` is *weighted* rendezvous: each
+  alive endpoint's rendezvous draw is scored ``-w / ln(u)`` (highest
+  score wins) where ``u`` is the tenant-endpoint hash mapped into (0,1)
+  and the weight ``w`` folds that host's latest fresh ``load_report``
+  (queue utilization, tenant-slot utilization, submit p99/EWMA against
+  ``latency_target_s``, optional HBM budget). With no load signal every
+  weight is 1 and the argmax is EXACTLY the classic unweighted
+  rendezvous (a monotone transform of the same draw), so placement
+  stays deterministic and minimal-movement; hosts whose fresh report
+  says ``draining`` — or whose subscribed stream went silent past the
+  staleness horizon — are ineligible for NEW tenants;
+* **rebalancing** — ``rebalance()`` (one pass; ``start_rebalancer()``
+  runs it on a timer) migrates tenants off hot hosts through the SAME
+  checkpoint+replay machinery as failure migration, made loss-proof for
+  a live source: flush (durable resume point) → ``export_tenant`` (wire
+  state + booked tail carried off; racing submits absorb through the
+  reroute-grace window) → ``drop_tenant`` on the source → re-attach
+  ``resume="auto"`` + ``adopt_tenant`` on the target. Hysteresis knobs
+  (``hot_load`` threshold, minimum ``improvement`` gap, per-tenant
+  ``min_dwell_s``, ``max_moves`` per pass) bound movement so the fleet
+  provably never thrashes;
+* **hot-tenant splitting** — ``split_tenant(tid, n)`` shards one
+  tenant's stream across N replica tenants (``tid``, ``tid@r1``, …),
+  each a first-class routed tenant with its OWN seq namespace (the
+  replica id IS the dedup key, so exactly-once holds per replica and
+  failover/migration work per-replica unchanged). ``submit`` fans out
+  by a stable hash of the split ordinal; ``compute`` flushes every
+  replica, rebuilds each collection through the daemon's own
+  ``build_collection`` path, restores the flush checkpoints, and merges
+  — ``merge_collections`` for sliced tenants (cohorts re-keyed by
+  original id), per-member ``merge_state`` otherwise — bit-identical to
+  the single-stream oracle;
+* **autoscale hooks** — ``add_host()`` / ``remove_host()`` (= drain +
+  forget) at runtime, and ``autoscale_step(policy)`` drives a pluggable
+  :class:`ScalingPolicy` from ``fleet_status()``'s aggregate
+  ``headroom`` scalar, so a bench-driven simulator or an external
+  orchestrator grows the fleet under load.
+
+New instruments: ``serve.router.rebalances{endpoint=}`` (one per
+completed rebalance move, alongside
+``serve.router.migrations{reason=rebalance}``),
+``serve.router.splits{tenant=}``, and the ``serve.fleet.headroom``
+gauge recorded by ``fleet_status()``.
 """
 
 from __future__ import annotations
 
 import hashlib
 import logging
+import math
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
@@ -70,16 +118,43 @@ from torcheval_tpu.serve.errors import AdmissionError, ServeError, WireError
 
 _logger = logging.getLogger(__name__)
 
-__all__ = ["EvalRouter"]
+__all__ = ["EvalRouter", "HeadroomScalingPolicy", "ScalingPolicy"]
+
+
+def _replica_id(tenant_id: str, k: int) -> str:
+    """Replica ``k``'s tenant id. Replica 0 IS the original tenant (its
+    id, state, and checkpoint lineage are unchanged by a split); higher
+    replicas get a namespaced id, which makes the replica id part of the
+    wire dedup key for free — each replica runs its own monotonic seq."""
+    return tenant_id if k == 0 else f"{tenant_id}@r{k}"
 
 
 class _RoutedTenant:
-    __slots__ = ("spec", "knobs", "endpoint")
+    __slots__ = (
+        "spec",
+        "knobs",
+        "endpoint",
+        "placed_at",
+        "replicas",
+        "parent",
+        "split_next",
+    )
 
-    def __init__(self, spec: Any, knobs: Dict[str, Any], endpoint: str):
+    def __init__(
+        self,
+        spec: Any,
+        knobs: Dict[str, Any],
+        endpoint: str,
+        *,
+        parent: Optional[str] = None,
+    ):
         self.spec = spec
         self.knobs = knobs
         self.endpoint = endpoint
+        self.placed_at = time.monotonic()  # rebalance dwell clock
+        self.replicas: Optional[List[str]] = None  # split parent only
+        self.parent = parent  # set on replicas k >= 1
+        self.split_next = 0  # fan-out ordinal (split parent only)
 
 
 class EvalRouter:
@@ -103,6 +178,8 @@ class EvalRouter:
         client_factory: Any = EvalClient,
         reroute_grace_s: float = 60.0,
         probe_timeout_s: Optional[float] = 5.0,
+        latency_target_s: float = 1.0,
+        hbm_budget_bytes: Optional[int] = None,
         **client_kwargs: Any,
     ) -> None:
         if not endpoints:
@@ -112,6 +189,7 @@ class EvalRouter:
         for knob, value in (
             ("reroute_grace_s", reroute_grace_s),
             ("probe_timeout_s", probe_timeout_s),
+            ("latency_target_s", latency_target_s),
         ):
             try:
                 _check_timeout_s(value)
@@ -119,8 +197,26 @@ class EvalRouter:
                 raise ValueError(f"{knob}: {e}") from None
         if reroute_grace_s is None:
             raise ValueError("reroute_grace_s must be a positive number.")
+        if latency_target_s is None:
+            raise ValueError("latency_target_s must be a positive number.")
+        if hbm_budget_bytes is not None and (
+            not isinstance(hbm_budget_bytes, int) or hbm_budget_bytes < 1
+        ):
+            raise ValueError(
+                f"hbm_budget_bytes must be a positive int or None, got "
+                f"{hbm_budget_bytes!r}."
+            )
         self._reroute_grace_s = float(reroute_grace_s)
         self._probe_timeout_s = probe_timeout_s
+        # load-score knobs (ISSUE 19): submit p99 at/above the latency
+        # target reads as full pressure; HBM pressure participates only
+        # when a budget is declared
+        self._latency_target_s = float(latency_target_s)
+        self._hbm_budget_bytes = hbm_budget_bytes
+        # kept so add_host() can mint new per-host clients at runtime
+        # with the exact construction the initial endpoints got
+        self._client_factory = client_factory
+        self._client_kwargs = dict(client_kwargs)
         self._clients: Dict[str, EvalClient] = {}
         for ep in endpoints:
             client = client_factory(ep, **client_kwargs)
@@ -146,23 +242,129 @@ class EvalRouter:
         self._obs_interval_s: Optional[float] = None
         self._stale_after_s: Optional[float] = None
         self._fleet_max_events = 4096
+        # background rebalancer (ISSUE 19)
+        self._rebalance_thread: Optional[threading.Thread] = None
+        self._rebalance_stop = threading.Event()
 
     # ------------------------------------------------------------ placement
-    def _place(self, tenant_id: str) -> str:
-        """Rendezvous placement over the alive set (deterministic for a
-        given alive set; no state to rebalance when hosts die)."""
+    def _host_load(self, report: Optional[Dict[str, Any]]) -> float:
+        """Fold one schema-1 ``load_report`` into a scalar load in
+        [0, 0.999]: the max of queue utilization, tenant-slot
+        utilization, submit latency pressure (p99, else EWMA, against
+        ``latency_target_s``), and — when ``hbm_budget_bytes`` is set —
+        HBM pressure. Max (not mean): placement must route around the
+        binding constraint, whichever it is."""
+        if not report:
+            return 0.0
+        pressures = [0.0]
+        queue = report.get("queue") or {}
+        qcap = queue.get("capacity") or 0
+        if qcap:
+            pressures.append(
+                float(queue.get("depth", 0)) / float(qcap)
+            )
+        capacity = report.get("capacity") or {}
+        max_t = capacity.get("max_tenants") or 0
+        if max_t:
+            pressures.append(
+                float(capacity.get("active_tenants", 0)) / float(max_t)
+            )
+        latency = report.get("latency") or {}
+        p99 = (
+            latency.get("submit_p99_s")
+            or latency.get("submit_ewma_s")
+            or 0.0
+        )
+        pressures.append(float(p99) / self._latency_target_s)
+        if self._hbm_budget_bytes:
+            hbm = report.get("hbm") or {}
+            pressures.append(
+                float(hbm.get("bytes_sum", 0.0))
+                / float(self._hbm_budget_bytes)
+            )
+        return min(0.999, max(0.0, max(pressures)))
+
+    def _fleet_loads(self) -> Dict[str, Dict[str, Any]]:
+        """Per-alive-endpoint load view from the folded fleet state:
+        ``{ep: {"load": float|None, "draining": bool, "suspect":
+        bool}}``. Only a FRESH report (inside the staleness horizon)
+        contributes ``load`` and ``draining`` — a stale number must not
+        weight placement. ``suspect`` marks a host whose subscribed
+        stream delivered at least once and then went quiet past the
+        horizon: ineligible for new tenants until the failure detector
+        rules (a host never heard from carries no signal and stays
+        eligible — no signal is not bad signal)."""
+        horizon = self._stale_after_s if self._stale_after_s else 3.0
+        now = time.monotonic()
+        out: Dict[str, Dict[str, Any]] = {}
+        alive = self.alive
+        with self._fleet_lock:
+            for ep in alive:
+                rec = self._fleet.get(ep)
+                subscribed = ep in self._obs_subs
+                report = rec["report"] if rec else None
+                age = (
+                    now - rec["received_at"]
+                    if rec is not None and rec["received_at"]
+                    else None
+                )
+                fresh = age is not None and age <= horizon
+                out[ep] = {
+                    "load": (
+                        self._host_load(report)
+                        if fresh and report is not None
+                        else None
+                    ),
+                    "draining": bool(
+                        fresh and report and report.get("draining")
+                    ),
+                    "suspect": bool(
+                        subscribed and age is not None and not fresh
+                    ),
+                }
+        return out
+
+    def _place(self, tenant_id: str, *, exclude: Any = ()) -> str:
+        """Weighted rendezvous placement over the alive set: every
+        endpoint's hash draw ``u`` is scored ``-w / ln(u)`` and the
+        highest score wins, with weight ``w = 1 - load`` folded from the
+        host's latest fresh ``load_report``. With no load signal every
+        weight is 1 and the argmax is EXACTLY classic
+        highest-random-weight hashing (monotone transform of the same
+        draw) — deterministic for a given alive set, minimal-movement
+        when hosts die. Hosts whose fresh report says ``draining``, or
+        whose subscribed stream went silent past the staleness horizon,
+        are ineligible for NEW tenants (unless that would empty the
+        candidate set — a merely-quiet fleet must still place)."""
         with self._lock:
             alive = sorted(self._alive)
+        if exclude:
+            alive = [ep for ep in alive if ep not in exclude]
         if not alive:
             raise ServeError(
                 "no_hosts", "every endpoint is dead or drained."
             )
-        return max(
-            alive,
-            key=lambda ep: hashlib.sha256(
+        info = self._fleet_loads()
+        eligible = [
+            ep
+            for ep in alive
+            if ep not in info
+            or not (info[ep]["draining"] or info[ep]["suspect"])
+        ] or alive
+        best, best_score = None, -math.inf
+        for ep in eligible:
+            load = info.get(ep, {}).get("load")
+            weight = max(1e-3, 1.0 - (load or 0.0))
+            digest = hashlib.sha256(
                 f"{tenant_id}@{ep}".encode()
-            ).digest(),
-        )
+            ).digest()
+            # first 8 digest bytes -> u in (0,1); ln(u) < 0, so the
+            # score is positive and monotone in u at equal weights
+            u = (int.from_bytes(digest[:8], "big") + 0.5) / 2.0**64
+            score = -weight / math.log(u)
+            if score > best_score:
+                best, best_score = ep, score
+        return best
 
     @property
     def endpoints(self) -> List[str]:
@@ -179,6 +381,7 @@ class EvalRouter:
             return {t: rec.endpoint for t, rec in self._tenants.items()}
 
     def close(self) -> None:
+        self.stop_rebalancer()  # before the clients its moves would use
         self.unsubscribe_obs()
         for client in self._clients.values():
             client.close()
@@ -202,8 +405,24 @@ class EvalRouter:
                     "duplicate_tenant",
                     f"tenant {tenant_id!r} is already routed.",
                 )
+        ep = self._attach_anywhere(tenant_id, spec, knobs)
+        with self._lock:
+            self._tenants[tenant_id] = _RoutedTenant(spec, dict(knobs), ep)
+        return ep
+
+    def _attach_anywhere(
+        self,
+        tenant_id: str,
+        spec: Dict[str, Any],
+        knobs: Dict[str, Any],
+        *,
+        exclude: Any = (),
+    ) -> str:
+        """Place-and-attach with dead/draining-host absorption; returns
+        the endpoint that admitted the tenant. Does NOT touch the
+        routing table — callers record the placement."""
         while True:
-            ep = self._place(tenant_id)
+            ep = self._place(tenant_id, exclude=exclude)
             try:
                 self._clients[ep].attach(tenant_id, spec, **knobs)
             except WireError as e:
@@ -220,8 +439,6 @@ class EvalRouter:
                 # just waits for it) and re-place among the survivors
                 self._host_failed(ep, cause=e)
                 continue
-            with self._lock:
-                self._tenants[tenant_id] = _RoutedTenant(spec, dict(knobs), ep)
             return ep
 
     def _routed(self, tenant_id: str) -> _RoutedTenant:
@@ -270,6 +487,26 @@ class EvalRouter:
                 raise
 
     def submit(self, tenant_id: str, *args: Any, **kw: Any) -> bool:
+        """Deliver one batch; a split tenant fans out by stable hash.
+
+        For an unsplit tenant this is :meth:`_submit_one` directly. For a
+        split tenant, a monotone per-tenant ordinal is hashed to pick the
+        replica, so the fan-out is deterministic given arrival order and
+        any retry of THIS batch stays on the replica that booked its seq
+        (exactly-once holds per replica namespace)."""
+        rec = self._routed(tenant_id)
+        with self._lock:
+            replicas = list(rec.replicas) if rec.replicas else None
+            if replicas:
+                ordinal = rec.split_next
+                rec.split_next = ordinal + 1
+        if not replicas:
+            return self._submit_one(tenant_id, *args, **kw)
+        digest = hashlib.sha256(f"{tenant_id}#{ordinal}".encode()).digest()
+        target = replicas[int.from_bytes(digest[:8], "big") % len(replicas)]
+        return self._submit_one(target, *args, **kw)
+
+    def _submit_one(self, tenant_id: str, *args: Any, **kw: Any) -> bool:
         """Deliver one batch, surviving a host death or drain mid-submit.
 
         A transport-failed submit whose batch was already booked in the
@@ -359,21 +596,55 @@ class EvalRouter:
                 sleep_s = min(sleep_s * 2, 0.5)
 
     def compute(self, tenant_id: str, **kw: Any) -> Any:
+        rec = self._routed(tenant_id)
+        if rec.replicas:
+            return self._merged_compute(tenant_id, rec, **kw)
         return self._with_failover(
             tenant_id, lambda c: c.compute(tenant_id, **kw)
         )
 
     def sync_compute(self, tenant_id: str, **kw: Any) -> Any:
+        rec = self._routed(tenant_id)
+        if rec.replicas:
+            raise ServeError(
+                "split_tenant",
+                f"tenant {tenant_id!r} is split across "
+                f"{len(rec.replicas)} replicas; sync_compute cannot run a "
+                "collective barrier across replica streams — use "
+                "compute(), which merges replica state.",
+            )
         return self._with_failover(
             tenant_id, lambda c: c.sync_compute(tenant_id, **kw)
         )
 
     def flush(self, tenant_id: str, **kw: Any) -> dict:
+        rec = self._routed(tenant_id)
+        if rec.replicas:
+            return {
+                rid: self._with_failover(
+                    rid, lambda c, rid=rid: c.flush(rid, **kw)
+                )
+                for rid in list(rec.replicas)
+            }
         return self._with_failover(
             tenant_id, lambda c: c.flush(tenant_id, **kw)
         )
 
     def detach(self, tenant_id: str, **kw: Any) -> Optional[str]:
+        rec = self._routed(tenant_id)
+        if rec.replicas:
+            result: Optional[str] = None
+            for rid in list(rec.replicas):
+                try:
+                    out = self._with_failover(
+                        rid, lambda c, rid=rid: c.detach(rid, **kw)
+                    )
+                finally:
+                    with self._lock:
+                        self._tenants.pop(rid, None)
+                if rid == tenant_id:
+                    result = out
+            return result
         try:
             return self._with_failover(
                 tenant_id, lambda c: c.detach(tenant_id, **kw)
@@ -381,6 +652,149 @@ class EvalRouter:
         finally:
             with self._lock:
                 self._tenants.pop(tenant_id, None)
+
+    # ------------------------------------------------------ tenant splitting
+    def split_tenant(self, tenant_id: str, replicas: int = 2) -> Dict[str, str]:
+        """Shard a hot tenant's stream across ``replicas`` replica tenants.
+
+        The existing stream keeps running as replica 0 under its original
+        id (nothing already booked moves); replicas 1..n-1 attach as
+        first-class routed tenants ``{tid}@r{k}`` with the same
+        spec/knobs, preferring hosts the tenant does not already occupy.
+        From the next :meth:`submit` on, batches fan out by stable hash;
+        each replica owns its own seq namespace, so exactly-once (dedup,
+        replay, migration) holds PER REPLICA. :meth:`compute` merges the
+        replica states back into one result (``merge_collections`` for
+        sliced tenants, per-member ``merge_state`` otherwise) —
+        bit-identical to the single-stream fold. Atomic: a mid-split
+        attach failure detaches the replicas already created and leaves
+        the tenant unsplit. Returns ``{replica_id: endpoint}``."""
+        if not isinstance(replicas, int) or isinstance(replicas, bool) \
+                or replicas < 2:
+            raise ValueError(
+                f"asked for replicas={replicas!r}; a split needs an int "
+                ">= 2 (1 replica is just the unsplit tenant)."
+            )
+        rec = self._routed(tenant_id)
+        if rec.parent is not None:
+            raise ServeError(
+                "split_tenant",
+                f"tenant {tenant_id!r} is already a replica of "
+                f"{rec.parent!r}; split the parent instead.",
+            )
+        if rec.replicas:
+            raise ServeError(
+                "split_tenant",
+                f"tenant {tenant_id!r} is already split into "
+                f"{len(rec.replicas)} replicas.",
+            )
+        # replicas must start from a clean seq namespace of their own —
+        # a "resume" knob would try to adopt the PARENT's checkpoint
+        child_knobs = {
+            k: v for k, v in rec.knobs.items() if k != "resume"
+        }
+        placed: Dict[str, str] = {tenant_id: rec.endpoint}
+        created: List[str] = []
+        try:
+            for k in range(1, replicas):
+                rid = _replica_id(tenant_id, k)
+                with self._lock:
+                    if rid in self._tenants:
+                        raise ServeError(
+                            "duplicate_tenant",
+                            f"replica id {rid!r} is already routed.",
+                        )
+                try:
+                    ep = self._attach_anywhere(
+                        rid, rec.spec, child_knobs,
+                        exclude=frozenset(placed.values()),
+                    )
+                except ServeError as e:
+                    if e.reason != "no_hosts":
+                        raise
+                    # fewer hosts than replicas: spreading is best-effort,
+                    # the split itself must not require fleet growth
+                    ep = self._attach_anywhere(rid, rec.spec, child_knobs)
+                with self._lock:
+                    self._tenants[rid] = _RoutedTenant(
+                        rec.spec, dict(child_knobs), ep, parent=tenant_id
+                    )
+                placed[rid] = ep
+                created.append(rid)
+        except BaseException:
+            for rid in created:
+                try:
+                    self.detach(rid)
+                except (ServeError, WireError):
+                    _logger.warning(
+                        "router: could not roll back replica %r after a "
+                        "failed split of %r", rid, tenant_id,
+                    )
+            raise
+        with self._lock:
+            rec.replicas = [
+                _replica_id(tenant_id, k) for k in range(replicas)
+            ]
+            rec.split_next = 0
+        if _obs._enabled:
+            _obs.counter("serve.router.splits", tenant=tenant_id)
+            _trace.instant(
+                "serve.router.split",
+                kind="router",
+                tenant=tenant_id,
+                replicas=replicas,
+            )
+        _logger.info(
+            "router: split tenant %r into %d replicas: %s",
+            tenant_id, replicas, placed,
+        )
+        return placed
+
+    def _merged_compute(
+        self, tenant_id: str, rec: _RoutedTenant, **kw: Any
+    ) -> Any:
+        """Compute a split tenant: flush every replica to its durable
+        checkpoint, rebuild one collection per replica from the recorded
+        spec/knobs, restore, and fold replicas 1..n-1 into replica 0 —
+        ``merge_collections`` re-keys cohorts by original id for sliced
+        tenants; plain collections merge member-by-member. The result is
+        bit-identical to computing the same batches on one stream."""
+        from torcheval_tpu.metrics import SlicedMetricCollection
+        from torcheval_tpu.resilience.snapshot import restore
+        from torcheval_tpu.serve.daemon import EvalDaemon
+        from torcheval_tpu.serve.wire import build_metrics
+
+        paths: Dict[str, str] = {}
+        for rid in list(rec.replicas):
+            out = self._with_failover(
+                rid, lambda c, rid=rid: c.flush(rid, **kw)
+            )
+            path = (out or {}).get("path")
+            if not path:
+                raise ServeError(
+                    "no_checkpoint",
+                    f"replica {rid!r} of split tenant {tenant_id!r} has "
+                    "no durable checkpoint to merge (its host serves "
+                    "without a checkpoint directory?).",
+                )
+            paths[rid] = path
+        knobs = rec.knobs
+        rebuilt = []
+        for rid in list(rec.replicas):
+            collection = EvalDaemon.build_collection(
+                build_metrics(rec.spec),
+                slices=knobs.get("slices"),
+                approx=knobs.get("approx"),
+                window_chunks=knobs.get("window_chunks"),
+            )
+            rebuilt.append(restore(collection, paths[rid]))
+        base, others = rebuilt[0], rebuilt[1:]
+        if isinstance(base, SlicedMetricCollection):
+            base.merge_collections(others)
+        else:
+            for name, member in base.metrics.items():
+                member.merge_state([o.metrics[name] for o in others])
+        return base.compute()
 
     # --------------------------------------------------------------- health
     def health(
@@ -534,6 +948,7 @@ class EvalRouter:
         now = time.monotonic()
         alive = set(self.alive)
         hosts: Dict[str, Any] = {}
+        fresh_loads: List[float] = []
         with self._fleet_lock:
             endpoints = set(self._fleet) | set(self._obs_subs)
             for ep in sorted(endpoints | alive):
@@ -544,6 +959,9 @@ class EvalRouter:
                     if rec is not None and rec["received_at"]
                     else None
                 )
+                report = rec["report"] if rec else None
+                load = self._host_load(report) if report else None
+                stale = age is None or age > stale_after_s
                 hosts[ep] = {
                     "alive": ep in alive,
                     "mode": rec["mode"] if rec else (
@@ -551,15 +969,35 @@ class EvalRouter:
                     ),
                     "subscribed": sub is not None,
                     "age_s": age,
-                    "stale": age is None or age > stale_after_s,
-                    "load_report": rec["report"] if rec else None,
+                    "stale": stale,
+                    "load_report": report,
+                    "load": load,
                     "pushes": rec["pushes"] if rec else 0,
                 }
+                if (
+                    ep in alive
+                    and not stale
+                    and load is not None
+                    and not (report or {}).get("draining")
+                ):
+                    fresh_loads.append(load)
+        # aggregate spare capacity across hosts with a FRESH report:
+        # 1.0 = idle fleet, 0.0 = every reporting host saturated, None =
+        # nobody is reporting (a policy must not scale on silence)
+        headroom = (
+            1.0 - sum(fresh_loads) / len(fresh_loads)
+            if fresh_loads
+            else None
+        )
+        if _obs._enabled and headroom is not None:
+            _obs.gauge("serve.fleet.headroom", float(headroom))
         return {
+            "schema": 1,
             "hosts": hosts,
             "alive": sorted(alive),
             "tenants": self.placement(),
             "stale_after_s": float(stale_after_s),
+            "headroom": headroom,
         }
 
     def fleet_snapshot(self, endpoint: str) -> Dict[str, Any]:
@@ -727,6 +1165,7 @@ class EvalRouter:
         )
         with self._lock:
             rec.endpoint = new_ep
+            rec.placed_at = time.monotonic()  # restart the dwell clock
         if _obs._enabled:
             _obs.counter("serve.router.migrations", reason=reason)
         _logger.warning(
@@ -739,3 +1178,398 @@ class EvalRouter:
             int(attach_resp["last_seq"]),
             replayed,
         )
+
+    # ------------------------------------------------------------ rebalance
+    def rebalance(
+        self,
+        *,
+        hot_load: float = 0.75,
+        improvement: float = 0.15,
+        min_dwell_s: float = 10.0,
+        max_moves: int = 1,
+    ) -> List[str]:
+        """One load-rebalancing pass: move tenants off hot hosts onto
+        the coldest eligible ones using the LIVE-host migration protocol
+        (flush -> export -> drop -> re-attach -> adopt; the replay tail
+        makes the move exactly-once even for batches booked mid-failure).
+
+        Thrash-proof by construction, not by tuning: a host is hot only
+        at fresh ``load >= hot_load``; a move happens only onto a target
+        at least ``improvement`` colder than the source (so a move can
+        never create a hotter imbalance than it cured); a tenant moves at
+        most once per ``min_dwell_s`` (the dwell clock resets on every
+        placement); and one pass moves at most ``max_moves`` tenants.
+        Returns the moved tenant ids."""
+        if max_moves < 1:
+            raise ValueError(f"max_moves must be >= 1, got {max_moves}.")
+        info = self._fleet_loads()
+        with self._cv:
+            migrating = set(self._migrating)
+        loads = {
+            ep: d["load"]
+            for ep, d in info.items()
+            if d["load"] is not None and ep not in migrating
+        }
+        hot = sorted(
+            (
+                ep
+                for ep, load in loads.items()
+                if load >= hot_load and not info[ep]["draining"]
+            ),
+            key=lambda ep: -loads[ep],
+        )
+        moved: List[str] = []
+        if not hot:
+            return moved
+        now = time.monotonic()
+        for src_ep in hot:
+            if len(moved) >= max_moves:
+                break
+            targets = sorted(
+                (
+                    ep
+                    for ep, load in loads.items()
+                    if ep != src_ep
+                    and not info[ep]["draining"]
+                    and not info[ep]["suspect"]
+                    and loads[src_ep] - load >= improvement
+                ),
+                key=lambda ep: loads[ep],
+            )
+            if not targets:
+                continue
+            with self._lock:
+                candidates = [
+                    t
+                    for t, rec in self._tenants.items()
+                    if rec.endpoint == src_ep
+                    and now - rec.placed_at >= min_dwell_s
+                ]
+            for tenant_id in candidates:
+                if len(moved) >= max_moves:
+                    break
+                if self._rebalance_move(tenant_id, src_ep, targets[0]):
+                    moved.append(tenant_id)
+        if moved:
+            _logger.info(
+                "router: rebalance moved %d tenant(s): %s", len(moved),
+                moved,
+            )
+        return moved
+
+    def _rebalance_move(
+        self, tenant_id: str, from_ep: str, to_ep: str
+    ) -> bool:
+        """Move one LIVE tenant ``from_ep -> to_ep``. Unlike the failure
+        path, the source is healthy: flush first (durable resume point),
+        export the client wire state (racing submits start absorbing into
+        the reroute grace window here), release the source slot WITHOUT a
+        second checkpoint (the flush already published the resume
+        source), then attach-resume + adopt on the target — the adopt
+        replays only the booked-but-not-durable tail, so exactly-once
+        holds across the move. If the chosen target refuses, the tenant
+        falls back onto the source; a tenant that can be placed nowhere
+        is dropped from the routing table with a loud log (the same
+        containment wall as failure migration). Returns True if the
+        tenant moved."""
+        with self._lock:
+            rec = self._tenants.get(tenant_id)
+        if rec is None or rec.endpoint != from_ep:
+            return False  # detached or moved underneath us
+        src = self._clients[from_ep]
+        knobs = dict(rec.knobs)
+        knobs["resume"] = "auto"  # restore the shared-root checkpoint
+        with _obs.span(
+            "serve.router.migrate", endpoint=from_ep, reason="rebalance"
+        ):
+            try:
+                src.flush(tenant_id)
+                exported = src.export_tenant(tenant_id)
+            except (ServeError, WireError) as e:
+                # the source refused the hand-off: nothing moved, the
+                # tenant still serves where it was — just skip this pass
+                _logger.warning(
+                    "router: rebalance of %r could not export from %s: "
+                    "%s", tenant_id, from_ep, e,
+                )
+                return False
+            try:
+                src.drop_tenant(tenant_id, checkpoint=False)
+            except (ServeError, WireError) as e:
+                _logger.warning(
+                    "router: rebalance of %r: source %s did not release "
+                    "its slot cleanly: %s", tenant_id, from_ep, e,
+                )
+            replayed = None
+            for target in (to_ep, from_ep):
+                try:
+                    resp = self._clients[target].attach(
+                        tenant_id, rec.spec, **knobs
+                    )
+                    replayed = self._clients[target].adopt_tenant(
+                        tenant_id,
+                        exported,
+                        restored_seq=int(resp["last_seq"]),
+                    )
+                    new_ep = target
+                    break
+                except (ServeError, WireError) as e:
+                    _logger.warning(
+                        "router: rebalance target %s refused tenant %r: "
+                        "%s", target, tenant_id, e,
+                    )
+            if replayed is None:
+                _logger.error(
+                    "router: tenant %r could not be re-placed after a "
+                    "rebalance export off %s; dropping it from the "
+                    "routing table.", tenant_id, from_ep,
+                )
+                with self._lock:
+                    self._tenants.pop(tenant_id, None)
+                return False
+        with self._lock:
+            rec.endpoint = new_ep
+            rec.placed_at = time.monotonic()
+        if _obs._enabled:
+            _obs.counter("serve.router.migrations", reason="rebalance")
+            _obs.counter("serve.router.rebalances", endpoint=from_ep)
+        if new_ep == from_ep:
+            return False  # fell back home: no rebalance happened
+        _logger.info(
+            "router: rebalanced tenant %r %s -> %s (replayed %d)",
+            tenant_id, from_ep, new_ep, replayed,
+        )
+        return True
+
+    def start_rebalancer(
+        self, interval_s: float = 2.0, **rebalance_kw: Any
+    ) -> None:
+        """Run :meth:`rebalance` on a background timer until
+        :meth:`stop_rebalancer` / :meth:`close`. ``rebalance_kw`` are
+        passed through to every pass (hysteresis knobs). Idempotent:
+        restarting replaces the running timer."""
+        from torcheval_tpu.metrics.toolkit import _check_timeout_s
+
+        _check_timeout_s(interval_s)
+        self.stop_rebalancer()
+        stop = threading.Event()
+
+        def _loop() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    self.rebalance(**rebalance_kw)
+                except Exception:  # noqa: BLE001 - keep the timer alive
+                    _logger.exception("router: rebalance pass failed")
+
+        thread = threading.Thread(
+            target=_loop,
+            name="torcheval-tpu-router-rebalance",
+            daemon=True,
+        )
+        self._rebalance_stop = stop
+        self._rebalance_thread = thread
+        thread.start()
+
+    def stop_rebalancer(self) -> None:
+        thread = self._rebalance_thread
+        if thread is None:
+            return
+        self._rebalance_stop.set()
+        thread.join(timeout=10.0)
+        self._rebalance_thread = None
+
+    # ------------------------------------------------------------- elasticity
+    def add_host(self, endpoint: str) -> None:
+        """Join one serving endpoint at runtime (scale-up). The router
+        mints a client with the same factory/kwargs the constructor used,
+        joins the host into the active obs stream (when one is running),
+        and the very next placement can choose it — already-routed
+        tenants move only via :meth:`rebalance` / failure migration, so
+        joining is disruption-free. Re-adding an endpoint that died is
+        allowed once its failure migration finished; re-adding a live one
+        raises ``ValueError``."""
+        self._wait_not_migrating(endpoint)
+        client = self._client_factory(endpoint, **self._client_kwargs)
+        endpoint = client.endpoint  # normalized form
+        with self._cv:
+            if endpoint in self._alive:
+                client.close()
+                raise ValueError(
+                    f"endpoint {endpoint!r} is already in the fleet."
+                )
+            stale = self._clients.pop(endpoint, None)
+            self._clients[endpoint] = client
+            self._alive.add(endpoint)
+        if stale is not None:
+            stale.close()
+        with self._fleet_lock:
+            # a fresh process behind a recycled endpoint must not inherit
+            # the dead one's folded telemetry
+            self._fleet.pop(endpoint, None)
+            interval_s = self._obs_interval_s
+        if interval_s is not None:
+            try:
+                sub = client.subscribe_obs(
+                    interval_s,
+                    on_push=lambda msg, _ep=endpoint: self._on_obs_push(
+                        _ep, msg
+                    ),
+                )
+            except (WireError, ServeError) as e:
+                _logger.warning(
+                    "router: obs subscription to %s failed: %s",
+                    endpoint, e,
+                )
+            else:
+                with self._fleet_lock:
+                    self._obs_subs[endpoint] = sub
+        if _obs._enabled:
+            _trace.instant(
+                "serve.router.host_added", kind="router", endpoint=endpoint
+            )
+        _logger.info("router: endpoint %s joined the fleet.", endpoint)
+
+    def remove_host(self, endpoint: str) -> Dict[str, Any]:
+        """Decommission one endpoint (scale-down): stop its obs stream,
+        :meth:`drain` it (checkpoint-and-evict everything, migrate the
+        tenants onto survivors), then forget it entirely — unlike a
+        drained host, a removed one is no longer probed or re-placeable.
+        A host that is already dead is migrated-and-forgotten instead of
+        drained. Returns the drain result."""
+        if endpoint not in self._clients:
+            raise ValueError(f"unknown endpoint {endpoint!r}.")
+        with self._fleet_lock:
+            sub = self._obs_subs.pop(endpoint, None)
+        if sub is not None:
+            sub.stop()
+        try:
+            out = self.drain(endpoint)
+        except WireError as e:
+            self._host_failed(endpoint, cause=e)
+            out = {"drained": {}, "migrated": []}
+        with self._cv:
+            self._alive.discard(endpoint)
+            client = self._clients.pop(endpoint, None)
+        with self._fleet_lock:
+            self._fleet.pop(endpoint, None)
+        if client is not None:
+            client.close()
+        if _obs._enabled:
+            _trace.instant(
+                "serve.router.host_removed",
+                kind="router",
+                endpoint=endpoint,
+            )
+        _logger.info("router: endpoint %s left the fleet.", endpoint)
+        return out
+
+    def autoscale_step(
+        self,
+        policy: "ScalingPolicy",
+        *,
+        provision: Any = None,
+        decommission: Any = None,
+    ) -> int:
+        """Run one autoscaling decision: feed :meth:`fleet_status` to
+        ``policy.decide`` and act on the signed host delta —
+        ``provision()`` must return a NEW ready endpoint for each
+        scale-up step (it is the deployer's hook: start the process, then
+        tell the router); each scale-down step picks the coldest host,
+        :meth:`remove_host`\\ s it, then hands the endpoint to
+        ``decommission(endpoint)`` for teardown. A direction whose hook
+        is missing is a no-op (the decision is still returned, so a
+        caller can act out-of-band). Returns the policy's delta."""
+        delta = int(policy.decide(self.fleet_status()))
+        if delta > 0 and provision is not None:
+            for _ in range(delta):
+                self.add_host(provision())
+        elif delta < 0 and decommission is not None:
+            for _ in range(-delta):
+                alive = self.alive
+                if len(alive) <= 1:
+                    break  # never scale to an empty fleet
+                info = self._fleet_loads()
+                coldest = min(
+                    alive,
+                    key=lambda ep: info.get(ep, {}).get("load") or 0.0,
+                )
+                self.remove_host(coldest)
+                decommission(coldest)
+        return delta
+
+
+class ScalingPolicy:
+    """Decide fleet resizing from one :meth:`EvalRouter.fleet_status`
+    snapshot. ``decide`` returns a signed host delta: positive = add
+    that many hosts, negative = drain-and-remove, 0 = hold. Policies are
+    pure deciders — :meth:`EvalRouter.autoscale_step` owns the acting."""
+
+    def decide(self, fleet_status: Dict[str, Any]) -> int:
+        raise NotImplementedError
+
+
+class HeadroomScalingPolicy(ScalingPolicy):
+    """Scale on aggregate fleet headroom (``fleet_status()["headroom"]``,
+    1.0 = idle, 0.0 = saturated): below ``scale_up_below`` asks for one
+    more host, above ``scale_down_above`` releases one, inside the band
+    holds. ``cooldown_s`` of mandatory quiet follows every nonzero
+    decision, and ``min_hosts``/``max_hosts`` bound the fleet — with the
+    dead band this makes the policy hysteretic, so load hovering at a
+    threshold cannot flap the fleet. ``headroom is None`` (nobody
+    reporting) always holds: a policy must not scale on silence."""
+
+    def __init__(
+        self,
+        *,
+        scale_up_below: float = 0.2,
+        scale_down_above: float = 0.8,
+        min_hosts: int = 1,
+        max_hosts: Optional[int] = None,
+        cooldown_s: float = 30.0,
+    ) -> None:
+        if not 0.0 <= scale_up_below < scale_down_above <= 1.0:
+            raise ValueError(
+                "need 0 <= scale_up_below < scale_down_above <= 1, got "
+                f"{scale_up_below!r} / {scale_down_above!r} (the gap is "
+                "the hysteresis dead band)."
+            )
+        if min_hosts < 1:
+            raise ValueError(f"min_hosts must be >= 1, got {min_hosts}.")
+        if max_hosts is not None and max_hosts < min_hosts:
+            raise ValueError(
+                f"max_hosts={max_hosts} is below min_hosts={min_hosts}."
+            )
+        if cooldown_s < 0:
+            raise ValueError(
+                f"cooldown_s must be >= 0, got {cooldown_s}."
+            )
+        self.scale_up_below = float(scale_up_below)
+        self.scale_down_above = float(scale_down_above)
+        self.min_hosts = int(min_hosts)
+        self.max_hosts = max_hosts
+        self.cooldown_s = float(cooldown_s)
+        self._last_scaled_at: Optional[float] = None
+
+    def decide(self, fleet_status: Dict[str, Any]) -> int:
+        headroom = fleet_status.get("headroom")
+        if headroom is None:
+            return 0
+        now = time.monotonic()
+        if (
+            self._last_scaled_at is not None
+            and now - self._last_scaled_at < self.cooldown_s
+        ):
+            return 0
+        n_hosts = len(fleet_status.get("alive") or ())
+        if headroom < self.scale_up_below and (
+            self.max_hosts is None or n_hosts < self.max_hosts
+        ):
+            self._last_scaled_at = now
+            return 1
+        if (
+            headroom > self.scale_down_above
+            and n_hosts > self.min_hosts
+        ):
+            self._last_scaled_at = now
+            return -1
+        return 0

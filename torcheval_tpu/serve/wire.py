@@ -1274,6 +1274,12 @@ class EvalServer:
             stage_box = [None]
         if op == "health":
             return {"health": self._daemon.health()}, b""
+        if op == "load_report":
+            # the rebalancer's cheap pull (ISSUE 19): the schema-1 load
+            # report alone, without the per-tenant health fold a full
+            # probe pays. Old peers reject the op as protocol and the
+            # client degrades to health()["load_report"].
+            return {"load_report": self._daemon.load_report()}, b""
         if op == "snapshot":
             from torcheval_tpu import obs
 
