@@ -7,6 +7,8 @@ edges (disarmed fast path, malformed env disarms instead of raising).
 """
 
 import os
+import shutil
+import tempfile
 import time
 import unittest
 from unittest import mock
@@ -315,6 +317,174 @@ class TestHostActions(unittest.TestCase):
         with self._arm():
             os.environ.pop("TORCHEVAL_TPU_CHAOS_STEP")
             chaos.reset_for_tests()
+            self.assertFalse(chaos.host_armed())
+
+
+class TestRouterKillHooks(unittest.TestCase):
+    """The control-plane kill (ISSUE 20 tentpole): targeting by point,
+    tenant and 1-based matching-op count. The real ``os._exit`` runs only
+    in the disposable driver of ``tests/serve/test_router_restart_mp.py``;
+    here it is mocked so the selection logic is testable in-process."""
+
+    def tearDown(self):
+        chaos.reset_for_tests()
+
+    def _arm(self, **extra):
+        env = {
+            "TORCHEVAL_TPU_CHAOS": "1",
+            "TORCHEVAL_TPU_CHAOS_ACTION": "router_kill",
+            "TORCHEVAL_TPU_CHAOS_TENANT": "*",
+            "TORCHEVAL_TPU_CHAOS_STEP": "2",
+            "TORCHEVAL_TPU_CHAOS_EXIT_CODE": "47",
+        }
+        env.update(extra)
+        return mock.patch.dict(os.environ, env)
+
+    def test_router_armed_gate(self):
+        with self._arm():
+            chaos.reset_for_tests()
+            self.assertTrue(chaos.router_armed())
+        with mock.patch.dict(os.environ):
+            os.environ.pop("TORCHEVAL_TPU_CHAOS", None)
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.router_armed())
+
+    def test_fires_at_the_armed_op_count_with_exit_code(self):
+        with self._arm(), mock.patch.object(os, "_exit") as ex:
+            chaos.reset_for_tests()
+            chaos.on_router_op("submit", "a")  # op 1: no action
+            ex.assert_not_called()
+            chaos.on_router_op("submit", "b")  # op 2: the kill
+            ex.assert_called_once_with(47)
+
+    def test_point_filter_counts_only_matching_ops(self):
+        with self._arm(
+            TORCHEVAL_TPU_CHAOS_POINT="migrate_exported",
+            TORCHEVAL_TPU_CHAOS_STEP="1",
+        ), mock.patch.object(os, "_exit") as ex:
+            chaos.reset_for_tests()
+            for _ in range(5):
+                chaos.on_router_op("submit", "a")  # wrong point: uncounted
+            ex.assert_not_called()
+            chaos.on_router_op("migrate_exported", "a")
+            ex.assert_called_once_with(47)
+
+    def test_tenant_filter_counts_only_matching_ops(self):
+        with self._arm(
+            TORCHEVAL_TPU_CHAOS_TENANT="vic", TORCHEVAL_TPU_CHAOS_STEP="1"
+        ), mock.patch.object(os, "_exit") as ex:
+            chaos.reset_for_tests()
+            chaos.on_router_op("submit", "other")  # uncounted
+            ex.assert_not_called()
+            chaos.on_router_op("submit", "vic")
+            ex.assert_called_once_with(47)
+
+    def test_fires_once_per_process(self):
+        with self._arm(TORCHEVAL_TPU_CHAOS_STEP="1"), mock.patch.object(
+            os, "_exit"
+        ) as ex:
+            chaos.reset_for_tests()
+            chaos.on_router_op("submit", "a")
+            chaos.on_router_op("submit", "a")
+            self.assertEqual(ex.call_count, 1)
+
+    def test_router_action_does_not_arm_other_hooks(self):
+        with self._arm():
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.host_armed())
+            self.assertFalse(chaos.ingest_armed())
+            self.assertFalse(chaos.ckpt_armed())
+
+    def test_malformed_config_disarms(self):
+        with self._arm():
+            os.environ.pop("TORCHEVAL_TPU_CHAOS_STEP")
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.router_armed())
+
+
+class TestCkptCorruptHooks(unittest.TestCase):
+    """The silent-bit-rot injector (ISSUE 20): flips one payload byte of
+    the selected save in place. End-to-end (quarantine + lineage
+    fallback) in tests/serve/test_router_recovery.py; here the substring
+    targeting, the matching-save count, and the flip itself."""
+
+    def tearDown(self):
+        chaos.reset_for_tests()
+
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="tpu_chaos_ckpt_")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+    def _ckpt(self, name):
+        path = os.path.join(self.dir, name)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "state.npz"), "wb") as f:
+            f.write(bytes(range(64)))
+        return path
+
+    def _payload(self, path):
+        with open(os.path.join(path, "state.npz"), "rb") as f:
+            return f.read()
+
+    def _arm(self, **extra):
+        env = {
+            "TORCHEVAL_TPU_CHAOS": "1",
+            "TORCHEVAL_TPU_CHAOS_ACTION": "ckpt_corrupt",
+            "TORCHEVAL_TPU_CHAOS_TENANT": "/vic/",
+            "TORCHEVAL_TPU_CHAOS_STEP": "1",
+        }
+        env.update(extra)
+        return mock.patch.dict(os.environ, env)
+
+    def test_flips_exactly_one_byte_of_the_matching_save(self):
+        path = self._ckpt("vic/ckpt-1")
+        before = self._payload(path)
+        with self._arm():
+            chaos.reset_for_tests()
+            self.assertTrue(chaos.ckpt_armed())
+            chaos.on_ckpt_saved(path)
+        after = self._payload(path)
+        diff = [i for i in range(len(before)) if before[i] != after[i]]
+        self.assertEqual(diff, [12])
+        self.assertEqual(after[12], before[12] ^ 0xFF)
+
+    def test_substring_filter_skips_other_tenants_saves(self):
+        other = self._ckpt("bob/ckpt-1")
+        vic = self._ckpt("vic/ckpt-1")
+        before = self._payload(other)
+        with self._arm():
+            chaos.reset_for_tests()
+            chaos.on_ckpt_saved(other)  # not /vic/: uncounted, untouched
+            self.assertEqual(self._payload(other), before)
+            vic_before = self._payload(vic)
+            chaos.on_ckpt_saved(vic)
+            self.assertNotEqual(self._payload(vic), vic_before)
+
+    def test_step_counts_matching_saves_and_fires_once(self):
+        g1 = self._ckpt("vic/ckpt-1")
+        g2 = self._ckpt("vic/ckpt-2")
+        g3 = self._ckpt("vic/ckpt-3")
+        with self._arm(TORCHEVAL_TPU_CHAOS_STEP="2"):
+            chaos.reset_for_tests()
+            before = {p: self._payload(p) for p in (g1, g2, g3)}
+            chaos.on_ckpt_saved(g1)  # save 1: intact
+            chaos.on_ckpt_saved(g2)  # save 2: flipped
+            chaos.on_ckpt_saved(g3)  # one-shot spent: intact
+        self.assertEqual(self._payload(g1), before[g1])
+        self.assertNotEqual(self._payload(g2), before[g2])
+        self.assertEqual(self._payload(g3), before[g3])
+
+    def test_missing_payload_warns_instead_of_raising(self):
+        path = os.path.join(self.dir, "vic", "ckpt-9")
+        os.makedirs(path)  # no state.npz inside
+        with self._arm():
+            chaos.reset_for_tests()
+            chaos.on_ckpt_saved(path)  # must not raise
+
+    def test_ckpt_action_does_not_arm_router_hooks(self):
+        with self._arm():
+            chaos.reset_for_tests()
+            self.assertFalse(chaos.router_armed())
             self.assertFalse(chaos.host_armed())
 
 

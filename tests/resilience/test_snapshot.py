@@ -588,6 +588,191 @@ class TestObsCounters(_TmpDirTest):
         self.assertEqual(snap["resilience.checkpoint.restores"], 1.0)
         self.assertGreater(snap["resilience.checkpoint.bytes"], 0.0)
 
+def _corrupt_manifest(ckpt):
+    with open(os.path.join(ckpt, "manifest.json"), "w") as f:
+        f.write('{"truncated mid-wr')
+
+
+def _flip_payload_byte(ckpt):
+    path = os.path.join(ckpt, "state.npz")
+    with open(path, "r+b") as f:
+        f.seek(12)
+        byte = f.read(1)
+        f.seek(12)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+class TestLineageFallback(_TmpDirTest):
+    """ISSUE 20 tentpole: restore_latest_valid walks newest->oldest past
+    corrupt generations, quarantining (renaming, never deleting) each."""
+
+    def _saved_sum(self, *values):
+        m = Sum()
+        for v in values:
+            m.update(jnp.asarray([float(v)]))
+            save(m, self.dir)
+        return m
+
+    def test_falls_back_past_corrupt_newest_and_quarantines(self):
+        from torcheval_tpu import obs
+
+        self._saved_sum(1.0, 2.0)  # gen1 holds 1.0, gen2 holds 3.0
+        ckpts = list_checkpoints(self.dir)
+        _flip_payload_byte(ckpts[-1])
+        obs.enable()
+        self.addCleanup(obs.disable)
+        self.addCleanup(obs.reset)
+        target = Sum()
+        restored_path = snapshot_mod.restore_latest_valid(target, self.dir)
+        self.assertEqual(restored_path, ckpts[0])
+        self.assertEqual(float(np.asarray(target.compute())), 1.0)
+        # quarantined: renamed corrupt-*, bytes preserved, no longer listed
+        self.assertEqual(list_checkpoints(self.dir), [ckpts[0]])
+        corrupt = [
+            n for n in os.listdir(self.dir) if n.startswith("corrupt-")
+        ]
+        self.assertEqual(len(corrupt), 1)
+        self.assertTrue(
+            os.path.exists(
+                os.path.join(self.dir, corrupt[0], "state.npz")
+            )
+        )
+        counters = obs.snapshot()["counters"]
+        self.assertEqual(
+            counters.get("resilience.checkpoint.corrupt_quarantined"), 1.0
+        )
+        self.assertEqual(
+            counters.get("resilience.checkpoint.fallback_restores"), 1.0
+        )
+
+    def test_every_generation_corrupt_raises_not_found(self):
+        self._saved_sum(1.0, 2.0)
+        for ckpt in list_checkpoints(self.dir):
+            _corrupt_manifest(ckpt)
+        with self.assertRaises(CheckpointError) as ctx:
+            snapshot_mod.restore_latest_valid(Sum(), self.dir)
+        self.assertEqual(ctx.exception.reason, "not_found")
+        # quarantined, not deleted: both generations' bytes survive
+        corrupt = [
+            n for n in os.listdir(self.dir) if n.startswith("corrupt-")
+        ]
+        self.assertEqual(len(corrupt), 2)
+
+    def test_schema_mismatch_raises_without_quarantining(self):
+        # A wrong restore TARGET indicts the caller's configuration, not
+        # the checkpoint's bytes — quarantining would destroy lineage a
+        # correctly-configured caller could still use.
+        self._saved_sum(1.0)
+        with self.assertRaises(CheckpointError) as ctx:
+            snapshot_mod.restore_latest_valid(
+                MulticlassAccuracy(num_classes=5), self.dir
+            )
+        self.assertEqual(ctx.exception.reason, "schema_mismatch")
+        self.assertEqual(len(list_checkpoints(self.dir)), 1)
+        self.assertEqual(
+            [n for n in os.listdir(self.dir) if n.startswith("corrupt-")],
+            [],
+        )
+
+
+class TestDiscoveryHardening(_TmpDirTest):
+    """ISSUE 20 satellite: one tenant's torn manifest must never raise
+    mid-discovery or hide other tenants' recoverable checkpoints."""
+
+    def _tenant(self, name, gens=1):
+        sub = os.path.join(self.dir, name)
+        m = Sum()
+        for i in range(gens):
+            m.update(jnp.asarray([1.0]))
+            save(m, sub)
+        return sub
+
+    def test_corrupt_manifest_skipped_and_counted(self):
+        from torcheval_tpu import obs
+
+        good = self._tenant("good")
+        bad = self._tenant("bad", gens=2)
+        ckpts = list_checkpoints(bad)
+        _corrupt_manifest(ckpts[-1])
+        obs.enable()
+        self.addCleanup(obs.disable)
+        self.addCleanup(obs.reset)
+        found = snapshot_mod.discover_checkpoints(self.dir)
+        # "bad" offers its previous (valid) generation; "good" unaffected
+        self.assertEqual(found["bad"], ckpts[0])
+        self.assertEqual(found["good"], list_checkpoints(good)[-1])
+        self.assertEqual(
+            obs.snapshot()["counters"].get(
+                "resilience.checkpoint.corrupt_skipped"
+                "{reason=corrupt_manifest}"
+            ),
+            1.0,
+        )
+
+    def test_tenant_with_no_readable_generation_is_omitted(self):
+        self._tenant("good")
+        bad = self._tenant("bad")
+        _corrupt_manifest(list_checkpoints(bad)[-1])
+        found = snapshot_mod.discover_checkpoints(self.dir)
+        self.assertEqual(sorted(found), ["good"])
+
+
+class TestQuarantineRotationInterplay(_TmpDirTest):
+    """ISSUE 20 satellite: the .tmp-* GC and keep_last rotation must
+    never collect a corrupt-* quarantine dir or the last valid
+    generation — including under the 30-rapid-saves churn pattern."""
+
+    def test_rotation_spares_the_last_valid_generation(self):
+        m = Sum()
+        for _ in range(3):
+            m.update(jnp.asarray([1.0]))
+            save(m, self.dir)
+        gen1, gen2, gen3 = list_checkpoints(self.dir)
+        _corrupt_manifest(gen2)
+        _corrupt_manifest(gen3)
+        snapshot_mod.rotate_checkpoints(self.dir, keep_last=2)
+        # the naive cut would delete gen1 — the only restorable bytes
+        self.assertTrue(os.path.exists(gen1))
+        restored = Sum()
+        self.assertEqual(
+            snapshot_mod.restore_latest_valid(restored, self.dir), gen1
+        )
+
+    def test_quarantine_survives_rapid_save_churn(self):
+        m = Sum()
+        m.update(jnp.asarray([1.0]))
+        save(m, self.dir)
+        quarantined = snapshot_mod.quarantine_checkpoint(
+            list_checkpoints(self.dir)[-1]
+        )
+        # a dead-writer tmp alongside it: the GC must reclaim THIS and
+        # only this
+        dead_tmp = os.path.join(self.dir, ".tmp-ckpt-00000099-999999999")
+        os.makedirs(dead_tmp)
+        for _ in range(30):
+            m.update(jnp.asarray([1.0]))
+            save(m, self.dir, keep_last=2)
+        self.assertTrue(os.path.exists(quarantined))
+        self.assertFalse(os.path.exists(dead_tmp))
+        self.assertLessEqual(len(list_checkpoints(self.dir)), 2)
+        # the newest generation is restorable after all that churn
+        snapshot_mod.restore_latest_valid(Sum(), self.dir)
+
+    def test_quarantine_collision_names_are_unique(self):
+        m = Sum()
+        for _ in range(2):
+            m.update(jnp.asarray([1.0]))
+            save(m, self.dir)
+        first, second = list_checkpoints(self.dir)
+        q1 = snapshot_mod.quarantine_checkpoint(first)
+        # recreate the same step name and quarantine again: the second
+        # quarantine must not clobber the first's forensic bytes
+        os.rename(second, first)
+        q2 = snapshot_mod.quarantine_checkpoint(first)
+        self.assertNotEqual(q1, q2)
+        self.assertTrue(os.path.exists(q1))
+        self.assertTrue(os.path.exists(q2))
+
 
 if __name__ == "__main__":
     unittest.main()

@@ -1,0 +1,87 @@
+"""The disposable router process for the router-restart drill
+(ISSUE 20 acceptance).
+
+Runs a JOURNALED ``EvalRouter`` over the drill's real host processes:
+attaches a plain tenant and a split-by-2 tenant, streams phase-1 batches
+through both, flushes (so every pre-kill update is durable), publishes
+its pre-kill view atomically (``driver.state.json.tmp`` ->
+``driver.state.json``), then drains the plain tenant's host. The
+environment arms ``router_kill`` at ``migrate_exported`` — this process
+dies by ``os._exit`` inside the drain's first live migration, in the
+nastiest window: the tenant's wire state is exported and adopted
+nowhere. The test process then restarts the router from the journal and
+finishes both streams; bit-identity against the fault-free oracle is
+the drill's verdict on the recovery.
+
+Run:  python mp_router_driver.py <outdir> <journal_dir> <ep1,ep2,...>
+"""
+
+import json
+import os
+import sys
+import zlib
+
+PHASE1 = 6
+NUM_CLASSES = 5
+BATCH = 32
+SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+
+
+def make_batch(tenant: str, idx: int):
+    # crc32, not hash(): the seed must match across driver/test processes
+    import numpy as np
+
+    seed = 1000 * (zlib.crc32(tenant.encode()) % 97) + idx
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((BATCH, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, BATCH),
+    )
+
+
+def main() -> None:
+    outdir, journal_dir, eps = sys.argv[1], sys.argv[2], sys.argv[3]
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from torcheval_tpu import obs
+    from torcheval_tpu.serve import EvalRouter
+
+    obs.enable()
+    router = EvalRouter(
+        eps.split(","),
+        journal_dir=journal_dir,
+        request_timeout_s=10.0,
+        connect_timeout_s=5.0,
+        max_attempts=2,
+        backoff_base_s=0.05,
+    )
+    router.attach("solo", SPEC)
+    router.attach("fan", SPEC)
+    router.split_tenant("fan", replicas=2)
+    for i in range(PHASE1):
+        router.submit("solo", *make_batch("solo", i))
+        router.submit("fan", *make_batch("fan", i))
+    router.flush("solo")
+    router.flush("fan")
+
+    state = {
+        "placement": router.placement(),
+        "submitted": PHASE1,
+        "victim": router.placement()["solo"],
+    }
+    path = os.path.join(outdir, "driver.state.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(state, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(path + ".tmp", path)
+
+    # chaos (router_kill @ migrate_exported) fires inside this call
+    router.drain(state["victim"])
+    os._exit(99)  # unreachable when the drill is armed correctly
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,265 @@
+"""Unit tests for the router's durable control-plane WAL (serve/journal.py,
+ISSUE 20). The contracts under test: append/replay round-trip with
+monotonic seqs, torn-tail drop + truncate-then-heal, snapshot compaction
+with exactly-once replay across the crash window (seq watermark), and the
+degrade-never-crash path for an unreadable snapshot."""
+
+import json
+import os
+import tempfile
+import unittest
+import zlib
+
+from torcheval_tpu import obs
+from torcheval_tpu.serve.journal import RouterJournal
+
+
+def _wal(directory):
+    return os.path.join(directory, "wal.log")
+
+
+def _snap(directory):
+    return os.path.join(directory, "snapshot.json")
+
+
+class TestJournalRoundTrip(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        self.dir = tempfile.mkdtemp(prefix="tpu_journal_test_")
+
+    def test_append_replay_round_trip(self):
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="a", endpoint="e1")
+        j.append("move", tenant="a", endpoint="e2")
+        j.append("remove", tenant="a")
+        j.close()
+        j2 = RouterJournal(self.dir)
+        snapshot, records = j2.replay()
+        j2.close()
+        self.assertIsNone(snapshot)
+        self.assertEqual(
+            [(r["kind"], r.get("endpoint")) for r in records],
+            [("place", "e1"), ("move", "e2"), ("remove", None)],
+        )
+
+    def test_seqs_are_monotonic_across_reopens(self):
+        j = RouterJournal(self.dir)
+        s1 = j.append("place", tenant="a")
+        s2 = j.append("place", tenant="b")
+        j.close()
+        j2 = RouterJournal(self.dir)
+        s3 = j2.append("place", tenant="c")
+        j2.close()
+        self.assertEqual([s1, s2, s3], sorted([s1, s2, s3]))
+        self.assertLess(s2, s3)
+
+    def test_append_on_closed_journal_raises(self):
+        j = RouterJournal(self.dir)
+        j.close()
+        with self.assertRaises(ValueError):
+            j.append("place", tenant="a")
+        with self.assertRaises(ValueError):
+            j.compact({})
+        j.close()  # idempotent
+
+    def test_empty_directory_replays_empty(self):
+        j = RouterJournal(self.dir)
+        snapshot, records = j.replay()
+        j.close()
+        self.assertIsNone(snapshot)
+        self.assertEqual(records, [])
+
+    def test_records_counter_labeled_by_kind(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="a")
+        j.append("place", tenant="b")
+        j.append("split", tenant="a", replicas=["a@r1"])
+        j.close()
+        counters = obs.snapshot()["counters"]
+        self.assertEqual(
+            counters.get("serve.router.journal_records{kind=place}"), 2.0
+        )
+        self.assertEqual(
+            counters.get("serve.router.journal_records{kind=split}"), 1.0
+        )
+
+
+class TestTornTail(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        self.dir = tempfile.mkdtemp(prefix="tpu_journal_torn_")
+
+    def _seed(self, *tenants):
+        j = RouterJournal(self.dir)
+        for t in tenants:
+            j.append("place", tenant=t)
+        j.close()
+
+    def test_torn_tail_dropped_and_counted_not_raised(self):
+        self._seed("x", "y")
+        with open(_wal(self.dir), "ab") as f:
+            f.write(b"deadbeef {torn mid-wri")  # no newline: torn write
+        obs.enable()
+        self.addCleanup(obs.disable)
+        j = RouterJournal(self.dir)
+        _, records = j.replay()
+        j.close()
+        self.assertEqual([r["tenant"] for r in records], ["x", "y"])
+        self.assertEqual(
+            obs.snapshot()["counters"].get(
+                "serve.router.journal_torn_tails{reason=wal}"
+            ),
+            1.0,
+        )
+
+    def test_crc_mismatch_dropped(self):
+        self._seed("x")
+        body = b'{"kind":"place","seq":99,"tenant":"evil"}'
+        with open(_wal(self.dir), "ab") as f:
+            f.write(b"%08x %s\n" % (0x12345678, body))  # wrong CRC
+        j = RouterJournal(self.dir)
+        _, records = j.replay()
+        j.close()
+        self.assertEqual([r["tenant"] for r in records], ["x"])
+
+    def test_append_after_tear_heals(self):
+        # Regression: the reopen must TRUNCATE the torn bytes before
+        # appending, or the new record glues onto the garbage and is
+        # dropped with it at the next replay.
+        self._seed("x", "y")
+        with open(_wal(self.dir), "ab") as f:
+            f.write(b"deadbeef {torn")
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="z")
+        j.close()
+        j2 = RouterJournal(self.dir)
+        _, records = j2.replay()
+        j2.close()
+        self.assertEqual([r["tenant"] for r in records], ["x", "y", "z"])
+
+    def test_everything_after_a_tear_is_dropped(self):
+        # Order is the journal's one integrity guarantee: a good-looking
+        # record PAST a corrupt one is not trusted.
+        self._seed("x")
+        good = json.dumps(
+            {"kind": "place", "seq": 50, "tenant": "late"},
+            sort_keys=True,
+            separators=(",", ":"),
+        ).encode()
+        with open(_wal(self.dir), "ab") as f:
+            f.write(b"nothexxx not-a-record\n")
+            f.write(b"%08x %s\n" % (zlib.crc32(good) & 0xFFFFFFFF, good))
+        j = RouterJournal(self.dir)
+        _, records = j.replay()
+        j.close()
+        self.assertEqual([r["tenant"] for r in records], ["x"])
+
+
+class TestCompaction(unittest.TestCase):
+    def setUp(self):
+        obs.reset()
+        self.dir = tempfile.mkdtemp(prefix="tpu_journal_compact_")
+
+    def test_compact_publishes_snapshot_and_truncates_wal(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="a")
+        j.append("place", tenant="b")
+        j.compact({"tenants": {"a": {}, "b": {}}})
+        j.append("place", tenant="c")
+        j.close()
+        self.assertEqual(os.path.getsize(_wal(self.dir)) > 0, True)
+        j2 = RouterJournal(self.dir)
+        snapshot, records = j2.replay()
+        j2.close()
+        self.assertEqual(snapshot, {"tenants": {"a": {}, "b": {}}})
+        self.assertEqual([r["tenant"] for r in records], ["c"])
+        self.assertEqual(
+            obs.snapshot()["counters"].get(
+                "serve.router.journal_compactions"
+            ),
+            1.0,
+        )
+
+    def test_replay_skips_records_folded_into_snapshot(self):
+        # Crash window: snapshot published but WAL NOT yet truncated.
+        # Replay must skip WAL records at or below the snapshot's seq
+        # watermark — each mutation applies exactly once.
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="a")
+        j.append("place", tenant="b")
+        j.close()
+        with open(_wal(self.dir), "rb") as f:
+            stale_wal = f.read()
+        j2 = RouterJournal(self.dir)
+        j2.compact({"folded": True})
+        j2.close()
+        # simulate the crash: restore the pre-compaction WAL alongside
+        # the published snapshot
+        with open(_wal(self.dir), "wb") as f:
+            f.write(stale_wal)
+        j3 = RouterJournal(self.dir)
+        snapshot, records = j3.replay()
+        j3.close()
+        self.assertEqual(snapshot, {"folded": True})
+        self.assertEqual(records, [])
+
+    def test_auto_compaction_via_snapshot_fn(self):
+        j = RouterJournal(
+            self.dir, snapshot_fn=lambda: {"auto": True}, compact_every=3
+        )
+        j.append("place", tenant="a")
+        j.append("place", tenant="b")
+        self.assertFalse(os.path.exists(_snap(self.dir)))
+        j.append("place", tenant="c")  # third record: auto-compact
+        self.assertTrue(os.path.exists(_snap(self.dir)))
+        j.append("place", tenant="d")
+        j.close()
+        j2 = RouterJournal(self.dir)
+        snapshot, records = j2.replay()
+        j2.close()
+        self.assertEqual(snapshot, {"auto": True})
+        self.assertEqual([r["tenant"] for r in records], ["d"])
+
+    def test_unreadable_snapshot_degrades_to_wal(self):
+        obs.enable()
+        self.addCleanup(obs.disable)
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="a")
+        j.compact({"fine": 1})
+        j.append("place", tenant="b")
+        j.close()
+        with open(_snap(self.dir), "wb") as f:
+            f.write(b"{not json at all")
+        j2 = RouterJournal(self.dir)
+        snapshot, records = j2.replay()
+        # still appendable after the degraded load
+        j2.append("place", tenant="c")
+        j2.close()
+        self.assertIsNone(snapshot)
+        self.assertEqual([r["tenant"] for r in records], ["b"])
+        self.assertEqual(
+            obs.snapshot()["counters"].get(
+                "serve.router.journal_torn_tails{reason=snapshot}"
+            ),
+            1.0,
+        )
+
+    def test_tmp_snapshot_from_crashed_compaction_is_harmless(self):
+        j = RouterJournal(self.dir)
+        j.append("place", tenant="a")
+        j.close()
+        with open(_snap(self.dir) + ".tmp", "wb") as f:
+            f.write(b"half-written garbage")
+        j2 = RouterJournal(self.dir)
+        snapshot, records = j2.replay()
+        j2.close()
+        self.assertIsNone(snapshot)
+        self.assertEqual([r["tenant"] for r in records], ["a"])
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,376 @@
+"""Router crash recovery from the control-plane journal (ISSUE 20
+tentpole). In-process drills for every reconciliation outcome the
+recovery pass can produce: adopt-in-place (including a split tenant whose
+fan-out ordinal is re-derived from replica watermarks), re-place off a
+dead host via checkpoint + resume, orphan adoption, stale double-attach
+resolution, torn-split rollback, drain persistence — and the
+corrupt-newest-checkpoint drill (``ckpt_corrupt`` chaos + lineage
+fallback). Every streaming scenario ends with the recovered stream
+bit-identical to a fault-free oracle with zero duplicate application.
+The real-process variant (router killed with ``os._exit`` mid-migration)
+lives in ``test_router_restart_mp.py``."""
+
+import glob
+import os
+import tempfile
+import unittest
+from unittest import mock
+
+import numpy as np
+
+from torcheval_tpu import obs
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.resilience import chaos
+from torcheval_tpu.serve import EvalDaemon, EvalRouter, EvalServer
+from torcheval_tpu.serve.journal import RouterJournal
+
+NUM_CLASSES = 5
+SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+
+
+def _batch(seed=0, n=8):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, n),
+    )
+
+
+def _oracle(batches):
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for s, l in batches:
+        m.update(s, l)
+    return float(np.asarray(m.compute()))
+
+
+class _RecoveryMixin:
+    """Three-host fleet with a shared checkpoint root and a journal dir.
+    Routers are managed per-test (the whole point is replacing one)."""
+
+    N_HOSTS = 3
+
+    def setUp(self):
+        obs.reset()
+        self.root = tempfile.mkdtemp(prefix="tpu_recovery_ckpt_")
+        self.journal_dir = tempfile.mkdtemp(prefix="tpu_recovery_journal_")
+        self.daemons, self.servers = [], []
+        for _ in range(self.N_HOSTS):
+            daemon = EvalDaemon(evict_dir=self.root).start()
+            server = EvalServer(daemon)
+            self.daemons.append(daemon)
+            self.servers.append(server)
+            self.addCleanup(daemon.stop)
+            self.addCleanup(server.close)
+        self.endpoints = [s.endpoint for s in self.servers]
+
+    def _router(self, *, journal=True, endpoints=None):
+        r = EvalRouter(
+            endpoints or self.endpoints,
+            journal_dir=self.journal_dir if journal else None,
+            request_timeout_s=10.0,
+            connect_timeout_s=1.0,
+            max_attempts=2,
+            backoff_base_s=0.01,
+        )
+        self.addCleanup(r.close)
+        return r
+
+    def _kill_host(self, endpoint):
+        idx = self.endpoints.index(endpoint)
+        self.servers[idx].close()
+        self.daemons[idx].stop()
+
+    def _daemon_for(self, endpoint):
+        return self.daemons[self.endpoints.index(endpoint)]
+
+    def _total_dupes(self):
+        total = 0
+        for d in self.daemons:
+            try:
+                tenants = d.health()["tenants"]
+            except RuntimeError:  # a host this test killed
+                continue
+            total += sum(t.get("dupes", 0) for t in tenants.values())
+        return total
+
+
+class TestAdoptRecovery(_RecoveryMixin, unittest.TestCase):
+    def test_adoption_preserves_placement_and_bit_identity(self):
+        # Smoke 1 as a regression test: crash with a plain tenant AND a
+        # split-by-3 tenant mid-stream; the recovered router must route
+        # both to completion bit-identically with zero re-application.
+        obs.enable()
+        self.addCleanup(obs.disable)
+        batches = [_batch(i) for i in range(24)]
+        r1 = self._router()
+        r1.attach("solo", SPEC)
+        r1.attach("fan", SPEC)
+        r1.split_tenant("fan", replicas=3)
+        for b in batches[:12]:
+            r1.submit("solo", *b)
+            r1.submit("fan", *b)
+        r1.flush("solo")
+        r1.flush("fan")
+        placement_before = r1.placement()
+        r1.close()  # the crash: routing table + client cursors gone
+
+        r2 = self._router()
+        self.assertEqual(r2.last_recovery["outcomes"], {"adopted": 4})
+        self.assertEqual(r2.placement(), placement_before)
+        for b in batches[12:]:
+            r2.submit("solo", *b)
+            r2.submit("fan", *b)
+        oracle = _oracle(batches)
+        self.assertEqual(float(np.asarray(r2.compute("solo")["acc"])), oracle)
+        self.assertEqual(float(np.asarray(r2.compute("fan")["acc"])), oracle)
+        self.assertEqual(self._total_dupes(), 0)
+        counters = obs.snapshot()["counters"]
+        self.assertEqual(
+            counters.get("serve.router.recoveries{outcome=adopted}"), 4.0
+        )
+        # the recovery pass folds the reconciled table into a snapshot
+        self.assertGreaterEqual(
+            counters.get("serve.router.journal_compactions", 0), 1.0
+        )
+
+    def test_blackout_is_measured_and_bounded(self):
+        r1 = self._router()
+        r1.attach("ten", SPEC)
+        r1.close()
+        r2 = self._router()
+        rec = r2.last_recovery
+        self.assertGreater(rec["duration_s"], 0.0)
+        self.assertLess(rec["duration_s"], 30.0)
+        self.assertEqual(rec["tenants"], 1)
+        self.assertEqual(sorted(rec["alive"]), sorted(self.endpoints))
+
+
+class TestReplaceRecovery(_RecoveryMixin, unittest.TestCase):
+    def test_dead_host_tenant_replaced_from_checkpoint(self):
+        # Smoke 3: the tenant's host dies WHILE the router is down, so
+        # failover can't see it — recovery must re-place from the shared
+        # checkpoint root and resume at the durable watermark.
+        obs.enable()
+        self.addCleanup(obs.disable)
+        batches = [_batch(i) for i in range(16)]
+        r1 = self._router()
+        victim_ep = r1.attach("vic", SPEC)
+        for b in batches[:8]:
+            r1.submit("vic", *b)
+        r1.flush("vic")  # durable watermark: seq 8
+        r1.close()
+        self._kill_host(victim_ep)
+
+        r2 = self._router()
+        self.assertEqual(r2.last_recovery["outcomes"], {"replaced": 1})
+        new_ep = r2.placement()["vic"]
+        self.assertNotEqual(new_ep, victim_ep)
+        # everything at or below the restored watermark is durable; the
+        # producer resubmits the tail above it
+        restored = r2._clients[new_ep]._tenants["vic"].durable_seq
+        self.assertEqual(restored, 8)
+        for b in batches[8:]:
+            r2.submit("vic", *b)
+        self.assertEqual(
+            float(np.asarray(r2.compute("vic")["acc"])), _oracle(batches)
+        )
+        self.assertEqual(self._total_dupes(), 0)
+        self.assertEqual(
+            obs.snapshot()["counters"].get(
+                "serve.router.recoveries{outcome=replaced}"
+            ),
+            1.0,
+        )
+
+    def test_unplaceable_tenant_is_dropped_not_fatal(self):
+        r1 = self._router()
+        r1.attach("ten", SPEC)  # never flushed: no checkpoint anywhere
+        victim_ep = r1.placement()["ten"]
+        r1.close()
+        self._kill_host(victim_ep)
+        r2 = self._router()
+        # resume="auto" on a fresh host admits with empty state — the
+        # tenant is replaced, just without its pre-crash updates (they
+        # were never durable). Either replaced or dropped is survivable;
+        # the router itself must come up.
+        self.assertIn(
+            list(r2.last_recovery["outcomes"]), [["replaced"], ["dropped"]]
+        )
+
+
+class TestOrphanAndStale(_RecoveryMixin, unittest.TestCase):
+    def test_live_unjournaled_tenant_is_adopted_with_its_spec(self):
+        # A tenant attached before its journal record landed (the
+        # attach/journal crash gap) is found live with the host-recorded
+        # spec and adopted.
+        obs.enable()
+        self.addCleanup(obs.disable)
+        batches = [_batch(i) for i in range(10)]
+        r0 = self._router(journal=False)
+        r0.attach("ghost", SPEC)
+        for b in batches[:5]:
+            r0.submit("ghost", *b)
+        r0.flush("ghost")
+        r0.close()
+
+        r2 = self._router()  # journal is empty: "ghost" is an orphan
+        self.assertEqual(
+            r2.last_recovery["outcomes"], {"orphan_adopted": 1}
+        )
+        for b in batches[5:]:
+            r2.submit("ghost", *b)
+        self.assertEqual(
+            float(np.asarray(r2.compute("ghost")["acc"])), _oracle(batches)
+        )
+        self.assertEqual(self._total_dupes(), 0)
+
+    def test_double_attached_tenant_keeps_the_advanced_copy(self):
+        # Mid-migration crash: the tenant exists on two hosts. Recovery
+        # keeps the copy with the higher watermark and drops the stale
+        # one WITHOUT a checkpoint.
+        r1 = self._router()
+        ep_new = r1.attach("twin", SPEC)
+        for i in range(6):
+            r1.submit("twin", *_batch(i))
+        r1.flush("twin")
+        # plant the stale copy on another host, behind by construction
+        # (resume="never": it must NOT restore the advanced copy's
+        # checkpoint from the shared root)
+        ep_stale = next(e for e in self.endpoints if e != ep_new)
+        stale_client = r1._clients[ep_stale]
+        stale_client.attach("twin", SPEC, resume="never")
+        stale_client.submit("twin", *_batch(0))
+        stale_client.flush("twin")
+        r1.close()
+
+        r2 = self._router()
+        outcomes = r2.last_recovery["outcomes"]
+        self.assertEqual(outcomes.get("stale_dropped"), 1)
+        self.assertEqual(outcomes.get("adopted"), 1)
+        self.assertEqual(r2.placement()["twin"], ep_new)
+        self.assertNotIn(
+            "twin", self._daemon_for(ep_stale).health()["tenants"]
+        )
+
+    def test_torn_split_replica_rolled_back(self):
+        # A replica journaled (place with parent=) whose parent never
+        # committed the split record is mid-split debris: recovery
+        # detaches it, matching split_tenant's crash-free rollback.
+        r0 = self._router(journal=False)
+        r0.attach("ten", SPEC)
+        r0.attach("ten@r1", SPEC)
+        ep_parent = r0.placement()["ten"]
+        ep_replica = r0.placement()["ten@r1"]
+        r0.close()
+        j = RouterJournal(self.journal_dir)
+        j.append(
+            "place", tenant="ten", endpoint=ep_parent, spec=SPEC,
+            knobs={}, parent=None,
+        )
+        j.append(
+            "place", tenant="ten@r1", endpoint=ep_replica, spec=SPEC,
+            knobs={}, parent="ten",
+        )  # and no "split" record: the crash hit between the two
+        j.close()
+
+        r2 = self._router()
+        outcomes = r2.last_recovery["outcomes"]
+        self.assertEqual(outcomes.get("split_rolled_back"), 1)
+        self.assertEqual(outcomes.get("adopted"), 1)
+        self.assertEqual(list(r2.placement()), ["ten"])
+        self.assertNotIn(
+            "ten@r1", self._daemon_for(ep_replica).health()["tenants"]
+        )
+
+
+class TestDrainAndHosts(_RecoveryMixin, unittest.TestCase):
+    def test_explicit_drain_survives_recovery(self):
+        r1 = self._router()
+        r1.attach("ten", SPEC)
+        drained_ep = next(
+            e for e in self.endpoints if e != r1.placement()["ten"]
+        )
+        r1.drain(drained_ep)
+        r1.close()
+        r2 = self._router()
+        self.assertEqual(r2.last_recovery["drained"], [drained_ep])
+        self.assertNotIn(drained_ep, r2.alive)
+        # new placements must avoid the drained host
+        for i in range(6):
+            ep = r2.attach(f"t{i}", SPEC)
+            self.assertNotEqual(ep, drained_ep)
+
+    def test_runtime_added_host_is_reminted_at_recovery(self):
+        extra_daemon = EvalDaemon(evict_dir=self.root).start()
+        extra_server = EvalServer(extra_daemon)
+        self.addCleanup(extra_daemon.stop)
+        self.addCleanup(extra_server.close)
+        r1 = self._router(endpoints=self.endpoints[:1])
+        r1.add_host(extra_server.endpoint)
+        r1.close()
+        # the new router is constructed WITHOUT the runtime host; the
+        # journal's host_add record restores it
+        r2 = self._router(endpoints=self.endpoints[:1])
+        self.assertIn(extra_server.endpoint, r2.endpoints)
+        self.assertIn(extra_server.endpoint, r2.alive)
+
+
+class TestCorruptCheckpointDrill(_RecoveryMixin, unittest.TestCase):
+    def tearDown(self):
+        chaos.reset_for_tests()
+
+    def test_corrupt_newest_falls_back_and_replay_heals(self):
+        # The acceptance drill: ckpt_corrupt flips a byte of the newest
+        # generation; attach(resume="auto") during recovery quarantines
+        # it (rename, never delete), restores the previous valid
+        # generation, and producer resubmission heals to bit-identity.
+        obs.enable()
+        self.addCleanup(obs.disable)
+        batches = [_batch(i) for i in range(16)]
+        env = {
+            "TORCHEVAL_TPU_CHAOS": "1",
+            "TORCHEVAL_TPU_CHAOS_ACTION": "ckpt_corrupt",
+            "TORCHEVAL_TPU_CHAOS_TENANT": "/vic/",
+            "TORCHEVAL_TPU_CHAOS_STEP": "2",
+        }
+        with mock.patch.dict(os.environ, env):
+            chaos.reset_for_tests()
+            r1 = self._router()
+            victim_ep = r1.attach("vic", SPEC)
+            for b in batches[:8]:
+                r1.submit("vic", *b)
+            r1.flush("vic")  # generation 1: intact
+            for b in batches[8:12]:
+                r1.submit("vic", *b)
+            r1.flush("vic")  # generation 2: chaos flips one payload byte
+            r1.close()
+            self._kill_host(victim_ep)
+
+            r2 = self._router()
+        self.assertEqual(r2.last_recovery["outcomes"], {"replaced": 1})
+        new_ep = r2.placement()["vic"]
+        # generation 2 held seqs 1..12 but is corrupt: the restored
+        # watermark must be generation 1's
+        restored = r2._clients[new_ep]._tenants["vic"].durable_seq
+        self.assertEqual(restored, 8)
+        for b in batches[8:]:
+            r2.submit("vic", *b)
+        self.assertEqual(
+            float(np.asarray(r2.compute("vic")["acc"])), _oracle(batches)
+        )
+        self.assertEqual(self._total_dupes(), 0)
+        # quarantined — renamed, not deleted — and counted
+        tenant_dir = os.path.join(self.root, "vic")
+        quarantined = glob.glob(os.path.join(tenant_dir, "corrupt-ckpt-*"))
+        self.assertEqual(len(quarantined), 1)
+        counters = obs.snapshot()["counters"]
+        self.assertEqual(
+            counters.get("resilience.checkpoint.corrupt_quarantined"), 1.0
+        )
+        self.assertGreaterEqual(
+            counters.get("resilience.checkpoint.fallback_restores", 0), 1.0
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,296 @@
+"""Router-restart drill: REAL processes, ``os._exit`` mid-migration
+(ISSUE 20 acceptance).
+
+One world: three host processes (``mp_cluster_host.py``) share a
+checkpoint root; a disposable DRIVER process (``mp_router_driver.py``)
+runs a journaled ``EvalRouter`` — a plain tenant plus a split-by-2
+tenant streaming live — and is chaos-killed (``router_kill`` at
+``migrate_exported``) inside a drain's first live migration, the window
+where a tenant's wire state is exported and adopted nowhere. This test
+process then constructs a NEW router from the same journal directory:
+the recovery pass replays the journal, reconciles against the live
+hosts (adopting survivors, re-placing the drained host's tenants from
+their checkpoints, re-deriving the split fan-out ordinal from replica
+watermarks), and the test finishes both streams. The verdict: every
+tenant bit-identical to its fault-free oracle, zero duplicate batch
+application anywhere, and a measured, bounded control-plane blackout.
+
+Artifacts (the journal itself, fleet status, a drill summary) land in
+test-artifacts on every run. All sockets bind port 0 (OS-assigned).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import unittest
+import zlib
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_HOST = os.path.join(_HERE, "mp_cluster_host.py")
+_DRIVER = os.path.join(_HERE, "mp_router_driver.py")
+
+NUM_CLASSES = 5
+BATCH = 32
+PHASE1, PHASE2 = 6, 5  # must match mp_router_driver.PHASE1
+CHAOS_EXIT_CODE = 47
+SPEC = {"acc": ["MulticlassAccuracy", {"num_classes": NUM_CLASSES}]}
+TENANTS = ("solo", "fan")
+
+
+def _make_batch(tenant: str, idx: int):
+    # crc32, not hash(): seeds must match the driver process exactly
+    seed = 1000 * (zlib.crc32(tenant.encode()) % 97) + idx
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((BATCH, NUM_CLASSES)).astype(np.float32),
+        rng.integers(0, NUM_CLASSES, BATCH),
+    )
+
+
+def _oracle(tenant: str, n: int) -> float:
+    from torcheval_tpu.metrics import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    for i in range(n):
+        m.update(*_make_batch(tenant, i))
+    return float(np.asarray(m.compute()))
+
+
+def _artifact_dir() -> str:
+    configured = os.environ.get("TORCHEVAL_TPU_TEST_ARTIFACT_DIR")
+    if configured:
+        out = os.path.join(configured, "router_restart_drill")
+        os.makedirs(out, exist_ok=True)
+        return out
+    return tempfile.mkdtemp(prefix="tpu_router_restart_drill_")
+
+
+def _clean_env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in list(env):
+        if k.startswith("TORCHEVAL_TPU_CHAOS"):
+            del env[k]
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _wait_port(outdir: str, tag: str, timeout_s: float = 90.0) -> int:
+    path = os.path.join(outdir, f"{tag}.port")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                return int(f.read())
+        time.sleep(0.05)
+    raise TimeoutError(f"host {tag} never published its port.")
+
+
+class TestRouterRestartDrill(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        cls.procs = {}
+        try:
+            cls._run_world()
+        except BaseException:
+            for proc in cls.procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            raise
+
+    @classmethod
+    def _run_world(cls):
+        from torcheval_tpu import obs
+        from torcheval_tpu.serve import EvalClient, EvalRouter
+
+        cls.outdir = _artifact_dir()
+        cls.ckpt_root = os.path.join(cls.outdir, "ckpt_root")
+        cls.journal_dir = os.path.join(cls.outdir, "journal")
+        os.makedirs(cls.ckpt_root, exist_ok=True)
+
+        endpoints = []
+        for tag in ("hostA", "hostB", "hostC"):
+            cls.procs[tag] = subprocess.Popen(
+                [sys.executable, _HOST, cls.outdir, tag, cls.ckpt_root],
+                env=_clean_env(),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            endpoints.append(f"127.0.0.1:{_wait_port(cls.outdir, tag)}")
+        cls.endpoints = endpoints
+
+        # the disposable router: journaled, armed to die mid-migration
+        driver = subprocess.Popen(
+            [
+                sys.executable,
+                _DRIVER,
+                cls.outdir,
+                cls.journal_dir,
+                ",".join(endpoints),
+            ],
+            env=_clean_env(
+                {
+                    "TORCHEVAL_TPU_CHAOS": "1",
+                    "TORCHEVAL_TPU_CHAOS_ACTION": "router_kill",
+                    "TORCHEVAL_TPU_CHAOS_TENANT": "*",
+                    "TORCHEVAL_TPU_CHAOS_STEP": "1",
+                    "TORCHEVAL_TPU_CHAOS_POINT": "migrate_exported",
+                    "TORCHEVAL_TPU_CHAOS_EXIT_CODE": str(
+                        CHAOS_EXIT_CODE
+                    ),
+                }
+            ),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        cls.driver_out, _ = driver.communicate(timeout=300)
+        cls.driver_rc = driver.returncode
+        with open(os.path.join(cls.outdir, "driver.state.json")) as f:
+            cls.driver_state = json.load(f)
+
+        # the restart: a NEW router recovers from the same journal
+        obs.reset()
+        obs.enable()
+        router = EvalRouter(
+            endpoints,
+            journal_dir=cls.journal_dir,
+            request_timeout_s=10.0,
+            connect_timeout_s=5.0,
+            max_attempts=2,
+            backoff_base_s=0.05,
+        )
+        cls.recovery = dict(router.last_recovery)
+        cls.placement_after = router.placement()
+        for i in range(PHASE1, PHASE1 + PHASE2):
+            for t in TENANTS:
+                router.submit(t, *_make_batch(t, i))
+        for t in TENANTS:
+            router.flush(t)
+        cls.results = {
+            t: float(np.asarray(router.compute(t)["acc"]))
+            for t in TENANTS
+        }
+
+        # zero duplicate application anywhere in the fleet
+        cls.host_dupes = {}
+        cls.fleet_status = router.fleet_status()
+        for ep in endpoints:
+            client = EvalClient(ep, request_timeout_s=30.0)
+            health = client.health()
+            cls.host_dupes[ep] = {
+                tid: info.get("dupes", 0)
+                for tid, info in health.get("tenants", {}).items()
+            }
+            client.close()
+        router.close()
+
+        # artifacts: the journal itself (the drill's black box), fleet
+        # status, and a summary with the measured blackout
+        journal_artifacts = os.path.join(cls.outdir, "journal_after")
+        shutil.copytree(
+            cls.journal_dir, journal_artifacts, dirs_exist_ok=True
+        )
+        with open(
+            os.path.join(cls.outdir, "fleet.status.json"), "w"
+        ) as f:
+            json.dump(cls.fleet_status, f, indent=2, default=str)
+        with open(
+            os.path.join(cls.outdir, "restart.summary.json"), "w"
+        ) as f:
+            json.dump(
+                {
+                    "driver_exit_code": cls.driver_rc,
+                    "recovery": cls.recovery,
+                    "blackout_ms": cls.recovery["duration_s"] * 1e3,
+                    "placement_before": cls.driver_state["placement"],
+                    "placement_after": cls.placement_after,
+                    "host_dupes": cls.host_dupes,
+                },
+                f,
+                indent=2,
+            )
+
+        for tag in list(cls.procs):
+            with open(os.path.join(cls.outdir, f"{tag}.stop"), "w"):
+                pass
+        for proc in cls.procs.values():
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        cls.leaked_threads = [
+            t.name
+            for t in threading.enumerate()
+            if "torcheval-tpu-obs-" in t.name
+            or t.name == "torcheval-tpu-router-rebalance"
+        ]
+        obs.disable()
+
+    def test_chaos_killed_the_router_mid_migration(self):
+        self.assertEqual(
+            self.driver_rc,
+            CHAOS_EXIT_CODE,
+            self.driver_out.decode(errors="replace")[-2000:],
+        )
+
+    def test_recovery_reconciled_every_tenant(self):
+        outcomes = self.recovery["outcomes"]
+        # the drained host's tenants re-place from checkpoints; any
+        # tenant living elsewhere is adopted where it stands
+        self.assertGreaterEqual(outcomes.get("replaced", 0), 1)
+        self.assertEqual(sum(outcomes.values()), 3)  # solo, fan, fan@r1
+        self.assertEqual(
+            sorted(self.placement_after),
+            sorted(self.driver_state["placement"]),
+        )
+        # the drain the dead router had journaled survives the restart
+        victim = self.driver_state["victim"]
+        self.assertIn(victim, self.recovery["drained"])
+        for t, ep in self.placement_after.items():
+            self.assertNotEqual(ep, victim, t)
+
+    def test_results_bit_identical_to_fault_free_oracles(self):
+        for t in TENANTS:
+            self.assertEqual(
+                self.results[t], _oracle(t, PHASE1 + PHASE2), t
+            )
+
+    def test_zero_duplicate_application(self):
+        for ep, dupes in self.host_dupes.items():
+            for tid, n in dupes.items():
+                self.assertEqual(n, 0, f"{tid} on {ep}")
+
+    def test_blackout_measured_and_bounded(self):
+        blackout_s = self.recovery["duration_s"]
+        self.assertGreater(blackout_s, 0.0)
+        self.assertLess(blackout_s, 60.0)
+
+    def test_no_threads_leaked(self):
+        self.assertEqual(self.leaked_threads, [])
+
+    def test_artifacts_written(self):
+        for name in (
+            "driver.state.json",
+            "fleet.status.json",
+            "restart.summary.json",
+            os.path.join("journal_after", "snapshot.json"),
+        ):
+            self.assertTrue(
+                os.path.getsize(os.path.join(self.outdir, name)) > 0,
+                name,
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -224,6 +224,21 @@ class TestBenchHygiene(unittest.TestCase):
                 "absorbing over-capacity load with zero sheds and an "
                 "exactly-merged split tenant) loses its regression pin",
             )
+        for row in (
+            "config13_router_restart_blackout_ms",
+            "config13_router_restart_recovered_tenants",
+            "config13_router_restart_journal_records",
+            "config13_router_restart_replay_exact",
+        ):
+            self.assertIn(
+                row,
+                expected,
+                f"{row} left the --smoke completeness set: the durable-"
+                "control-plane contract (ISSUE 20 — a journaled router "
+                "restart with a measured blackout, every tenant "
+                "reconciled, and replay bit-identical to the fault-free "
+                "oracle) loses its regression pin",
+            )
 
     def test_loopback_rows_carry_machine_readable_sandbox_caveat(self):
         # ISSUE 15 satellite (ROADMAP 1a/6): the 1-core loopback artifacts
@@ -246,6 +261,7 @@ class TestBenchHygiene(unittest.TestCase):
             "config11_sliced_1m_sharded_ratio",
             "config12_obs_stream_overhead",
             "config9_elastic_p99",
+            "config13_router_restart_blackout_ms",
         ):
             self.assertIn(
                 row,

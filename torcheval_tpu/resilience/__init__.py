@@ -28,8 +28,10 @@ from torcheval_tpu.resilience.snapshot import (
     discover_checkpoints,
     latest_checkpoint,
     list_checkpoints,
+    quarantine_checkpoint,
     read_extra,
     restore,
+    restore_latest_valid,
     save,
 )
 
@@ -41,8 +43,10 @@ __all__ = [
     "discover_checkpoints",
     "latest_checkpoint",
     "list_checkpoints",
+    "quarantine_checkpoint",
     "read_extra",
     "restore",
+    "restore_latest_valid",
     "save",
 ]
 

@@ -87,6 +87,41 @@ families inject here, armed through the environment before launch:
     ``TORCHEVAL_TPU_CHAOS_STEP`` (the 1-based index among *submit* acks
     for the matching tenant, counted process-wide), exactly like host
     actions, and fire once per process.
+
+    **Router actions** (fire in ``on_router_op``, at the router's
+    control-plane funnel — ISSUE 20):
+
+    * ``"router_kill"`` — ``os._exit`` at the chosen router operation:
+      the control plane vanishes mid-stream with its routing table,
+      split topology, and replay buffers. Recovery is the journal's job
+      (``EvalRouter(journal_dir=)``); the drill restarts the router in a
+      fresh process and reconciles against the still-live hosts.
+
+    Router actions select their moment with ``TORCHEVAL_TPU_CHAOS_TENANT``
+    (``"*"`` = any tenant), ``TORCHEVAL_TPU_CHAOS_STEP`` (the 1-based
+    index among matching hook calls, counted process-wide) and the
+    optional ``TORCHEVAL_TPU_CHAOS_POINT`` (``"submit"`` /
+    ``"migrate_exported"`` / … — ``"*"`` = any point, the default;
+    ``"migrate_exported"`` is the nastiest: the tenant's wire state is
+    exported but not yet adopted anywhere). Fire once per process.
+
+    **Checkpoint actions** (fire in ``on_ckpt_saved``, immediately after
+    ``resilience.save`` publishes a generation — ISSUE 20):
+
+    * ``"ckpt_corrupt"`` — flip one payload byte (``state.npz``) of the
+      just-published checkpoint, modelling silent media corruption of
+      the newest generation: the next ``restore_latest_valid`` /
+      ``attach(resume="auto")`` must quarantine it and fall back to the
+      previous valid generation. The manifest (and its checksum record)
+      stays intact, so the corruption is caught by verification, not by
+      a missing file.
+
+    Checkpoint actions select their save with
+    ``TORCHEVAL_TPU_CHAOS_TENANT`` (a substring of the checkpoint path —
+    the daemon's per-tenant directory carries the sanitized tenant id;
+    ``"*"`` = any save) and ``TORCHEVAL_TPU_CHAOS_STEP`` (the 1-based
+    index among matching saves, counted process-wide). Fire once per
+    process.
 ``TORCHEVAL_TPU_CHAOS_RANK``
     Global process index the fault targets. Required for sync-funnel
     actions (other ranks never act); optional for ingestion actions (when
@@ -147,6 +182,7 @@ _ENV_EXIT = "TORCHEVAL_TPU_CHAOS_EXIT_CODE"
 _ENV_TENANT = "TORCHEVAL_TPU_CHAOS_TENANT"
 _ENV_STEP = "TORCHEVAL_TPU_CHAOS_STEP"
 _ENV_POISON = "TORCHEVAL_TPU_CHAOS_POISON"
+_ENV_POINT = "TORCHEVAL_TPU_CHAOS_POINT"
 
 _SYNC_ACTIONS = ("kill", "delay")
 # load actions fire REPEATEDLY (every matching admitted batch), the rest
@@ -155,6 +191,8 @@ _LOAD_ACTIONS = ("load_spike", "hot_tenant")
 _INGEST_ACTIONS = ("poison", "ingest_delay") + _LOAD_ACTIONS
 _HOST_ACTIONS = ("host_kill", "host_partition", "ack_drop")
 _ACK_ACTIONS = ("ack_delay", "ack_reorder")
+_ROUTER_ACTIONS = ("router_kill",)
+_CKPT_ACTIONS = ("ckpt_corrupt",)
 _POISON_KINDS = ("nan", "shape")
 
 
@@ -168,6 +206,7 @@ class _ChaosConfig:
         "tenant",
         "step",
         "poison",
+        "point",
     )
 
     def __init__(
@@ -181,6 +220,7 @@ class _ChaosConfig:
         tenant: Optional[str] = None,
         step: Optional[int] = None,
         poison: str = "nan",
+        point: str = "*",
     ):
         self.action = action
         self.rank = rank
@@ -190,6 +230,7 @@ class _ChaosConfig:
         self.tenant = tenant
         self.step = step
         self.poison = poison
+        self.point = point
 
 
 # resolved lazily on first hook; False = disarmed, None = not yet resolved
@@ -201,6 +242,10 @@ _host_fired = False
 _host_submits_seen: dict = {}  # tenant_id -> submit requests observed
 _ack_fired = False
 _acks_seen: dict = {}  # tenant_id -> submit acks observed
+_router_fired = False
+_router_ops_seen = 0  # matching router-op hook calls observed
+_ckpt_fired = False
+_ckpt_saves_seen = 0  # matching checkpoint publishes observed
 _lock = threading.Lock()
 
 
@@ -251,6 +296,20 @@ def _resolve() -> object:
                 tenant=os.environ[_ENV_TENANT],
                 step=int(os.environ[_ENV_STEP]),
             )
+        elif action in _ROUTER_ACTIONS:
+            _config = _ChaosConfig(
+                action,
+                exit_code=exit_code,
+                tenant=os.environ[_ENV_TENANT],
+                step=int(os.environ[_ENV_STEP]),
+                point=os.environ.get(_ENV_POINT, "*"),
+            )
+        elif action in _CKPT_ACTIONS:
+            _config = _ChaosConfig(
+                action,
+                tenant=os.environ[_ENV_TENANT],
+                step=int(os.environ[_ENV_STEP]),
+            )
         else:
             raise ValueError(f"unknown chaos action {action!r}")
     except (KeyError, ValueError) as e:
@@ -263,7 +322,8 @@ def reset_for_tests() -> None:
     """Re-read the environment and restart the round/step bookkeeping
     (test hook)."""
     global _config, _rounds_seen, _ingest_fired, _host_fired, _ack_fired
-    global _load_logged
+    global _load_logged, _router_fired, _router_ops_seen
+    global _ckpt_fired, _ckpt_saves_seen
     with _lock:
         _config = None
         _rounds_seen = 0
@@ -273,6 +333,10 @@ def reset_for_tests() -> None:
         _host_submits_seen.clear()
         _ack_fired = False
         _acks_seen.clear()
+        _router_fired = False
+        _router_ops_seen = 0
+        _ckpt_fired = False
+        _ckpt_saves_seen = 0
 
 
 def on_sync_round() -> None:
@@ -488,6 +552,139 @@ def host_die(action: str) -> None:
         "chaos: killing host (%s, exit %d)", action, exit_code
     )
     os._exit(exit_code)
+
+
+def router_armed() -> bool:
+    """True when a router action is armed for this process — the
+    router's cheap gate (when False, its control-plane paths never call
+    :func:`on_router_op` at all)."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    return cfg is not False and cfg.action in _ROUTER_ACTIONS
+
+
+def on_router_op(point: str, tenant_id: Optional[str]) -> None:
+    """Called by the router at its control-plane moments (``"submit"``
+    per fan-out decision, ``"migrate_exported"`` between a migration's
+    export and its adopt, …). Counts matching calls process-wide under
+    the lock; at the armed count, ``router_kill`` exits HERE — the
+    routing table, split topology and replay buffers die unsaved, and
+    only the journal (fsync'd before every table mutation committed)
+    survives. Fires once per process."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    if cfg is False or cfg.action not in _ROUTER_ACTIONS:
+        return
+    global _router_fired, _router_ops_seen
+    if _router_fired:
+        return
+    if cfg.point not in ("*", point):
+        return
+    if cfg.tenant not in ("*", tenant_id):
+        return
+    with _lock:
+        if _router_fired:
+            return
+        _router_ops_seen += 1
+        if _router_ops_seen != cfg.step:
+            return
+        _router_fired = True
+    if _obs_registry._enabled:
+        _obs_trace.instant(
+            "resilience.chaos",
+            kind="chaos",
+            action=cfg.action,
+            tenant=tenant_id,
+            point=point,
+            step=cfg.step,
+        )
+    _logger.warning(
+        "chaos: killing router at %s op %d (tenant %r, exit %d)",
+        point,
+        cfg.step,
+        tenant_id,
+        cfg.exit_code,
+    )
+    os._exit(cfg.exit_code)
+
+
+def ckpt_armed() -> bool:
+    """True when a checkpoint action is armed for this process — the
+    snapshot writer's cheap gate (when False, ``save`` never calls
+    :func:`on_ckpt_saved` at all)."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    return cfg is not False and cfg.action in _CKPT_ACTIONS
+
+
+def on_ckpt_saved(ckpt_path: str) -> None:
+    """Called by ``resilience.save`` immediately after it publishes a
+    generation. At the armed save (``TORCHEVAL_TPU_CHAOS_TENANT`` as a
+    path substring, ``TORCHEVAL_TPU_CHAOS_STEP`` the 1-based matching
+    count), flips one ``state.npz`` payload byte in place — the newest
+    generation is now silently corrupt, exactly what
+    ``restore_latest_valid`` / ``attach(resume="auto")`` must quarantine
+    and fall back from. Fires once per process."""
+    cfg = _config
+    if cfg is None:
+        cfg = _resolve()
+    if cfg is False or cfg.action not in _CKPT_ACTIONS:
+        return
+    global _ckpt_fired, _ckpt_saves_seen
+    if _ckpt_fired:
+        return
+    if cfg.tenant != "*" and cfg.tenant not in ckpt_path:
+        return
+    with _lock:
+        if _ckpt_fired:
+            return
+        _ckpt_saves_seen += 1
+        if _ckpt_saves_seen != cfg.step:
+            return
+        _ckpt_fired = True
+    payload = os.path.join(ckpt_path, "state.npz")
+    try:
+        with open(payload, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size == 0:
+                _logger.warning(
+                    "chaos: ckpt_corrupt found an empty payload at %s; "
+                    "nothing flipped.", payload,
+                )
+                return
+            # inside the zip local-file header / first member: restore
+            # fails verification (corrupt_payload / checksum_mismatch),
+            # never "file missing" — the silent-bit-rot model
+            offset = min(12, size - 1)
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([byte[0] ^ 0xFF]))
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError as e:
+        _logger.warning(
+            "chaos: ckpt_corrupt could not touch %s (%s); the drill "
+            "should fail loudly, not silently pass.", payload, e,
+        )
+        return
+    if _obs_registry._enabled:
+        _obs_trace.instant(
+            "resilience.chaos",
+            kind="chaos",
+            action=cfg.action,
+            path=ckpt_path,
+            step=cfg.step,
+        )
+    _logger.warning(
+        "chaos: flipped one payload byte of %s (save %d).",
+        ckpt_path,
+        cfg.step,
+    )
 
 
 def on_ingest(tenant_id: str, step: int, args: Tuple) -> Tuple:

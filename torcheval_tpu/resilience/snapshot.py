@@ -75,11 +75,14 @@ _MANIFEST = "manifest.json"
 _PAYLOAD = "state.npz"
 _CKPT_PREFIX = "ckpt-"
 _TMP_PREFIX = ".tmp-"
+_CORRUPT_PREFIX = "corrupt-"
 
 __all__ = [
     "CheckpointError",
     "save",
     "restore",
+    "restore_latest_valid",
+    "quarantine_checkpoint",
     "latest_checkpoint",
     "list_checkpoints",
     "read_extra",
@@ -279,12 +282,45 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return ckpts[-1] if ckpts else None
 
 
+def _manifest_readable(ckpt: str) -> bool:
+    """``True`` when ``ckpt``'s manifest parses and carries every required
+    field — the cheap validity probe (no payload scan) rotation and
+    discovery use to avoid orphaning/hiding the last valid generation."""
+    try:
+        _read_manifest(ckpt)
+    except CheckpointError:
+        return False
+    return True
+
+
 def rotate_checkpoints(directory: str, keep_last: int) -> None:
     """Remove published checkpoints beyond the newest ``keep_last``.
     ``save(keep_last=)`` calls this after its durable publish; callers
     that must defer rotation past their own commit point (the serve
-    daemon's abortable idle eviction) call it directly afterwards."""
-    for old in list_checkpoints(directory)[:-keep_last]:
+    daemon's abortable idle eviction) call it directly afterwards.
+
+    The last *valid* generation is never a victim (ISSUE 20): when none
+    of the retained newest ``keep_last`` dirs has a readable manifest —
+    every retained generation is torn or bit-rotted — the newest valid
+    older generation is spared, so rotation can never leave a directory
+    with zero restorable checkpoints. Quarantined ``corrupt-*`` dirs are
+    invisible here by construction (they no longer parse as ``ckpt-*``)
+    and are therefore never rotated away either.
+    """
+    ckpts = list_checkpoints(directory)
+    victims = ckpts[:-keep_last]
+    if victims and not any(_manifest_readable(c) for c in ckpts[-keep_last:]):
+        for c in reversed(victims):
+            if _manifest_readable(c):
+                victims = [v for v in victims if v != c]
+                _logger.warning(
+                    "checkpoint rotation spared %s: it is the newest "
+                    "generation with a readable manifest (every retained "
+                    "newer generation is corrupt).",
+                    c,
+                )
+                break
+    for old in victims:
         shutil.rmtree(old, ignore_errors=True)
 
 
@@ -303,6 +339,14 @@ def discover_checkpoints(root: str) -> Dict[str, str]:
     Names are the subdirectory names (the daemon's filesystem-safe
     tenant ids). Subdirectories without a published ``ckpt-*`` (e.g.
     only ``.tmp-*`` left by a crash mid-save) are omitted.
+
+    Hardened against bit rot (ISSUE 20 satellite): a generation whose
+    ``manifest.json`` is unparseable or truncated is SKIPPED (counted
+    into ``resilience.checkpoint.corrupt_skipped{reason=}``) and the
+    next-older generation is offered instead — one tenant's torn
+    manifest must never raise mid-discovery and hide every *other*
+    tenant's recoverable checkpoints. A subdirectory with no readable
+    generation at all is omitted like an empty one.
     """
     out: Dict[str, str] = {}
     try:
@@ -313,9 +357,23 @@ def discover_checkpoints(root: str) -> Dict[str, str]:
         sub = os.path.join(root, name)
         if not os.path.isdir(sub):
             continue
-        newest = latest_checkpoint(sub)
-        if newest is not None:
-            out[name] = newest
+        for ckpt in reversed(list_checkpoints(sub)):
+            try:
+                _read_manifest(ckpt)
+            except CheckpointError as e:
+                _logger.warning(
+                    "checkpoint discovery skipping %s (%s); trying the "
+                    "previous generation.",
+                    ckpt,
+                    e.reason,
+                )
+                _obs.counter(
+                    "resilience.checkpoint.corrupt_skipped",
+                    reason=e.reason,
+                )
+                continue
+            out[name] = ckpt
+            break
     return out
 
 
@@ -495,6 +553,14 @@ def save(
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         _fsync_dir(directory)
+    from torcheval_tpu.resilience import chaos as _chaos
+
+    if _chaos.ckpt_armed():
+        # test-only silent-bit-rot injection (ISSUE 20): flips one
+        # payload byte of the generation just published, AFTER the
+        # durable publish — the reader-side lineage fallback is what is
+        # under test, never the writer
+        _chaos.on_ckpt_saved(final)
     nbytes = manifest["payload_bytes"] + os.path.getsize(
         os.path.join(final, _MANIFEST)
     )
@@ -776,3 +842,87 @@ def restore(obj: Any, path: str) -> Any:
         step=manifest.get("step", -1),
     )
     return obj
+
+
+# --------------------------------------------------------- lineage fallback
+# the CheckpointError reasons that mean THIS generation's bytes are bad
+# (quarantine it and fall back to an older one) — as opposed to
+# schema_mismatch/unsupported, which indict the restore TARGET's
+# configuration and would fail identically against every generation
+_CORRUPT_REASONS = frozenset(
+    {"corrupt_manifest", "corrupt_payload", "checksum_mismatch"}
+)
+
+
+def quarantine_checkpoint(ckpt: str) -> Optional[str]:
+    """Atomically rename a corrupt generation ``ckpt-<step>`` to
+    ``corrupt-ckpt-<step>`` (ISSUE 20): the bytes are preserved for
+    forensics — counted, never deleted — while the dir stops parsing as a
+    published checkpoint, so every reader (``list_checkpoints``, rotation,
+    the zombie-writer watermark pick) forgets it exists. ``corrupt-*``
+    names are likewise invisible to the ``.tmp-*`` GC, so a quarantined
+    generation outlives any amount of later save churn. Returns the new
+    path, or ``None`` when the dir vanished underneath us (a concurrent
+    reader already quarantined it — not an error)."""
+    parent, name = os.path.split(os.path.normpath(ckpt))
+    target = os.path.join(parent, _CORRUPT_PREFIX + name)
+    suffix = 1
+    while os.path.exists(target):
+        suffix += 1
+        target = os.path.join(parent, f"{_CORRUPT_PREFIX}{name}.{suffix}")
+    try:
+        os.rename(ckpt, target)
+    except FileNotFoundError:
+        return None
+    _fsync_dir(parent)
+    _logger.warning(
+        "checkpoint: quarantined corrupt generation %s -> %s "
+        "(preserved for forensics, excluded from every future restore).",
+        ckpt,
+        target,
+    )
+    _obs.counter("resilience.checkpoint.corrupt_quarantined")
+    _obs_trace.instant(
+        "resilience.checkpoint.quarantined", kind="checkpoint", path=target
+    )
+    return target
+
+
+def restore_latest_valid(obj: Any, directory: str) -> str:
+    """Restore ``obj`` from the newest *valid* generation under
+    ``directory``, walking newest→oldest past corrupt ones (ISSUE 20).
+
+    Each generation whose bytes fail validation (``corrupt_manifest`` /
+    ``corrupt_payload`` / ``checksum_mismatch``) is quarantined via
+    :func:`quarantine_checkpoint` and the walk continues — a bit-flipped
+    newest checkpoint degrades the caller to the previous durable
+    generation instead of failing the restore outright.
+    ``schema_mismatch`` / ``unsupported`` raise immediately: they indict
+    the restore target's configuration, not the checkpoint's bytes, and
+    quarantining on them would destroy lineage a correctly-configured
+    caller could still use. Raises ``CheckpointError("not_found")`` when
+    no valid generation remains. Counts each successful restore that had
+    to skip at least one corrupt generation into
+    ``resilience.checkpoint.fallback_restores``. Returns the path of the
+    generation actually restored."""
+    skipped = 0
+    while True:
+        ckpt = latest_checkpoint(directory)
+        if ckpt is None:
+            raise CheckpointError(
+                "not_found",
+                f"no valid checkpoint generation remains under "
+                f"{directory!r} ({skipped} corrupt generation(s) "
+                "quarantined).",
+            )
+        try:
+            restore(obj, ckpt)
+        except CheckpointError as e:
+            if e.reason not in _CORRUPT_REASONS:
+                raise
+            quarantine_checkpoint(ckpt)
+            skipped += 1
+            continue
+        if skipped:
+            _obs.counter("resilience.checkpoint.fallback_restores")
+        return ckpt

@@ -1610,6 +1610,42 @@ class EvalClient:
             return self.health(timeout_s=timeout_s)["load_report"]
         return header["load_report"]
 
+    def list_tenants(
+        self,
+        *,
+        timeout_s: Any = _UNSET,
+        attempts: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """The host's attached-tenant directory — per tenant: ``status``,
+        ``last_seq``, ``durable_seq``, plus the attach-time ``spec`` and
+        ``knobs`` the server recorded (ISSUE 20). This is the recovering
+        router's reconciliation pull: journal replay names the tenants it
+        EXPECTS, this op names the tenants each host actually HOLDS, and
+        the diff drives adopt / re-place / orphan adoption. An old server
+        rejects the op as ``WireError("protocol")``; degrade to the
+        ``health()`` per-tenant fold — same status + watermarks, no
+        spec/knobs (orphans on old hosts stay unadoptable, a degradation
+        not a break)."""
+        try:
+            header, _ = self._call(
+                "list_tenants", {}, timeout_s=timeout_s, attempts=attempts
+            )
+        except WireError as e:
+            if e.reason != "protocol":
+                raise
+            tenants = self.health(
+                timeout_s=timeout_s, attempts=attempts
+            ).get("tenants", {})
+            return {
+                tid: {
+                    "status": info.get("status"),
+                    "last_seq": info.get("last_seq", 0),
+                    "durable_seq": info.get("durable_seq", 0),
+                }
+                for tid, info in tenants.items()
+            }
+        return header["tenants"]
+
     # ------------------------------------------------------------ obs stream
     def subscribe_obs(
         self,
@@ -1887,3 +1923,19 @@ class EvalClient:
                 "serve.router.replays", float(replayed), tenant=tenant_id
             )
         return replayed
+
+    def adopt_attached(self, tenant_id: str, last_seq: int) -> None:
+        """Install client-side wire state for a tenant that is ALREADY
+        attached server-side (ISSUE 20: a recovered router re-adopting a
+        live tenant — ``attach`` would raise ``duplicate_tenant``, and a
+        detach/re-attach round-trip would discard queued batches). Seeds
+        the seq cursor from the host's reported ``last_seq`` so the next
+        submit continues the exactly-once stream; the codec stays "raw"
+        (frames are self-describing — a codec is a per-attach bandwidth
+        negotiation, not a correctness requirement). The replay buffer
+        starts empty: everything at or below ``last_seq`` is applied on
+        the host, and nothing above it was ever submitted through this
+        client. Idempotent; refuses to clobber live local state."""
+        with self._lock:
+            if tenant_id not in self._tenants:
+                self._tenants[tenant_id] = _ClientTenant(int(last_seq))

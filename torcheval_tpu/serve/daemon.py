@@ -437,38 +437,84 @@ class EvalDaemon:
                 do_resume = has_ckpt
             if do_resume:
                 # restore BEFORE the tenant is visible: a failed restore
-                # (schema drift, corrupt payload) must reject admission,
-                # not quarantine a half-born tenant
+                # (schema drift) must reject admission, not quarantine a
+                # half-born tenant. Corrupt BYTES are different (ISSUE
+                # 20): a bit-flipped generation is quarantined and the
+                # walk falls back to the previous durable one — the
+                # tenant degrades to an older watermark and the client
+                # replay buffer heals the gap, instead of the whole
+                # attach rejecting over storage rot.
                 from torcheval_tpu.resilience.snapshot import (
+                    _CORRUPT_REASONS,
+                    CheckpointError,
                     _resolve_ckpt,
+                    quarantine_checkpoint,
                     read_extra,
                     restore,
                 )
 
-                # resolve the checkpoint ONCE and use the same directory
-                # for both the state and the watermark — resolving twice
-                # would let a concurrent publish (e.g. a partitioned old
-                # host still flushing into the shared root) slip a newer
-                # manifest between the two reads, arming the dedup window
-                # ahead of the restored state and silently dropping
-                # replayed batches. For seq-tracked tenants prefer the
-                # HIGHEST acked watermark over the newest step: a
-                # partitioned-but-alive old host can publish a stale
-                # checkpoint into the shared root AFTER the tenant
-                # migrated, and "newest step" would resurrect it.
-                ckpt = self._best_serve_ckpt(ckpt_dir) or _resolve_ckpt(
-                    ckpt_dir
-                )
-                restore(collection, ckpt)
-                # the wire-sequence watermark rides the manifest (written
-                # atomically with the state it describes): every batch
-                # with seq <= resumed_seq is IN the restored state, so the
-                # dedup window re-arms exactly where the checkpoint left
-                # it and a client replaying its un-acked window after a
-                # migration can never double-apply a checkpointed batch
-                resumed_seq = int(
-                    read_extra(ckpt).get("serve", {}).get("acked_seq", 0)
-                )
+                fell_back = 0
+                while True:
+                    # resolve the checkpoint ONCE per attempt and use the
+                    # same directory for both the state and the watermark
+                    # — resolving twice would let a concurrent publish
+                    # (e.g. a partitioned old host still flushing into
+                    # the shared root) slip a newer manifest between the
+                    # two reads, arming the dedup window ahead of the
+                    # restored state and silently dropping replayed
+                    # batches. For seq-tracked tenants prefer the HIGHEST
+                    # acked watermark over the newest step: a
+                    # partitioned-but-alive old host can publish a stale
+                    # checkpoint into the shared root AFTER the tenant
+                    # migrated, and "newest step" would resurrect it.
+                    try:
+                        ckpt = self._best_serve_ckpt(
+                            ckpt_dir
+                        ) or _resolve_ckpt(ckpt_dir)
+                    except CheckpointError:
+                        ckpt = None
+                    if ckpt is None:
+                        # the lineage ran dry: every generation was
+                        # corrupt and is now quarantined. "require"
+                        # promised a restorable checkpoint — reject;
+                        # "auto" degrades to a clean start (the replay
+                        # buffer is the only healer left).
+                        if resume == "require":
+                            self._count_admission(
+                                "rejected", "no_checkpoint"
+                            )
+                            raise AdmissionError(
+                                "no_checkpoint",
+                                f"resume='require' but every checkpoint "
+                                f"generation for tenant {tenant_id!r} "
+                                f"under {ckpt_dir!r} was corrupt "
+                                f"({fell_back} quarantined).",
+                            )
+                        do_resume = False
+                        break
+                    try:
+                        restore(collection, ckpt)
+                    except CheckpointError as e:
+                        if e.reason not in _CORRUPT_REASONS:
+                            raise
+                        quarantine_checkpoint(ckpt)
+                        fell_back += 1
+                        continue
+                    # the wire-sequence watermark rides the manifest
+                    # (written atomically with the state it describes):
+                    # every batch with seq <= resumed_seq is IN the
+                    # restored state, so the dedup window re-arms exactly
+                    # where the checkpoint left it and a client replaying
+                    # its un-acked window after a migration can never
+                    # double-apply a checkpointed batch
+                    resumed_seq = int(
+                        read_extra(ckpt).get("serve", {}).get("acked_seq", 0)
+                    )
+                    if fell_back and _obs._enabled:
+                        _obs.counter(
+                            "resilience.checkpoint.fallback_restores"
+                        )
+                    break
         except BaseException:
             with self._cond:
                 self._attaching.discard(tenant_id)
@@ -1653,6 +1699,24 @@ class EvalDaemon:
             "bytes_sum": hbm_sum,
         }
         return out
+
+    def list_tenants(self) -> Dict[str, Dict[str, Any]]:
+        """The tenant directory a recovering control plane reconciles
+        against (ISSUE 20): every attached tenant's status and seq
+        watermarks, one cheap read under the daemon lock. ``last_seq`` is
+        the highest wire sequence this daemon has admitted (a restarted
+        router resumes its client-side numbering from here);
+        ``durable_seq`` is the checkpointed watermark. Served over the
+        wire as the ``list_tenants`` op."""
+        with self._cond:
+            return {
+                t.id: {
+                    "status": t.status.value,
+                    "last_seq": t.last_seq,
+                    "durable_seq": t.durable_seq,
+                }
+                for t in self._tenants.values()
+            }
 
     def health(
         self,

@@ -100,6 +100,28 @@ completed rebalance move, alongside
 ``serve.router.migrations{reason=rebalance}``),
 ``serve.router.splits{tenant=}``, and the ``serve.fleet.headroom``
 gauge recorded by ``fleet_status()``.
+
+Durable control plane (ISSUE 20): with ``journal_dir=`` every
+control-plane mutation — placement, migration move, split, drain, host
+add/remove — appends one fsync'd record to a
+:class:`~torcheval_tpu.serve.journal.RouterJournal` before the call
+returns (submits never touch it; seq watermarks are the hosts' to
+keep). A new router constructed over the same ``journal_dir`` replays
+the journal and then **reconciles** against the live fleet via the
+``list_tenants`` wire op: journaled tenants still attached are
+*adopted* in place (client seq state re-seeded from the host's
+``last_seq`` — zero blackout beyond the probe), tenants whose host died
+while the router was down are *re-placed* through the ordinary
+``attach(resume="auto")`` checkpoint machinery, live tenants the
+journal never heard of are *orphan-adopted* from the attach-time
+spec/knobs each server records, a tenant found attached on TWO hosts
+(killed mid-migration) keeps the copy that advanced further and the
+stale one is dropped without a checkpoint, and split fan-out namespaces
+are reconstructed exactly — the fan-out ordinal is the sum of replica
+``last_seq``\\ s, because every parent submit bumps exactly one
+replica's seq by one. Outcomes count into
+``serve.router.recoveries{outcome=}`` and the whole pass is summarized
+in :attr:`EvalRouter.last_recovery` (the drill's blackout artifact).
 """
 
 from __future__ import annotations
@@ -113,8 +135,10 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from torcheval_tpu.obs import registry as _obs
 from torcheval_tpu.obs import trace as _trace
+from torcheval_tpu.resilience import chaos as _chaos
 from torcheval_tpu.serve.client import EvalClient
 from torcheval_tpu.serve.errors import AdmissionError, ServeError, WireError
+from torcheval_tpu.serve.journal import RouterJournal
 
 _logger = logging.getLogger(__name__)
 
@@ -180,6 +204,7 @@ class EvalRouter:
         probe_timeout_s: Optional[float] = 5.0,
         latency_target_s: float = 1.0,
         hbm_budget_bytes: Optional[int] = None,
+        journal_dir: Optional[str] = None,
         **client_kwargs: Any,
     ) -> None:
         if not endpoints:
@@ -245,6 +270,321 @@ class EvalRouter:
         # background rebalancer (ISSUE 19)
         self._rebalance_thread: Optional[threading.Thread] = None
         self._rebalance_stop = threading.Event()
+        # durable control plane (ISSUE 20): endpoints taken out of the
+        # alive set by an explicit drain stay out across a recovery (a
+        # DEAD endpoint, by contrast, is re-derived by probing — the
+        # journal records intent, the fleet records reality)
+        self._drained: set = set()
+        self._journal: Optional[RouterJournal] = None
+        # the last recovery pass's summary (outcomes, duration, fleet),
+        # None for a journal-less or genuinely cold start
+        self.last_recovery: Optional[Dict[str, Any]] = None
+        if journal_dir is not None:
+            self._journal = RouterJournal(
+                journal_dir, snapshot_fn=self._journal_state
+            )
+            self._recover()
+
+    # -------------------------------------------------------------- journal
+    def _journal_append(self, kind: str, **fields: Any) -> None:
+        """Durably record one control-plane mutation. A journal write
+        failure (disk full, dir removed) is logged, never raised — the
+        fleet keeps serving and the gap heals at the next recovery's
+        reconciliation pass (orphan adoption covers unjournaled
+        placements)."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.append(kind, **fields)
+        except (OSError, ValueError, TypeError) as e:
+            _logger.error(
+                "router: journal append (%s) failed: %s — continuing "
+                "unjournaled; the next recovery reconciles the gap.",
+                kind,
+                e,
+            )
+
+    def _journal_state(self) -> Dict[str, Any]:
+        """The full routing table as one compactable snapshot."""
+        with self._lock:
+            return {
+                "tenants": {
+                    tid: {
+                        "endpoint": rec.endpoint,
+                        "spec": rec.spec,
+                        "knobs": rec.knobs,
+                        "parent": rec.parent,
+                        "replicas": rec.replicas,
+                    }
+                    for tid, rec in self._tenants.items()
+                },
+                "endpoints": sorted(self._clients),
+                "drained": sorted(self._drained),
+            }
+
+    def _recover(self) -> None:
+        """Rebuild the routing table from the journal, then reconcile it
+        against the live fleet (module docstring: adopt / re-place /
+        orphan-adopt / drop, split reconstruction). Runs once, from the
+        constructor, before the router serves anything — the wall-clock
+        of this method IS the control-plane blackout."""
+        t0 = time.monotonic()
+        snapshot, records = self._journal.replay()
+        expected: Dict[str, Dict[str, Any]] = {}
+        known_eps = set(self._clients)
+        drained: set = set()
+        if snapshot:
+            for tid, meta in (snapshot.get("tenants") or {}).items():
+                expected[tid] = dict(meta)
+            known_eps |= set(snapshot.get("endpoints") or ())
+            drained |= set(snapshot.get("drained") or ())
+        for r in records:
+            kind = r.get("kind")
+            if kind == "place":
+                expected[r["tenant"]] = {
+                    "endpoint": r.get("endpoint"),
+                    "spec": r.get("spec"),
+                    "knobs": r.get("knobs") or {},
+                    "parent": r.get("parent"),
+                    "replicas": None,
+                }
+            elif kind == "remove":
+                expected.pop(r.get("tenant"), None)
+            elif kind == "move":
+                meta = expected.get(r.get("tenant"))
+                if meta is not None:
+                    meta["endpoint"] = r.get("endpoint")
+            elif kind == "split":
+                meta = expected.get(r.get("tenant"))
+                if meta is not None:
+                    meta["replicas"] = list(r.get("replicas") or ())
+            elif kind == "host_add":
+                known_eps.add(r.get("endpoint"))
+                drained.discard(r.get("endpoint"))
+            elif kind == "host_remove":
+                known_eps.discard(r.get("endpoint"))
+                drained.discard(r.get("endpoint"))
+            elif kind == "host_drain":
+                drained.add(r.get("endpoint"))
+            # unknown kinds: a newer writer's record — skip, never crash
+        # endpoints the journal knows that the constructor was not given
+        # (hosts added at runtime before the crash) get clients minted
+        # with the same factory/kwargs
+        for ep in sorted(e for e in known_eps if e and e not in self._clients):
+            try:
+                client = self._client_factory(ep, **self._client_kwargs)
+            except (ValueError, OSError) as e:
+                _logger.warning(
+                    "router recovery: cannot mint a client for journaled "
+                    "endpoint %s: %s", ep, e,
+                )
+                continue
+            self._clients[client.endpoint] = client
+        # probe: aliveness comes from the fleet, not the journal — a
+        # host that died AND restarted while the router was down is
+        # simply alive again; only an explicit drain survives recovery
+        self._drained = drained & set(self._clients)
+        alive: set = set()
+        live: Dict[str, Dict[str, Any]] = {}
+        stale_copies: List[Any] = []
+        for ep in sorted(self._clients):
+            if ep in self._drained:
+                continue
+            try:
+                tenants = self._clients[ep].list_tenants(
+                    timeout_s=self._probe_timeout_s, attempts=1
+                )
+            except (WireError, ServeError) as e:
+                if _obs._enabled:
+                    _obs.counter(
+                        "serve.router.probe_failures", endpoint=ep
+                    )
+                _logger.warning(
+                    "router recovery: endpoint %s did not answer the "
+                    "reconciliation probe (%s); its tenants re-place "
+                    "from checkpoints.", ep, e,
+                )
+                continue
+            alive.add(ep)
+            for tid, info in tenants.items():
+                cur = dict(info or {})
+                cur["endpoint"] = ep
+                prior = live.get(tid)
+                if prior is None:
+                    live[tid] = cur
+                    continue
+                # attached on TWO hosts: a migration was mid-flight when
+                # the router died. Keep the copy that advanced further;
+                # the stale one is dropped WITHOUT a checkpoint so it
+                # cannot publish a zombie generation.
+                keep, stale = (
+                    (cur, prior)
+                    if int(cur.get("last_seq") or 0)
+                    >= int(prior.get("last_seq") or 0)
+                    else (prior, cur)
+                )
+                live[tid] = keep
+                stale_copies.append((tid, stale["endpoint"]))
+        self._alive = alive
+        outcomes: Dict[str, int] = {}
+
+        def _count(outcome: str) -> None:
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+            if _obs._enabled:
+                _obs.counter("serve.router.recoveries", outcome=outcome)
+
+        for tid, ep in stale_copies:
+            try:
+                self._clients[ep].drop_tenant(tid, checkpoint=False)
+            except (ServeError, WireError) as e:
+                _logger.warning(
+                    "router recovery: stale copy of %r on %s did not "
+                    "release cleanly: %s", tid, ep, e,
+                )
+            _count("stale_dropped")
+        # torn-split rollback: a replica whose parent never committed a
+        # split record is the debris of a mid-split crash — the split
+        # itself is atomic, so the replica is detached, matching the
+        # crash-free rollback path of split_tenant
+        for tid in sorted(expected):
+            meta = expected[tid]
+            parent = meta.get("parent")
+            if not parent:
+                continue
+            pmeta = expected.get(parent)
+            committed = bool(
+                pmeta
+                and pmeta.get("replicas")
+                and tid in pmeta["replicas"]
+            )
+            if committed:
+                continue
+            expected.pop(tid)
+            info = live.pop(tid, None)
+            if info is not None:
+                try:
+                    self._clients[info["endpoint"]].drop_tenant(
+                        tid, checkpoint=False
+                    )
+                except (ServeError, WireError):
+                    pass
+            _count("split_rolled_back")
+        seqs: Dict[str, int] = {}
+        for tid in sorted(expected):
+            meta = expected[tid]
+            knobs = dict(meta.get("knobs") or {})
+            info = live.pop(tid, None)
+            if info is not None:
+                # still attached where (or wherever) the fleet holds it:
+                # adopt in place, re-seeding this router's client-side
+                # seq cursor from the host's watermark
+                rec = _RoutedTenant(
+                    meta.get("spec"),
+                    knobs,
+                    info["endpoint"],
+                    parent=meta.get("parent"),
+                )
+                self._tenants[tid] = rec
+                seqs[tid] = int(info.get("last_seq") or 0)
+                self._clients[info["endpoint"]].adopt_attached(
+                    tid, seqs[tid]
+                )
+                _count("adopted")
+                continue
+            # its host died while the router was down: re-place from the
+            # shared checkpoint root. The replay buffer died with the
+            # old router, so the resume point is the last DURABLE
+            # watermark — producers resubmit above it, dedup absorbs
+            # any overlap.
+            place_knobs = dict(knobs)
+            place_knobs["resume"] = "auto"
+            try:
+                ep = self._attach_anywhere(
+                    tid, meta.get("spec"), place_knobs
+                )
+            except (ServeError, WireError, AdmissionError) as e:
+                _logger.error(
+                    "router recovery: journaled tenant %r could not be "
+                    "re-placed (%s); dropping it from the routing "
+                    "table.", tid, e,
+                )
+                _count("dropped")
+                continue
+            self._tenants[tid] = _RoutedTenant(
+                meta.get("spec"), knobs, ep, parent=meta.get("parent")
+            )
+            # the freshly attached client state carries the restored
+            # watermark — read it back for split reconstruction
+            state = self._clients[ep]._tenants.get(tid)
+            seqs[tid] = int(state.durable_seq) if state is not None else 0
+            _count("replaced")
+        # orphans: live tenants the journal never heard of (attached in
+        # the crash window before their journal record landed, or placed
+        # behind the router's back). Adoptable only when the host
+        # recorded the attach-time spec; an old host's degraded
+        # list_tenants has none, so the tenant stays unrouted — loudly.
+        for tid in sorted(live):
+            info = live[tid]
+            if info.get("spec") is None:
+                _logger.warning(
+                    "router recovery: live tenant %r on %s carries no "
+                    "attach spec (old host?); leaving it unrouted.",
+                    tid, info["endpoint"],
+                )
+                _count("orphan_skipped")
+                continue
+            self._tenants[tid] = _RoutedTenant(
+                info["spec"],
+                dict(info.get("knobs") or {}),
+                info["endpoint"],
+            )
+            seqs[tid] = int(info.get("last_seq") or 0)
+            self._clients[info["endpoint"]].adopt_attached(
+                tid, seqs[tid]
+            )
+            _count("orphan_adopted")
+        # split reconstruction: surviving replicas re-form the fan-out
+        # set, and the fan-out ordinal is reconciliation-derived — every
+        # parent submit bumped exactly one replica's seq by one, so the
+        # ordinal is the sum of replica watermarks, exactly
+        for tid, meta in expected.items():
+            replicas = meta.get("replicas")
+            rec = self._tenants.get(tid)
+            if not replicas or rec is None:
+                continue
+            present = [r for r in replicas if r in self._tenants]
+            rec.replicas = present if len(present) >= 2 else None
+            rec.split_next = sum(seqs.get(r, 0) for r in present)
+        duration_s = time.monotonic() - t0
+        self.last_recovery = {
+            "outcomes": outcomes,
+            "duration_s": duration_s,
+            "alive": sorted(alive),
+            "drained": sorted(self._drained),
+            "tenants": len(self._tenants),
+            "journal_records": len(records),
+        }
+        if _obs._enabled:
+            _trace.instant(
+                "serve.router.recovered",
+                kind="router",
+                duration_s=duration_s,
+                tenants=len(self._tenants),
+            )
+        _logger.info(
+            "router: recovered from journal in %.3fs — %s (alive: %s).",
+            duration_s,
+            outcomes or "cold start",
+            sorted(alive),
+        )
+        # fold the reconciled table into one snapshot so the next
+        # recovery replays the OUTCOME, not the pre-crash history
+        try:
+            self._journal.compact(self._journal_state())
+        except (OSError, ValueError) as e:
+            _logger.error(
+                "router: post-recovery journal compaction failed: %s", e
+            )
 
     # ------------------------------------------------------------ placement
     def _host_load(self, report: Optional[Dict[str, Any]]) -> float:
@@ -385,6 +725,8 @@ class EvalRouter:
         self.unsubscribe_obs()
         for client in self._clients.values():
             client.close()
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self) -> "EvalRouter":
         return self
@@ -408,6 +750,18 @@ class EvalRouter:
         ep = self._attach_anywhere(tenant_id, spec, knobs)
         with self._lock:
             self._tenants[tenant_id] = _RoutedTenant(spec, dict(knobs), ep)
+        # journaled AFTER the commit: a crash in between leaves a live,
+        # unjournaled tenant — exactly what recovery's orphan adoption
+        # reconciles (journaling first would instead fabricate a tenant
+        # the caller was never told about)
+        self._journal_append(
+            "place",
+            tenant=tenant_id,
+            endpoint=ep,
+            spec=spec,
+            knobs=dict(knobs),
+            parent=None,
+        )
         return ep
 
     def _attach_anywhere(
@@ -494,6 +848,8 @@ class EvalRouter:
         replica, so the fan-out is deterministic given arrival order and
         any retry of THIS batch stays on the replica that booked its seq
         (exactly-once holds per replica namespace)."""
+        if _chaos.router_armed():
+            _chaos.on_router_op("submit", tenant_id)
         rec = self._routed(tenant_id)
         with self._lock:
             replicas = list(rec.replicas) if rec.replicas else None
@@ -642,6 +998,7 @@ class EvalRouter:
                 finally:
                     with self._lock:
                         self._tenants.pop(rid, None)
+                    self._journal_append("remove", tenant=rid)
                 if rid == tenant_id:
                     result = out
             return result
@@ -652,6 +1009,7 @@ class EvalRouter:
         finally:
             with self._lock:
                 self._tenants.pop(tenant_id, None)
+            self._journal_append("remove", tenant=tenant_id)
 
     # ------------------------------------------------------ tenant splitting
     def split_tenant(self, tenant_id: str, replicas: int = 2) -> Dict[str, str]:
@@ -719,6 +1077,16 @@ class EvalRouter:
                     self._tenants[rid] = _RoutedTenant(
                         rec.spec, dict(child_knobs), ep, parent=tenant_id
                     )
+                # a replica place record WITHOUT a later split record is
+                # how recovery identifies (and rolls back) a torn split
+                self._journal_append(
+                    "place",
+                    tenant=rid,
+                    endpoint=ep,
+                    spec=rec.spec,
+                    knobs=dict(child_knobs),
+                    parent=tenant_id,
+                )
                 placed[rid] = ep
                 created.append(rid)
         except BaseException:
@@ -736,6 +1104,11 @@ class EvalRouter:
                 _replica_id(tenant_id, k) for k in range(replicas)
             ]
             rec.split_next = 0
+        # the split's commit record: from here recovery reconstructs the
+        # fan-out set (the ordinal itself is reconciliation-derived)
+        self._journal_append(
+            "split", tenant=tenant_id, replicas=list(rec.replicas)
+        )
         if _obs._enabled:
             _obs.counter("serve.router.splits", tenant=tenant_id)
             _trace.instant(
@@ -1083,6 +1456,12 @@ class EvalRouter:
             raise ValueError(f"unknown endpoint {endpoint!r}.")
         kw = {} if timeout_s is None else {"timeout_s": timeout_s}
         drained = self._clients[endpoint].drain(**kw)
+        with self._lock:
+            self._drained.add(endpoint)
+        # recorded as intent: unlike a death (probes re-derive those), a
+        # drain must survive recovery — the host answers probes but must
+        # stay out of the alive set
+        self._journal_append("host_drain", endpoint=endpoint)
         with self._cv:
             if endpoint in self._migrating:
                 # a concurrent failure migration beat us to the move;
@@ -1137,6 +1516,7 @@ class EvalRouter:
                     )
                     with self._lock:
                         self._tenants.pop(tenant_id, None)
+                    self._journal_append("remove", tenant=tenant_id)
         if _obs._enabled and victims:
             _trace.instant(
                 "serve.router.migrated",
@@ -1155,6 +1535,11 @@ class EvalRouter:
         if rec is None:
             return  # detached while the migration was queued
         exported = self._clients[from_ep].export_tenant(tenant_id)
+        if _chaos.router_armed():
+            # the drill's nastiest window: the wire state is exported,
+            # the tenant is adopted nowhere — recovery must re-derive
+            # everything from the journal + the hosts
+            _chaos.on_router_op("migrate_exported", tenant_id)
         new_ep = self._place(tenant_id)
         client = self._clients[new_ep]
         knobs = dict(rec.knobs)
@@ -1166,6 +1551,7 @@ class EvalRouter:
         with self._lock:
             rec.endpoint = new_ep
             rec.placed_at = time.monotonic()  # restart the dwell clock
+        self._journal_append("move", tenant=tenant_id, endpoint=new_ep)
         if _obs._enabled:
             _obs.counter("serve.router.migrations", reason=reason)
         _logger.warning(
@@ -1293,6 +1679,8 @@ class EvalRouter:
                     "%s", tenant_id, from_ep, e,
                 )
                 return False
+            if _chaos.router_armed():
+                _chaos.on_router_op("migrate_exported", tenant_id)
             try:
                 src.drop_tenant(tenant_id, checkpoint=False)
             except (ServeError, WireError) as e:
@@ -1326,10 +1714,12 @@ class EvalRouter:
                 )
                 with self._lock:
                     self._tenants.pop(tenant_id, None)
+                self._journal_append("remove", tenant=tenant_id)
                 return False
         with self._lock:
             rec.endpoint = new_ep
             rec.placed_at = time.monotonic()
+        self._journal_append("move", tenant=tenant_id, endpoint=new_ep)
         if _obs._enabled:
             _obs.counter("serve.router.migrations", reason="rebalance")
             _obs.counter("serve.router.rebalances", endpoint=from_ep)
@@ -1400,6 +1790,8 @@ class EvalRouter:
             stale = self._clients.pop(endpoint, None)
             self._clients[endpoint] = client
             self._alive.add(endpoint)
+            self._drained.discard(endpoint)
+        self._journal_append("host_add", endpoint=endpoint)
         if stale is not None:
             stale.close()
         with self._fleet_lock:
@@ -1449,7 +1841,9 @@ class EvalRouter:
             out = {"drained": {}, "migrated": []}
         with self._cv:
             self._alive.discard(endpoint)
+            self._drained.discard(endpoint)
             client = self._clients.pop(endpoint, None)
+        self._journal_append("host_remove", endpoint=endpoint)
         with self._fleet_lock:
             self._fleet.pop(endpoint, None)
         if client is not None:

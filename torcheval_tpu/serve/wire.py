@@ -889,6 +889,13 @@ class EvalServer:
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
         self._handles: Dict[str, Any] = {}
         self._attach_nonces: Dict[str, Any] = {}
+        # attach-time spec + knobs per tenant, served back by the
+        # ``list_tenants`` op (ISSUE 20): a recovering router adopts an
+        # orphan — a tenant live here but absent from its journal — only
+        # if it can reconstruct the tenant's routing entry, and the spec
+        # is not recoverable from the daemon (metrics are already built
+        # objects there)
+        self._tenant_meta: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._conns: set = set()
         self._publishers: list = []
@@ -1280,6 +1287,21 @@ class EvalServer:
             # probe pays. Old peers reject the op as protocol and the
             # client degrades to health()["load_report"].
             return {"load_report": self._daemon.load_report()}, b""
+        if op == "list_tenants":
+            # the recovering router's reconciliation pull (ISSUE 20):
+            # authoritative per-tenant status + seq watermarks from the
+            # daemon, joined with the attach-time spec/knobs this server
+            # recorded so orphans are adoptable. Old peers reject the op
+            # as protocol and the client degrades to health()["tenants"]
+            # (no spec/knobs — orphans on old hosts stay unadopted).
+            tenants = self._daemon.list_tenants()
+            with self._lock:
+                for tid, info in tenants.items():
+                    meta = self._tenant_meta.get(tid)
+                    if meta is not None:
+                        info["spec"] = meta.get("spec")
+                        info["knobs"] = meta.get("knobs")
+            return {"tenants": tenants}, b""
         if op == "snapshot":
             from torcheval_tpu import obs
 
@@ -1293,6 +1315,7 @@ class EvalServer:
                 for tid in drained:
                     self._handles.pop(tid, None)
                     self._attach_nonces.pop(tid, None)
+                    self._tenant_meta.pop(tid, None)
             return {"tenants": drained}, b""
         if op == "attach":
             return self._handle_attach(header)
@@ -1389,6 +1412,7 @@ class EvalServer:
             with self._lock:
                 self._handles.pop(handle.tenant_id, None)
                 self._attach_nonces.pop(handle.tenant_id, None)
+                self._tenant_meta.pop(handle.tenant_id, None)
             return {"checkpoint": path}, b""
         raise AssertionError(op)  # pragma: no cover - gated above
 
@@ -1555,6 +1579,10 @@ class EvalServer:
         with self._lock:
             self._handles[tenant_id] = handle
             self._attach_nonces[tenant_id] = nonce
+            self._tenant_meta[tenant_id] = {
+                "spec": header.get("spec"),
+                "knobs": dict(kwargs),
+            }
         return {"last_seq": handle._tenant.durable_seq, **codec_fields}, b""
 
     def _attach_pending(self, tenant_id: str) -> bool:
